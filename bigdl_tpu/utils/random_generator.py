@@ -1,0 +1,70 @@
+"""Deterministic global RNG — analog of the reference's Torch-compatible ``RandomGenerator``.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/utils/RandomGenerator.scala`` — unverified):
+the reference seeds a global Mersenne-twister RNG used by weight init and dropout.
+
+TPU-native split (SURVEY.md §7.4 "RNG parity"):
+- **Weight initialisation** happens eagerly on host at module construction (Torch semantics),
+  so it uses a numpy ``Generator`` seeded from the global seed — deterministic and
+  reproducible, independent of device count.
+- **Traced randomness** (dropout masks inside ``jit``) must use the JAX counter-based PRNG;
+  ``next_key()`` hands out fresh ``jax.random`` keys derived from the same seed via a
+  monotonically increasing fold-in counter (never reused, safe across replicas when further
+  folded with the shard index).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class RandomGenerator:
+    _lock = threading.Lock()
+    _seed: int = 1
+    _np: np.random.Generator = np.random.default_rng(1)
+    _key_counter: int = 0
+
+    @classmethod
+    def set_seed(cls, seed: int) -> None:
+        with cls._lock:
+            cls._seed = int(seed)
+            cls._np = np.random.default_rng(cls._seed)
+            cls._key_counter = 0
+
+    @classmethod
+    def get_seed(cls) -> int:
+        return cls._seed
+
+    @classmethod
+    def numpy(cls) -> np.random.Generator:
+        """Host RNG for eager weight init."""
+        return cls._np
+
+    # Torch-style sampling helpers used by InitializationMethod ------------
+    @classmethod
+    def uniform(cls, low: float, high: float, shape) -> np.ndarray:
+        with cls._lock:
+            return cls._np.uniform(low, high, size=shape).astype(np.float32)
+
+    @classmethod
+    def normal(cls, mean: float, std: float, shape) -> np.ndarray:
+        with cls._lock:
+            return cls._np.normal(mean, std, size=shape).astype(np.float32)
+
+    @classmethod
+    def bernoulli(cls, p: float, shape) -> np.ndarray:
+        with cls._lock:
+            return (cls._np.random(shape) < p).astype(np.float32)
+
+    # JAX keys for traced randomness ---------------------------------------
+    @classmethod
+    def next_key(cls):
+        """A fresh, never-reused jax PRNG key derived from the global seed."""
+        import jax
+
+        with cls._lock:
+            c = cls._key_counter
+            cls._key_counter += 1
+        return jax.random.fold_in(jax.random.PRNGKey(cls._seed), c)

@@ -1,0 +1,33 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's trick of testing the distributed path with ``local[N]`` Spark masters
+inside one JVM (SURVEY.md §4): we fake an 8-chip topology with
+``--xla_force_host_platform_device_count=8`` so DistriOptimizer/collective tests exercise real
+sharding + collectives without TPU hardware. Must run before jax is imported anywhere.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# jax._src is preloaded at interpreter startup by a site hook in this image, so env vars alone
+# are too late — use the runtime config API as well (backend is not yet initialised here).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine():
+    """Reset Engine + RNG between tests for determinism."""
+    yield
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.random_generator import RandomGenerator
+
+    Engine.reset()
+    RandomGenerator.set_seed(1)

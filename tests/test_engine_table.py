@@ -1,0 +1,71 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import Engine, T, Table
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+class TestEngine:
+    def test_init_builds_data_mesh(self):
+        Engine.init()
+        mesh = Engine.mesh()
+        assert mesh.axis_names == (Engine.DATA_AXIS,)
+        assert mesh.devices.size == 8  # conftest forces 8 CPU devices
+
+    def test_custom_mesh_axes(self):
+        Engine.init(mesh_shape=(4, 2), mesh_axes=("data", "model"))
+        assert Engine.mesh().axis_names == ("data", "model")
+        assert dict(Engine.mesh().shape) == {"data": 4, "model": 2}
+
+    def test_seed_flows_to_rng(self):
+        Engine.init(seed=42)
+        a = RandomGenerator.uniform(0, 1, (3,))
+        RandomGenerator.set_seed(42)
+        b = RandomGenerator.uniform(0, 1, (3,))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestTable:
+    def test_builder_and_access(self):
+        t = T(jnp.ones(2), jnp.zeros(3))
+        assert len(t) == 2
+        assert t[1].shape == (2,)
+        assert t[2].shape == (3,)
+
+    def test_is_pytree(self):
+        t = T(jnp.ones(2), T(jnp.zeros(3), jnp.ones(1)))
+        leaves = jax.tree_util.tree_leaves(t)
+        assert len(leaves) == 3
+        doubled = jax.tree_util.tree_map(lambda x: x * 2, t)
+        assert isinstance(doubled, Table)
+        np.testing.assert_array_equal(np.asarray(doubled[1]), 2 * np.ones(2))
+
+    def test_traces_through_jit(self):
+        @jax.jit
+        def f(t):
+            return T(t[1] + t[2], t[1] * t[2])
+
+        out = f(T(jnp.full(3, 2.0), jnp.full(3, 3.0)))
+        np.testing.assert_allclose(np.asarray(out[1]), 5.0)
+        np.testing.assert_allclose(np.asarray(out[2]), 6.0)
+
+    def test_insert_and_equality(self):
+        t = T()
+        t.insert(jnp.ones(1)).insert(jnp.zeros(1))
+        assert t.keys() == [1, 2]
+        assert t == T(jnp.ones(1), jnp.zeros(1))
+
+
+class TestRandomGenerator:
+    def test_next_key_never_repeats(self):
+        RandomGenerator.set_seed(7)
+        k1, k2 = RandomGenerator.next_key(), RandomGenerator.next_key()
+        assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+    def test_keys_reproducible_after_reseed(self):
+        RandomGenerator.set_seed(7)
+        k1 = RandomGenerator.next_key()
+        RandomGenerator.set_seed(7)
+        k2 = RandomGenerator.next_key()
+        assert np.array_equal(np.asarray(k1), np.asarray(k2))

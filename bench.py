@@ -1,0 +1,83 @@
+"""Benchmark harness. Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``
+
+Primary metric (BASELINE.md): ResNet-50 ImageNet images/sec/chip. Until the ResNet-50
+model lands, benches the best available flagship (LeNet training throughput). The
+reference's published number is unavailable (BASELINE.json.published empty, mount empty),
+so ``vs_baseline`` is null until a citable reference value exists.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_train_throughput(model_name: str = "lenet", batch: int = 256,
+                           iters: int = 30, warmup: int = 5):
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.utils.engine import Engine
+
+    if not Engine.is_initialized():
+        Engine.init()
+
+    if model_name == "lenet":
+        from bigdl_tpu.models.lenet import LeNet5
+        model = LeNet5(10)
+        x = np.random.default_rng(0).normal(size=(batch, 1, 28, 28)).astype(np.float32)
+        y = np.random.default_rng(1).integers(0, 10, size=(batch,)).astype(np.int32)
+    else:
+        raise ValueError(f"unknown model {model_name}")
+
+    criterion = nn.ClassNLLCriterion()
+    method = SGD(learningrate=0.01, momentum=0.9, dampening=0.0)
+    params, mstate = model.get_params(), model.get_state()
+    ostate = method.init_state(params)
+
+    def step(params, mstate, ostate, step_idx, inp, target):
+        def loss_fn(p):
+            out, new_ms = model.apply(p, mstate, inp, training=True, rng=None)
+            return criterion.apply(out, target), new_ms
+        (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_p, new_os = method.update(params, grads, ostate, step_idx)
+        return new_p, new_ms, new_os, loss
+
+    jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
+    inp, target = jax.device_put(x), jax.device_put(y)
+
+    for i in range(warmup):
+        params, mstate, ostate, loss = jit_step(
+            params, mstate, ostate, jnp.asarray(i, jnp.int32), inp, target)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, mstate, ostate, loss = jit_step(
+            params, mstate, ostate, jnp.asarray(i, jnp.int32), inp, target)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="lenet")
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--iters", type=int, default=30)
+    args = p.parse_args()
+
+    imgs_per_sec = bench_train_throughput(args.model, args.batch, args.iters)
+    print(json.dumps({
+        "metric": f"{args.model}_train_images_per_sec_per_chip",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": None,
+    }))

@@ -1,0 +1,8 @@
+from bigdl_tpu.dataset.dataset import (
+    AbstractDataSet, DataSet, DistributedDataSet, LocalDataSet, TransformedDataSet,
+    is_distributed,
+)
+from bigdl_tpu.dataset.sample import MiniBatch, Sample, SampleToMiniBatch
+from bigdl_tpu.dataset.transformer import (
+    ChainedTransformer, Identity, MapTransformer, Transformer,
+)

@@ -1,0 +1,32 @@
+"""Torch-style layer library (flat namespace, mirroring the reference's ``<dl>/nn/``)."""
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, Container, TensorModule
+from bigdl_tpu.nn.activation import (
+    Abs, AddConstant, Clamp, ELU, Exp, GELU, HardSigmoid, HardTanh, LeakyReLU, Log,
+    LogSoftMax, MulConstant, Power, PReLU, ReLU, ReLU6, Sigmoid, SoftMax, SoftMin,
+    SoftPlus, SoftSign, Sqrt, Square, Swish, Tanh,
+)
+from bigdl_tpu.nn.containers import (
+    CAddTable, CMulTable, Concat, ConcatTable, Echo, FlattenTable, Identity, JoinTable,
+    MapTable, ParallelTable, SelectTable, Sequential,
+)
+from bigdl_tpu.nn.convolution import (
+    SpatialConvolution, SpatialDilatedConvolution, SpatialFullConvolution,
+)
+from bigdl_tpu.nn.criterion import (
+    AbsCriterion, AbstractCriterion, BCECriterion, BCECriterionWithLogits,
+    ClassNLLCriterion, CosineEmbeddingCriterion, CrossEntropyCriterion,
+    DistKLDivCriterion, HingeEmbeddingCriterion, L1Cost, MarginCriterion, MSECriterion,
+    MultiCriterion, MultiLabelSoftMarginCriterion, ParallelCriterion, SmoothL1Criterion,
+    TimeDistributedCriterion,
+)
+from bigdl_tpu.nn.initialization import (
+    BilinearFiller, ConstInitMethod, InitializationMethod, MsraFiller, Ones,
+    RandomNormal, RandomUniform, Xavier, Zeros,
+)
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.pooling import SpatialAveragePooling, SpatialMaxPooling
+from bigdl_tpu.nn.shape_ops import (
+    Contiguous, Flatten, Narrow, Padding, Replicate, Reshape, Select, SpatialZeroPadding,
+    SplitTable, Squeeze, Transpose, Unsqueeze, View,
+)

@@ -1,0 +1,194 @@
+"""Composition containers.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/Sequential.scala``, ``Concat.scala``,
+``ConcatTable.scala``, ``ParallelTable.scala``, ``CAddTable.scala``, ``JoinTable.scala`` —
+unverified). TPU-native: containers compose the children's pure ``apply`` functions; the
+whole composite stays one traced program under ``jit`` (XLA fuses across layer boundaries —
+the reference needed explicit mkldnn fusion passes for that, SURVEY.md §2.1 "Fusion").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, Container, split_rng
+from bigdl_tpu.utils.table import Table, T
+
+
+class Sequential(Container):
+    """Chain children; output of child i feeds child i+1."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        new_state = {}
+        rngs = split_rng(rng, len(self.modules))
+        for (name, m), r in zip(self.named_children(), rngs):
+            x, s = m.apply(params[name], state[name], x, training=training, rng=r)
+            new_state[name] = s
+        return x, new_state
+
+    def __repr__(self):
+        inner = "\n".join(f"  ({i}): {m!r}" for i, m in enumerate(self.modules))
+        return f"Sequential(\n{inner}\n)"
+
+
+class Concat(Container):
+    """Apply each child to the same input; concatenate outputs along ``dimension``.
+
+    The workhorse of Inception's branch blocks. ``dimension`` is 1-based counting the batch
+    dim first (reference convention): default 2 = channel axis of NCHW.
+    """
+
+    def __init__(self, dimension: int = 2):
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs, new_state = [], {}
+        rngs = split_rng(rng, len(self.modules))
+        for (name, m), r in zip(self.named_children(), rngs):
+            o, s = m.apply(params[name], state[name], input, training=training, rng=r)
+            outs.append(o)
+            new_state[name] = s
+        return jnp.concatenate(outs, axis=self.dimension - 1), new_state
+
+    def __repr__(self):
+        inner = " | ".join(repr(m) for m in self.modules)
+        return f"Concat(dim={self.dimension})[{inner}]"
+
+
+class ConcatTable(Container):
+    """Apply each child to the same input; output a Table of the results."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs, new_state = [], {}
+        rngs = split_rng(rng, len(self.modules))
+        for (name, m), r in zip(self.named_children(), rngs):
+            o, s = m.apply(params[name], state[name], input, training=training, rng=r)
+            outs.append(o)
+            new_state[name] = s
+        return T(*outs), new_state
+
+
+class ParallelTable(Container):
+    """Child i consumes input Table element i; outputs a Table."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        outs, new_state = [], {}
+        rngs = split_rng(rng, len(self.modules))
+        for (name, m), x, r in zip(self.named_children(), xs, rngs):
+            o, s = m.apply(params[name], state[name], x, training=training, rng=r)
+            outs.append(o)
+            new_state[name] = s
+        return T(*outs), new_state
+
+
+class CAddTable(AbstractModule):
+    """Element-wise sum of a Table of tensors (ResNet shortcut join)."""
+
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out, state
+
+
+class CMulTable(AbstractModule):
+    """Element-wise product of a Table of tensors."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        out = xs[0]
+        for x in xs[1:]:
+            out = out * x
+        return out, state
+
+
+class JoinTable(AbstractModule):
+    """Concatenate a Table of tensors along ``dimension`` (1-based; n_input_dims lets
+    batched input shift the axis, reference semantics)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        axis = self.dimension - 1
+        if self.n_input_dims > 0 and xs[0].ndim == self.n_input_dims + 1:
+            axis += 1  # leading batch dim present
+        return jnp.concatenate(xs, axis=axis), state
+
+
+class SelectTable(AbstractModule):
+    """Pick element ``index`` (1-based) from the input Table."""
+
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        i = self.index - 1 if self.index > 0 else self.index
+        return xs[i], state
+
+
+class FlattenTable(AbstractModule):
+    """Flatten nested Tables into one flat Table."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        flat = []
+
+        def rec(x):
+            if isinstance(x, Table):
+                for v in x.values():
+                    rec(v)
+            elif isinstance(x, (list, tuple)):
+                for v in x:
+                    rec(v)
+            else:
+                flat.append(x)
+
+        rec(input)
+        return T(*flat), state
+
+
+class Identity(AbstractModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input, state
+
+
+class Echo(AbstractModule):
+    """Debug layer: prints shape at trace time, passes input through."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        shape = jax.tree_util.tree_map(lambda x: x.shape, input)
+        print(f"[Echo {self.name}] {shape}")
+        return input, state
+
+
+class MapTable(Container):
+    """Apply ONE shared child to every element of the input Table (shared params)."""
+
+    def __init__(self, module: Optional[AbstractModule] = None):
+        super().__init__(*( [module] if module is not None else [] ))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        m = self.modules[0]
+        outs = []
+        s = state["0"]
+        rngs = split_rng(rng, len(xs))
+        for x, r in zip(xs, rngs):
+            o, s = m.apply(params["0"], s, x, training=training, rng=r)
+            outs.append(o)
+        return T(*outs), {"0": s}

@@ -1,0 +1,193 @@
+"""Spatial convolution layers.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/SpatialConvolution.scala`` —
+unverified): NCHW activations, OIHW weights (with groups: (nGroup, out/g, in/g, kH, kW)
+upstream; here flat OIHW + ``feature_group_count``), stride (dW, dH), padding (padW, padH)
+with ``-1`` meaning TensorFlow-style SAME. Default init Xavier-like U(-1/sqrt(fanIn), +).
+
+TPU-native: ``lax.conv_general_dilated`` — XLA tiles it onto the MXU directly; the
+reference's im2col+gemm with per-thread workspaces (BLAS path) and its mkldnn layout
+reorders are both deleted as concepts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform
+
+
+def _conv_padding(pad_w: int, pad_h: int):
+    """Map reference pad ints to lax padding. -1 → SAME (reference convention)."""
+    if pad_w == -1 or pad_h == -1:
+        return "SAME"
+    return [(pad_h, pad_h), (pad_w, pad_w)]
+
+
+class SpatialConvolution(TensorModule):
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 n_group: int = 1, propagate_back: bool = True,
+                 with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_group = n_group
+        self.propagate_back = propagate_back
+        self.with_bias = with_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.reset()
+
+    def reset(self) -> None:
+        fan_in = (self.n_input_plane // self.n_group) * self.kernel_h * self.kernel_w
+        fan_out = (self.n_output_plane // self.n_group) * self.kernel_h * self.kernel_w
+        w = self.w_init.init(
+            (self.n_output_plane, self.n_input_plane // self.n_group,
+             self.kernel_h, self.kernel_w),
+            fan_in=fan_in, fan_out=fan_out)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            b = self.b_init.init((self.n_output_plane,), fan_in=fan_in, fan_out=fan_out)
+            self._params["bias"] = jnp.asarray(b)
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        out = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.stride_h, self.stride_w),
+            padding=_conv_padding(self.pad_w, self.pad_h),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group,
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None]
+        if squeeze:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return (f"SpatialConvolution({self.n_input_plane} -> {self.n_output_plane}, "
+                f"{self.kernel_w}x{self.kernel_h}, {self.stride_w},{self.stride_h}, "
+                f"{self.pad_w},{self.pad_h})")
+
+
+class SpatialConvolutionMap(SpatialConvolution):
+    """Simplified stand-in: full-connection table conv (reference has sparse maps)."""
+
+
+class SpatialDilatedConvolution(TensorModule):
+    """Atrous convolution (reference ``<dl>/nn/SpatialDilatedConvolution.scala``)."""
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh, dw=1, dh=1,
+                 pad_w=0, pad_h=0, dilation_w=1, dilation_h=1,
+                 w_init=None, b_init=None, with_bias: bool = True):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.dilation_w, self.dilation_h = dilation_w, dilation_h
+        self.with_bias = with_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self):
+        fan_in = self.n_input_plane * self.kh * self.kw
+        fan_out = self.n_output_plane * self.kh * self.kw
+        w = self.w_init.init((self.n_output_plane, self.n_input_plane, self.kh, self.kw),
+                             fan_in=fan_in, fan_out=fan_out)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(
+                self.b_init.init((self.n_output_plane,), fan_in=fan_in, fan_out=fan_out))
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        out = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.dh, self.dw),
+            padding=[(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+            rhs_dilation=(self.dilation_h, self.dilation_w),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None]
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class SpatialFullConvolution(TensorModule):
+    """Transposed convolution (deconvolution), reference ``SpatialFullConvolution``."""
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh, dw=1, dh=1,
+                 pad_w=0, pad_h=0, adj_w=0, adj_h=0, n_group=1,
+                 no_bias: bool = False, w_init=None, b_init=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h, self.adj_w, self.adj_h = pad_w, pad_h, adj_w, adj_h
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self):
+        fan_in = self.n_input_plane * self.kh * self.kw
+        fan_out = self.n_output_plane * self.kh * self.kw
+        # Torch layout for full conv: (nIn, nOut/g, kH, kW); keep IOHW and tell lax.
+        w = self.w_init.init(
+            (self.n_input_plane, self.n_output_plane // self.n_group, self.kh, self.kw),
+            fan_in=fan_in, fan_out=fan_out)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(
+                self.b_init.init((self.n_output_plane,), fan_in=fan_in, fan_out=fan_out))
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        kh, kw = self.kh, self.kw
+        pad = [(kh - 1 - self.pad_h, kh - 1 - self.pad_h + self.adj_h),
+               (kw - 1 - self.pad_w, kw - 1 - self.pad_w + self.adj_w)]
+        out = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(1, 1),
+            padding=pad,
+            lhs_dilation=(self.dh, self.dw),
+            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+            feature_group_count=self.n_group,
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None]
+        if squeeze:
+            out = out[0]
+        return out, state

@@ -1,0 +1,290 @@
+"""Loss criterions.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/ClassNLLCriterion.scala`` etc. —
+unverified): ~30 Torch-style criterions with ``forward(input, target)`` /
+``backward(input, target)``, ``sizeAverage`` semantics.
+
+TPU-native: each criterion is a pure function ``apply(input, target) -> scalar``; the
+trainer differentiates through it together with the model (one fused XLA program).
+``backward`` on the facade uses ``jax.grad`` for API parity.
+
+Label convention: targets are **0-based** class indices by default (numpy/torch-native);
+pass ``one_based=True`` for the reference's Torch 1-based labels.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.utils.table import Table
+
+
+class AbstractCriterion:
+    def __init__(self) -> None:
+        self.output = None
+        self.grad_input = None
+        self._cache: dict = {}
+
+    # functional core ------------------------------------------------------
+    def apply(self, input, target):
+        """Pure loss. Returns a scalar."""
+        raise NotImplementedError
+
+    # facade ---------------------------------------------------------------
+    def forward(self, input, target):
+        if "fwd" not in self._cache:
+            self._cache["fwd"] = jax.jit(self.apply)
+        self.output = self._cache["fwd"](input, target)
+        return self.output
+
+    def backward(self, input, target):
+        if "bwd" not in self._cache:
+            self._cache["bwd"] = jax.jit(jax.grad(lambda i, t: self.apply(i, t)))
+        self.grad_input = self._cache["bwd"](input, target)
+        return self.grad_input
+
+    def __call__(self, input, target):
+        return self.forward(input, target)
+
+    def __repr__(self):
+        return type(self).__name__
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_cache"] = {}
+        return d
+
+
+def _reduce(loss, size_average: bool):
+    return jnp.mean(loss) if size_average else jnp.sum(loss)
+
+
+def _class_index(target, one_based: bool):
+    t = target.astype(jnp.int32)
+    return t - 1 if one_based else t
+
+
+class ClassNLLCriterion(AbstractCriterion):
+    """Negative log-likelihood over log-probabilities (pairs with LogSoftMax)."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 logprob_as_input: bool = True, one_based: bool = False):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+        self.logprob_as_input = logprob_as_input
+        self.one_based = one_based
+
+    def apply(self, input, target):
+        logp = input if self.logprob_as_input else jnp.log(jnp.clip(input, 1e-8))
+        if logp.ndim == 1:
+            logp = logp[None]
+            target = jnp.reshape(target, (1,))
+        idx = _class_index(jnp.reshape(target, (-1,)), self.one_based)
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, idx)
+            loss = -(picked * w)
+            return jnp.sum(loss) / jnp.sum(w) if self.size_average else jnp.sum(loss)
+        return _reduce(-picked, self.size_average)
+
+
+class CrossEntropyCriterion(AbstractCriterion):
+    """LogSoftMax + ClassNLL fused (input = raw logits)."""
+
+    def __init__(self, weights=None, size_average: bool = True, one_based: bool = False):
+        super().__init__()
+        self.inner = ClassNLLCriterion(weights, size_average, one_based=one_based)
+
+    def apply(self, input, target):
+        return self.inner.apply(jax.nn.log_softmax(input, axis=-1), target)
+
+
+class MSECriterion(AbstractCriterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        return _reduce(jnp.square(input - target), self.size_average)
+
+
+class AbsCriterion(AbstractCriterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        return _reduce(jnp.abs(input - target), self.size_average)
+
+
+class BCECriterion(AbstractCriterion):
+    """Binary cross-entropy over probabilities (pairs with Sigmoid)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        eps = 1e-12
+        p = jnp.clip(input, eps, 1.0 - eps)
+        loss = -(target * jnp.log(p) + (1.0 - target) * jnp.log1p(-p))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(loss, self.size_average)
+
+
+class BCECriterionWithLogits(AbstractCriterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        loss = jnp.maximum(input, 0) - input * target + jnp.log1p(jnp.exp(-jnp.abs(input)))
+        return _reduce(loss, self.size_average)
+
+
+class SmoothL1Criterion(AbstractCriterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        d = jnp.abs(input - target)
+        loss = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(loss, self.size_average)
+
+
+class MarginCriterion(AbstractCriterion):
+    """Hinge loss; target ∈ {-1, 1}."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        super().__init__()
+        self.margin, self.size_average, self.squared = margin, size_average, squared
+
+    def apply(self, input, target):
+        loss = jnp.maximum(0.0, self.margin - input * target)
+        if self.squared:
+            loss = jnp.square(loss)
+        return _reduce(loss, self.size_average)
+
+
+class HingeEmbeddingCriterion(AbstractCriterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin, self.size_average = margin, size_average
+
+    def apply(self, input, target):
+        loss = jnp.where(target > 0, input, jnp.maximum(0.0, self.margin - input))
+        return _reduce(loss, self.size_average)
+
+
+class DistKLDivCriterion(AbstractCriterion):
+    """KL(target ‖ input) where input is log-prob, target is prob."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        loss = jnp.where(target > 0, target * (jnp.log(jnp.clip(target, 1e-12)) - input), 0.0)
+        return _reduce(loss, self.size_average)
+
+
+class MultiLabelSoftMarginCriterion(AbstractCriterion):
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        loss = jnp.maximum(input, 0) - input * target + jnp.log1p(jnp.exp(-jnp.abs(input)))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(loss, self.size_average)
+
+
+class CosineEmbeddingCriterion(AbstractCriterion):
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__()
+        self.margin, self.size_average = margin, size_average
+
+    def apply(self, input, target):
+        x1, x2 = (input[1], input[2]) if isinstance(input, Table) else (input[0], input[1])
+        cos = jnp.sum(x1 * x2, -1) / jnp.clip(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+        t = jnp.reshape(target, cos.shape)
+        loss = jnp.where(t > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return _reduce(loss, self.size_average)
+
+
+class ParallelCriterion(AbstractCriterion):
+    """Weighted sum of criterions over (Table input, Table target) pairs."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__()
+        self.criterions: list[tuple[AbstractCriterion, float]] = []
+        self.repeat_target = repeat_target
+
+    def add(self, criterion: AbstractCriterion, weight: float = 1.0) -> "ParallelCriterion":
+        self.criterions.append((criterion, weight))
+        return self
+
+    def apply(self, input, target):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        if self.repeat_target:
+            ts = [target] * len(xs)
+        else:
+            ts = target.values() if isinstance(target, Table) else list(target)
+        total = 0.0
+        for (crit, w), x, t in zip(self.criterions, xs, ts):
+            total = total + w * crit.apply(x, t)
+        return total
+
+
+class TimeDistributedCriterion(AbstractCriterion):
+    """Apply an inner criterion at every timestep of (N, T, ...) input."""
+
+    def __init__(self, criterion: AbstractCriterion, size_average: bool = False,
+                 dimension: int = 2):
+        super().__init__()
+        self.criterion = criterion
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        t_steps = input.shape[1]
+        flat_in = input.reshape((-1,) + input.shape[2:])
+        flat_t = target.reshape((-1,) + target.shape[2:])
+        loss = self.criterion.apply(flat_in, flat_t)
+        if not self.size_average:
+            return loss
+        return loss / t_steps
+
+
+class MultiCriterion(AbstractCriterion):
+    """Weighted sum of criterions applied to the SAME (input, target)."""
+
+    def __init__(self):
+        super().__init__()
+        self.criterions: list[tuple[AbstractCriterion, float]] = []
+
+    def add(self, criterion: AbstractCriterion, weight: float = 1.0) -> "MultiCriterion":
+        self.criterions.append((criterion, weight))
+        return self
+
+    def apply(self, input, target):
+        total = 0.0
+        for crit, w in self.criterions:
+            total = total + w * crit.apply(input, target)
+        return total
+
+
+class L1Cost(AbstractCriterion):
+    def apply(self, input, target):
+        return jnp.sum(jnp.abs(input))

@@ -1,0 +1,133 @@
+"""Spatial pooling layers.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/SpatialMaxPooling.scala``,
+``SpatialAveragePooling.scala`` — unverified): NCHW, kernel (kW,kH), stride (dW,dH),
+pad (padW,padH), floor mode by default with a ``.ceil()`` toggle.
+
+TPU-native: ``lax.reduce_window`` — XLA maps it onto the VPU; the extra high-side padding
+needed for ceil mode is computed statically so shapes stay static under ``jit``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+
+
+def _out_size(in_size: int, k: int, s: int, p: int, ceil_mode: bool) -> int:
+    if ceil_mode:
+        out = int(math.ceil((in_size + 2 * p - k) / s)) + 1
+    else:
+        out = int(math.floor((in_size + 2 * p - k) / s)) + 1
+    if p > 0 and (out - 1) * s >= in_size + p:
+        out -= 1  # last window must start inside the (low-padded) input — Torch rule
+    return out
+
+
+def _pad_amounts(in_size: int, k: int, s: int, p: int, ceil_mode: bool):
+    out = _out_size(in_size, k, s, p, ceil_mode)
+    needed = (out - 1) * s + k - in_size - p
+    return p, max(needed, 0), out
+
+
+class SpatialMaxPooling(TensorModule):
+    def __init__(self, kw: int, kh: int, dw: int | None = None, dh: int | None = None,
+                 pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = ceil_mode
+
+    def ceil(self) -> "SpatialMaxPooling":
+        self.ceil_mode = True
+        return self
+
+    def floor(self) -> "SpatialMaxPooling":
+        self.ceil_mode = False
+        return self
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        h, w = x.shape[2], x.shape[3]
+        ph_lo, ph_hi, _ = _pad_amounts(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
+        pw_lo, pw_hi, _ = _pad_amounts(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
+        out = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, 1, self.kh, self.kw),
+            window_strides=(1, 1, self.dh, self.dw),
+            padding=((0, 0), (0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi)),
+        )
+        if squeeze:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return (f"SpatialMaxPooling({self.kw}x{self.kh}, {self.dw},{self.dh}, "
+                f"{self.pad_w},{self.pad_h}{', ceil' if self.ceil_mode else ''})")
+
+
+class SpatialAveragePooling(TensorModule):
+    def __init__(self, kw: int, kh: int, dw: int | None = None, dh: int | None = None,
+                 pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,
+                 count_include_pad: bool = True, divide: bool = True,
+                 global_pooling: bool = False):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+        self.global_pooling = global_pooling
+
+    def ceil(self) -> "SpatialAveragePooling":
+        self.ceil_mode = True
+        return self
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        h, w = x.shape[2], x.shape[3]
+        kh, kw = (h, w) if self.global_pooling else (self.kh, self.kw)
+        dh, dw = (1, 1) if self.global_pooling else (self.dh, self.dw)
+        ph_lo, ph_hi, _ = _pad_amounts(h, kh, dh, self.pad_h, self.ceil_mode)
+        pw_lo, pw_hi, _ = _pad_amounts(w, kw, dw, self.pad_w, self.ceil_mode)
+        pad = ((0, 0), (0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi))
+        sums = lax.reduce_window(
+            x, 0.0, lax.add,
+            window_dimensions=(1, 1, kh, kw),
+            window_strides=(1, 1, dh, dw),
+            padding=pad,
+        )
+        if not self.divide:
+            out = sums
+        elif self.count_include_pad and (self.pad_h > 0 or self.pad_w > 0):
+            out = sums / float(kh * kw)
+        else:
+            ones = jnp.ones((1, 1, h, w), x.dtype)
+            counts = lax.reduce_window(
+                ones, 0.0, lax.add,
+                window_dimensions=(1, 1, kh, kw),
+                window_strides=(1, 1, dh, dw),
+                padding=pad,
+            )
+            out = sums / jnp.maximum(counts, 1.0)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return f"SpatialAveragePooling({self.kw}x{self.kh}, {self.dw},{self.dh})"

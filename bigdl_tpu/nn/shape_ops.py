@@ -1,0 +1,178 @@
+"""Shape-manipulation layers.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/Reshape.scala``, ``View.scala``,
+``Squeeze.scala``, ``Unsqueeze.scala``, ``Transpose.scala``, ``Padding.scala``,
+``Narrow.scala``, ``Select.scala``, ``SplitTable.scala``, ``Contiguous.scala`` — unverified).
+All are metadata-only ops under XLA (free at runtime when fused).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, TensorModule
+from bigdl_tpu.utils.table import T, Table
+
+
+class Reshape(TensorModule):
+    """Reshape non-batch dims to ``size``; ``batch_mode=None`` auto-detects a batch dim
+    (reference heuristic: ndim == len(size)+1 → batched)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool | None = None):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        batched = self.batch_mode
+        if batched is None:
+            import numpy as np
+            batched = (input.ndim == len(self.size) + 1 or
+                       int(np.prod(input.shape)) != int(np.prod(self.size)))
+        if batched:
+            return input.reshape((input.shape[0],) + self.size), state
+        return input.reshape(self.size), state
+
+    def __repr__(self):
+        return f"Reshape({'x'.join(map(str, self.size))})"
+
+
+class View(Reshape):
+    """Alias of Reshape with batch handling (reference ``View`` with num_input_dims)."""
+
+
+class Flatten(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input.reshape(input.shape[0], -1), state
+
+
+class Squeeze(TensorModule):
+    def __init__(self, dim: int | None = None, num_input_dims: int | None = None):
+        super().__init__()
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if self.dim is None:
+            return jnp.squeeze(input), state
+        return jnp.squeeze(input, axis=self.dim - 1), state
+
+
+class Unsqueeze(TensorModule):
+    def __init__(self, pos: int, num_input_dims: int | None = None):
+        super().__init__()
+        self.pos = pos
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.expand_dims(input, axis=self.pos - 1), state
+
+
+class Transpose(TensorModule):
+    """Swap listed (1-based) dim pairs in order (reference semantics)."""
+
+    def __init__(self, permutations: Sequence[tuple[int, int]]):
+        super().__init__()
+        self.permutations = [(a - 1, b - 1) for a, b in permutations]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        perm = list(range(input.ndim))
+        for a, b in self.permutations:
+            perm[a], perm[b] = perm[b], perm[a]
+        return jnp.transpose(input, perm), state
+
+
+class Select(TensorModule):
+    """Select index ``index`` (1-based; negative from end) along dim (1-based)."""
+
+    def __init__(self, dim: int, index: int):
+        super().__init__()
+        self.dim, self.index = dim, index
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
+        idx = self.index - 1 if self.index > 0 else input.shape[axis] + self.index
+        return jnp.take(input, idx, axis=axis), state
+
+
+class Narrow(TensorModule):
+    """Slice ``length`` elements starting at ``offset`` (1-based) along dim."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
+        start = self.offset - 1
+        length = self.length
+        if length < 0:
+            length = input.shape[axis] - start + length + 1
+        return jnp.take(input, jnp.arange(start, start + length), axis=axis), state
+
+
+class SplitTable(AbstractModule):
+    """Split a tensor along dim (1-based) into a Table of slices."""
+
+    def __init__(self, dim: int, num_input_dims: int = -1):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
+        if self.num_input_dims > 0 and input.ndim == self.num_input_dims + 1:
+            axis += 1
+        parts = [jnp.squeeze(p, axis=axis)
+                 for p in jnp.split(input, input.shape[axis], axis=axis)]
+        return T(*parts), state
+
+
+class Padding(TensorModule):
+    """Pad ``pad`` entries (negative → before, positive → after) along dim with value."""
+
+    def __init__(self, dim: int, pad: int, num_input_dims: int = 0,
+                 value: float = 0.0, n_index: int = 1):
+        super().__init__()
+        self.dim, self.pad, self.value = dim, pad, value
+        self.num_input_dims = num_input_dims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1
+        if self.num_input_dims > 0 and input.ndim == self.num_input_dims + 1:
+            axis += 1
+        widths = [(0, 0)] * input.ndim
+        widths[axis] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(input, widths, constant_values=self.value), state
+
+
+class SpatialZeroPadding(TensorModule):
+    def __init__(self, pad_left: int, pad_right: int, pad_top: int, pad_bottom: int):
+        super().__init__()
+        self.pads = (pad_left, pad_right, pad_top, pad_bottom)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        l, r, t, b = self.pads
+        widths = [(0, 0)] * (input.ndim - 2) + [(t, b), (l, r)]
+        return jnp.pad(input, widths), state
+
+
+class Contiguous(TensorModule):
+    """No-op under XLA (arrays are always logically contiguous)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input, state
+
+
+class Replicate(TensorModule):
+    """Replicate input ``n_features`` times along a new dim (1-based)."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_input_dims: int = -1):
+        super().__init__()
+        self.n_features, self.dim, self.n_input_dims = n_features, dim, n_input_dims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1
+        if self.n_input_dims > 0 and input.ndim == self.n_input_dims + 1:
+            axis += 1
+        return jnp.repeat(jnp.expand_dims(input, axis), self.n_features, axis=axis), state

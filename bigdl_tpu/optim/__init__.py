@@ -1,0 +1,7 @@
+from bigdl_tpu.optim.optim_method import Adam, OptimMethod, SGD
+from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import (
+    AccuracyResult, Loss, LossResult, MAE, Top1Accuracy, Top5Accuracy, TopKAccuracy,
+    ValidationMethod, ValidationResult,
+)

@@ -1,0 +1,132 @@
+"""Optimization methods (pure, jit-compatible).
+
+Reference parity (SURVEY.md §2.3, expected ``<dl>/optim/SGD.scala`` etc. — unverified):
+``OptimMethod`` subclasses hold hyper-parameters and per-weight slots; SGD carries the
+learning-rate schedule family (Default/Step/Poly/…, see ``schedules.py``).
+
+TPU-native: an OptimMethod is a **pure transform**: ``init_state(params)`` builds the slot
+pytree, ``update(params, grads, state, step)`` returns the new params+slots. The trainer
+fuses it into the jitted train step, so on a mesh the sharded (ZeRO-1) update falls out of
+sharding the pytrees — matching the reference's slice-owned ``AllReduceParameter`` update.
+``step`` is a traced scalar so schedules don't retrigger compilation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_map(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class OptimMethod:
+    def init_state(self, params) -> dict:
+        return {}
+
+    def update(self, params, grads, state: dict, step):
+        """Return (new_params, new_state). ``step`` is a 0-based traced int scalar."""
+        raise NotImplementedError
+
+    def get_learning_rate(self, step: int) -> float:
+        return 0.0
+
+    def __repr__(self):
+        return type(self).__name__
+
+    # Reference-parity convenience: stateful single-tensor optimize ---------
+    def optimize(self, feval: Callable, weight):
+        """Torch-style: feval(w) -> (loss, grad); mutates internal state. Parity shim."""
+        if not hasattr(self, "_shim_state"):
+            self._shim_state = self.init_state(weight)
+            self._shim_step = 0
+        loss, grad = feval(weight)
+        new_w, self._shim_state = self.update(weight, grad, self._shim_state,
+                                              jnp.asarray(self._shim_step))
+        self._shim_step += 1
+        return new_w, (loss,)
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/dampening/nesterov/weight-decay + LR schedules.
+
+    Default schedule matches the reference's ``SGD.Default``:
+    ``clr = lr / (1 + step * learningrate_decay)``.
+    """
+
+    def __init__(self, learningrate: float = 1e-3, learningrate_decay: float = 0.0,
+                 weightdecay: float = 0.0, momentum: float = 0.0,
+                 dampening: Optional[float] = None, nesterov: bool = False,
+                 learningrate_schedule=None):
+        self.learningrate = learningrate
+        self.learningrate_decay = learningrate_decay
+        self.weightdecay = weightdecay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        self.learningrate_schedule = learningrate_schedule
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            raise ValueError("nesterov requires momentum > 0 and dampening = 0")
+
+    def _lr(self, step):
+        if self.learningrate_schedule is not None:
+            return self.learningrate_schedule(self.learningrate, step)
+        return self.learningrate / (1.0 + step * self.learningrate_decay)
+
+    def get_learning_rate(self, step):
+        import numpy as np
+        return float(jax.device_get(self._lr(jnp.asarray(step, jnp.float32))))
+
+    def init_state(self, params) -> dict:
+        if self.momentum > 0:
+            return {"v": tree_map(jnp.zeros_like, params)}
+        return {}
+
+    def update(self, params, grads, state, step):
+        lr = self._lr(step.astype(jnp.float32))
+        wd, mu, damp = self.weightdecay, self.momentum, self.dampening
+
+        if wd > 0:
+            grads = tree_map(lambda g, p: g + wd * p, grads, params)
+        new_state = {}
+        if mu > 0:
+            v = tree_map(lambda v, g: mu * v + (1.0 - damp) * g, state["v"], grads)
+            new_state["v"] = v
+            if self.nesterov:
+                grads = tree_map(lambda g, v: g + mu * v, grads, v)
+            else:
+                grads = v
+        new_params = tree_map(lambda p, g: p - lr * g, params, grads)
+        return new_params, new_state
+
+
+class Adam(OptimMethod):
+    """Adam (reference ``<dl>/optim/Adam.scala`` — unverified)."""
+
+    def __init__(self, learningrate: float = 1e-3, learningrate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8):
+        self.learningrate = learningrate
+        self.learningrate_decay = learningrate_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        return {"m": tree_map(jnp.zeros_like, params),
+                "v": tree_map(jnp.zeros_like, params)}
+
+    def get_learning_rate(self, step):
+        return float(self.learningrate / (1.0 + step * self.learningrate_decay))
+
+    def update(self, params, grads, state, step):
+        t = step.astype(jnp.float32) + 1.0
+        lr = self.learningrate / (1.0 + step.astype(jnp.float32) * self.learningrate_decay)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        m = tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        bc1 = 1.0 - jnp.power(b1, t)
+        bc2 = 1.0 - jnp.power(b2, t)
+        new_params = tree_map(
+            lambda p, m, v: p - lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps), params, m, v)
+        return new_params, {"m": m, "v": v}

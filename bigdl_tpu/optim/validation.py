@@ -1,0 +1,129 @@
+"""Validation methods and results.
+
+Reference parity (SURVEY.md §2.3, expected ``<dl>/optim/ValidationMethod.scala`` —
+unverified): ``Top1Accuracy``, ``Top5Accuracy``, ``Loss``, ``MAE``, …; partial results
+aggregate with ``+`` and ``.result()`` yields (value, count).
+
+Padded batches: methods take ``valid`` (real sample count) so the repeated padding rows
+never contaminate metrics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ValidationResult:
+    def result(self) -> tuple[float, int]:
+        raise NotImplementedError
+
+    def __add__(self, other: "ValidationResult") -> "ValidationResult":
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct: float, count: int):
+        self.correct, self.count = float(correct), int(count)
+
+    def result(self):
+        return (self.correct / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct, self.count + other.count)
+
+    def __repr__(self):
+        v, c = self.result()
+        return f"Accuracy({v:.4f}, count={c})"
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss_sum: float, count: int):
+        self.loss_sum, self.count = float(loss_sum), int(count)
+
+    def result(self):
+        return (self.loss_sum / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return LossResult(self.loss_sum + other.loss_sum, self.count + other.count)
+
+    def __repr__(self):
+        v, c = self.result()
+        return f"Loss({v:.4f}, count={c})"
+
+
+class ValidationMethod:
+    name = "ValidationMethod"
+
+    def apply(self, output, target, valid: int | None = None) -> ValidationResult:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.name
+
+
+def _mask_valid(n: int, valid: int | None):
+    if valid is None or valid >= n:
+        return None
+    return np.arange(n) < valid
+
+
+class TopKAccuracy(ValidationMethod):
+    def __init__(self, k: int, one_based: bool = False):
+        self.k = k
+        self.one_based = one_based
+        self.name = f"Top{k}Accuracy"
+
+    def apply(self, output, target, valid=None):
+        out = np.asarray(output)
+        t = np.asarray(target).astype(np.int64).reshape(-1)
+        if self.one_based:
+            t = t - 1
+        if out.ndim == 1:
+            out = out[None]
+        topk = np.argsort(-out, axis=1)[:, : self.k]
+        correct = (topk == t[:, None]).any(axis=1).astype(np.float64)
+        mask = _mask_valid(len(t), valid)
+        if mask is not None:
+            correct = correct[mask]
+        return AccuracyResult(correct.sum(), len(correct))
+
+
+class Top1Accuracy(TopKAccuracy):
+    def __init__(self, one_based: bool = False):
+        super().__init__(1, one_based)
+
+
+class Top5Accuracy(TopKAccuracy):
+    def __init__(self, one_based: bool = False):
+        super().__init__(5, one_based)
+
+
+class Loss(ValidationMethod):
+    def __init__(self, criterion=None):
+        from bigdl_tpu.nn.criterion import ClassNLLCriterion
+        self.criterion = criterion or ClassNLLCriterion()
+        self.name = "Loss"
+
+    def apply(self, output, target, valid=None):
+        n = np.asarray(output).shape[0]
+        if valid is not None and valid < n:
+            output = np.asarray(output)[:valid]
+            target = np.asarray(target)[:valid]
+            n = valid
+        loss = float(self.criterion.forward(jnp.asarray(np.asarray(output)),
+                                            jnp.asarray(np.asarray(target))))
+        return LossResult(loss * n, n)
+
+
+class MAE(ValidationMethod):
+    name = "MAE"
+
+    def apply(self, output, target, valid=None):
+        out = np.asarray(output)
+        t = np.asarray(target)
+        n = out.shape[0]
+        if valid is not None and valid < n:
+            out, t = out[:valid], t[:valid]
+            n = valid
+        return LossResult(float(np.abs(out - t).mean()) * n, n)

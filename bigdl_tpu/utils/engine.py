@@ -43,7 +43,7 @@ class EngineConfig:
     param_dtype: Any = None            # master parameter dtype (None = float32)
     failure_retry_times: int = 5       # bigdl.failure.retryTimes analog
     failure_retry_interval: float = 15.0  # seconds, bigdl.failure.retryTimeInterval analog
-    check_singleton: bool = True       # bigdl.check.singleton analog
+    check_singleton: bool = False      # bigdl.check.singleton analog (BIGDL_CHECK_SINGLETON=1)
     extra: dict = field(default_factory=dict)
 
 
@@ -65,6 +65,7 @@ class _EngineState:
         self.mesh = None               # default data-parallel Mesh
         self.devices = None
         self.distributed_initialized = False
+        self.auto_initialized = False
         self.lock = threading.Lock()
 
 
@@ -103,7 +104,9 @@ class Engine:
 
         with _STATE.lock:
             if _STATE.initialized:
-                if _STATE.config.check_singleton:
+                # an implicit auto-init (from an accessor) never blocks the user's
+                # explicit init
+                if _STATE.config.check_singleton and not _STATE.auto_initialized:
                     raise RuntimeError(
                         "Engine.init called twice with singleton check enabled "
                         "(BIGDL_CHECK_SINGLETON=1)")
@@ -134,10 +137,14 @@ class Engine:
             cfg.node_number = node_number or jax.process_count()
             cfg.core_number = core_number or jax.local_device_count()
             if core_number is not None:
-                if core_number > len(devices):
+                if core_number <= 0 or core_number > jax.local_device_count():
                     raise ValueError(
-                        f"core_number={core_number} exceeds available devices "
-                        f"({len(devices)})")
+                        f"core_number={core_number} must be in [1, "
+                        f"{jax.local_device_count()}] (local devices)")
+                if jax.process_count() > 1:
+                    raise ValueError(
+                        "core_number restriction is only supported single-host; "
+                        "multi-host meshes must cover every process's devices")
                 # Restrict to the first core_number local devices (reference semantics:
                 # Engine validates and pins the topology it was told to use).
                 devices = devices[:core_number]
@@ -151,6 +158,7 @@ class Engine:
             _STATE.devices = devices
             _STATE.mesh = cls._build_mesh(devices, mesh_shape, mesh_axes)
             _STATE.initialized = True
+            _STATE.auto_initialized = False
 
             from bigdl_tpu.utils.random_generator import RandomGenerator
             RandomGenerator.set_seed(cfg.seed)
@@ -186,8 +194,10 @@ class Engine:
     def _require_init(cls) -> None:
         if not _STATE.initialized:
             # Auto-init with defaults for ergonomic local use; the reference hard-fails,
-            # but on TPU there is no cluster conf that could be mis-detected.
+            # but on TPU there is no cluster conf that could be mis-detected. A later
+            # explicit Engine.init always overrides an auto-init.
             cls.init()
+            _STATE.auto_initialized = True
 
     @classmethod
     def config(cls) -> EngineConfig:

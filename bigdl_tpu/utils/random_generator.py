@@ -25,6 +25,7 @@ class RandomGenerator:
     _seed: int = 1
     _np: np.random.Generator = np.random.default_rng(1)
     _key_counter: int = 0
+    _base_key = None  # lazily-built jax PRNGKey for the current seed
 
     @classmethod
     def set_seed(cls, seed: int) -> None:
@@ -32,6 +33,7 @@ class RandomGenerator:
             cls._seed = int(seed)
             cls._np = np.random.default_rng(cls._seed)
             cls._key_counter = 0
+            cls._base_key = None
 
     @classmethod
     def get_seed(cls) -> int:
@@ -67,4 +69,7 @@ class RandomGenerator:
         with cls._lock:
             c = cls._key_counter
             cls._key_counter += 1
-        return jax.random.fold_in(jax.random.PRNGKey(cls._seed), c)
+            if cls._base_key is None:
+                cls._base_key = jax.random.PRNGKey(cls._seed)
+            base = cls._base_key
+        return jax.random.fold_in(base, c)

@@ -43,8 +43,9 @@ class Table:
 
     def keys(self):
         ints = sorted(k for k in self._dict if isinstance(k, int))
-        strs = sorted(k for k in self._dict if not isinstance(k, int))
-        return ints + strs
+        others = sorted((k for k in self._dict if not isinstance(k, int)),
+                        key=lambda k: (type(k).__name__, repr(k)))
+        return ints + others
 
     def values(self):
         return [self._dict[k] for k in self.keys()]
@@ -79,15 +80,11 @@ class Table:
         import numpy as np
         for k in self.keys():
             a, b = self[k], other[k]
-            try:
-                if isinstance(a, Table) or isinstance(b, Table):
-                    if a != b:
-                        return False
-                elif not np.array_equal(np.asarray(a), np.asarray(b)):
+            if isinstance(a, Table) or isinstance(b, Table):
+                if a != b:
                     return False
-            except Exception:
-                if a is not b:
-                    return False
+            elif not np.array_equal(np.asarray(a), np.asarray(b)):
+                return False
         return True
 
     __hash__ = None  # mutable

@@ -1,0 +1,184 @@
+"""End-to-end training tests: LeNet-5 on (synthetic) MNIST — baseline config #1 in
+miniature (SURVEY.md §7.2)."""
+
+import logging
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.mnist import load_mnist, to_samples
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.optim import Loss, Optimizer, SGD, Top1Accuracy, Trigger
+from bigdl_tpu.utils.engine import Engine
+
+
+def make_datasets(n_train=512, n_test=256, batch=64):
+    imgs, labels = load_mnist(None, "train", synthetic_size=n_train)
+    train = DataSet.array(to_samples(imgs, labels)) >> SampleToMiniBatch(batch)
+    imgs_t, labels_t = load_mnist(None, "test", synthetic_size=n_test)
+    test = DataSet.array(to_samples(imgs_t, labels_t)) >> SampleToMiniBatch(batch)
+    return train, test
+
+
+class TestLocalOptimizer:
+    def test_lenet_learns_synthetic_mnist(self, caplog):
+        Engine.init(seed=1)
+        train, test = make_datasets()
+        model = LeNet5(10)
+        opt = (Optimizer(model=model, dataset=train,
+                         criterion=nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.05, momentum=0.9, dampening=0.0))
+               .set_end_when(Trigger.max_epoch(4))
+               .set_validation(Trigger.every_epoch(), test,
+                               [Top1Accuracy(), Loss(nn.ClassNLLCriterion())]))
+        with caplog.at_level(logging.INFO, logger="bigdl_tpu.optim"):
+            trained = opt.optimize()
+        assert trained is model
+        # the synthetic task is easy: full-batch accuracy should be far above chance
+        assert opt.state.get("score", 0) > 0.6, f"val acc {opt.state.get('score')}"
+        assert opt.state["loss"] < 1.0
+
+    def test_loss_decreases(self):
+        Engine.init(seed=3)
+        train, _ = make_datasets(n_train=256, batch=32)
+        model = nn.Sequential().add(nn.Reshape([28 * 28])) \
+            .add(nn.Linear(784, 10)).add(nn.LogSoftMax())
+        opt = (Optimizer(model=model, dataset=train, criterion=nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_end_when(Trigger.max_iteration(3)))
+        opt.optimize()
+        first_loss = opt.state["loss"]
+        opt.set_end_when(Trigger.max_iteration(40))
+        opt.optimize()
+        assert opt.state["loss"] < first_loss
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        Engine.init(seed=4)
+        train, _ = make_datasets(n_train=128, batch=32)
+        model = nn.Sequential().add(nn.Reshape([784])).add(nn.Linear(784, 10)) \
+            .add(nn.LogSoftMax())
+        ckpt = str(tmp_path / "ckpt")
+        opt = (Optimizer(model=model, dataset=train, criterion=nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.05, momentum=0.9))
+               .set_end_when(Trigger.max_iteration(6))
+               .set_checkpoint(ckpt, Trigger.several_iteration(2)))
+        opt.optimize()
+        assert os.path.exists(os.path.join(ckpt, "checkpoint.pkl"))
+        w_before = np.asarray(model[1]._params["weight"]).copy()
+        # resume: load checkpoint into a fresh model
+        model2 = nn.Sequential().add(nn.Reshape([784])).add(nn.Linear(784, 10)) \
+            .add(nn.LogSoftMax())
+        opt2 = (Optimizer(model=model2, dataset=train, criterion=nn.ClassNLLCriterion())
+                .set_optim_method(SGD(learningrate=0.05, momentum=0.9)))
+        opt2.checkpoint_path = ckpt
+        opt2._load_latest_checkpoint()
+        np.testing.assert_allclose(np.asarray(model2[1]._params["weight"]), w_before,
+                                   rtol=1e-6)
+        assert opt2.state["neval"] >= 6
+
+    def test_grad_clipping_runs(self):
+        Engine.init(seed=5)
+        train, _ = make_datasets(n_train=64, batch=32)
+        model = nn.Sequential().add(nn.Reshape([784])).add(nn.Linear(784, 10)) \
+            .add(nn.LogSoftMax())
+        opt = (Optimizer(model=model, dataset=train, criterion=nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_gradient_clipping_by_l2_norm(1.0)
+               .set_end_when(Trigger.max_iteration(4)))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+
+    def test_padded_final_batch_static_shapes(self):
+        Engine.init(seed=6)
+        # 80 samples / batch 32 -> batches of 32, 32, 16(padded to 32)
+        imgs, labels = load_mnist(None, "train", synthetic_size=80)
+        train = DataSet.array(to_samples(imgs, labels)) >> SampleToMiniBatch(32)
+        batches = list(train.data(train=True))
+        assert [b.size() for b in batches] == [32, 32, 32]
+        assert [b.valid for b in batches] == [32, 32, 16]
+        model = nn.Sequential().add(nn.Reshape([784])).add(nn.Linear(784, 10)) \
+            .add(nn.LogSoftMax())
+        opt = (Optimizer(model=model, dataset=train, criterion=nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.01))
+               .set_end_when(Trigger.max_epoch(2)))
+        opt.optimize()  # two epochs over padded batches, single compilation
+        assert np.isfinite(opt.state["loss"])
+
+
+class TestOptimMethods:
+    def test_sgd_matches_torch(self):
+        import torch
+
+        w0 = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+        g = np.random.default_rng(1).normal(size=(4, 3)).astype(np.float32)
+        sgd = SGD(learningrate=0.1, momentum=0.9, dampening=0.0, weightdecay=0.01,
+                  nesterov=True)
+        params = {"w": jnp.asarray(w0)}
+        state = sgd.init_state(params)
+        for i in range(3):
+            params, state = sgd.update(params, {"w": jnp.asarray(g)}, state,
+                                       jnp.asarray(i))
+        tw = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+        topt = torch.optim.SGD([tw], lr=0.1, momentum=0.9, weight_decay=0.01,
+                               nesterov=True)
+        for _ in range(3):
+            tw.grad = torch.from_numpy(g.copy())
+            topt.step()
+        np.testing.assert_allclose(np.asarray(params["w"]), tw.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_adam_matches_torch(self):
+        import torch
+
+        w0 = np.random.default_rng(2).normal(size=(5,)).astype(np.float32)
+        g = np.random.default_rng(3).normal(size=(5,)).astype(np.float32)
+        adam = __import__("bigdl_tpu.optim", fromlist=["Adam"]).Adam(learningrate=0.01)
+        params = {"w": jnp.asarray(w0)}
+        state = adam.init_state(params)
+        for i in range(5):
+            params, state = adam.update(params, {"w": jnp.asarray(g)}, state,
+                                        jnp.asarray(i))
+        tw = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+        topt = torch.optim.Adam([tw], lr=0.01)
+        for _ in range(5):
+            tw.grad = torch.from_numpy(g.copy())
+            topt.step()
+        np.testing.assert_allclose(np.asarray(params["w"]), tw.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestTriggers:
+    def test_factories(self):
+        assert Trigger.max_epoch(2)({"epoch": 3})
+        assert not Trigger.max_epoch(2)({"epoch": 2})
+        assert Trigger.max_iteration(5)({"neval": 6})
+        assert not Trigger.max_iteration(5)({"neval": 5})
+        assert Trigger.several_iteration(3)({"neval": 6})
+        assert Trigger.every_epoch()({"epoch_finished": True})
+        assert Trigger.and_(Trigger.max_epoch(1), Trigger.min_loss(2.0))(
+            {"epoch": 2, "loss": 1.0})
+        assert Trigger.or_(Trigger.max_epoch(9), Trigger.min_loss(2.0))(
+            {"epoch": 2, "loss": 1.0})
+
+
+class TestValidationMethods:
+    def test_top1_top5(self):
+        out = np.asarray([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1], [0.2, 0.3, 0.5]])
+        target = np.asarray([1, 0, 0])
+        r = Top1Accuracy().apply(out, target)
+        np.testing.assert_allclose(r.result()[0], 2 / 3)
+        from bigdl_tpu.optim import TopKAccuracy
+        r5 = TopKAccuracy(2).apply(out, target)
+        np.testing.assert_allclose(r5.result()[0], 2 / 3)
+        r5b = TopKAccuracy(3).apply(out, target)
+        np.testing.assert_allclose(r5b.result()[0], 1.0)
+
+    def test_valid_masking(self):
+        out = np.asarray([[0.9, 0.1], [0.9, 0.1], [0.1, 0.9]])
+        target = np.asarray([0, 0, 0])
+        r = Top1Accuracy().apply(out, target, valid=2)
+        assert r.result() == (1.0, 2)

@@ -1,3 +1,5 @@
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import Adam, OptimMethod, SGD
 from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer
 from bigdl_tpu.optim.trigger import Trigger

@@ -172,13 +172,19 @@ class Optimizer:
                 raise
             except Exception:
                 retry_budget -= 1
-                if retry_budget < 0 or self.checkpoint_path is None:
-                    raise
+                if retry_budget < 0 or not self._has_checkpoint():
+                    raise  # no recovery point yet → surface the original failure
                 logger.exception(
                     "training failed; retrying from last checkpoint "
                     "(%d retries left)", retry_budget)
                 time.sleep(Engine.config().failure_retry_interval)
                 self._load_latest_checkpoint()
+
+    def _has_checkpoint(self) -> bool:
+        return (self.checkpoint_path is not None
+                and os.path.isdir(self.checkpoint_path)
+                and any(p.startswith("checkpoint") and p.endswith(".pkl")
+                        for p in os.listdir(self.checkpoint_path)))
 
     def _optimize_impl(self) -> AbstractModule:
         self.model.training()
@@ -226,7 +232,7 @@ class Optimizer:
                     records = 0
                     window_t0 = time.perf_counter()
 
-                self._fire_triggers(params, mstate, ostate, state)
+                self._fire_triggers(params, mstate, ostate, state, boundary=False)
                 state["neval"] += 1
             if stop:
                 break
@@ -234,7 +240,7 @@ class Optimizer:
                 raise RuntimeError("dataset yielded no batches")
             state["epoch"] += 1
             state["epoch_finished"] = True
-            self._fire_triggers(params, mstate, ostate, state)
+            self._fire_triggers(params, mstate, ostate, state, boundary=True)
             if self.end_when(state):
                 break
 
@@ -246,13 +252,23 @@ class Optimizer:
         return self.model
 
     # ------------------------------------------------------------ triggers
-    def _fire_triggers(self, params, mstate, ostate, state) -> None:
-        if self.val_trigger is not None and self.val_trigger(state):
+    @staticmethod
+    def _in_scope(trigger: Trigger, boundary: bool) -> bool:
+        scope = getattr(trigger, "scope", "any")
+        if scope == "any":
+            return True
+        return (scope == "epoch") == boundary
+
+    def _fire_triggers(self, params, mstate, ostate, state, boundary: bool) -> None:
+        if self.val_trigger is not None and self._in_scope(self.val_trigger, boundary) \
+                and self.val_trigger(state):
             self._run_validation(params, mstate, state)
         if self.checkpoint_trigger is not None and self.checkpoint_path is not None \
+                and self._in_scope(self.checkpoint_trigger, boundary) \
                 and self.checkpoint_trigger(state):
             self._save_checkpoint(params, mstate, ostate, state)
-        if self.train_summary is not None and "loss" in state:
+        # summaries are iteration-keyed: write once per iteration, never at boundaries
+        if not boundary and self.train_summary is not None and "loss" in state:
             self.train_summary.add_scalar("Loss", state["loss"], state["neval"])
             self.train_summary.add_scalar(
                 "LearningRate",

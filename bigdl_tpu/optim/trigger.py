@@ -13,9 +13,14 @@ from typing import Callable
 
 
 class Trigger:
-    def __init__(self, fn: Callable[[dict], bool], name: str = "trigger"):
+    """``scope`` controls when side-effect triggers are evaluated by the trainer:
+    'iteration' (inside the batch loop), 'epoch' (at epoch boundaries), or 'any'."""
+
+    def __init__(self, fn: Callable[[dict], bool], name: str = "trigger",
+                 scope: str = "any"):
         self._fn = fn
         self._name = name
+        self.scope = scope
 
     def __call__(self, state: dict) -> bool:
         return bool(self._fn(state))
@@ -26,12 +31,13 @@ class Trigger:
     # factories ------------------------------------------------------------
     @staticmethod
     def every_epoch() -> "Trigger":
-        return Trigger(lambda s: s.get("epoch_finished", False), "everyEpoch")
+        return Trigger(lambda s: s.get("epoch_finished", False), "everyEpoch",
+                       scope="epoch")
 
     @staticmethod
     def several_iteration(interval: int) -> "Trigger":
         return Trigger(lambda s: s.get("neval", 0) % interval == 0,
-                       f"severalIteration({interval})")
+                       f"severalIteration({interval})", scope="iteration")
 
     @staticmethod
     def max_epoch(n: int) -> "Trigger":

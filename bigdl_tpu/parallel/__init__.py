@@ -1,0 +1,3 @@
+from bigdl_tpu.parallel.sharding import (
+    batch_sharding, replicated, shard_leading_axis, zero1_state_sharding,
+)

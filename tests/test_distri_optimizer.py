@@ -1,0 +1,118 @@
+"""DistriOptimizer tests on the virtual 8-device CPU mesh — the analog of the reference's
+``local[N]`` in-JVM distributed tests (SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.mnist import load_mnist, to_samples
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.optim import (
+    DistriOptimizer, LocalOptimizer, Optimizer, SGD, Top1Accuracy, Trigger,
+)
+from bigdl_tpu.utils.engine import Engine
+
+
+def make_train(n=512, batch=64, distributed=True):
+    imgs, labels = load_mnist(None, "train", synthetic_size=n)
+    return DataSet.array(to_samples(imgs, labels),
+                         distributed=distributed) >> SampleToMiniBatch(batch)
+
+
+def fresh_linear_model():
+    from bigdl_tpu.utils.random_generator import RandomGenerator
+    RandomGenerator.set_seed(99)
+    return nn.Sequential().add(nn.Reshape([784])).add(nn.Linear(784, 10)) \
+        .add(nn.LogSoftMax())
+
+
+class TestDistriOptimizer:
+    def test_factory_dispatch(self):
+        Engine.init()
+        dist = Optimizer(model=fresh_linear_model(), dataset=make_train(64, 32),
+                         criterion=nn.ClassNLLCriterion())
+        assert isinstance(dist, DistriOptimizer)
+        local = Optimizer(model=fresh_linear_model(),
+                          dataset=make_train(64, 32, distributed=False),
+                          criterion=nn.ClassNLLCriterion())
+        assert isinstance(local, LocalOptimizer)
+        assert not isinstance(local, DistriOptimizer)
+
+    def test_trains_on_8_device_mesh(self):
+        Engine.init(seed=2)
+        assert Engine.device_count() == 8
+        model = LeNet5(10)
+        opt = (Optimizer(model=model, dataset=make_train(),
+                         criterion=nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.05, momentum=0.9, dampening=0.0))
+               .set_end_when(Trigger.max_epoch(5)))
+        opt.optimize()
+        assert opt.state["loss"] < 1.5
+
+    @pytest.mark.parametrize("sync", ["allreduce", "zero1"])
+    def test_matches_local_training(self, sync):
+        """Distributed DP must be numerically ≡ single-device training (same batches)."""
+        Engine.init(seed=7)
+        batches = make_train(256, 64, distributed=False)
+        m_local = fresh_linear_model()
+        opt_l = (Optimizer(model=m_local, dataset=batches,
+                           criterion=nn.ClassNLLCriterion())
+                 .set_optim_method(SGD(learningrate=0.1, momentum=0.9, dampening=0.0))
+                 .set_end_when(Trigger.max_iteration(8)))
+        opt_l.optimize()
+
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        RandomGenerator.set_seed(1)  # same shuffle order
+        Engine.reset()
+        Engine.init(seed=7)
+        dist_data = make_train(256, 64, distributed=True)
+        m_dist = fresh_linear_model()
+        opt_d = (DistriOptimizer(m_dist, dist_data, nn.ClassNLLCriterion(),
+                                 parameter_sync=sync)
+                 .set_optim_method(SGD(learningrate=0.1, momentum=0.9, dampening=0.0))
+                 .set_end_when(Trigger.max_iteration(8)))
+        opt_d.optimize()
+
+        w_l = np.asarray(m_local[1]._params["weight"])
+        w_d = np.asarray(m_dist[1]._params["weight"])
+        np.testing.assert_allclose(w_d, w_l, rtol=1e-4, atol=1e-5)
+
+    def test_zero1_shards_optimizer_state(self):
+        Engine.init(seed=8)
+        model = fresh_linear_model()
+        data = make_train(128, 64)
+        opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion(),
+                               parameter_sync="zero1")
+               .set_optim_method(SGD(learningrate=0.1, momentum=0.9))
+               .set_end_when(Trigger.max_iteration(2)))
+        opt.optimize()
+        v = opt._final_ostate["v"]["1"]["weight"]  # momentum slot of the Linear
+        assert v.shape == (10, 784)
+        # slot sharding: leading dim 10 not divisible by 8 → replicated;
+        # bias (10,) likewise — check the *sharding decision function* directly
+        from bigdl_tpu.parallel.sharding import shard_leading_axis
+        mesh = Engine.mesh()
+        assert shard_leading_axis(mesh, (16, 4)).spec == jax.sharding.PartitionSpec("data")
+        assert shard_leading_axis(mesh, (10, 4)).spec == jax.sharding.PartitionSpec()
+
+    def test_batch_not_divisible_raises(self):
+        Engine.init(seed=9)
+        model = fresh_linear_model()
+        data = make_train(60, 30)  # 30 % 8 != 0
+        opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion())
+               .set_end_when(Trigger.max_iteration(1)))
+        with pytest.raises(ValueError, match="not divisible"):
+            opt.optimize()
+
+    def test_validation_on_mesh(self):
+        Engine.init(seed=10)
+        model = LeNet5(10)
+        test_ds = make_train(128, 64, distributed=False)
+        opt = (DistriOptimizer(model, make_train(256, 64), nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.05, momentum=0.9, dampening=0.0))
+               .set_end_when(Trigger.max_epoch(5))
+               .set_validation(Trigger.every_epoch(), test_ds, [Top1Accuracy()]))
+        opt.optimize()
+        assert opt.state.get("score", 0) > 0.3

@@ -13,6 +13,15 @@ from bigdl_tpu.nn.containers import (
 from bigdl_tpu.nn.convolution import (
     SpatialConvolution, SpatialDilatedConvolution, SpatialFullConvolution,
 )
+from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.normalization import (
+    Add, BatchNormalization, CAdd, CMul, Dropout, GaussianDropout, GaussianNoise, Mul,
+    Normalize, SpatialBatchNormalization, SpatialCrossMapLRN, SpatialDropout2D,
+)
+from bigdl_tpu.nn.recurrent import (
+    BiRecurrent, Cell, GRU, LSTM, LSTMPeephole, Masking, Recurrent, RnnCell,
+    TimeDistributed,
+)
 from bigdl_tpu.nn.criterion import (
     AbsCriterion, AbstractCriterion, BCECriterion, BCECriterionWithLogits,
     ClassNLLCriterion, CosineEmbeddingCriterion, CrossEntropyCriterion,

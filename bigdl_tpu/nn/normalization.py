@@ -1,0 +1,312 @@
+"""Normalization + regularisation layers.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/BatchNormalization.scala``,
+``SpatialBatchNormalization.scala``, ``Dropout.scala``, ``SpatialCrossMapLRN.scala``,
+``Normalize.scala`` — unverified, mount empty): BatchNorm keeps running mean/var with
+``momentum`` mixing (Torch convention: ``running = (1-momentum)*running + momentum*batch``),
+normalises with biased batch variance in training and running stats in eval; affine
+weight/bias optional. Dropout scales by ``1/(1-p)`` at train time.
+
+TPU-native design: running stats are non-trainable buffers in the module ``state`` pytree —
+the trainer threads them through the jitted step functionally, so there is no mutable-buffer
+aliasing problem under ``jit``. Batch stats are computed per *program*: under plain
+``jit`` over a mesh the global-batch reduction XLA emits matches the full-batch statistics,
+and per-replica statistics (the reference's per-core BN, SURVEY.md §7.4) arise only inside
+``shard_map`` bodies — cross-replica sync-BN is future work at that level.
+
+Dropout randomness comes from the ``rng`` key threaded by the trainer (per-step
+``fold_in``; on a mesh XLA splits the key per shard automatically since the mask is computed
+on the sharded activation shape).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.nn.initialization import InitializationMethod, Ones, RandomUniform, Zeros
+
+
+class BatchNormalization(TensorModule):
+    """BN over the feature axis of (N, F) input (reference ``nn.BatchNormalization``)."""
+
+    _feature_axis = 1  # axis holding n_output; reduce over all other axes
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.init_weight = init_weight or RandomUniform(0.0, 1.0)
+        self.init_bias = init_bias or Zeros()
+        self.reset()
+
+    def reset(self) -> None:
+        n = self.n_output
+        if self.affine:
+            self._params = {
+                "weight": jnp.asarray(self.init_weight.init((n,), n, n)),
+                "bias": jnp.asarray(self.init_bias.init((n,), n, n)),
+            }
+        else:
+            self._params = {}
+        self._state = {
+            "running_mean": jnp.zeros((n,), jnp.float32),
+            "running_var": jnp.ones((n,), jnp.float32),
+        }
+        self.zero_grad_parameters()
+
+    def _reduce_axes(self, x):
+        return tuple(a for a in range(x.ndim) if a != self._feature_axis)
+
+    def _bshape(self, x):
+        return tuple(self.n_output if a == self._feature_axis else 1
+                     for a in range(x.ndim))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        axes = self._reduce_axes(x)
+        shape = self._bshape(x)
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)  # biased, used for normalisation (Torch)
+            n = x.size // self.n_output
+            unbiased = var * (n / max(n - 1, 1))
+            m = self.momentum
+            new_state = {
+                "running_mean": (1 - m) * state["running_mean"] + m * mean,
+                "running_var": (1 - m) * state["running_var"] + m * unbiased,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        inv = jax.lax.rsqrt(var + self.eps).reshape(shape)
+        out = (x - mean.reshape(shape)) * inv
+        if self.affine:
+            out = out * params["weight"].reshape(shape) + params["bias"].reshape(shape)
+        return out, new_state
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.n_output})"
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """BN over channel axis of NCHW input (reference ``nn.SpatialBatchNormalization``)."""
+
+
+class Dropout(TensorModule):
+    """Inverted dropout (reference ``nn.Dropout``: ``initP`` keep-drop prob, scale)."""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False, scale: bool = True):
+        super().__init__()
+        if not 0.0 <= init_p < 1.0:
+            raise ValueError("dropout probability must be in [0, 1)")
+        self.p = init_p
+        self.scale = scale
+
+    def needs_rng(self) -> bool:
+        return True
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return input, state
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, input.shape)
+        out = jnp.where(mask, input, 0.0)
+        if self.scale:
+            out = out / keep
+        return out, state
+
+    def set_p(self, p: float) -> "Dropout":
+        if not 0.0 <= p < 1.0:
+            raise ValueError("dropout probability must be in [0, 1)")
+        self.p = p
+        self._apply_cache = {}  # p is baked into the jit trace — invalidate
+        return self
+
+    def __repr__(self):
+        return f"Dropout({self.p})"
+
+
+class SpatialDropout2D(TensorModule):
+    """Drop whole channels of NCHW input (reference ``nn.SpatialDropout2D``)."""
+
+    def __init__(self, init_p: float = 0.5):
+        super().__init__()
+        self.p = init_p
+
+    def needs_rng(self) -> bool:
+        return True
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return input, state
+        keep = 1.0 - self.p
+        mask_shape = input.shape[:2] + (1,) * (input.ndim - 2)
+        mask = jax.random.bernoulli(rng, keep, mask_shape)
+        return jnp.where(mask, input / keep, 0.0), state
+
+
+class GaussianDropout(TensorModule):
+    """Multiplicative unit-mean gaussian noise (reference ``nn.GaussianDropout``)."""
+
+    def __init__(self, rate: float):
+        super().__init__()
+        self.rate = rate
+
+    def needs_rng(self) -> bool:
+        return True
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or self.rate == 0.0:
+            return input, state
+        stddev = jnp.sqrt(self.rate / (1.0 - self.rate))
+        noise = 1.0 + stddev * jax.random.normal(rng, input.shape)
+        return input * noise, state
+
+
+class GaussianNoise(TensorModule):
+    """Additive zero-mean gaussian noise (reference ``nn.GaussianNoise``)."""
+
+    def __init__(self, stddev: float):
+        super().__init__()
+        self.stddev = stddev
+
+    def needs_rng(self) -> bool:
+        return True
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training:
+            return input, state
+        return input + self.stddev * jax.random.normal(rng, input.shape), state
+
+
+class SpatialCrossMapLRN(TensorModule):
+    """Local response normalisation across channels (reference ``nn.SpatialCrossMapLRN``;
+    used by Inception-v1/AlexNet-era models).
+
+    ``out = x / (k + alpha/size * sum_{size local channels} x^2) ** beta``
+
+    TPU-native: the windowed channel sum is one ``reduce_window`` — XLA fuses the whole
+    expression; no im2col-style workspace needed.
+    """
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        sq = jnp.square(input)
+        # window over the channel axis of NCHW; Torch pads size//2 before and
+        # (size-1)//2 after, which matters for even window sizes
+        pre, post = self.size // 2, (self.size - 1) // 2
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, self.size, 1, 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (pre, post), (0, 0), (0, 0)))
+        denom = jnp.power(self.k + (self.alpha / self.size) * summed, self.beta)
+        return input / denom, state
+
+    def __repr__(self):
+        return (f"SpatialCrossMapLRN({self.size}, {self.alpha}, "
+                f"{self.beta}, {self.k})")
+
+
+class Normalize(TensorModule):
+    """Lp-normalise over the feature axis (reference ``nn.Normalize``)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10):
+        super().__init__()
+        self.p = p
+        self.eps = eps
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(input), axis=1, keepdims=True)
+        else:
+            norm = jnp.power(
+                jnp.sum(jnp.power(jnp.abs(input), self.p), axis=1, keepdims=True),
+                1.0 / self.p)
+        return input / (norm + self.eps), state
+
+
+class CMul(TensorModule):
+    """Learnable per-element scale broadcast over the input (reference ``nn.CMul``)."""
+
+    def __init__(self, size: tuple[int, ...]):
+        super().__init__()
+        self.size = tuple(size)
+        self.reset()
+
+    def reset(self) -> None:
+        import numpy as np
+        fan_in = int(np.prod(self.size))
+        self._params = {"weight": jnp.asarray(
+            RandomUniform().init(self.size, fan_in, fan_in))}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input * params["weight"], state
+
+
+class CAdd(TensorModule):
+    """Learnable per-element bias broadcast over the input (reference ``nn.CAdd``)."""
+
+    def __init__(self, size: tuple[int, ...]):
+        super().__init__()
+        self.size = tuple(size)
+        self.reset()
+
+    def reset(self) -> None:
+        import numpy as np
+        fan_in = int(np.prod(self.size))
+        self._params = {"bias": jnp.asarray(
+            RandomUniform().init(self.size, fan_in, fan_in))}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input + params["bias"], state
+
+
+class Mul(TensorModule):
+    """Single learnable scalar gain (reference ``nn.Mul``)."""
+
+    def __init__(self):
+        super().__init__()
+        self.reset()
+
+    def reset(self) -> None:
+        self._params = {"weight": jnp.asarray(RandomUniform().init((1,), 1, 1))}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input * params["weight"][0], state
+
+
+class Add(TensorModule):
+    """Learnable bias vector added to (N, F) input (reference ``nn.Add``)."""
+
+    def __init__(self, input_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.reset()
+
+    def reset(self) -> None:
+        self._params = {"bias": jnp.asarray(
+            RandomUniform().init((self.input_size,), self.input_size, self.input_size))}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input + params["bias"], state

@@ -1,0 +1,257 @@
+"""Oracle tests for normalization, embedding, and recurrent layers (torch-cpu oracle,
+mirroring the reference's Torch7-oracle strategy, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+
+RTOL, ATOL = 1e-5, 1e-5
+
+
+def np32(x):
+    return np.asarray(x, np.float32)
+
+
+class TestBatchNormalization:
+    def test_training_forward_matches_torch(self):
+        bn = nn.SpatialBatchNormalization(4)
+        x = np32(np.random.default_rng(0).normal(size=(3, 4, 5, 5)))
+        out = bn.forward(jnp.asarray(x))
+
+        tbn = torch.nn.BatchNorm2d(4)
+        with torch.no_grad():
+            tbn.weight.copy_(torch.from_numpy(np.asarray(bn._params["weight"])))
+            tbn.bias.copy_(torch.from_numpy(np.asarray(bn._params["bias"])))
+        tbn.train()
+        ref = tbn(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-4)
+        # running stats updated with torch momentum convention
+        np.testing.assert_allclose(np.asarray(bn._state["running_mean"]),
+                                   tbn.running_mean.numpy(), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(bn._state["running_var"]),
+                                   tbn.running_var.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_eval_uses_running_stats(self):
+        bn = nn.BatchNormalization(3)
+        x = np32(np.random.default_rng(1).normal(size=(8, 3)))
+        bn.forward(jnp.asarray(x))  # one training step updates stats
+        bn.evaluate()
+        out = bn.forward(jnp.asarray(x))
+        mean = np.asarray(bn._state["running_mean"])
+        var = np.asarray(bn._state["running_var"])
+        w = np.asarray(bn._params["weight"])
+        b = np.asarray(bn._params["bias"])
+        ref = (x - mean) / np.sqrt(var + bn.eps) * w + b
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+    def test_backward_matches_torch(self):
+        bn = nn.SpatialBatchNormalization(2)
+        rng = np.random.default_rng(2)
+        x = np32(rng.normal(size=(4, 2, 3, 3)))
+        go = np32(rng.normal(size=(4, 2, 3, 3)))
+        gi = bn.backward(jnp.asarray(x), jnp.asarray(go))
+
+        tbn = torch.nn.BatchNorm2d(2)
+        with torch.no_grad():
+            tbn.weight.copy_(torch.from_numpy(np.asarray(bn._params["weight"])))
+            tbn.bias.copy_(torch.from_numpy(np.asarray(bn._params["bias"])))
+        tbn.train()
+        tx = torch.from_numpy(x).requires_grad_(True)
+        tbn(tx).backward(torch.from_numpy(go))
+        np.testing.assert_allclose(np.asarray(gi), tx.grad.numpy(), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(bn._grads["weight"]),
+                                   tbn.weight.grad.numpy(), rtol=1e-4, atol=1e-4)
+
+
+class TestDropout:
+    def test_eval_is_identity(self):
+        d = nn.Dropout(0.5).evaluate()
+        x = jnp.ones((4, 4))
+        np.testing.assert_array_equal(np.asarray(d.forward(x)), np.ones((4, 4)))
+
+    def test_train_scales_and_masks(self):
+        d = nn.Dropout(0.5)
+        x = jnp.ones((100, 100))
+        out = np.asarray(d.forward(x))
+        vals = set(np.unique(out).tolist())
+        assert vals <= {0.0, 2.0}
+        assert 0.3 < (out == 0).mean() < 0.7
+
+    def test_set_p_invalidates_jit_cache(self):
+        d = nn.Dropout(0.5)
+        x = jnp.ones((32, 32))
+        d.forward(x)          # traces with p=0.5
+        d.set_p(0.0)
+        out = np.asarray(d.forward(x))
+        np.testing.assert_array_equal(out, np.ones((32, 32)))
+        with pytest.raises(ValueError):
+            d.set_p(1.0)
+
+    def test_spatial_dropout_drops_whole_channels(self):
+        d = nn.SpatialDropout2D(0.5)
+        x = jnp.ones((2, 16, 4, 4))
+        out = np.asarray(d.forward(x))
+        per_channel = out.reshape(2, 16, -1)
+        # each channel map is either all zero or all scaled
+        assert all(len(np.unique(c)) == 1 for b in per_channel for c in b)
+
+
+class TestLRN:
+    @pytest.mark.parametrize("size", [4, 5])  # even size exercises asymmetric padding
+    def test_matches_torch(self, size):
+        lrn = nn.SpatialCrossMapLRN(size, alpha=1e-4, beta=0.75, k=1.0)
+        x = np32(np.random.default_rng(3).normal(size=(2, 8, 4, 4)))
+        out = lrn.forward(jnp.asarray(x))
+        ref = F.local_response_norm(torch.from_numpy(x), size,
+                                    alpha=1e-4, beta=0.75, k=1.0)
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+class TestLookupTable:
+    def test_forward_one_based(self):
+        emb = nn.LookupTable(10, 4)
+        idx = jnp.asarray([[1, 3], [10, 2]], jnp.int32)
+        out = np.asarray(emb.forward(idx))
+        w = np.asarray(emb._params["weight"])
+        np.testing.assert_allclose(out[0, 0], w[0])
+        np.testing.assert_allclose(out[1, 0], w[9])
+
+    def test_backward_scatters(self):
+        emb = nn.LookupTable(5, 3)
+        idx = jnp.asarray([[1, 1, 2]], jnp.int32)
+        emb.zero_grad_parameters()
+        emb.forward(idx)
+        go = jnp.ones((1, 3, 3))
+        emb.backward(idx, go)
+        g = np.asarray(emb._grads["weight"])
+        np.testing.assert_allclose(g[0], 2 * np.ones(3))  # index 1 hit twice
+        np.testing.assert_allclose(g[1], np.ones(3))
+        np.testing.assert_allclose(g[2], np.zeros(3))
+
+
+def _copy_lstm_to_torch(cell, t_lstm):
+    with torch.no_grad():
+        t_lstm.weight_ih_l0.copy_(torch.from_numpy(np.asarray(cell._params["w_ih"])))
+        t_lstm.weight_hh_l0.copy_(torch.from_numpy(np.asarray(cell._params["w_hh"])))
+        t_lstm.bias_ih_l0.copy_(torch.from_numpy(np.asarray(cell._params["b_ih"])))
+        t_lstm.bias_hh_l0.copy_(torch.from_numpy(np.asarray(cell._params["b_hh"])))
+
+
+class TestRecurrent:
+    def test_lstm_forward_matches_torch(self):
+        cell = nn.LSTM(6, 5)
+        rec = nn.Recurrent(cell)
+        x = np32(np.random.default_rng(4).normal(size=(3, 7, 6)))
+        out = rec.forward(jnp.asarray(x))
+
+        t_lstm = torch.nn.LSTM(6, 5, batch_first=True)
+        _copy_lstm_to_torch(cell, t_lstm)
+        ref, _ = t_lstm(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_backward_matches_torch(self):
+        cell = nn.LSTM(4, 3)
+        rec = nn.Recurrent(cell)
+        rng = np.random.default_rng(5)
+        x = np32(rng.normal(size=(2, 5, 4)))
+        go = np32(rng.normal(size=(2, 5, 3)))
+        rec.zero_grad_parameters()
+        gi = rec.backward(jnp.asarray(x), jnp.asarray(go))
+
+        t_lstm = torch.nn.LSTM(4, 3, batch_first=True)
+        _copy_lstm_to_torch(cell, t_lstm)
+        tx = torch.from_numpy(x).requires_grad_(True)
+        out, _ = t_lstm(tx)
+        out.backward(torch.from_numpy(go))
+        np.testing.assert_allclose(np.asarray(gi), tx.grad.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cell._grads["w_ih"]),
+                                   t_lstm.weight_ih_l0.grad.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cell._grads["w_hh"]),
+                                   t_lstm.weight_hh_l0.grad.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_gru_forward_matches_torch(self):
+        cell = nn.GRU(4, 6)
+        rec = nn.Recurrent(cell)
+        x = np32(np.random.default_rng(6).normal(size=(2, 5, 4)))
+        out = rec.forward(jnp.asarray(x))
+
+        t_gru = torch.nn.GRU(4, 6, batch_first=True)
+        with torch.no_grad():
+            t_gru.weight_ih_l0.copy_(torch.from_numpy(np.asarray(cell._params["w_ih"])))
+            t_gru.weight_hh_l0.copy_(torch.from_numpy(np.asarray(cell._params["w_hh"])))
+            t_gru.bias_ih_l0.copy_(torch.from_numpy(np.asarray(cell._params["b_ih"])))
+            t_gru.bias_hh_l0.copy_(torch.from_numpy(np.asarray(cell._params["b_hh"])))
+        ref, _ = t_gru(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_rnncell_forward_matches_torch(self):
+        cell = nn.RnnCell(3, 4)
+        rec = nn.Recurrent(cell)
+        x = np32(np.random.default_rng(7).normal(size=(2, 6, 3)))
+        out = rec.forward(jnp.asarray(x))
+
+        t_rnn = torch.nn.RNN(3, 4, batch_first=True)
+        with torch.no_grad():
+            t_rnn.weight_ih_l0.copy_(torch.from_numpy(np.asarray(cell._params["w_ih"])))
+            t_rnn.weight_hh_l0.copy_(torch.from_numpy(np.asarray(cell._params["w_hh"])))
+            t_rnn.bias_ih_l0.copy_(torch.from_numpy(np.asarray(cell._params["b_ih"])))
+            t_rnn.bias_hh_l0.copy_(torch.from_numpy(np.asarray(cell._params["b_hh"])))
+        ref, _ = t_rnn(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_birecurrent_concat_shape(self):
+        rec = nn.BiRecurrent(nn.LSTM(4, 3))
+        x = jnp.zeros((2, 5, 4))
+        out = rec.forward(x)
+        assert out.shape == (2, 5, 6)
+
+    def test_birecurrent_add_path(self):
+        rec = nn.BiRecurrent(merge="add")
+        rec.add(nn.LSTM(4, 3))
+        assert len(rec.modules) == 2  # forward cell + independent backward clone
+        out = rec.forward(jnp.ones((2, 5, 4)))
+        assert out.shape == (2, 5, 3)
+
+    def test_birecurrent_matches_torch_bilstm(self):
+        cell = nn.LSTM(3, 4)
+        rec = nn.BiRecurrent(cell)
+        x = np32(np.random.default_rng(8).normal(size=(2, 6, 3)))
+        out = rec.forward(jnp.asarray(x))
+
+        t = torch.nn.LSTM(3, 4, batch_first=True, bidirectional=True)
+        fwd, bwd = rec.modules
+        with torch.no_grad():
+            t.weight_ih_l0.copy_(torch.from_numpy(np.asarray(fwd._params["w_ih"])))
+            t.weight_hh_l0.copy_(torch.from_numpy(np.asarray(fwd._params["w_hh"])))
+            t.bias_ih_l0.copy_(torch.from_numpy(np.asarray(fwd._params["b_ih"])))
+            t.bias_hh_l0.copy_(torch.from_numpy(np.asarray(fwd._params["b_hh"])))
+            t.weight_ih_l0_reverse.copy_(
+                torch.from_numpy(np.asarray(bwd._params["w_ih"])))
+            t.weight_hh_l0_reverse.copy_(
+                torch.from_numpy(np.asarray(bwd._params["w_hh"])))
+            t.bias_ih_l0_reverse.copy_(torch.from_numpy(np.asarray(bwd._params["b_ih"])))
+            t.bias_hh_l0_reverse.copy_(torch.from_numpy(np.asarray(bwd._params["b_hh"])))
+        ref, _ = t(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_time_distributed_linear(self):
+        lin = nn.Linear(4, 2)
+        td = nn.TimeDistributed(lin)
+        x = np32(np.random.default_rng(9).normal(size=(3, 5, 4)))
+        out = td.forward(jnp.asarray(x))
+        assert out.shape == (3, 5, 2)
+        w = np.asarray(lin._params["weight"])
+        b = np.asarray(lin._params["bias"])
+        ref = x.reshape(15, 4) @ w.T + b
+        np.testing.assert_allclose(np.asarray(out).reshape(15, 2), ref,
+                                   rtol=RTOL, atol=ATOL)

@@ -14,6 +14,7 @@ from bigdl_tpu.nn.convolution import (
     SpatialConvolution, SpatialDilatedConvolution, SpatialFullConvolution,
 )
 from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.graph import Graph, Input, ModuleNode, StaticGraph
 from bigdl_tpu.nn.normalization import (
     Add, BatchNormalization, CAdd, CMul, Dropout, GaussianDropout, GaussianNoise, Mul,
     Normalize, SpatialBatchNormalization, SpatialCrossMapLRN, SpatialDropout2D,

@@ -219,6 +219,14 @@ class AbstractModule:
         self._forward_time = 0.0
         self._backward_time = 0.0
 
+    # -------------------------------------------------------------- graph
+    def inputs(self, *nodes):
+        """Torch-style node wiring: ``layer.inputs(nodeA, nodeB)`` returns a graph
+        ``ModuleNode`` wrapping this layer with the given predecessor nodes (reference
+        ``AbstractModule.inputs`` / ``Node`` wiring — SURVEY.md §2.1 Static graph)."""
+        from bigdl_tpu.nn.graph import make_node
+        return make_node(self, nodes)
+
     # -------------------------------------------------------------- misc
     def set_name(self, name: str) -> "AbstractModule":
         self.name = name

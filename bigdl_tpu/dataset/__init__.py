@@ -6,3 +6,6 @@ from bigdl_tpu.dataset.sample import MiniBatch, Sample, SampleToMiniBatch
 from bigdl_tpu.dataset.transformer import (
     ChainedTransformer, Identity, MapTransformer, Transformer,
 )
+from bigdl_tpu.dataset.text import (
+    Dictionary, LabeledSentenceToSample, SentenceTokenizer, TextToLabeledSentence,
+)

@@ -1,0 +1,3 @@
+from bigdl_tpu.models.inception.inception import (
+    Inception_Layer_v1, Inception_v1, Inception_v1_NoAuxClassifier,
+)

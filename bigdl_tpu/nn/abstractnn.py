@@ -255,6 +255,24 @@ class AbstractModule:
         return f"{type(self).__name__}"
 
     # serialization (pickle-safe: drop jit caches) -------------------------
+    def save(self, path: str, overwrite: bool = True) -> "AbstractModule":
+        """Persist this module (params + structure) — reference ``Module.save``."""
+        from bigdl_tpu.utils import file as _file
+        _file.save(self, path, overwrite=overwrite)
+        return self
+
+    save_module = save  # reference ``saveModule`` alias
+
+    @staticmethod
+    def load(path: str) -> "AbstractModule":
+        from bigdl_tpu.utils import file as _file
+        obj = _file.load(path)
+        if not isinstance(obj, AbstractModule):
+            raise TypeError(f"{path} does not contain a module (got {type(obj)})")
+        return obj
+
+    load_module = load  # reference ``Module.loadModule`` alias
+
     def __getstate__(self):
         d = dict(self.__dict__)
         d["_apply_cache"] = {}

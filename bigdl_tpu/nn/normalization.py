@@ -208,14 +208,19 @@ class SpatialCrossMapLRN(TensorModule):
 
     def apply(self, params, state, input, *, training=False, rng=None):
         sq = jnp.square(input)
-        # window over the channel axis of NCHW; Torch pads size//2 before and
-        # (size-1)//2 after, which matters for even window sizes
+        # Windowed sum over the channel axis of NCHW; Torch pads size//2 before and
+        # (size-1)//2 after, which matters for even window sizes. Formulated as a banded
+        # C×C 0/1 matmul on the MXU rather than a padded reduce_window or cumsum+gather:
+        # both of those miscompile on the axon TPU backend when fused next to a conv
+        # (reduce_window loses its padding; the cumsum concat trips
+        # space_to_batch_converter), while a matmul is the op TPUs are built around.
         pre, post = self.size // 2, (self.size - 1) // 2
-        summed = jax.lax.reduce_window(
-            sq, 0.0, jax.lax.add,
-            window_dimensions=(1, self.size, 1, 1),
-            window_strides=(1, 1, 1, 1),
-            padding=((0, 0), (pre, post), (0, 0), (0, 0)))
+        c = sq.shape[1]
+        idx = jnp.arange(c)
+        # band[i, j] = 1 where channel i falls in j's window [j - pre, j + post]
+        band = ((idx[:, None] >= idx[None, :] - pre)
+                & (idx[:, None] <= idx[None, :] + post)).astype(sq.dtype)
+        summed = jnp.einsum("nihw,ij->njhw", sq, band)
         denom = jnp.power(self.k + (self.alpha / self.size) * summed, self.beta)
         return input / denom, state
 

@@ -17,8 +17,9 @@ from bigdl_tpu.utils.table import T, Table
 
 
 class Reshape(TensorModule):
-    """Reshape non-batch dims to ``size``; ``batch_mode=None`` auto-detects a batch dim
-    (reference heuristic: ndim == len(size)+1 → batched)."""
+    """Reshape non-batch dims to ``size``; ``batch_mode=None`` auto-detects a batch dim:
+    input is treated as batched when its non-batch dims hold exactly ``prod(size)``
+    elements (``ndim >= 2 and prod(shape[1:]) == prod(size)``)."""
 
     def __init__(self, size: Sequence[int], batch_mode: bool | None = None):
         super().__init__()
@@ -29,8 +30,10 @@ class Reshape(TensorModule):
         batched = self.batch_mode
         if batched is None:
             import numpy as np
-            batched = (input.ndim == len(self.size) + 1 or
-                       int(np.prod(input.shape)) != int(np.prod(self.size)))
+            # batch dim preserved whenever the non-batch dims hold exactly the target
+            # element count (robust for batch size 1, unlike ndim heuristics)
+            batched = (input.ndim >= 2 and
+                       int(np.prod(input.shape[1:])) == int(np.prod(self.size)))
         if batched:
             return input.reshape((input.shape[0],) + self.size), state
         return input.reshape(self.size), state

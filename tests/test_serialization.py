@@ -1,0 +1,60 @@
+"""Module save/load round-trips (reference ModuleSerializerSpec analog, SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+
+
+def _roundtrip(model, x, tmp_path, name):
+    y = model.evaluate().forward(x)
+    path = str(tmp_path / f"{name}.bigdl")
+    model.save(path)
+    loaded = nn.AbstractModule.load(path)
+    y2 = loaded.evaluate().forward(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y2), rtol=1e-6)
+    return loaded
+
+
+class TestModuleSaveLoad:
+    def test_sequential_roundtrip(self, tmp_path):
+        m = nn.Sequential().add(nn.Linear(4, 8)).add(nn.ReLU()).add(nn.Linear(8, 3))
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 4)), jnp.float32)
+        _roundtrip(m, x, tmp_path, "seq")
+
+    def test_graph_roundtrip(self, tmp_path):
+        inp = nn.Input()
+        a = nn.Linear(4, 4).inputs(inp)
+        out = nn.CAddTable().inputs(a, inp)
+        g = nn.Graph(inp, out)
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 4)), jnp.float32)
+        _roundtrip(g, x, tmp_path, "graph")
+
+    def test_lenet_roundtrip(self, tmp_path):
+        from bigdl_tpu.models.lenet import LeNet5
+        m = LeNet5(10)
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 1, 28, 28)), jnp.float32)
+        _roundtrip(m, x, tmp_path, "lenet")
+
+    def test_bn_state_roundtrip(self, tmp_path):
+        m = nn.Sequential().add(nn.SpatialBatchNormalization(3))
+        x = jnp.asarray(np.random.default_rng(3).normal(size=(4, 3, 5, 5)), jnp.float32)
+        m.training().forward(x)  # update running stats
+        _roundtrip(m, x, tmp_path, "bn")
+
+    def test_overwrite_guard(self, tmp_path):
+        m = nn.Linear(2, 2)
+        path = str(tmp_path / "m.bigdl")
+        m.save(path)
+        with pytest.raises(FileExistsError):
+            m.save(path, overwrite=False)
+
+    def test_optim_method_roundtrip(self, tmp_path):
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.utils import file as _file
+        method = SGD(learningrate=0.05, momentum=0.9)
+        path = str(tmp_path / "sgd.bigdl")
+        _file.save(method, path)
+        loaded = _file.load(path)
+        assert loaded.learningrate == 0.05 and loaded.momentum == 0.9

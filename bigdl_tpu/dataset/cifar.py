@@ -54,13 +54,38 @@ def _load_python_batches(folder: str, split: str):
     return np.concatenate(xs) / np.float32(255.0), np.concatenate(ys).astype(np.int32)
 
 
+def _load_binary_batches(folder: str, split: str):
+    names = ([f"data_batch_{i}.bin" for i in range(1, 6)] if split == "train"
+             else ["test_batch.bin"])
+    root = folder
+    sub = os.path.join(folder, "cifar-10-batches-bin")
+    if os.path.isdir(sub):
+        root = sub
+    xs, ys = [], []
+    for name in names:
+        path = os.path.join(root, name)
+        if not os.path.exists(path):
+            return None
+        raw = np.fromfile(path, np.uint8).reshape(-1, 3073)  # 1 label + 3072 pixels
+        ys.append(raw[:, 0].astype(np.int64))
+        xs.append(raw[:, 1:].reshape(-1, 3, 32, 32))
+    return np.concatenate(xs) / np.float32(255.0), np.concatenate(ys).astype(np.int32)
+
+
 def load_cifar10(folder: str | None = None, split: str = "train",
                  synthetic_size: int | None = None):
-    """Return ``(images float32 NCHW in [0,1], labels int32)``."""
+    """Return ``(images float32 NCHW in [0,1], labels int32)``.
+
+    With an explicit ``folder`` the python-pickle then binary layouts are tried and a
+    missing/unreadable dataset is an error — never a silent synthetic substitution.
+    Synthetic data is used only when no folder is given (this offline environment).
+    """
     if folder:
-        loaded = _load_python_batches(folder, split)
-        if loaded is not None:
-            return loaded
+        loaded = _load_python_batches(folder, split) or _load_binary_batches(folder, split)
+        if loaded is None:
+            raise FileNotFoundError(
+                f"no CIFAR-10 batches (python or binary layout) under {folder!r}")
+        return loaded
     n = synthetic_size or (2048 if split == "train" else 512)
     return synthetic_cifar10(n, seed=0 if split == "train" else 1)
 

@@ -67,8 +67,7 @@ def main(argv=None):
                  .set_validation(Trigger.every_epoch(), test_set, [Top1Accuracy()]))
     if args.checkpoint:
         optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
-        if args.overwrite_checkpoint:
-            optimizer.over_write_checkpoint()
+        optimizer.over_write_checkpoint(args.overwrite_checkpoint)
     if args.summary_dir:
         from bigdl_tpu.visualization import TrainSummary, ValidationSummary
         optimizer.set_train_summary(TrainSummary(args.summary_dir, "lenet"))

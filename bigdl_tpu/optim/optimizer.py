@@ -64,7 +64,9 @@ class Optimizer:
         self.val_methods: Sequence[ValidationMethod] = ()
         self.checkpoint_path: Optional[str] = None
         self.checkpoint_trigger: Optional[Trigger] = None
-        self.overwrite_checkpoint: bool = True
+        # Reference parity: checkpoints are versioned per iteration by default;
+        # over_write_checkpoint() opts into a single rolling file.
+        self.overwrite_checkpoint: bool = False
         self.train_summary = None
         self.val_summary = None
         self.summary_trigger: Optional[Trigger] = None
@@ -263,6 +265,7 @@ class Optimizer:
         if self.val_trigger is not None and self._in_scope(self.val_trigger, boundary) \
                 and self.val_trigger(state):
             self._run_validation(params, mstate, state)
+            self._update_stateful_schedule(ostate, state)
         if self.checkpoint_trigger is not None and self.checkpoint_path is not None \
                 and self._in_scope(self.checkpoint_trigger, boundary) \
                 and self.checkpoint_trigger(state):
@@ -273,6 +276,20 @@ class Optimizer:
             self.train_summary.add_scalar(
                 "LearningRate",
                 self.optim_method.get_learning_rate(state["neval"] - 1), state["neval"])
+
+    def _update_stateful_schedule(self, ostate, state) -> None:
+        """Feed the monitored metric to a stateful LR schedule (Plateau) and write
+        the resulting LR into the live optimizer state — a traced leaf, so the LR
+        drops without recompiling the step."""
+        sched = getattr(self.optim_method, "learningrate_schedule", None)
+        if not getattr(sched, "stateful", False) or "clr" not in ostate:
+            return
+        monitor = getattr(sched, "monitor", "score")
+        value = state.get("score") if monitor == "score" else state.get("loss")  # loss/Loss
+        if value is None:
+            return
+        new_lr = sched.on_metric(float(value))
+        ostate["clr"] = jnp.asarray(new_lr, jnp.float32)
 
     def _run_validation(self, params, mstate, state) -> None:
         if self.val_dataset is None or not self.val_methods:
@@ -309,6 +326,9 @@ class Optimizer:
             "ostate": jax.device_get(ostate),
             "state": dict(state),
         }
+        sched = getattr(self.optim_method, "learningrate_schedule", None)
+        if getattr(sched, "stateful", False):
+            payload["sched_state"] = sched.state_dict()
         path = self._ckpt_file(state)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
@@ -329,6 +349,9 @@ class Optimizer:
         self.model.set_state(payload["mstate"])
         self._resume_ostate = payload["ostate"]
         self.state = payload["state"]
+        sched = getattr(self.optim_method, "learningrate_schedule", None)
+        if getattr(sched, "stateful", False) and "sched_state" in payload:
+            sched.load_state_dict(payload["sched_state"])
         logger.info("resumed from checkpoint %s at iter %d", cand[-1],
                     self.state.get("neval", 0))
 

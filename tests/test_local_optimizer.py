@@ -65,7 +65,8 @@ class TestLocalOptimizer:
         opt = (Optimizer(model=model, dataset=train, criterion=nn.ClassNLLCriterion())
                .set_optim_method(SGD(learningrate=0.05, momentum=0.9))
                .set_end_when(Trigger.max_iteration(6))
-               .set_checkpoint(ckpt, Trigger.several_iteration(2)))
+               .set_checkpoint(ckpt, Trigger.several_iteration(2))
+               .over_write_checkpoint())
         opt.optimize()
         assert os.path.exists(os.path.join(ckpt, "checkpoint.pkl"))
         w_before = np.asarray(model[1]._params["weight"]).copy()

@@ -1,0 +1,215 @@
+"""OptimMethod + LR schedule tests.
+
+Oracle strategy (SURVEY.md §4 takeaway 1): torch.optim is the independent
+implementation for Adagrad/Adadelta/Adamax/RMSprop; LBFGS is checked by
+convergence on a strongly-convex quadratic; schedules against closed forms.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from bigdl_tpu.optim import (
+    Adadelta, Adagrad, Adam, Adamax, Ftrl, LBFGS, LarsSGD, RMSprop, SGD,
+)
+from bigdl_tpu.optim.schedules import (
+    Default, Exponential, MultiStep, NaturalExp, Plateau, Poly, SequentialSchedule,
+    Step, Warmup,
+)
+
+
+def _run_ours(method, w0, grads):
+    params = {"w": jnp.asarray(w0)}
+    state = method.init_state(params)
+    for i, g in enumerate(grads):
+        params, state = method.update(params, {"w": jnp.asarray(g)}, state,
+                                      jnp.asarray(i))
+    return np.asarray(params["w"])
+
+
+def _run_torch(opt_ctor, w0, grads):
+    w = torch.tensor(w0, requires_grad=True)
+    opt = opt_ctor([w])
+    for g in grads:
+        opt.zero_grad()
+        w.grad = torch.tensor(g)
+        opt.step()
+    return w.detach().numpy()
+
+
+@pytest.fixture
+def problem():
+    rng = np.random.default_rng(42)
+    w0 = rng.normal(size=(5, 3)).astype(np.float32)
+    grads = [rng.normal(size=(5, 3)).astype(np.float32) for _ in range(6)]
+    return w0, grads
+
+
+class TestVsTorchOracles:
+    def test_adagrad(self, problem):
+        w0, grads = problem
+        ours = _run_ours(Adagrad(learningrate=0.1), w0, grads)
+        ref = _run_torch(lambda p: torch.optim.Adagrad(p, lr=0.1), w0, grads)
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_adadelta(self, problem):
+        w0, grads = problem
+        ours = _run_ours(Adadelta(decayrate=0.9, epsilon=1e-6, learningrate=0.5),
+                         w0, grads)
+        ref = _run_torch(lambda p: torch.optim.Adadelta(p, lr=0.5, rho=0.9, eps=1e-6),
+                         w0, grads)
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_adamax(self, problem):
+        w0, grads = problem
+        ours = _run_ours(Adamax(learningrate=0.02, epsilon=1e-8), w0, grads)
+        ref = _run_torch(lambda p: torch.optim.Adamax(p, lr=0.02, eps=1e-8), w0, grads)
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_rmsprop(self, problem):
+        w0, grads = problem
+        ours = _run_ours(RMSprop(learningrate=0.01, decayrate=0.95, epsilon=1e-8),
+                         w0, grads)
+        ref = _run_torch(lambda p: torch.optim.RMSprop(p, lr=0.01, alpha=0.95,
+                                                       eps=1e-8), w0, grads)
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+class TestConvergence:
+    """Each method must minimize a strongly-convex quadratic under jit."""
+
+    def _quadratic(self):
+        rng = np.random.default_rng(0)
+        Q = rng.normal(size=(12, 12)).astype(np.float32)
+        A = Q @ Q.T + 10.0 * np.eye(12, dtype=np.float32)
+        b = rng.normal(size=(12,)).astype(np.float32)
+        return jnp.asarray(A), jnp.asarray(b)
+
+    @pytest.mark.parametrize("method,iters", [
+        (LBFGS(history=8, learningrate=1.0), 40),
+        (Ftrl(learningrate=0.5), 300),
+        (LarsSGD(learningrate=0.05, momentum=0.9, trust=1.0), 300),
+        (Adam(learningrate=0.3), 300),
+    ])
+    def test_minimizes_quadratic(self, method, iters):
+        A, b = self._quadratic()
+        params = {"x": jnp.zeros(12)}
+        state = method.init_state(params)
+
+        @jax.jit
+        def step(params, state, i):
+            g = {"x": A @ params["x"] - b}
+            return method.update(params, g, state, i)
+
+        for i in range(iters):
+            params, state = step(params, state, jnp.asarray(i))
+        x_star = jnp.linalg.solve(A, b)
+        f = lambda x: float(0.5 * x @ A @ x - b @ x)
+        assert f(params["x"]) - f(x_star) < 1e-2
+
+    def test_ftrl_l1_produces_sparsity(self):
+        A, b = self._quadratic()
+        method = Ftrl(learningrate=0.5, l1_regularization_strength=2.0)
+        params = {"x": jnp.zeros(12)}
+        state = method.init_state(params)
+        for i in range(200):
+            g = {"x": A @ params["x"] - b}
+            params, state = method.update(params, g, state, jnp.asarray(i))
+        assert int(np.sum(np.abs(np.asarray(params["x"])) < 1e-6)) > 0
+
+
+class TestSchedules:
+    def _lr(self, sched, base, step):
+        return float(sched(jnp.asarray(base, jnp.float32),
+                           jnp.asarray(step, jnp.float32)))
+
+    def test_default(self):
+        s = Default(learningrate_decay=0.1)
+        assert self._lr(s, 1.0, 0) == pytest.approx(1.0)
+        assert self._lr(s, 1.0, 10) == pytest.approx(0.5)
+
+    def test_step(self):
+        s = Step(step_size=10, gamma=0.5)
+        assert self._lr(s, 1.0, 9) == pytest.approx(1.0)
+        assert self._lr(s, 1.0, 10) == pytest.approx(0.5)
+        assert self._lr(s, 1.0, 25) == pytest.approx(0.25)
+
+    def test_multistep(self):
+        s = MultiStep(step_sizes=[10, 30], gamma=0.1)
+        assert self._lr(s, 1.0, 5) == pytest.approx(1.0)
+        assert self._lr(s, 1.0, 15) == pytest.approx(0.1)
+        assert self._lr(s, 1.0, 40) == pytest.approx(0.01)
+
+    def test_poly(self):
+        s = Poly(power=2.0, max_iteration=100)
+        assert self._lr(s, 1.0, 0) == pytest.approx(1.0)
+        assert self._lr(s, 1.0, 50) == pytest.approx(0.25)
+        assert self._lr(s, 1.0, 100) == pytest.approx(0.0)
+        assert self._lr(s, 1.0, 200) == pytest.approx(0.0)  # clamped past max
+
+    def test_exponential(self):
+        s = Exponential(decay_step=10, decay_rate=0.5)
+        assert self._lr(s, 1.0, 10) == pytest.approx(0.5)
+        s2 = Exponential(decay_step=10, decay_rate=0.5, stair_case=True)
+        assert self._lr(s2, 1.0, 15) == pytest.approx(0.5)
+
+    def test_natural_exp(self):
+        s = NaturalExp(decay_step=1, decay_rate=0.1)
+        assert self._lr(s, 1.0, 10) == pytest.approx(np.exp(-1.0), rel=1e-5)
+
+    def test_warmup_sequential(self):
+        # 5-iteration linear warmup 0.1→0.6, then Default decay from base 1.0
+        seq = (SequentialSchedule()
+               .add(Warmup(delta=0.1), 5)
+               .add(Default(learningrate_decay=0.0), 1000))
+        assert self._lr(seq, 0.1, 0) == pytest.approx(0.1)
+        assert self._lr(seq, 0.1, 4) == pytest.approx(0.5)
+        assert self._lr(seq, 0.1, 5) == pytest.approx(0.1)  # stage 2, its own base
+
+    def test_plateau(self):
+        p = Plateau(factor=0.5, patience=2, mode="min", epsilon=0.0)
+        p.reset(1.0)
+        assert p.on_metric(10.0) == 1.0   # first value = improvement
+        assert p.on_metric(10.0) == 1.0   # wait 1
+        assert p.on_metric(10.0) == 1.0   # wait 2
+        assert p.on_metric(10.0) == 0.5   # patience exceeded → halve
+        assert p.on_metric(5.0) == 0.5    # improvement resets wait
+
+    def test_sgd_with_schedule_in_jit(self):
+        method = SGD(learningrate=1.0, learningrate_schedule=Step(10, 0.1))
+        params = {"w": jnp.ones(3)}
+        state = method.init_state(params)
+
+        @jax.jit
+        def step(params, state, i):
+            return method.update(params, {"w": jnp.ones(3)}, state, i)
+
+        p0, state = step(params, state, jnp.asarray(0))
+        np.testing.assert_allclose(np.asarray(p0["w"]), 0.0, atol=1e-6)  # lr=1
+        p1, state = step(p0, state, jnp.asarray(10))
+        np.testing.assert_allclose(np.asarray(p1["w"]), -0.1, atol=1e-6)  # lr=0.1
+
+    def test_sgd_stateful_plateau_state_leaf(self):
+        sched = Plateau(factor=0.1, patience=0, mode="min")
+        method = SGD(learningrate=0.5, learningrate_schedule=sched)
+        params = {"w": jnp.ones(2)}
+        state = method.init_state(params)
+        assert float(state["clr"]) == pytest.approx(0.5)
+        # host lowers the LR leaf; update must honor it without re-tracing
+        step_fn = jax.jit(lambda p, s, i: method.update(p, {"w": jnp.ones(2)}, s, i))
+        p1, s1 = step_fn(params, state, jnp.asarray(0))
+        np.testing.assert_allclose(np.asarray(p1["w"]), 0.5, atol=1e-6)
+        s1["clr"] = jnp.asarray(0.05, jnp.float32)
+        p2, _ = step_fn(p1, s1, jnp.asarray(1))
+        np.testing.assert_allclose(np.asarray(p2["w"]), 0.45, atol=1e-6)
+
+    def test_sgd_layer_lr_mults(self):
+        method = SGD(learningrate=1.0, layer_lr_mults={"frozen": 0.0})
+        params = {"frozen": jnp.ones(2), "hot": jnp.ones(2)}
+        state = method.init_state(params)
+        g = {"frozen": jnp.ones(2), "hot": jnp.ones(2)}
+        new_p, _ = method.update(params, g, state, jnp.asarray(0))
+        np.testing.assert_allclose(np.asarray(new_p["frozen"]), 1.0)
+        np.testing.assert_allclose(np.asarray(new_p["hot"]), 0.0)

@@ -380,7 +380,7 @@ class LarsSGD(OptimMethod):
         if self.learningrate_schedule is not None:
             clr = self.learningrate_schedule(self.learningrate, s)
         else:
-            clr = self.learningrate / (1.0 + s * self.learningrate_decay)
+            clr = decayed_lr(self.learningrate, self.learningrate_decay, s)
         wd, mu, trust, eps = self.weightdecay, self.momentum, self.trust, self.epsilon
 
         def upd(p, g, v):

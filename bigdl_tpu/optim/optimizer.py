@@ -189,6 +189,14 @@ class Optimizer:
                         for p in os.listdir(self.checkpoint_path)))
 
     def _optimize_impl(self) -> AbstractModule:
+        sched = getattr(self.optim_method, "learningrate_schedule", None)
+        if getattr(sched, "stateful", False) \
+                and getattr(sched, "monitor", "score") == "score" \
+                and self.val_trigger is None:
+            logger.warning(
+                "Plateau(monitor='score') without set_validation never sees a metric "
+                "— the LR will stay at its base value; configure validation or use "
+                "monitor='loss'")
         self.model.training()
         params = self.model.get_params()
         mstate = self.model.get_state()
@@ -228,6 +236,7 @@ class Optimizer:
                 if state["neval"] % self.log_every == 0 and "loss" in state:
                     dt = time.perf_counter() - window_t0
                     thr = records / dt if dt > 0 else 0.0
+                    state["throughput"] = thr
                     logger.info(
                         "Epoch %d iter %d: loss %.6f, %.1f records/s",
                         state["epoch"], state["neval"], state["loss"], thr)
@@ -266,16 +275,46 @@ class Optimizer:
                 and self.val_trigger(state):
             self._run_validation(params, mstate, state)
             self._update_stateful_schedule(ostate, state)
+        # A loss-monitoring Plateau needs no validation set: feed it training loss
+        # once per epoch (the reference's per-epoch Plateau cadence).
+        if boundary:
+            sched = getattr(self.optim_method, "learningrate_schedule", None)
+            if getattr(sched, "stateful", False) \
+                    and getattr(sched, "monitor", "score") != "score":
+                self._update_stateful_schedule(ostate, state)
         if self.checkpoint_trigger is not None and self.checkpoint_path is not None \
                 and self._in_scope(self.checkpoint_trigger, boundary) \
                 and self.checkpoint_trigger(state):
             self._save_checkpoint(params, mstate, ostate, state)
-        # summaries are iteration-keyed: write once per iteration, never at boundaries
+        # summaries are iteration-keyed: write once per iteration, never at boundaries;
+        # per-tag triggers from set_summary_trigger gate the write rate (default: all)
         if not boundary and self.train_summary is not None and "loss" in state:
-            self.train_summary.add_scalar("Loss", state["loss"], state["neval"])
-            self.train_summary.add_scalar(
-                "LearningRate",
-                self.optim_method.get_learning_rate(state["neval"] - 1), state["neval"])
+            def _tag_fires(name: str) -> bool:
+                get = getattr(self.train_summary, "get_summary_trigger", None)
+                trig = get(name) if get else None
+                return trig is None or trig(state)
+
+            if _tag_fires("Loss"):
+                self.train_summary.add_scalar("Loss", state["loss"], state["neval"])
+            if _tag_fires("LearningRate"):
+                self.train_summary.add_scalar(
+                    "LearningRate",
+                    self.optim_method.get_learning_rate(state["neval"] - 1),
+                    state["neval"])
+            if "throughput" in state and _tag_fires("Throughput"):
+                self.train_summary.add_scalar("Throughput", state["throughput"],
+                                              state["neval"])
+            # parameter histograms are opt-in via set_summary_trigger (expensive:
+            # device→host pull of every weight)
+            ptrig = self.train_summary.get_summary_trigger("Parameters") \
+                if hasattr(self.train_summary, "get_summary_trigger") else None
+            if ptrig is not None and ptrig(state):
+                from jax.tree_util import keystr, tree_flatten_with_path
+                leaves, _ = tree_flatten_with_path(jax.device_get(params))
+                for path, leaf in leaves:
+                    self.train_summary.add_histogram(
+                        keystr(path).strip("[]'\"").replace("']['", "/"),
+                        leaf, state["neval"])
 
     def _update_stateful_schedule(self, ostate, state) -> None:
         """Feed the monitored metric to a stateful LR schedule (Plateau) and write

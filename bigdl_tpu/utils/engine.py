@@ -102,6 +102,21 @@ class Engine:
         """
         import jax
 
+        # Some images preload jax._src at interpreter startup, which can swallow a
+        # JAX_PLATFORMS set for this process before jax reads it. Re-assert platform
+        # selection here (harmless no-op once a backend is already live).
+        resolved_backend = backend or _env("BIGDL_BACKEND", "auto")
+        platforms = None
+        if resolved_backend in ("cpu", "tpu"):
+            platforms = resolved_backend
+        elif os.environ.get("JAX_PLATFORMS"):
+            platforms = os.environ["JAX_PLATFORMS"]
+        if platforms:
+            try:
+                jax.config.update("jax_platforms", platforms)
+            except Exception:
+                pass  # backend already initialized — selection is final
+
         with _STATE.lock:
             if _STATE.initialized:
                 # an implicit auto-init (from an accessor) never blocks the user's
@@ -113,7 +128,7 @@ class Engine:
                 logger.debug("Engine.init: already initialized; re-init with new config")
 
             cfg = EngineConfig()
-            cfg.backend = backend or _env("BIGDL_BACKEND", "auto")
+            cfg.backend = resolved_backend
             cfg.seed = int(seed if seed is not None else _env("BIGDL_SEED", "1"))
             cfg.failure_retry_times = int(_env("BIGDL_FAILURE_RETRY_TIMES", "5"))
             cfg.failure_retry_interval = float(_env("BIGDL_FAILURE_RETRY_INTERVAL", "15"))

@@ -179,9 +179,31 @@ class AbstractModule:
         self._training = True
         return self
 
-    def evaluate(self) -> "AbstractModule":
+    def evaluate(self, dataset=None, methods=None, batch_size=None):
+        """No arguments: switch to eval mode (Torch parity). With a dataset and
+        ValidationMethods: run distributed evaluation and return
+        ``[(ValidationResult, method)]`` (reference ``model.evaluate(rdd, methods,
+        batchSize)`` overload)."""
         self._training = False
-        return self
+        if dataset is None:
+            return self
+        from bigdl_tpu.optim.evaluator import Evaluator
+        return Evaluator(self).test(dataset, methods, batch_size)
+
+    def predict(self, data, batch_size=None):
+        """Forward the model over samples/arrays/a DataSet; returns stacked outputs
+        (reference ``model.predict``)."""
+        from bigdl_tpu.optim.evaluator import Predictor
+        self._training = False
+        return Predictor(self).predict(data, batch_size)
+
+    def predict_class(self, data, batch_size=None):
+        """Argmax class index per sample (reference ``model.predictClass``; 0-based
+        here — this framework uses 0-based labels throughout, unlike the 1-based
+        Torch convention)."""
+        from bigdl_tpu.optim.evaluator import Predictor
+        self._training = False
+        return Predictor(self).predict_class(data, batch_size)
 
     def is_training(self) -> bool:
         return self._training
@@ -298,6 +320,7 @@ class Container(AbstractModule):
 
     def add(self, module: AbstractModule) -> "Container":
         self.modules.append(module)
+        self.__dict__.pop("_cached_fwd_jit", None)  # structure changed
         return self
 
     def __len__(self) -> int:
@@ -345,11 +368,14 @@ class Container(AbstractModule):
             m.training()
         return self
 
-    def evaluate(self) -> "Container":
-        super().evaluate()
+    def evaluate(self, dataset=None, methods=None, batch_size=None):
+        self._training = False
         for m in self.modules:
             m.evaluate()
-        return self
+        if dataset is None:
+            return self
+        from bigdl_tpu.optim.evaluator import Evaluator
+        return Evaluator(self).test(dataset, methods, batch_size)
 
     def reset(self) -> None:
         for m in self.modules:

@@ -1,4 +1,5 @@
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.evaluator import Evaluator, Predictor
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import (
     Adadelta, Adagrad, Adam, Adamax, Ftrl, LBFGS, LarsSGD, OptimMethod, RMSprop, SGD,
@@ -10,6 +11,6 @@ from bigdl_tpu.optim.schedules import (
 )
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
-    AccuracyResult, Loss, LossResult, MAE, Top1Accuracy, Top5Accuracy, TopKAccuracy,
-    ValidationMethod, ValidationResult,
+    AccuracyResult, HitRatio, Loss, LossResult, MAE, NDCG, Top1Accuracy, Top5Accuracy,
+    TopKAccuracy, ValidationMethod, ValidationResult,
 )

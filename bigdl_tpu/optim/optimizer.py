@@ -152,13 +152,8 @@ class Optimizer:
         return jax.jit(self._make_step_fn(), donate_argnums=(0, 1, 2))
 
     def _make_eval_fn(self):
-        model = self.model
-
-        def fwd(params, mstate, inp):
-            out, _ = model.apply(params, mstate, inp, training=False, rng=None)
-            return out
-
-        return jax.jit(fwd)
+        from bigdl_tpu.optim.evaluator import cached_forward_jit
+        return cached_forward_jit(self.model)
 
     def _put_batch(self, batch: MiniBatch):
         return jax.device_put(batch.input), jax.device_put(batch.target)
@@ -271,17 +266,18 @@ class Optimizer:
         return (scope == "epoch") == boundary
 
     def _fire_triggers(self, params, mstate, ostate, state, boundary: bool) -> None:
+        # Stateful-schedule (Plateau) cadence: monitor='score' is fed after each
+        # validation round; monitor='loss' is fed exactly once per epoch boundary
+        # (whether or not validation is configured) — never both for one metric.
+        sched_monitor = getattr(
+            getattr(self.optim_method, "learningrate_schedule", None), "monitor", None)
         if self.val_trigger is not None and self._in_scope(self.val_trigger, boundary) \
                 and self.val_trigger(state):
             self._run_validation(params, mstate, state)
-            self._update_stateful_schedule(ostate, state)
-        # A loss-monitoring Plateau needs no validation set: feed it training loss
-        # once per epoch (the reference's per-epoch Plateau cadence).
-        if boundary:
-            sched = getattr(self.optim_method, "learningrate_schedule", None)
-            if getattr(sched, "stateful", False) \
-                    and getattr(sched, "monitor", "score") != "score":
+            if sched_monitor == "score":
                 self._update_stateful_schedule(ostate, state)
+        if boundary and sched_monitor in ("loss", "Loss"):
+            self._update_stateful_schedule(ostate, state)
         if self.checkpoint_trigger is not None and self.checkpoint_path is not None \
                 and self._in_scope(self.checkpoint_trigger, boundary) \
                 and self.checkpoint_trigger(state):

@@ -127,3 +127,60 @@ class MAE(ValidationMethod):
             out, t = out[:valid], t[:valid]
             n = valid
         return LossResult(float(np.abs(out - t).mean()) * n, n)
+
+
+class HitRatio(ValidationMethod):
+    """HR@k over (1 positive + neg_num negatives) score groups (reference
+    ``<dl>/optim/ValidationMethod.scala`` HitRatio, used by the NCF
+    recommendation example — unverified).
+
+    ``output`` holds one score per candidate item; ``target`` is 1 for the
+    positive item and 0 for sampled negatives. Rows of ``neg_num + 1``
+    candidates are formed in order; the hit rate is the fraction of rows whose
+    positive lands in the top ``k`` scores.
+    """
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+        self.neg_num = neg_num
+        self.name = f"HitRatio@{k}"
+
+    def _ranks(self, output, target, valid):
+        output = np.asarray(output).reshape(-1)
+        target = np.asarray(target).reshape(-1)
+        if valid is not None:
+            output, target = output[:valid], target[:valid]
+        group = self.neg_num + 1
+        if len(output) % group != 0 or len(output) == 0:
+            # silent regrouping across misaligned batches would produce a
+            # plausible-looking but wrong metric — refuse instead
+            raise ValueError(
+                f"{self.name}: got {len(output)} scores, not a positive multiple of "
+                f"neg_num+1={group}; evaluate with batch_size a multiple of {group} "
+                "so every (positive + negatives) group stays within one batch")
+        n_rows = len(output) // group
+        scores = output.reshape(n_rows, group)
+        labels = target.reshape(n_rows, group)
+        pos_idx = labels.argmax(axis=1)
+        pos_score = scores[np.arange(n_rows), pos_idx]
+        # rank = 1 + number of candidates scoring strictly higher
+        return 1 + (scores > pos_score[:, None]).sum(axis=1), n_rows
+
+    def apply(self, output, target, valid: int | None = None):
+        ranks, n = self._ranks(output, target, valid)
+        hits = float((ranks <= self.k).sum())
+        return AccuracyResult(hits, n)
+
+
+class NDCG(HitRatio):
+    """NDCG@k over the same grouped layout as :class:`HitRatio`: one relevant
+    item per group, so DCG reduces to ``log(2)/log(1 + rank)`` within top-k."""
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        super().__init__(k, neg_num)
+        self.name = f"NDCG@{k}"
+
+    def apply(self, output, target, valid: int | None = None):
+        ranks, n = self._ranks(output, target, valid)
+        gains = np.where(ranks <= self.k, np.log(2.0) / np.log(1.0 + ranks), 0.0)
+        return AccuracyResult(float(gains.sum()), n)

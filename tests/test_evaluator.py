@@ -1,0 +1,121 @@
+"""Evaluator/Predictor + HitRatio/NDCG tests."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.optim import Evaluator, HitRatio, Loss, NDCG, Predictor, Top1Accuracy
+from bigdl_tpu.utils.engine import Engine
+
+
+@pytest.fixture(autouse=True)
+def engine():
+    Engine.init(seed=7)
+
+
+def _linear_model():
+    m = nn.Sequential().add(nn.Linear(4, 3)).add(nn.LogSoftMax())
+    return m
+
+
+class TestPredictor:
+    def test_predict_shapes_and_padding(self):
+        model = _linear_model()
+        x = np.random.default_rng(0).normal(size=(10, 4)).astype(np.float32)
+        out = model.predict(x, batch_size=4)  # 10 samples → batches 4+4+2(padded)
+        assert out.shape == (10, 3)
+        # batched prediction equals single-shot forward
+        ref = np.asarray(model.evaluate().forward(x))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_predict_class(self):
+        model = _linear_model()
+        x = np.random.default_rng(0).normal(size=(7, 4)).astype(np.float32)
+        cls = model.predict_class(x, batch_size=3)
+        assert cls.shape == (7,)
+        ref = np.asarray(model.evaluate().forward(x)).argmax(axis=1)
+        np.testing.assert_array_equal(cls, ref)
+
+    def test_predict_dataset_input(self):
+        model = _linear_model()
+        samples = [Sample(np.random.default_rng(i).normal(size=(4,)).astype(np.float32))
+                   for i in range(6)]
+        ds = DataSet.array(samples) >> SampleToMiniBatch(2)
+        out = Predictor(model).predict(ds)
+        assert out.shape == (6, 3)
+
+
+class TestEvaluator:
+    def test_model_evaluate_overload(self):
+        model = _linear_model()
+        rng = np.random.default_rng(1)
+        samples = [Sample(rng.normal(size=(4,)).astype(np.float32),
+                          np.int32(rng.integers(0, 3))) for _ in range(20)]
+        results = model.evaluate(samples, [Top1Accuracy(), Loss()], batch_size=8)
+        assert len(results) == 2
+        (acc, acc_m), (loss, loss_m) = results
+        v, c = acc.result()
+        assert c == 20 and 0.0 <= v <= 1.0
+        lv, lc = loss.result()
+        assert lc == 20 and lv > 0
+
+    def test_perfect_model_accuracy_one(self):
+        # identity-ish model: route feature argmax straight to logits
+        model = nn.Sequential().add(nn.Linear(3, 3)).add(nn.LogSoftMax())
+        model[0].set_params({"weight": np.eye(3, dtype=np.float32) * 10,
+                             "bias": np.zeros(3, np.float32)})
+        samples = [Sample(np.eye(3, dtype=np.float32)[i % 3], np.int32(i % 3))
+                   for i in range(9)]
+        results = Evaluator(model).test(samples, [Top1Accuracy()], batch_size=4)
+        v, c = results[0][0].result()
+        assert v == pytest.approx(1.0) and c == 9
+
+
+class TestRankingMetrics:
+    def test_hit_ratio_known_ranks(self):
+        # 2 groups of (1 pos + 3 negs). Group 1: pos is top-1. Group 2: pos rank 4.
+        output = np.asarray([0.9, 0.1, 0.2, 0.3,
+                             0.1, 0.5, 0.6, 0.7], np.float32)
+        target = np.asarray([1, 0, 0, 0,
+                             1, 0, 0, 0], np.float32)
+        hr = HitRatio(k=2, neg_num=3)
+        v, c = hr.apply(output, target).result()
+        assert c == 2
+        assert v == pytest.approx(0.5)  # only group 1 hits top-2
+
+    def test_ndcg_known_values(self):
+        output = np.asarray([0.9, 0.1, 0.2, 0.3,
+                             0.1, 0.5, 0.6, 0.7], np.float32)
+        target = np.asarray([1, 0, 0, 0,
+                             1, 0, 0, 0], np.float32)
+        ndcg = NDCG(k=10, neg_num=3)
+        v, c = ndcg.apply(output, target).result()
+        # group1 rank 1 → log2/log2 = 1 ; group2 rank 4 → log2/log5
+        expected = (1.0 + np.log(2) / np.log(5)) / 2
+        assert v == pytest.approx(expected, rel=1e-6)
+
+    def test_partial_batch_valid_mask(self):
+        output = np.asarray([0.9, 0.1, 0.2, 0.3, 99.0, 99.0, 99.0, 99.0], np.float32)
+        target = np.asarray([1, 0, 0, 0, 1, 0, 0, 0], np.float32)
+        hr = HitRatio(k=1, neg_num=3)
+        v, c = hr.apply(output, target, valid=4).result()  # second group is padding
+        assert c == 1 and v == pytest.approx(1.0)
+
+    def test_aggregation_across_batches(self):
+        hr = HitRatio(k=1, neg_num=1)
+        r1 = hr.apply(np.asarray([1.0, 0.0]), np.asarray([1, 0]))  # hit
+        r2 = hr.apply(np.asarray([0.0, 1.0]), np.asarray([1, 0]))  # miss
+        v, c = (r1 + r2).result()
+        assert c == 2 and v == pytest.approx(0.5)
+
+    def test_misaligned_batch_raises(self):
+        hr = HitRatio(k=1, neg_num=3)
+        with pytest.raises(ValueError, match="multiple"):
+            hr.apply(np.zeros(6), np.zeros(6))  # 6 % 4 != 0
+
+    def test_methods_required(self):
+        model = _linear_model()
+        with pytest.raises(ValueError, match="methods"):
+            model.evaluate([Sample(np.zeros(4, np.float32))], batch_size=2)

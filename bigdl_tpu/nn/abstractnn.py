@@ -297,6 +297,7 @@ class AbstractModule:
 
     def __getstate__(self):
         d = dict(self.__dict__)
+        d.pop("_cached_fwd_jit", None)  # jitted closures don't pickle
         d["_apply_cache"] = {}
         d["_params"] = {k: np.asarray(v) for k, v in self._params.items()}
         d["_grads"] = {k: np.asarray(v) for k, v in self._grads.items()}
@@ -369,13 +370,9 @@ class Container(AbstractModule):
         return self
 
     def evaluate(self, dataset=None, methods=None, batch_size=None):
-        self._training = False
         for m in self.modules:
             m.evaluate()
-        if dataset is None:
-            return self
-        from bigdl_tpu.optim.evaluator import Evaluator
-        return Evaluator(self).test(dataset, methods, batch_size)
+        return super().evaluate(dataset, methods, batch_size)
 
     def reset(self) -> None:
         for m in self.modules:

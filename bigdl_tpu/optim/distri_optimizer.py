@@ -45,11 +45,20 @@ class DistriOptimizer(Optimizer):
         self.metrics = Metrics()
         self._mesh = None
         self._batch_sh = None
+        self.tp_rules = None
 
     def set_parameter_sync(self, mode: str) -> "DistriOptimizer":
         if mode not in ("allreduce", "zero1"):
             raise ValueError("parameter_sync must be 'allreduce' or 'zero1'")
         self.parameter_sync = mode
+        return self
+
+    def set_tensor_parallel(self, rules) -> "DistriOptimizer":
+        """Enable tensor parallelism: ``rules`` is a
+        :class:`~bigdl_tpu.parallel.TPRules` mapping parameter paths to
+        PartitionSpecs over the mesh's ``model`` axis. XLA's SPMD partitioner
+        splits the matmuls and inserts the activation collectives."""
+        self.tp_rules = rules
         return self
 
     # ------------------------------------------------------------- compile
@@ -65,10 +74,20 @@ class DistriOptimizer(Optimizer):
         params = self.model.get_params()
         # shapes only — no device allocation for the throwaway state
         ostate_shapes = jax.eval_shape(self.optim_method.init_state, params)
-        param_sh = jax.tree_util.tree_map(lambda _: repl, params)
+        if self.tp_rules is not None:
+            param_sh = self.tp_rules.param_shardings(params, self._mesh)
+        else:
+            param_sh = jax.tree_util.tree_map(lambda _: repl, params)
         mstate_sh = jax.tree_util.tree_map(lambda _: repl, self.model.get_state())
-        if self.parameter_sync == "zero1":
-            ostate_sh = zero1_state_sharding(self._mesh, ostate_shapes, Engine.DATA_AXIS)
+        if self.tp_rules is not None:
+            # TP slots always mirror the param sharding; unmatched slots get
+            # ZeRO-1 data sharding or replication per the sync mode
+            dp_axis = Engine.DATA_AXIS if self.parameter_sync == "zero1" else None
+            ostate_sh = self.tp_rules.slot_shardings(ostate_shapes, self._mesh,
+                                                     dp_axis)
+        elif self.parameter_sync == "zero1":
+            ostate_sh = zero1_state_sharding(self._mesh, ostate_shapes,
+                                             Engine.DATA_AXIS)
         else:
             ostate_sh = jax.tree_util.tree_map(lambda _: repl, ostate_shapes)
         self._shardings = (param_sh, mstate_sh, ostate_sh)

@@ -203,13 +203,14 @@ class _Pooling2D(KerasLayer):
         self.border_mode = border_mode
 
     def build(self, input_shape):
-        ph, pw = ((self.pool_size[0] - 1) // 2, (self.pool_size[1] - 1) // 2) \
-            if self.border_mode == "same" else (0, 0)
-        pool = self._op(self.pool_size[1], self.pool_size[0],
-                        self.strides[1], self.strides[0], pw, ph)
         if self.border_mode == "same":
-            pool.ceil()
-        return pool
+            # SAME = ceil(h/s) per dimension; the pooling primitive computes the exact
+            # asymmetric lo/hi padding itself (pad_mode="same"), which is correct for
+            # odd, even, and mixed pool sizes alike — no ceil-mode double counting.
+            return self._op(self.pool_size[1], self.pool_size[0],
+                            self.strides[1], self.strides[0], pad_mode="same")
+        return self._op(self.pool_size[1], self.pool_size[0],
+                        self.strides[1], self.strides[0], 0, 0)
 
     def compute_output_shape(self, input_shape):
         c, h, w = input_shape

@@ -35,15 +35,31 @@ def _pad_amounts(in_size: int, k: int, s: int, p: int, ceil_mode: bool):
     return p, max(needed, 0), out
 
 
+def _same_pad(in_size: int, k: int, s: int):
+    """TF/Keras SAME padding: out = ceil(in/s), asymmetric lo/hi split per dimension.
+
+    ``lax.reduce_window`` takes arbitrary (lo, hi) pads, so SAME needs no ceil-mode
+    trickery — it is exact for every kernel parity and stride.
+    """
+    out = -(-in_size // s)
+    total = max((out - 1) * s + k - in_size, 0)
+    lo = total // 2
+    return lo, total - lo
+
+
 class SpatialMaxPooling(TensorModule):
     def __init__(self, kw: int, kh: int, dw: int | None = None, dh: int | None = None,
-                 pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False):
+                 pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,
+                 pad_mode: str = "torch"):
         super().__init__()
         self.kw, self.kh = kw, kh
         self.dw = dw if dw is not None else kw
         self.dh = dh if dh is not None else kh
         self.pad_w, self.pad_h = pad_w, pad_h
         self.ceil_mode = ceil_mode
+        if pad_mode not in ("torch", "same"):
+            raise ValueError(f"pad_mode must be torch|same, got {pad_mode!r}")
+        self.pad_mode = pad_mode
 
     def ceil(self) -> "SpatialMaxPooling":
         self.ceil_mode = True
@@ -59,8 +75,12 @@ class SpatialMaxPooling(TensorModule):
         if squeeze:
             x = x[None]
         h, w = x.shape[2], x.shape[3]
-        ph_lo, ph_hi, _ = _pad_amounts(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
-        pw_lo, pw_hi, _ = _pad_amounts(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
+        if self.pad_mode == "same":
+            ph_lo, ph_hi = _same_pad(h, self.kh, self.dh)
+            pw_lo, pw_hi = _same_pad(w, self.kw, self.dw)
+        else:
+            ph_lo, ph_hi, _ = _pad_amounts(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
+            pw_lo, pw_hi, _ = _pad_amounts(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
         out = lax.reduce_window(
             x, -jnp.inf, lax.max,
             window_dimensions=(1, 1, self.kh, self.kw),
@@ -80,7 +100,7 @@ class SpatialAveragePooling(TensorModule):
     def __init__(self, kw: int, kh: int, dw: int | None = None, dh: int | None = None,
                  pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,
                  count_include_pad: bool = True, divide: bool = True,
-                 global_pooling: bool = False):
+                 global_pooling: bool = False, pad_mode: str = "torch"):
         super().__init__()
         self.kw, self.kh = kw, kh
         self.dw = dw if dw is not None else kw
@@ -90,6 +110,12 @@ class SpatialAveragePooling(TensorModule):
         self.count_include_pad = count_include_pad
         self.divide = divide
         self.global_pooling = global_pooling
+        if pad_mode not in ("torch", "same"):
+            raise ValueError(f"pad_mode must be torch|same, got {pad_mode!r}")
+        if pad_mode == "same" and global_pooling:
+            raise ValueError("pad_mode='same' is meaningless with global_pooling "
+                             "(the window already covers the whole input)")
+        self.pad_mode = pad_mode
 
     def ceil(self) -> "SpatialAveragePooling":
         self.ceil_mode = True
@@ -103,8 +129,16 @@ class SpatialAveragePooling(TensorModule):
         h, w = x.shape[2], x.shape[3]
         kh, kw = (h, w) if self.global_pooling else (self.kh, self.kw)
         dh, dw = (1, 1) if self.global_pooling else (self.dh, self.dw)
-        ph_lo, ph_hi, _ = _pad_amounts(h, kh, dh, self.pad_h, self.ceil_mode)
-        pw_lo, pw_hi, _ = _pad_amounts(w, kw, dw, self.pad_w, self.ceil_mode)
+        if self.pad_mode == "same":
+            # TF/Keras SAME semantics: padded positions never count toward the average.
+            ph_lo, ph_hi = _same_pad(h, kh, dh)
+            pw_lo, pw_hi = _same_pad(w, kw, dw)
+            include_pad_in_count = False
+        else:
+            ph_lo, ph_hi, _ = _pad_amounts(h, kh, dh, self.pad_h, self.ceil_mode)
+            pw_lo, pw_hi, _ = _pad_amounts(w, kw, dw, self.pad_w, self.ceil_mode)
+            include_pad_in_count = self.count_include_pad and (
+                self.pad_h > 0 or self.pad_w > 0)
         pad = ((0, 0), (0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi))
         sums = lax.reduce_window(
             x, 0.0, lax.add,
@@ -112,9 +146,10 @@ class SpatialAveragePooling(TensorModule):
             window_strides=(1, 1, dh, dw),
             padding=pad,
         )
+        no_pad = ph_lo == ph_hi == pw_lo == pw_hi == 0
         if not self.divide:
             out = sums
-        elif self.count_include_pad and (self.pad_h > 0 or self.pad_w > 0):
+        elif include_pad_in_count or no_pad:
             out = sums / float(kh * kw)
         else:
             ones = jnp.ones((1, 1, h, w), x.dtype)

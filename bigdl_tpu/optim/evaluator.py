@@ -40,6 +40,22 @@ def cached_forward_jit(model):
     return fn
 
 
+def _put_eval_batch(inp):
+    """Place an inference batch (array or pytree of feature arrays): sharded over
+    the mesh's data axis when a multi-device mesh is live and the batch divides
+    evenly (the SPMD partitioner then splits the forward like DistriOptimizer's
+    step), else default device."""
+    from bigdl_tpu.dataset.sample import _batch_dim
+
+    mesh = Engine.mesh()
+    if mesh is not None and Engine.DATA_AXIS in mesh.axis_names:
+        n_dev = int(dict(mesh.shape)[Engine.DATA_AXIS])
+        if n_dev > 1 and _batch_dim(inp) % n_dev == 0:
+            from bigdl_tpu.parallel.sharding import batch_sharding
+            return jax.device_put(inp, batch_sharding(mesh, Engine.DATA_AXIS))
+    return jax.device_put(inp)
+
+
 def _as_dataset(data, batch_size: Optional[int]) -> AbstractDataSet:
     """Accept a DataSet (already batched), a list of Samples, or a numpy array."""
     if isinstance(data, AbstractDataSet):
@@ -73,7 +89,7 @@ class Predictor:
         outs = []
         for batch in dataset.data(train=False):
             out = np.asarray(jax.device_get(fwd(params, mstate,
-                                                jax.device_put(batch.input))))
+                                                _put_eval_batch(batch.input))))
             outs.append(out[: batch.valid])
         if not outs:
             raise ValueError("empty dataset")
@@ -102,7 +118,7 @@ class Evaluator:
         params, mstate = self.model.get_params(), self.model.get_state()
         results: list[Optional[ValidationResult]] = [None] * len(methods)
         for batch in dataset.data(train=False):
-            out = jax.device_get(fwd(params, mstate, jax.device_put(batch.input)))
+            out = jax.device_get(fwd(params, mstate, _put_eval_batch(batch.input)))
             target = np.asarray(batch.target)
             for i, m in enumerate(methods):
                 r = m.apply(np.asarray(out), target, batch.valid)

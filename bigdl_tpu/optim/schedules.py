@@ -206,6 +206,11 @@ class Plateau(LearningRateSchedule):
         """Record a monitored value; return the (possibly reduced) current LR."""
         if self.current_lr is None:
             raise RuntimeError("Plateau.reset(base_lr) must be called before on_metric")
+        # Keras-exact cooldown semantics (ReduceLROnPlateau): the counter is
+        # decremented first and the patience guard reads the *decremented* value,
+        # so the round on which cooldown expires DOES count toward patience.
+        # (A round-1 advisor note suggested snapshotting pre-decrement; that
+        # mis-stated Keras and was declined — see tests/test_advice_fixes.py.)
         if self._cooldown_left > 0:
             self._cooldown_left -= 1
             self._wait = 0

@@ -161,6 +161,14 @@ class HitRatio(ValidationMethod):
         n_rows = len(output) // group
         scores = output.reshape(n_rows, group)
         labels = target.reshape(n_rows, group)
+        if not (labels.max(axis=1) > 0).all():
+            # argmax on an all-zero row would silently crown candidate 0 the
+            # "positive" and inflate the metric — refuse, like the alignment
+            # check above
+            raise ValueError(
+                f"{self.name}: found a candidate group with no positive label "
+                "(every label 0); each neg_num+1 group must contain exactly one "
+                "positive item")
         pos_idx = labels.argmax(axis=1)
         pos_score = scores[np.arange(n_rows), pos_idx]
         # rank = 1 + number of candidates scoring strictly higher

@@ -100,18 +100,30 @@ class ImageFrame:
 class FeatureTransformer(Transformer):
     """Per-record transformer; compose with ``>>`` (the reference's ``->``)."""
 
+    # Monotonic per-instance salt: transformers built from the same Engine seed must
+    # still draw *decorrelated* streams (Brightness/Contrast/Saturation inside one
+    # ColorJitter would otherwise make identical random picks). Reproducibility is
+    # preserved: construction order is deterministic for a fixed pipeline.
+    _instance_counter = 0
+
     def __init__(self):
         self._rng = np.random.default_rng(self._seed())
 
-    @staticmethod
-    def _seed() -> int:
+    @classmethod
+    def _next_salt(cls) -> int:
+        FeatureTransformer._instance_counter += 1
+        return FeatureTransformer._instance_counter
+
+    @classmethod
+    def _seed(cls):
+        salt = cls._next_salt()
         try:
             from bigdl_tpu.utils.engine import Engine
             if Engine.is_initialized():
-                return Engine.config().seed
+                return [Engine.config().seed, salt]
         except Exception:
             pass
-        return int.from_bytes(os.urandom(4), "little")
+        return [int.from_bytes(os.urandom(4), "little"), salt]
 
     def set_seed(self, seed: int) -> "FeatureTransformer":
         self._rng = np.random.default_rng(seed)

@@ -1,0 +1,139 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as N
+from bigdl_tpu.nn.keras.layers import AveragePooling2D, MaxPooling2D
+
+
+class TestSamePooling:
+    """SAME-mode pooling must produce exactly ceil(h/s) x ceil(w/s) for every
+    kernel parity (odd, even, mixed) — the round-1 bug double-counted by
+    combining symmetric pad with ceil mode for odd pools."""
+
+    @pytest.mark.parametrize("pool,stride,hw", [
+        ((3, 3), (2, 2), (4, 4)),    # the reported failing case: must be 2x2, not 3x3
+        ((3, 3), (2, 2), (5, 7)),
+        ((2, 2), (2, 2), (4, 4)),
+        ((2, 2), (1, 1), (4, 4)),    # even kernel stride 1: needs asymmetric pad
+        ((2, 3), (2, 2), (5, 6)),    # mixed even/odd per-dimension
+        ((3, 2), (1, 2), (6, 5)),
+    ])
+    @pytest.mark.parametrize("cls", [MaxPooling2D, AveragePooling2D])
+    def test_shape_matches_keras_same(self, cls, pool, stride, hw):
+        h, w = hw
+        layer = cls(pool_size=pool, strides=stride, border_mode="same")
+        reported = layer.compute_output_shape((3, h, w))
+        sh, sw = stride
+        assert reported == (3, -(-h // sh), -(-w // sw))
+        mod = layer.build((3, h, w))
+        x = np.random.default_rng(0).normal(size=(2, 3, h, w)).astype(np.float32)
+        out, _ = mod.apply(mod.get_params(), mod.get_state(), x)
+        assert out.shape[1:] == reported
+
+    def test_max_values_odd_pool(self):
+        # 1x1x4x4 ramp, pool 3 stride 2 SAME: TF pads lo=0, hi=1 each dim
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        mod = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                           border_mode="same").build((1, 4, 4))
+        out, _ = mod.apply(mod.get_params(), mod.get_state(), x)
+        np.testing.assert_allclose(np.asarray(out)[0, 0],
+                                   [[10.0, 11.0], [14.0, 15.0]])
+
+    def test_avg_excludes_pad_from_count(self):
+        # ones input: SAME average must stay exactly 1.0 everywhere (TF counts
+        # only real elements under the window, never the zero padding)
+        x = np.ones((1, 1, 5, 5), np.float32)
+        mod = AveragePooling2D(pool_size=(3, 3), strides=(2, 2),
+                               border_mode="same").build((1, 5, 5))
+        out, _ = mod.apply(mod.get_params(), mod.get_state(), x)
+        np.testing.assert_allclose(np.asarray(out), np.ones((1, 1, 3, 3)), atol=1e-6)
+
+
+class TestTransformerSeeds:
+    def test_instances_draw_different_streams(self):
+        from bigdl_tpu.transform.vision.image import Brightness, Contrast, Saturation
+        from bigdl_tpu.utils.engine import Engine
+
+        Engine.init(backend="cpu")
+        parts = [Brightness(-0.2, 0.2), Contrast(0.8, 1.2), Saturation(0.8, 1.2)]
+        draws = [t._rng.uniform() for t in parts]
+        assert len(set(draws)) == 3, f"correlated streams: {draws}"
+
+
+class TestPlateauCooldown:
+    def test_cooldown_semantics_match_keras(self):
+        """Keras ReduceLROnPlateau decrements the cooldown counter and then reads
+        the DECREMENTED value in the patience guard: with cooldown=1 the very next
+        round both expires cooldown and counts wait=1. (The round-1 advisor note
+        claiming otherwise was checked against Keras and declined.)"""
+        from bigdl_tpu.optim.schedules import Plateau
+
+        s = Plateau(monitor="score", factor=0.5, patience=2, mode="min",
+                    epsilon=0.0, cooldown=1, min_lr=0.0)
+        s.reset(1.0)
+        s.on_metric(1.0)          # best=1.0
+        s.on_metric(2.0)          # wait=1
+        s.on_metric(2.0)          # wait=2
+        lr = s.on_metric(2.0)     # wait=3 > patience → reduce, cooldown=1
+        assert lr == 0.5
+        lr = s.on_metric(2.0)     # cooldown expires AND wait=1 (Keras-exact)
+        assert lr == 0.5 and s._wait == 1
+        s.on_metric(2.0)          # wait=2
+        lr = s.on_metric(2.0)     # wait=3 → second reduction
+        assert lr == 0.25
+
+    def test_long_cooldown_rounds_skip_patience(self):
+        from bigdl_tpu.optim.schedules import Plateau
+
+        s = Plateau(monitor="score", factor=0.5, patience=1, mode="min",
+                    epsilon=0.0, cooldown=3, min_lr=0.0)
+        s.reset(1.0)
+        s.on_metric(1.0)
+        s.on_metric(2.0)          # wait=1
+        lr = s.on_metric(2.0)     # wait=2 > 1 → reduce, cooldown=3
+        assert lr == 0.5
+        assert s.on_metric(2.0) == 0.5 and s._wait == 0  # cooldown 3→2: skipped
+        assert s.on_metric(2.0) == 0.5 and s._wait == 0  # cooldown 2→1: skipped
+        assert s.on_metric(2.0) == 0.5 and s._wait == 1  # 1→0: expiry counts
+
+
+class TestHitRatioZeroLabels:
+    def test_all_zero_group_raises(self):
+        from bigdl_tpu.optim.validation import HitRatio
+
+        m = HitRatio(k=2, neg_num=3)
+        scores = np.random.default_rng(0).normal(size=(8,)).astype(np.float32)
+        labels = np.zeros(8, np.float32)
+        labels[1] = 1.0  # first group ok, second group all-zero
+        with pytest.raises(ValueError, match="no positive"):
+            m.apply(scores, labels)
+
+
+class TestEvaluatorSharding:
+    def test_eval_batch_sharded_over_mesh(self):
+        import jax
+
+        from bigdl_tpu.optim.evaluator import _put_eval_batch
+        from bigdl_tpu.utils.engine import Engine
+
+        Engine.init(backend="cpu")
+        n = Engine.device_count()
+        assert n == 8
+        arr = np.ones((16, 4), np.float32)
+        placed = _put_eval_batch(arr)
+        assert len(placed.sharding.device_set) == n
+        # non-divisible batch falls back to single-device placement
+        odd = _put_eval_batch(np.ones((15, 4), np.float32))
+        assert len(odd.sharding.device_set) == 1
+
+    def test_multi_input_tuple_batch(self):
+        from bigdl_tpu.optim.evaluator import _put_eval_batch
+        from bigdl_tpu.utils.engine import Engine
+
+        Engine.init(backend="cpu")
+        # tuple of differently-shaped features: batch dim read from first leaf
+        placed = _put_eval_batch((np.ones((16, 4), np.float32),
+                                  np.ones((16, 2, 3), np.float32)))
+        assert all(len(p.sharding.device_set) == 8 for p in placed)

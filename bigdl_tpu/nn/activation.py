@@ -179,17 +179,26 @@ class AddConstant(TensorModule):
 
 
 class LogSoftMax(TensorModule):
-    """Log-softmax over the last axis for (N, C) or 1-D input (reference semantics)."""
+    """Log-softmax over the last axis for (N, C) or 1-D input (reference semantics).
+
+    fp32 island (nn/precision.py): the exp/sum/log normalisation runs — and the
+    output STAYS — in fp32 even under a bf16 compute dtype, so criterions always
+    see full-precision log-probabilities. The upcast is free next to the loss.
+    """
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        return jax.nn.log_softmax(input, axis=-1), state
+        return jax.nn.log_softmax(input.astype(jnp.float32), axis=-1), state
 
 
 class SoftMax(TensorModule):
+    """fp32 island under mixed precision — see :class:`LogSoftMax`."""
+
     def apply(self, params, state, input, *, training=False, rng=None):
-        return jax.nn.softmax(input, axis=-1), state
+        return jax.nn.softmax(input.astype(jnp.float32), axis=-1), state
 
 
 class SoftMin(TensorModule):
+    """fp32 island under mixed precision — see :class:`LogSoftMax`."""
+
     def apply(self, params, state, input, *, training=False, rng=None):
-        return jax.nn.softmax(-input, axis=-1), state
+        return jax.nn.softmax(-input.astype(jnp.float32), axis=-1), state

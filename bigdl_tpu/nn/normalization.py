@@ -74,9 +74,14 @@ class BatchNormalization(TensorModule):
         x = input
         axes = self._reduce_axes(x)
         shape = self._bshape(x)
+        # fp32 island under mixed precision: batch statistics are reductions over
+        # the whole batch — computing them in bf16 loses ~3 decimal digits, and the
+        # running buffers are fp32 masters anyway. Normalisation happens in fp32;
+        # only the (cheap, fusable) elementwise tail is cast back.
+        x32 = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
         if training:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)  # biased, used for normalisation (Torch)
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)  # biased, used for normalisation (Torch)
             n = x.size // self.n_output
             unbiased = var * (n / max(n - 1, 1))
             m = self.momentum
@@ -88,10 +93,12 @@ class BatchNormalization(TensorModule):
             mean, var = state["running_mean"], state["running_var"]
             new_state = state
         inv = jax.lax.rsqrt(var + self.eps).reshape(shape)
-        out = (x - mean.reshape(shape)) * inv
+        out = (x32 - mean.reshape(shape)) * inv
         if self.affine:
-            out = out * params["weight"].reshape(shape) + params["bias"].reshape(shape)
-        return out, new_state
+            w = params["weight"].astype(jnp.float32)
+            b = params["bias"].astype(jnp.float32)
+            out = out * w.reshape(shape) + b.reshape(shape)
+        return out.astype(x.dtype), new_state
 
     def __repr__(self):
         return f"{type(self).__name__}({self.n_output})"

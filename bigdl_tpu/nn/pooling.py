@@ -140,8 +140,12 @@ class SpatialAveragePooling(TensorModule):
             include_pad_in_count = self.count_include_pad and (
                 self.pad_h > 0 or self.pad_w > 0)
         pad = ((0, 0), (0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi))
+        # fp32 island (nn/precision.py): window sums are reductions — under bf16
+        # a global pool over H*W values would lose ~1% relative accuracy, so
+        # accumulate fp32 and cast back at the end (same rule as BN statistics).
+        x32 = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
         sums = lax.reduce_window(
-            x, 0.0, lax.add,
+            x32, 0.0, lax.add,
             window_dimensions=(1, 1, kh, kw),
             window_strides=(1, 1, dh, dw),
             padding=pad,
@@ -152,7 +156,7 @@ class SpatialAveragePooling(TensorModule):
         elif include_pad_in_count or no_pad:
             out = sums / float(kh * kw)
         else:
-            ones = jnp.ones((1, 1, h, w), x.dtype)
+            ones = jnp.ones((1, 1, h, w), jnp.float32)
             counts = lax.reduce_window(
                 ones, 0.0, lax.add,
                 window_dimensions=(1, 1, kh, kw),
@@ -160,6 +164,7 @@ class SpatialAveragePooling(TensorModule):
                 padding=pad,
             )
             out = sums / jnp.maximum(counts, 1.0)
+        out = out.astype(x.dtype)
         if squeeze:
             out = out[0]
         return out, state

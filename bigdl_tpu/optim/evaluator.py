@@ -26,33 +26,45 @@ from bigdl_tpu.utils.engine import Engine
 
 
 def cached_forward_jit(model):
-    """One jitted inference forward per model instance — repeat predict/evaluate
-    calls (e.g. a serving loop) reuse the compiled executable instead of
-    retracing. Container.add invalidates the cache on structure change."""
-    fn = model.__dict__.get("_cached_fwd_jit")
+    """One jitted inference forward per (model, compute dtype) — repeat
+    predict/evaluate calls (e.g. a serving loop) reuse the compiled executable
+    instead of retracing. Container.add invalidates the cache on structure
+    change. Inference honors the Engine compute dtype the same way training
+    does: bf16 matmuls, fp32 outputs for the ValidationMethods."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.precision import cast_floating
+
+    compute_dtype = Engine.compute_dtype()
+    cache = model.__dict__.setdefault("_cached_fwd_jit", {})
+    fn = cache.get(jnp.dtype(compute_dtype).name)
     if fn is None:
+        mixed = compute_dtype != jnp.float32
+
         def fwd(params, mstate, inp):
+            if mixed:
+                params = cast_floating(params, compute_dtype)
+                inp = cast_floating(inp, compute_dtype)
             out, _ = model.apply(params, mstate, inp, training=False, rng=None)
-            return out
+            return cast_floating(out, jnp.float32) if mixed else out
 
         fn = jax.jit(fwd)
-        model.__dict__["_cached_fwd_jit"] = fn
+        cache[jnp.dtype(compute_dtype).name] = fn
     return fn
 
 
 def _put_eval_batch(inp):
-    """Place an inference batch (array or pytree of feature arrays): sharded over
-    the mesh's data axis when a multi-device mesh is live and the batch divides
-    evenly (the SPMD partitioner then splits the forward like DistriOptimizer's
-    step), else default device."""
-    from bigdl_tpu.dataset.sample import _batch_dim
-
+    """Place an inference batch (array or pytree of feature arrays): batch dim
+    sharded over the mesh's data axis when it divides evenly (the SPMD
+    partitioner then splits the forward like DistriOptimizer's step), else
+    default device. The divisibility policy is shard_leading_axis — one copy."""
     mesh = Engine.mesh()
-    if mesh is not None and Engine.DATA_AXIS in mesh.axis_names:
-        n_dev = int(dict(mesh.shape)[Engine.DATA_AXIS])
-        if n_dev > 1 and _batch_dim(inp) % n_dev == 0:
-            from bigdl_tpu.parallel.sharding import batch_sharding
-            return jax.device_put(inp, batch_sharding(mesh, Engine.DATA_AXIS))
+    if mesh is not None and Engine.DATA_AXIS in mesh.axis_names \
+            and int(dict(mesh.shape)[Engine.DATA_AXIS]) > 1:
+        from bigdl_tpu.parallel.sharding import shard_leading_axis
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, shard_leading_axis(mesh, np.shape(x), Engine.DATA_AXIS)), inp)
     return jax.device_put(inp)
 
 

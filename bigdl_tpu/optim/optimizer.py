@@ -131,14 +131,29 @@ class Optimizer:
         return grads
 
     def _make_step_fn(self):
+        from bigdl_tpu.nn.precision import cast_floating
+
         model, criterion, method = self.model, self.criterion, self.optim_method
         needs_rng = model.needs_rng()
+        # Mixed precision (nn/precision.py): params stay fp32 masters; the casts
+        # below put the matmul/conv FLOPs in the compute dtype (bf16 → MXU double
+        # rate) while the cast's transpose returns fp32 gradients, and the loss /
+        # criterion softmax stays fp32.
+        compute_dtype = Engine.compute_dtype()
+        mixed = compute_dtype != jnp.float32
 
         def step(params, mstate, ostate, step_idx, inp, target, base_rng):
             rng = jax.random.fold_in(base_rng, step_idx) if needs_rng else None
 
             def loss_fn(p):
-                out, new_ms = model.apply(p, mstate, inp, training=True, rng=rng)
+                x = inp
+                if mixed:
+                    p = cast_floating(p, compute_dtype)
+                    x = cast_floating(x, compute_dtype)
+                out, new_ms = model.apply(p, mstate, x, training=True, rng=rng)
+                if mixed:
+                    out = cast_floating(out, jnp.float32)
+                    new_ms = cast_floating(new_ms, jnp.float32)
                 return criterion.apply(out, target), new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)

@@ -45,7 +45,11 @@ def _ring_attention_kernel(q, k, v, *, axis_name: str, causal: bool, scale: floa
         o, l, m, k_blk, v_blk = carry
         # the block currently held originated on device (rank - i) mod n
         src = (rank - i) % n
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        # fp32 islands: scores and the streaming-softmax accumulators (m, l, o)
+        # stay fp32 across all n ring steps; the two matmuls run in the input
+        # dtype with fp32 accumulation (MXU-native under bf16).
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = src * t_local + jnp.arange(t_local)
             mask = q_pos[:, None] >= k_pos[None, :]
@@ -54,18 +58,20 @@ def _ring_attention_kernel(q, k, v, *, axis_name: str, causal: bool, scale: floa
         p = jnp.exp(s - m_new[..., None])
         correction = jnp.exp(m - m_new)
         l_new = l * correction + p.sum(axis=-1)
-        o_new = o * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        o_new = o * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
         # rotate K/V to the neighbor for the next step (skipped result unused on last)
         k_next = lax.ppermute(k_blk, axis_name, perm)
         v_next = lax.ppermute(v_blk, axis_name, perm)
         return o_new, l_new, m_new, k_next, v_next
 
     # derive accumulators from q so they carry shard_map's varying-axis tag
-    o0 = jnp.zeros_like(q)
-    l0 = q[..., 0] * 0.0
-    m0 = q[..., 0] * 0.0 + _NEG_INF
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+    l0 = (q[..., 0] * 0.0).astype(jnp.float32)
+    m0 = l0 + _NEG_INF
     o, l, m, _, _ = lax.fori_loop(0, n, step, (o0, l0, m0, k, v))
-    return o / jnp.maximum(l, 1e-30)[..., None]
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh: Optional[Mesh] = None, seq_axis: str = "seq",
@@ -106,12 +112,21 @@ def _present_axis(axes: dict, batch: int, name: str = "data"):
 
 
 def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
-    """Single-device reference attention (also the oracle in tests)."""
+    """Single-device reference attention (also the oracle in tests).
+
+    Mixed-precision contract: the two matmuls run in the input dtype (bf16 →
+    MXU double rate) with fp32 accumulation (``preferred_element_type`` — the
+    MXU accumulates fp32 natively, this just keeps XLA from truncating), and the
+    softmax itself is an fp32 island. Output returns in the input dtype.
+    """
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         t_q, t_k = s.shape[-2], s.shape[-1]
         mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)

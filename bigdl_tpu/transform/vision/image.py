@@ -100,23 +100,19 @@ class ImageFrame:
 class FeatureTransformer(Transformer):
     """Per-record transformer; compose with ``>>`` (the reference's ``->``)."""
 
-    # Monotonic per-instance salt: transformers built from the same Engine seed must
-    # still draw *decorrelated* streams (Brightness/Contrast/Saturation inside one
-    # ColorJitter would otherwise make identical random picks). Reproducibility is
-    # preserved: construction order is deterministic for a fixed pipeline.
-    _instance_counter = 0
+    # Per-instance salt (RandomGenerator.next_salt): transformers built from the
+    # same Engine seed must still draw *decorrelated* streams (Brightness/Contrast/
+    # Saturation inside one ColorJitter would otherwise make identical random
+    # picks). The salt counter resets with RandomGenerator.set_seed, so an
+    # identically-seeded run rebuilding the same pipeline reproduces exactly.
 
     def __init__(self):
         self._rng = np.random.default_rng(self._seed())
 
     @classmethod
-    def _next_salt(cls) -> int:
-        FeatureTransformer._instance_counter += 1
-        return FeatureTransformer._instance_counter
-
-    @classmethod
     def _seed(cls):
-        salt = cls._next_salt()
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        salt = RandomGenerator.next_salt()
         try:
             from bigdl_tpu.utils.engine import Engine
             if Engine.is_initialized():

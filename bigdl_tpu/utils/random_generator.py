@@ -25,6 +25,7 @@ class RandomGenerator:
     _seed: int = 1
     _np: np.random.Generator = np.random.default_rng(1)
     _key_counter: int = 0
+    _salt_counter: int = 0
     _base_key = None  # lazily-built jax PRNGKey for the current seed
 
     @classmethod
@@ -33,6 +34,7 @@ class RandomGenerator:
             cls._seed = int(seed)
             cls._np = np.random.default_rng(cls._seed)
             cls._key_counter = 0
+            cls._salt_counter = 0
             cls._base_key = None
 
     @classmethod
@@ -59,6 +61,15 @@ class RandomGenerator:
     def bernoulli(cls, p: float, shape) -> np.ndarray:
         with cls._lock:
             return (cls._np.random(shape) < p).astype(np.float32)
+
+    @classmethod
+    def next_salt(cls) -> int:
+        """Monotonic per-construction salt (host-side decorrelation, e.g. vision
+        transformers sharing the Engine seed). Resets with ``set_seed`` so an
+        identically-seeded, identically-ordered pipeline reproduces exactly."""
+        with cls._lock:
+            cls._salt_counter += 1
+            return cls._salt_counter
 
     # JAX keys for traced randomness ---------------------------------------
     @classmethod

@@ -1,0 +1,149 @@
+"""bf16 mixed-precision policy (nn/precision.py): fp32 masters, bf16 compute,
+fp32 islands, convergence parity vs fp32."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as N
+from bigdl_tpu.nn.precision import cast_floating
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _make_dataset(n=256, seed=0):
+    """Linearly-separable-ish synthetic 2-class image blobs."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 1, 8, 8)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    x[y == 1] += 0.5
+    return x, y
+
+
+def _small_model():
+    return (N.Sequential()
+            .add(N.SpatialConvolution(1, 8, 3, 3, 1, 1, 1, 1))
+            .add(N.SpatialBatchNormalization(8))
+            .add(N.ReLU())
+            .add(N.SpatialMaxPooling(2, 2))
+            .add(N.Reshape([8 * 4 * 4]))
+            .add(N.Linear(8 * 4 * 4, 2))
+            .add(N.LogSoftMax()))
+
+
+def _train(compute_dtype, steps=40):
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.optim.trigger import Trigger
+
+    Engine.reset()
+    Engine.init(backend="cpu", compute_dtype=compute_dtype)
+    RandomGenerator.set_seed(42)
+    x, y = _make_dataset()
+    samples = [Sample(x[i], y[i]) for i in range(len(x))]
+    ds = DataSet.array(samples) >> SampleToMiniBatch(64)
+    model = _small_model()
+    opt = LocalOptimizer(model, ds, N.ClassNLLCriterion())
+    opt.set_optim_method(SGD(learningrate=0.1))
+    opt.set_end_when(Trigger.max_iteration(steps))
+    opt.optimize()
+    return model, opt.state["loss"]
+
+
+class TestCastHelpers:
+    def test_cast_floating_skips_ints(self):
+        tree = {"w": jnp.ones((2,), jnp.float32), "idx": jnp.ones((2,), jnp.int32)}
+        out = cast_floating(tree, jnp.bfloat16)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["idx"].dtype == jnp.int32
+
+
+class TestFp32Islands:
+    def test_log_softmax_is_fp32_island(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 10)) * 8,
+                        jnp.bfloat16)
+        m = N.LogSoftMax()
+        out, _ = m.apply({}, {}, x)
+        assert out.dtype == jnp.float32
+        ref, _ = m.apply({}, {}, x.astype(jnp.float32))
+        # normalisation error must be fp32-level, not bf16-level
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    def test_avg_pool_accumulates_fp32(self):
+        # global average over 196 elements: bf16 running sum would drift ~1%
+        x32 = np.random.default_rng(3).normal(size=(2, 4, 14, 14)).astype(np.float32)
+        pool = N.SpatialAveragePooling(14, 14)
+        ref, _ = pool.apply({}, {}, jnp.asarray(x32))
+        got, _ = pool.apply({}, {}, jnp.asarray(x32, jnp.bfloat16))
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(ref),
+                                   atol=1e-2)
+
+    def test_batchnorm_stats_fp32_under_bf16(self):
+        bn = N.SpatialBatchNormalization(4)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 4, 5, 5)),
+                        jnp.bfloat16)
+        params = cast_floating(bn.get_params(), jnp.bfloat16)
+        out, new_state = bn.apply(params, bn.get_state(), x, training=True)
+        assert out.dtype == jnp.bfloat16
+        assert new_state["running_mean"].dtype == jnp.float32
+        assert new_state["running_var"].dtype == jnp.float32
+
+    def test_full_attention_bf16_close_to_fp32(self):
+        from bigdl_tpu.parallel.ring_attention import full_attention
+        rng = np.random.default_rng(1)
+        q, k, v = (rng.normal(size=(2, 2, 16, 8)).astype(np.float32)
+                   for _ in range(3))
+        ref = full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        got = full_attention(jnp.asarray(q, jnp.bfloat16),
+                             jnp.asarray(k, jnp.bfloat16),
+                             jnp.asarray(v, jnp.bfloat16))
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref), atol=3e-2)
+
+    def test_ring_attention_bf16_matches_oracle(self):
+        from jax.sharding import Mesh
+        from bigdl_tpu.parallel.ring_attention import full_attention, ring_attention
+
+        devs = np.asarray(jax.devices("cpu")[:4])
+        mesh = Mesh(devs, ("seq",))
+        rng = np.random.default_rng(2)
+        q, k, v = (rng.normal(size=(2, 2, 16, 8)).astype(np.float32)
+                   for _ in range(3))
+        ref = full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                             causal=True)
+        got = ring_attention(jnp.asarray(q, jnp.bfloat16),
+                             jnp.asarray(k, jnp.bfloat16),
+                             jnp.asarray(v, jnp.bfloat16),
+                             mesh=mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref), atol=5e-2)
+
+
+class TestTrainingParity:
+    def test_masters_stay_fp32_and_loss_matches_fp32_run(self):
+        model32, loss32 = _train(jnp.float32)
+        model16, loss16 = _train(jnp.bfloat16)
+        # master params never leave fp32
+        for leaf in jax.tree_util.tree_leaves(model16.get_params()):
+            assert leaf.dtype == jnp.float32
+        for leaf in jax.tree_util.tree_leaves(model16.get_state()):
+            assert leaf.dtype == jnp.float32
+        # both converge, and to comparable losses
+        assert loss32 < 0.55 and loss16 < 0.55, (loss32, loss16)
+        assert abs(loss16 - loss32) < 0.15, (loss32, loss16)
+
+    def test_bf16_evaluate_path(self):
+        from bigdl_tpu.dataset.sample import Sample
+        from bigdl_tpu.optim.validation import Top1Accuracy
+
+        model, _ = _train(jnp.bfloat16, steps=40)
+        x, y = _make_dataset(128, seed=9)
+        samples = [Sample(x[i], y[i]) for i in range(len(x))]
+        res = model.evaluate(samples, [Top1Accuracy()], batch_size=64)
+        acc = res[0][0].result()[0]
+        assert acc > 0.7, acc

@@ -72,8 +72,10 @@ class TransformedDataSet(AbstractDataSet):
 
     def is_distributed(self) -> bool:
         b = self.base
-        return isinstance(b, DistributedDataSet) or (
-            isinstance(b, TransformedDataSet) and b.is_distributed())
+        if isinstance(b, TransformedDataSet):
+            return b.is_distributed()
+        return isinstance(b, DistributedDataSet) or bool(
+            getattr(b, "distributed", False))
 
 
 class DistributedDataSet(LocalDataSet):
@@ -81,11 +83,21 @@ class DistributedDataSet(LocalDataSet):
 
 
 class DataSet:
-    """Factory namespace (reference ``DataSet.array`` / ``DataSet.rdd``)."""
+    """Factory namespace (reference ``DataSet.array`` / ``DataSet.rdd`` /
+    ``DataSet.imageFolder``)."""
 
     @staticmethod
     def array(data: Iterable, distributed: bool = False) -> AbstractDataSet:
         return DistributedDataSet(list(data)) if distributed else LocalDataSet(list(data))
+
+    @staticmethod
+    def image_folder(root: str, num_workers: int = 8, one_based: bool = False,
+                     distributed: bool = False) -> AbstractDataSet:
+        """On-disk ``root/<class>/<image>`` source streaming ImageFeatures
+        (dataset/image_folder.py) — compose vision transformers + SampleToMiniBatch."""
+        from bigdl_tpu.dataset.image_folder import ImageFolderDataSet
+        return ImageFolderDataSet(root, num_workers=num_workers,
+                                  one_based=one_based, distributed=distributed)
 
 
 def is_distributed(dataset: AbstractDataSet) -> bool:
@@ -93,4 +105,4 @@ def is_distributed(dataset: AbstractDataSet) -> bool:
         return True
     if isinstance(dataset, TransformedDataSet):
         return dataset.is_distributed()
-    return False
+    return bool(getattr(dataset, "distributed", False))

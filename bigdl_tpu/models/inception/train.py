@@ -40,17 +40,25 @@ def main(argv=None):
     if not Engine.is_initialized():
         Engine.init()
 
-    rng = np.random.default_rng(0)
-    n_cls = min(args.classes, 10)
-    protos = np.random.default_rng(7).normal(
-        0, 1, size=(n_cls, 3, args.image_size, args.image_size)).astype(np.float32)
-    labels = rng.integers(0, n_cls, size=args.synthetic_size)
-    imgs = (protos[labels]
-            + rng.normal(0, 0.5, size=(args.synthetic_size, 3, args.image_size,
-                                       args.image_size)).astype(np.float32))
-    samples = [Sample(x, y) for x, y in zip(imgs, labels.astype(np.int32))]
-    train_set = (DataSet.array(samples, distributed=args.distributed)
-                 >> SampleToMiniBatch(args.batch_size))
+    if args.folder is not None:
+        # on-disk ImageNet-layout folder through the streaming pipeline
+        from bigdl_tpu.models.imagenet_data import imagenet_sets
+        train_set, _ = imagenet_sets(
+            args.folder, args.batch_size, crop=args.image_size,
+            distributed=args.distributed)
+    else:
+        # fast in-memory synthetic set (clustered blobs so loss visibly drops)
+        rng = np.random.default_rng(0)
+        n_cls = min(args.classes, 10)
+        protos = np.random.default_rng(7).normal(
+            0, 1, size=(n_cls, 3, args.image_size, args.image_size)).astype(np.float32)
+        labels = rng.integers(0, n_cls, size=args.synthetic_size)
+        imgs = (protos[labels]
+                + rng.normal(0, 0.5, size=(args.synthetic_size, 3, args.image_size,
+                                           args.image_size)).astype(np.float32))
+        samples = [Sample(x, y) for x, y in zip(imgs, labels.astype(np.int32))]
+        train_set = (DataSet.array(samples, distributed=args.distributed)
+                     >> SampleToMiniBatch(args.batch_size))
 
     if args.no_aux:
         model = Inception_v1_NoAuxClassifier(args.classes)

@@ -45,12 +45,14 @@ def main(argv=None):
         Engine.init()
 
     if args.dataset == "ImageNet":
-        raise NotImplementedError(
-            "ImageNet training main needs an on-disk dataset; use the CIFAR-10 path or "
-            "bench.py for ResNet-50 throughput")
-    train_set, test_set = cifar.train_val_sets(
-        args.folder, args.batch_size, distributed=args.distributed,
-        synthetic_size=args.synthetic_size)
+        from bigdl_tpu.models.imagenet_data import imagenet_sets
+        train_set, test_set = imagenet_sets(
+            args.folder, args.batch_size, distributed=args.distributed,
+            synthetic_per_class=max(args.synthetic_size // 4, 8))
+    else:
+        train_set, test_set = cifar.train_val_sets(
+            args.folder, args.batch_size, distributed=args.distributed,
+            synthetic_size=args.synthetic_size)
 
     opt = {"depth": args.depth, "dataSet": args.dataset}
     if args.shortcut_type:

@@ -23,10 +23,6 @@ import jax
 import jax.numpy as jnp
 
 
-def is_floating(x) -> bool:
-    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
-
-
 def cast_floating(tree, dtype):
     """Cast every floating leaf of a pytree to ``dtype``; integer/bool leaves pass
     through untouched (targets, masks, valid counts)."""
@@ -34,9 +30,3 @@ def cast_floating(tree, dtype):
         x = jnp.asarray(x)
         return x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
     return jax.tree_util.tree_map(_cast, tree)
-
-
-def mixed_precision_active() -> bool:
-    """True when the Engine's compute dtype is narrower than fp32."""
-    from bigdl_tpu.utils.engine import Engine
-    return Engine.compute_dtype() != jnp.float32

@@ -28,7 +28,6 @@ import logging
 import jax
 import numpy as np
 
-from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.parallel.sharding import batch_sharding, replicated, zero1_state_sharding
 from bigdl_tpu.utils.engine import Engine
@@ -42,7 +41,6 @@ class DistriOptimizer(Optimizer):
         if parameter_sync not in ("allreduce", "zero1"):
             raise ValueError("parameter_sync must be 'allreduce' or 'zero1'")
         self.parameter_sync = parameter_sync
-        self.metrics = Metrics()
         self._mesh = None
         self._batch_sh = None
         self.tp_rules = None
@@ -51,6 +49,7 @@ class DistriOptimizer(Optimizer):
         if mode not in ("allreduce", "zero1"):
             raise ValueError("parameter_sync must be 'allreduce' or 'zero1'")
         self.parameter_sync = mode
+        self._step_cache = None
         return self
 
     def set_tensor_parallel(self, rules) -> "DistriOptimizer":
@@ -59,6 +58,7 @@ class DistriOptimizer(Optimizer):
         PartitionSpecs over the mesh's ``model`` axis. XLA's SPMD partitioner
         splits the matmuls and inserts the activation collectives."""
         self.tp_rules = rules
+        self._step_cache = None
         return self
 
     # ------------------------------------------------------------- compile
@@ -116,7 +116,4 @@ class DistriOptimizer(Optimizer):
         # compile path sets mesh/shardings before the first _put_batch
         logger.info("DistriOptimizer: mesh=%s sync=%s",
                     dict(Engine.mesh().shape), self.parameter_sync)
-        result = super()._optimize_impl()
-        if self.metrics.summary():
-            logger.info("DistriOptimizer phase timings: %r", self.metrics)
-        return result
+        return super()._optimize_impl()

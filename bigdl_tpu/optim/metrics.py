@@ -12,28 +12,42 @@ dispatch-side (async-safe); per-op device attribution comes from ``jax.profiler`
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import defaultdict
 
 
 class Metrics:
+    """Thread-safe phase-timing accumulator (the producer thread times
+    ``put_batch`` while the step loop times ``feed``/``step_dispatch``)."""
+
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self._sums: dict[str, float] = defaultdict(float)
         self._counts: dict[str, int] = defaultdict(int)
 
     def add(self, name: str, seconds: float) -> None:
-        self._sums[name] += seconds
-        self._counts[name] += 1
+        with self._lock:
+            self._sums[name] += seconds
+            self._counts[name] += 1
 
     def timer(self, name: str):
         return _Timer(self, name)
 
     def summary(self) -> dict[str, float]:
-        return {k: self._sums[k] / max(self._counts[k], 1) for k in self._sums}
+        """Mean seconds per phase occurrence."""
+        with self._lock:
+            return {k: self._sums[k] / max(self._counts[k], 1) for k in self._sums}
+
+    def totals(self) -> dict[str, float]:
+        """Total seconds per phase."""
+        with self._lock:
+            return dict(self._sums)
 
     def reset(self) -> None:
-        self._sums.clear()
-        self._counts.clear()
+        with self._lock:
+            self._sums.clear()
+            self._counts.clear()
 
     def __repr__(self):
         parts = ", ".join(f"{k} {v * 1e3:.2f}ms" for k, v in sorted(self.summary().items()))

@@ -74,10 +74,42 @@ class Optimizer:
         self.grad_clip_norm: Optional[float] = None
         self.state: dict = {"epoch": 1, "neval": 1, "epoch_finished": False}
         self.log_every: int = 1
+        from bigdl_tpu.optim.metrics import Metrics
+        self.metrics = Metrics()
+        # feed pipeline depth (placed batches in flight); 0 = synchronous
+        self.prefetch_depth: int = int(os.environ.get("BIGDL_PREFETCH", "2"))
+        # jax.profiler trace window (set_profile / BIGDL_PROFILE_DIR)
+        self.profile_dir: Optional[str] = os.environ.get("BIGDL_PROFILE_DIR")
+        self.profile_start_iter: int = int(os.environ.get("BIGDL_PROFILE_START", "10"))
+        self.profile_n_iters: int = int(os.environ.get("BIGDL_PROFILE_ITERS", "10"))
+        # per-iteration device sync for true step-time metrics (debug only —
+        # defeats async dispatch)
+        self.sync_metrics: bool = os.environ.get("BIGDL_SYNC_METRICS", "0") == "1"
+        self._step_cache = None
 
     # fluent config (reference API shape) ----------------------------------
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
         self.optim_method = method
+        self._step_cache = None
+        return self
+
+    def set_prefetch(self, depth: int) -> "Optimizer":
+        """Feed-pipeline depth: placed batches kept in flight by the background
+        producer (dataset/prefetch.py). 0 = synchronous feeding."""
+        if depth < 0:
+            raise ValueError("prefetch depth must be >= 0")
+        self.prefetch_depth = depth
+        return self
+
+    def set_profile(self, trace_dir: str, start_iter: int = 10,
+                    n_iters: int = 10) -> "Optimizer":
+        """Capture a ``jax.profiler`` trace (TensorBoard-viewable) covering
+        iterations ``[start_iter, start_iter + n_iters)`` — device-time
+        attribution per op, the honest answer to where a slow step goes
+        (SURVEY.md §5.1)."""
+        self.profile_dir = trace_dir
+        self.profile_start_iter = start_iter
+        self.profile_n_iters = n_iters
         return self
 
     def set_end_when(self, trigger: Trigger) -> "Optimizer":
@@ -107,15 +139,18 @@ class Optimizer:
 
     def set_constant_gradient_clipping(self, min_v: float, max_v: float) -> "Optimizer":
         self.grad_clip_const = (min_v, max_v)
+        self._step_cache = None
         return self
 
     def set_gradient_clipping_by_l2_norm(self, clip_norm: float) -> "Optimizer":
         self.grad_clip_norm = clip_norm
+        self._step_cache = None
         return self
 
     def disable_gradient_clipping(self) -> "Optimizer":
         self.grad_clip_const = None
         self.grad_clip_norm = None
+        self._step_cache = None
         return self
 
     # ------------------------------------------------------------- compile
@@ -171,9 +206,23 @@ class Optimizer:
         return cached_forward_jit(self.model)
 
     def _put_batch(self, batch: MiniBatch):
-        return jax.device_put(batch.input), jax.device_put(batch.target)
+        # runs in the prefetch producer thread: assembly already happened in the
+        # dataset iterator; this just enqueues the h2d DMA
+        with self.metrics.timer("put_batch"):
+            return jax.device_put(batch.input), jax.device_put(batch.target)
 
     # ------------------------------------------------------------ optimize
+    def _stop_profiler_if_active(self) -> None:
+        """Close a live jax.profiler trace (error paths must not leak it — the
+        checkpoint-retry loop would otherwise call start_trace on an already
+        active profiler and burn its retry budget on that)."""
+        if getattr(self, "_profiling", False):
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                logger.exception("failed to stop profiler trace")
+            self._profiling = False
+
     def optimize(self) -> AbstractModule:
         Engine._require_init()
         retry_budget = Engine.config().failure_retry_times
@@ -181,8 +230,10 @@ class Optimizer:
             try:
                 return self._optimize_impl()
             except KeyboardInterrupt:
+                self._stop_profiler_if_active()
                 raise
             except Exception:
+                self._stop_profiler_if_active()
                 retry_budget -= 1
                 if retry_budget < 0 or not self._has_checkpoint():
                     raise  # no recovery point yet → surface the original failure
@@ -212,49 +263,88 @@ class Optimizer:
         mstate = self.model.get_state()
         ostate = getattr(self, "_resume_ostate", None) or self.optim_method.init_state(params)
         self._resume_ostate = None
-        step_fn = self._compile_step()
+        # step cache is keyed on the Engine compute dtype (the casts are baked
+        # into the trace); config setters that change the program clear it
+        cdt = Engine.compute_dtype()
+        if self._step_cache is None or getattr(self, "_step_cache_dtype", None) != cdt:
+            self._step_cache = self._compile_step()
+            self._step_cache_dtype = cdt
+        step_fn = self._step_cache
         base_rng = RandomGenerator.next_key()
+
+        from bigdl_tpu.dataset.prefetch import PrefetchingFeed
 
         state = self.state
         records = 0
         window_t0 = time.perf_counter()
         prev_loss = None
         stop = False
+        self._profiling = False
 
         while not stop:
             state["epoch_finished"] = False
             self.dataset.shuffle()
             epoch_had_data = False
-            for batch in self.dataset.data(train=True):
-                # endWhen is evaluated at loop top with the reference's 1-based neval,
-                # so maxIteration(n) runs exactly n iterations (SURVEY.md §3.1)
-                if self.end_when(state):
-                    stop = True
-                    break
-                epoch_had_data = True
-                inp, target = self._put_batch(batch)
-                step_idx = jnp.asarray(state["neval"] - 1, jnp.int32)
-                params, mstate, ostate, loss = step_fn(
-                    params, mstate, ostate, step_idx, inp, target, base_rng)
-                records += batch.valid
+            feed = PrefetchingFeed(lambda: self.dataset.data(train=True),
+                                   self._put_batch, self.prefetch_depth)
+            with feed:
+                feed_it = iter(feed)
+                while True:
+                    # endWhen is evaluated at loop top with the reference's 1-based
+                    # neval, so maxIteration(n) runs exactly n iterations (SURVEY §3.1)
+                    if self.end_when(state):
+                        stop = True
+                        break
+                    # "feed" = time the step loop actually *waits* on data; in
+                    # steady state the producer thread hides assembly + transfer
+                    with self.metrics.timer("feed"):
+                        try:
+                            batch, (inp, target) = next(feed_it)
+                        except StopIteration:
+                            break
+                    epoch_had_data = True
 
-                # one-step-lagged loss fetch: logs every iteration without stalling
-                # the async dispatch pipeline (reference logged synchronously)
-                if prev_loss is not None:
-                    state["loss"] = float(jax.device_get(prev_loss))
-                prev_loss = loss
-                if state["neval"] % self.log_every == 0 and "loss" in state:
-                    dt = time.perf_counter() - window_t0
-                    thr = records / dt if dt > 0 else 0.0
-                    state["throughput"] = thr
-                    logger.info(
-                        "Epoch %d iter %d: loss %.6f, %.1f records/s",
-                        state["epoch"], state["neval"], state["loss"], thr)
-                    records = 0
-                    window_t0 = time.perf_counter()
+                    if self.profile_dir is not None and not self._profiling \
+                            and state["neval"] >= self.profile_start_iter:
+                        jax.profiler.start_trace(self.profile_dir)
+                        self._profiling = True
+                        profile_stop_at = state["neval"] + self.profile_n_iters
 
-                self._fire_triggers(params, mstate, ostate, state, boundary=False)
-                state["neval"] += 1
+                    step_idx = jnp.asarray(state["neval"] - 1, jnp.int32)
+                    with self.metrics.timer("step_dispatch"):
+                        params, mstate, ostate, loss = step_fn(
+                            params, mstate, ostate, step_idx, inp, target, base_rng)
+                    if self.sync_metrics:
+                        with self.metrics.timer("step_device"):
+                            jax.block_until_ready(loss)
+                    records += batch.valid
+
+                    if self._profiling and state["neval"] + 1 >= profile_stop_at:
+                        jax.block_until_ready(loss)
+                        jax.profiler.stop_trace()
+                        self._profiling = False
+                        self.profile_dir = None  # one window per optimize()
+                        logger.info("profiler trace captured")
+
+                    # one-step-lagged loss fetch: logs every iteration without
+                    # stalling the async dispatch pipeline (reference logged
+                    # synchronously)
+                    if prev_loss is not None:
+                        with self.metrics.timer("loss_fetch"):
+                            state["loss"] = float(jax.device_get(prev_loss))
+                    prev_loss = loss
+                    if state["neval"] % self.log_every == 0 and "loss" in state:
+                        dt = time.perf_counter() - window_t0
+                        thr = records / dt if dt > 0 else 0.0
+                        state["throughput"] = thr
+                        logger.info(
+                            "Epoch %d iter %d: loss %.6f, %.1f records/s",
+                            state["epoch"], state["neval"], state["loss"], thr)
+                        records = 0
+                        window_t0 = time.perf_counter()
+
+                    self._fire_triggers(params, mstate, ostate, state, boundary=False)
+                    state["neval"] += 1
             if stop:
                 break
             if not epoch_had_data:
@@ -265,11 +355,14 @@ class Optimizer:
             if self.end_when(state):
                 break
 
+        self._stop_profiler_if_active()  # endWhen fired inside the trace window
         if prev_loss is not None:
             state["loss"] = float(jax.device_get(prev_loss))
         self.model.set_params(jax.device_get(params))
         self.model.set_state(jax.device_get(mstate))
         self._final_ostate = jax.device_get(ostate)
+        if self.metrics.summary():
+            logger.info("phase timings (mean): %r", self.metrics)
         return self.model
 
     # ------------------------------------------------------------ triggers

@@ -1,0 +1,187 @@
+"""Input pipeline: PrefetchingFeed, on-disk ImageFolder source, per-phase
+metrics, and the jax.profiler capture hook."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset.prefetch import PrefetchingFeed
+
+
+class TestPrefetchingFeed:
+    def test_yields_all_in_order(self):
+        items = list(range(20))
+        feed = PrefetchingFeed(lambda: iter(items), lambda b: b * 10, depth=3)
+        got = list(feed)
+        assert got == [(i, i * 10) for i in items]
+
+    def test_depth_zero_synchronous(self):
+        feed = PrefetchingFeed(lambda: iter([1, 2]), lambda b: b, depth=0)
+        assert list(feed) == [(1, 1), (2, 2)]
+
+    def test_overlaps_producer_with_consumer(self):
+        # producer "assembly" takes 20ms/batch; consumer "compute" 20ms/batch.
+        # serial = ~n*40ms, overlapped = ~n*20ms. assert well under serial.
+        n = 8
+
+        def slow_iter():
+            for i in range(n):
+                time.sleep(0.02)
+                yield i
+
+        feed = PrefetchingFeed(lambda: slow_iter(), lambda b: b, depth=2)
+        t0 = time.perf_counter()
+        for _item in feed:
+            time.sleep(0.02)
+        dt = time.perf_counter() - t0
+        assert dt < n * 0.04 * 0.85, f"no overlap: {dt:.3f}s"
+
+    def test_producer_exception_surfaces(self):
+        def bad_iter():
+            yield 1
+            raise ValueError("boom")
+
+        feed = PrefetchingFeed(lambda: bad_iter(), lambda b: b, depth=2)
+        with pytest.raises(ValueError, match="boom"):
+            list(feed)
+
+    def test_early_break_stops_producer(self):
+        produced = []
+
+        def counted():
+            for i in range(10_000):
+                produced.append(i)
+                yield i
+
+        feed = PrefetchingFeed(lambda: counted(), lambda b: b, depth=2)
+        for item, _ in feed:
+            if item == 3:
+                break
+        feed.close()
+        n_after_close = len(produced)
+        time.sleep(0.2)
+        assert len(produced) == n_after_close  # producer actually stopped
+        assert n_after_close < 100
+
+
+class TestImageFolder:
+    @pytest.fixture()
+    def folder(self, tmp_path):
+        from bigdl_tpu.dataset.image_folder import write_synthetic_image_folder
+        return write_synthetic_image_folder(str(tmp_path), n_classes=3,
+                                            n_per_class=4, size=40)
+
+    def test_scan_and_stream(self, folder):
+        from bigdl_tpu.dataset.dataset import DataSet
+        ds = DataSet.image_folder(folder, num_workers=2)
+        assert ds.size() == 12
+        feats = list(ds.data(train=False))
+        assert len(feats) == 12
+        assert feats[0].image.shape == (40, 40, 3)
+        labels = sorted({f["label"] for f in feats})
+        assert labels == [0, 1, 2]
+
+    def test_one_based_labels(self, folder):
+        from bigdl_tpu.dataset.dataset import DataSet
+        ds = DataSet.image_folder(folder, one_based=True)
+        labels = sorted({f["label"] for f in ds.data(train=False)})
+        assert labels == [1, 2, 3]
+
+    def test_shuffle_is_seeded(self, folder):
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+
+        ds = DataSet.image_folder(folder)
+        RandomGenerator.set_seed(5)
+        ds.shuffle()
+        order1 = list(ds._order)
+        ds2 = DataSet.image_folder(folder)
+        RandomGenerator.set_seed(5)
+        ds2.shuffle()
+        assert list(ds2._order) == order1
+
+    def test_full_pipeline_to_minibatch(self, folder):
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import SampleToMiniBatch
+        from bigdl_tpu.transform.vision.image import (
+            CenterCrop, ChannelNormalize, ImageFrameToSample, MatToTensor,
+        )
+
+        ds = (DataSet.image_folder(folder)
+              >> CenterCrop(32, 32)
+              >> ChannelNormalize((120, 120, 120), (60, 60, 60))
+              >> MatToTensor()
+              >> ImageFrameToSample()
+              >> SampleToMiniBatch(4))
+        batches = list(ds.data(train=False))
+        assert len(batches) == 3
+        assert batches[0].input.shape == (4, 3, 32, 32)
+        assert batches[0].target.shape == (4,)
+
+    def test_imagenet_main_trains_from_folder(self, tmp_path):
+        """The round-1 NotImplementedError path: ResNet ImageNet main end-to-end
+        from an on-disk folder (tiny synthetic stand-in)."""
+        from bigdl_tpu.dataset.image_folder import write_synthetic_image_folder
+        from bigdl_tpu.models.resnet import train as resnet_train
+
+        folder = write_synthetic_image_folder(str(tmp_path), n_classes=2,
+                                              n_per_class=4, size=80)
+        model = resnet_train.main([
+            "--dataset", "ImageNet", "--depth", "18", "--classes", "2",
+            "-f", folder, "-b", "4", "--max-epoch", "1"])
+        assert model is not None
+
+
+class TestPhaseMetricsAndProfiler:
+    def _train(self, tmp_path, **opt_kw):
+        import bigdl_tpu.nn as N
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = rng.integers(0, 3, size=(64,)).astype(np.int32)
+        ds = (DataSet.array([Sample(x[i], y[i]) for i in range(64)])
+              >> SampleToMiniBatch(16))
+        model = (N.Sequential().add(N.Linear(8, 3)).add(N.LogSoftMax()))
+        opt = LocalOptimizer(model, ds, N.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_iteration(12))
+        for k, v in opt_kw.items():
+            getattr(opt, k)(*v) if isinstance(v, tuple) else None
+        return opt
+
+    def test_phase_metrics_populate(self, tmp_path):
+        opt = self._train(tmp_path)
+        opt.sync_metrics = True
+        opt.optimize()
+        means = opt.metrics.summary()
+        for phase in ("feed", "put_batch", "step_dispatch", "step_device",
+                      "loss_fetch"):
+            assert phase in means, means
+            assert means[phase] >= 0.0
+
+    def test_profiler_trace_captured(self, tmp_path):
+        opt = self._train(tmp_path)
+        trace_dir = str(tmp_path / "trace")
+        opt.set_profile(trace_dir, start_iter=3, n_iters=4)
+        opt.optimize()
+        files = []
+        for root, _dirs, names in os.walk(trace_dir):
+            files += [os.path.join(root, n) for n in names]
+        assert files, "no profiler trace files written"
+
+    def test_second_optimize_reuses_compiled_step(self, tmp_path):
+        opt = self._train(tmp_path)
+        opt.optimize()
+        first = opt._step_cache
+        assert first is not None
+        from bigdl_tpu.optim.trigger import Trigger
+        opt.set_end_when(Trigger.max_iteration(24))
+        opt.optimize()
+        assert opt._step_cache is first  # no recompile for a warm continue

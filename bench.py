@@ -1,83 +1,249 @@
-"""Benchmark harness. Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``
+"""Benchmark harness. Prints ONE JSON line on stdout:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}``
 
-Primary metric (BASELINE.md): ResNet-50 ImageNet images/sec/chip. Until the ResNet-50
-model lands, benches the best available flagship (LeNet training throughput). The
-reference's published number is unavailable (BASELINE.json.published empty, mount empty),
-so ``vs_baseline`` is null until a citable reference value exists.
+Primary metric (BASELINE.md): ResNet-50 ImageNet images/sec/chip, measured through the
+framework's OWN training loop (LocalOptimizer + PrefetchingFeed — triggers, feed, loss
+fetch and all), not a hand-rolled step. Also reports an MFU estimate (analytic FLOPs
+table: 2*MACs forward x3 for the training step, ÷ chip peak) and the bf16:fp32
+throughput ratio (measured in a separate subprocess so a comparison-leg failure can
+never discard a good primary number).
+
+Resilience contract (round-1 failure mode: TPU backend init hung → rc=1 → no number for
+the whole round): the measurement runs in a SUBPROCESS with a bounded timeout and one
+retry; on failure it falls back to a CPU run of LeNet so the round still records a
+parseable line with the failure reason instead of a traceback. Exit code is always 0.
+
+``vs_baseline`` stays null: the reference mount has been empty every round so far, so
+there is no citable denominator (BASELINE.md).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+# chip peak bf16 FLOP/s by device_kind substring (public spec sheets)
+_PEAK_FLOPS = [
+    ("v6", 918e12),        # Trillium
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+# analytic fallback: training-step FLOPs per image (2*MACs fwd, x3 for fwd+bwd)
+_ANALYTIC_STEP_FLOPS_PER_IMG = {
+    "resnet50": 3 * 2 * 4.09e9,   # 4.09 GMACs fwd @ 224x224
+    "lenet": 3 * 2 * 0.43e6,
+}
 
 
-def bench_train_throughput(model_name: str = "lenet", batch: int = 256,
-                           iters: int = 30, warmup: int = 5):
-    import jax
-    import jax.numpy as jnp
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _build(model_name: str, batch: int, n_batches: int, dtype: str):
+    import numpy as np
 
     from bigdl_tpu import nn
-    from bigdl_tpu.optim import SGD
-    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.sample import MiniBatch
 
-    if not Engine.is_initialized():
-        Engine.init()
-
-    if model_name == "lenet":
+    if model_name == "resnet50":
+        from bigdl_tpu.models.resnet import ResNet
+        model = ResNet(1000, {"depth": 50, "dataSet": "ImageNet"})
+        shape = (batch, 3, 224, 224)
+        n_classes = 1000
+    elif model_name == "lenet":
         from bigdl_tpu.models.lenet import LeNet5
         model = LeNet5(10)
-        x = np.random.default_rng(0).normal(size=(batch, 1, 28, 28)).astype(np.float32)
-        y = np.random.default_rng(1).integers(0, 10, size=(batch,)).astype(np.int32)
+        shape = (batch, 1, 28, 28)
+        n_classes = 10
     else:
-        raise ValueError(f"unknown model {model_name}")
+        raise ValueError(f"unknown model {model_name!r}")
 
-    criterion = nn.ClassNLLCriterion()
-    method = SGD(learningrate=0.01, momentum=0.9, dampening=0.0)
-    params, mstate = model.get_params(), model.get_state()
-    ostate = method.init_state(params)
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(n_batches):
+        x = rng.normal(size=shape).astype(np.float32)
+        y = rng.integers(0, n_classes, size=(batch,)).astype(np.int32)
+        batches.append(MiniBatch(x, y))
+    return model, DataSet.array(batches), nn.ClassNLLCriterion()
 
-    def step(params, mstate, ostate, step_idx, inp, target):
-        def loss_fn(p):
-            out, new_ms = model.apply(p, mstate, inp, training=True, rng=None)
-            return criterion.apply(out, target), new_ms
-        (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        new_p, new_os = method.update(params, grads, ostate, step_idx)
-        return new_p, new_ms, new_os, loss
 
-    jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
-    inp, target = jax.device_put(x), jax.device_put(y)
+def _measure(model_name: str, batch: int, iters: int, warmup: int,
+             dtype: str) -> dict:
+    """Train `warmup` iters (compile + steady-state), then time `iters` more
+    through the same LocalOptimizer (compiled-step cache keeps it warm)."""
+    import jax.numpy as jnp
 
-    for i in range(warmup):
-        params, mstate, ostate, loss = jit_step(
-            params, mstate, ostate, jnp.asarray(i, jnp.int32), inp, target)
-    jax.block_until_ready(loss)
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.utils.engine import Engine
 
+    Engine.reset()
+    Engine.init(compute_dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32)
+    dev = Engine.devices()[0]
+
+    model, dataset, criterion = _build(model_name, batch, n_batches=8, dtype=dtype)
+    opt = LocalOptimizer(model, dataset, criterion)
+    opt.set_optim_method(SGD(learningrate=0.01, momentum=0.9, dampening=0.0))
+    opt.log_every = 10 ** 9  # no per-iter logging during warmup
+
+    opt.set_end_when(Trigger.max_iteration(warmup))
+    opt.optimize()
+
+    # The loop logs windowed throughput; one window ending exactly at the last
+    # iteration covers all `iters` post-warmup steps and EXCLUDES optimize()'s
+    # end-of-run teardown (full param/state device_get) from the timing.
+    opt.log_every = warmup + iters
+    opt.set_end_when(Trigger.max_iteration(warmup + iters))
     t0 = time.perf_counter()
-    for i in range(iters):
-        params, mstate, ostate, loss = jit_step(
-            params, mstate, ostate, jnp.asarray(i, jnp.int32), inp, target)
-    jax.block_until_ready(loss)
+    opt.optimize()
     dt = time.perf_counter() - t0
-    return batch * iters / dt
+    imgs_per_sec = opt.state.get("throughput") or (batch * iters / dt)
+
+    # analytic FLOPs per training step (2*MACs forward, x3 fwd+bwd) — BASELINE.md
+    # MFU convention; re-lowering the compiled step for XLA cost analysis would
+    # pay a second full compile for a number that should be shape-derived anyway
+    per_img = _ANALYTIC_STEP_FLOPS_PER_IMG.get(model_name)
+    flops_per_step = per_img * batch if per_img else None
+
+    peak = _peak_flops(dev.device_kind)
+    steps_per_sec = imgs_per_sec / batch
+    mfu = (flops_per_step * steps_per_sec / peak) if (flops_per_step and peak) else None
+
+    return {
+        "images_per_sec": imgs_per_sec,
+        "mfu": mfu,
+        "flops_per_step": flops_per_step,
+        "device_kind": dev.device_kind,
+        "platform": dev.platform,
+        "peak_flops": peak,
+        "feed_wait_ms": 1e3 * opt.metrics.summary().get("feed", 0.0),
+    }
+
+
+def run_worker(args) -> None:
+    """The measured child process: ONE dtype, one JSON line, exit."""
+    res = _measure(args.model, args.batch, args.iters, args.warmup, args.dtype)
+    line = {
+        "metric": f"{args.model}_train_images_per_sec_per_chip",
+        "value": round(res["images_per_sec"], 1),
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "dtype": args.dtype,
+        "batch": args.batch,
+        "mfu": round(res["mfu"], 4) if res["mfu"] is not None else None,
+        "device_kind": res["device_kind"],
+        "platform": res["platform"],
+        "feed_wait_ms": round(res["feed_wait_ms"], 2),
+    }
+    print(json.dumps(line))
+
+
+def _spawn(argv, env, timeout):
+    try:
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)] + argv,
+                           capture_output=True, text=True, timeout=timeout,
+                           env=env)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout}s (backend init hang or slow compile)"
+    for ln in reversed(p.stdout.strip().splitlines()):
+        try:
+            return json.loads(ln), None
+        except json.JSONDecodeError:
+            continue
+    tail = (p.stderr or p.stdout or "").strip().splitlines()[-8:]
+    return None, f"rc={p.returncode}: " + " | ".join(tail)[-600:]
+
+
+def run_orchestrator(args) -> None:
+    """Always prints one JSON line and exits 0 — degraded runs carry a reason."""
+    worker_argv = ["--run", "--model", args.model, "--batch", str(args.batch),
+                   "--iters", str(args.iters), "--warmup", str(args.warmup),
+                   "--dtype", args.dtype]
+    attempts = []
+    for attempt in (1, 2):
+        print(f"bench: attempt {attempt}: {args.model} dtype={args.dtype} "
+              f"batch={args.batch}", file=sys.stderr)
+        result, err = _spawn(worker_argv, dict(os.environ), args.timeout)
+        if result is not None:
+            # comparison leg in its OWN subprocess: its failure can never
+            # discard the good primary number above
+            if args.compare_dtypes and args.dtype == "bf16":
+                cmp_argv = ["--run", "--model", args.model,
+                            "--batch", str(args.batch),
+                            "--iters", str(max(args.iters // 2, 5)),
+                            "--warmup", str(args.warmup), "--dtype", "fp32"]
+                cmp_res, cmp_err = _spawn(cmp_argv, dict(os.environ), args.timeout)
+                if cmp_res is not None and cmp_res.get("value"):
+                    result["fp32_images_per_sec"] = cmp_res["value"]
+                    result["bf16_fp32_ratio"] = round(
+                        result["value"] / cmp_res["value"], 2)
+                elif cmp_err:
+                    print(f"bench: fp32 comparison leg failed: {cmp_err}",
+                          file=sys.stderr)
+            print(json.dumps(result))
+            return
+        attempts.append(f"attempt{attempt}: {err}")
+        print(f"bench: {err}", file=sys.stderr)
+
+    # degraded CPU fallback: a number with a reason beats a traceback
+    print("bench: falling back to CPU LeNet", file=sys.stderr)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    fb_argv = ["--run", "--model", "lenet", "--batch", "256",
+               "--iters", "20", "--warmup", "5", "--dtype", "fp32"]
+    result, err = _spawn(fb_argv, env, args.timeout)
+    if result is not None:
+        result["degraded"] = True
+        result["degraded_reason"] = "; ".join(attempts)
+        print(json.dumps(result))
+        return
+    attempts.append(f"cpu-fallback: {err}")
+    print(json.dumps({
+        "metric": f"{args.model}_train_images_per_sec_per_chip",
+        "value": None,
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "error": "; ".join(attempts)[-1200:],
+    }))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50", choices=["resnet50", "lenet"])
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=8)
+    p.add_argument("--dtype", default="bf16", choices=["bf16", "fp32"])
+    p.add_argument("--compare-dtypes", action="store_true", default=True,
+                   help="also run fp32 and report the bf16:fp32 ratio")
+    p.add_argument("--no-compare-dtypes", dest="compare_dtypes",
+                   action="store_false")
+    p.add_argument("--timeout", type=int, default=1500,
+                   help="per-attempt subprocess timeout (s)")
+    p.add_argument("--run", action="store_true",
+                   help=argparse.SUPPRESS)  # internal: worker mode
+    args = p.parse_args()
+    if args.run:
+        run_worker(args)
+    else:
+        run_orchestrator(args)
+    sys.exit(0)
 
 
 if __name__ == "__main__":
-    import argparse
-
-    p = argparse.ArgumentParser()
-    p.add_argument("--model", default="lenet")
-    p.add_argument("--batch", type=int, default=256)
-    p.add_argument("--iters", type=int, default=30)
-    args = p.parse_args()
-
-    imgs_per_sec = bench_train_throughput(args.model, args.batch, args.iters)
-    print(json.dumps({
-        "metric": f"{args.model}_train_images_per_sec_per_chip",
-        "value": round(imgs_per_sec, 1),
-        "unit": "images/sec",
-        "vs_baseline": None,
-    }))
+    main()

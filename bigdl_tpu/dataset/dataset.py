@@ -71,11 +71,7 @@ class TransformedDataSet(AbstractDataSet):
         return self.transformer(self.base.data(train))
 
     def is_distributed(self) -> bool:
-        b = self.base
-        if isinstance(b, TransformedDataSet):
-            return b.is_distributed()
-        return isinstance(b, DistributedDataSet) or bool(
-            getattr(b, "distributed", False))
+        return is_distributed(self.base)
 
 
 class DistributedDataSet(LocalDataSet):

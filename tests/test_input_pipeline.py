@@ -135,7 +135,7 @@ class TestImageFolder:
 
 
 class TestPhaseMetricsAndProfiler:
-    def _train(self, tmp_path, **opt_kw):
+    def _train(self):
         import bigdl_tpu.nn as N
         from bigdl_tpu.dataset.dataset import DataSet
         from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
@@ -152,12 +152,10 @@ class TestPhaseMetricsAndProfiler:
         opt = LocalOptimizer(model, ds, N.ClassNLLCriterion())
         opt.set_optim_method(SGD(learningrate=0.1))
         opt.set_end_when(Trigger.max_iteration(12))
-        for k, v in opt_kw.items():
-            getattr(opt, k)(*v) if isinstance(v, tuple) else None
         return opt
 
-    def test_phase_metrics_populate(self, tmp_path):
-        opt = self._train(tmp_path)
+    def test_phase_metrics_populate(self):
+        opt = self._train()
         opt.sync_metrics = True
         opt.optimize()
         means = opt.metrics.summary()
@@ -167,7 +165,7 @@ class TestPhaseMetricsAndProfiler:
             assert means[phase] >= 0.0
 
     def test_profiler_trace_captured(self, tmp_path):
-        opt = self._train(tmp_path)
+        opt = self._train()
         trace_dir = str(tmp_path / "trace")
         opt.set_profile(trace_dir, start_iter=3, n_iters=4)
         opt.optimize()
@@ -176,8 +174,8 @@ class TestPhaseMetricsAndProfiler:
             files += [os.path.join(root, n) for n in names]
         assert files, "no profiler trace files written"
 
-    def test_second_optimize_reuses_compiled_step(self, tmp_path):
-        opt = self._train(tmp_path)
+    def test_second_optimize_reuses_compiled_step(self):
+        opt = self._train()
         opt.optimize()
         first = opt._step_cache
         assert first is not None

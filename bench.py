@@ -105,14 +105,28 @@ def _measure(model_name: str, batch: int, iters: int, warmup: int,
     opt.optimize()
 
     # The loop logs windowed throughput; one window ending exactly at the last
-    # iteration covers all `iters` post-warmup steps and EXCLUDES optimize()'s
-    # end-of-run teardown (full param/state device_get) from the timing.
+    # iteration covers the post-warmup steps and EXCLUDES optimize()'s one-time
+    # costs (first-step sync starts the window) and end-of-run teardown (full
+    # param/state device_get) from the timing. Optimizer state (momentum) carries
+    # over — optimize() on the same instance is a continuation.
     opt.log_every = warmup + iters
     opt.set_end_when(Trigger.max_iteration(warmup + iters))
     t0 = time.perf_counter()
     opt.optimize()
     dt = time.perf_counter() - t0
     imgs_per_sec = opt.state.get("throughput") or (batch * iters / dt)
+
+    # Direct-step cross-check leg (round-2 verdict item 1): drive the SAME
+    # compiled step raw — pre-placed fixed batch, loss fetched only at the end.
+    # This is the framework's step capability; if the loop number diverges from
+    # it the harness must say so instead of publishing the worse one as truth.
+    # Guarded: a cross-check failure must never discard the measured loop number.
+    try:
+        step_imgs_per_sec = _measure_direct_step(opt, batch, iters)
+        step_error = None
+    except Exception as e:
+        step_imgs_per_sec = None
+        step_error = f"{type(e).__name__}: {e}"[:300]
 
     # analytic FLOPs per training step (2*MACs forward, x3 fwd+bwd) — BASELINE.md
     # MFU convention; re-lowering the compiled step for XLA cost analysis would
@@ -121,12 +135,18 @@ def _measure(model_name: str, batch: int, iters: int, warmup: int,
     flops_per_step = per_img * batch if per_img else None
 
     peak = _peak_flops(dev.device_kind)
-    steps_per_sec = imgs_per_sec / batch
-    mfu = (flops_per_step * steps_per_sec / peak) if (flops_per_step and peak) else None
+
+    def _mfu(ips):
+        if not (flops_per_step and peak and ips):
+            return None
+        return flops_per_step * (ips / batch) / peak
 
     return {
         "images_per_sec": imgs_per_sec,
-        "mfu": mfu,
+        "images_per_sec_step": step_imgs_per_sec,
+        "step_leg_error": step_error,
+        "mfu": _mfu(imgs_per_sec),
+        "mfu_step": _mfu(step_imgs_per_sec),
         "flops_per_step": flops_per_step,
         "device_kind": dev.device_kind,
         "platform": dev.platform,
@@ -135,21 +155,84 @@ def _measure(model_name: str, batch: int, iters: int, warmup: int,
     }
 
 
+def _measure_direct_step(opt, batch: int, iters: int) -> float:
+    """Drive the optimizer's own compiled train step in a bare loop: warm steps,
+    then `iters` timed dispatches with ONE terminal loss fetch as the sync point.
+    Measures step capability with zero loop/feed/logging overhead."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    step_fn = opt._step_cache
+    model, method = opt.model, opt.optim_method
+    params = jax.device_put(model.get_params())
+    mstate = jax.device_put(model.get_state())
+    ostate = jax.device_put(getattr(opt, "_final_ostate", None)
+                            or method.init_state(params))
+    for b in opt.dataset.data(train=True):
+        inp = jax.device_put(b.input)
+        target = jax.device_put(b.target)
+        break
+    from bigdl_tpu.utils.random_generator import RandomGenerator
+    base_rng = RandomGenerator.next_key()
+
+    def run(n, start):
+        nonlocal params, mstate, ostate
+        loss = None
+        for i in range(n):
+            step_idx = jnp.asarray(start + i, jnp.int32)
+            params, mstate, ostate, loss = step_fn(
+                params, mstate, ostate, step_idx, inp, target, base_rng)
+        return loss
+
+    # warm: absorb placement + any recompile, and sync before timing
+    float(jax.device_get(run(2, 0)))
+    t0 = time.perf_counter()
+    loss = run(iters, 2)
+    float(jax.device_get(loss))  # terminal sync — the only host round trip
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
 def run_worker(args) -> None:
-    """The measured child process: ONE dtype, one JSON line, exit."""
+    """The measured child process: ONE dtype, one JSON line, exit.
+
+    Self-validation (round-2 verdict): the end-to-end loop number is published as
+    `value` only when it is within 1.5x of the direct-step capability. On larger
+    divergence the step number is published (`suspect: true`), with both legs
+    reported — the harness never presents a broken-loop measurement as the
+    framework's speed without saying so.
+    """
     res = _measure(args.model, args.batch, args.iters, args.warmup, args.dtype)
+    loop_ips, step_ips = res["images_per_sec"], res["images_per_sec_step"]
+    if step_ips is None:
+        ratio, suspect = None, False  # cross-check unavailable; loop stands alone
+    else:
+        ratio = (step_ips / loop_ips) if loop_ips else float("inf")
+        suspect = ratio > 1.5
+    value, mfu = (step_ips, res["mfu_step"]) if suspect else (loop_ips, res["mfu"])
     line = {
         "metric": f"{args.model}_train_images_per_sec_per_chip",
-        "value": round(res["images_per_sec"], 1),
+        "value": round(value, 1),
         "unit": "images/sec",
         "vs_baseline": None,
         "dtype": args.dtype,
         "batch": args.batch,
-        "mfu": round(res["mfu"], 4) if res["mfu"] is not None else None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "images_per_sec_loop": round(loop_ips, 1),
+        "images_per_sec_step": round(step_ips, 1) if step_ips is not None else None,
+        "loop_step_ratio": round(ratio, 2) if ratio is not None else None,
+        "suspect": suspect,
         "device_kind": res["device_kind"],
         "platform": res["platform"],
         "feed_wait_ms": round(res["feed_wait_ms"], 2),
     }
+    if res.get("step_leg_error"):
+        line["step_leg_error"] = res["step_leg_error"]
+    if suspect:
+        line["suspect_reason"] = (
+            "optimize() loop >1.5x slower than the same compiled step driven "
+            "raw; publishing step capability, loop number retained for diagnosis")
     print(json.dumps(line))
 
 
@@ -174,11 +257,15 @@ def run_orchestrator(args) -> None:
     worker_argv = ["--run", "--model", args.model, "--batch", str(args.batch),
                    "--iters", str(args.iters), "--warmup", str(args.warmup),
                    "--dtype", args.dtype]
+    env = dict(os.environ)
+    # TPU attach in this environment swings from ~20 s to outright hangs; give a
+    # real attempt generous headroom (the subprocess timeout still bounds it)
+    env.setdefault("BIGDL_INIT_TIMEOUT", "420")
     attempts = []
     for attempt in (1, 2):
         print(f"bench: attempt {attempt}: {args.model} dtype={args.dtype} "
               f"batch={args.batch}", file=sys.stderr)
-        result, err = _spawn(worker_argv, dict(os.environ), args.timeout)
+        result, err = _spawn(worker_argv, env, args.timeout)
         if result is not None:
             # comparison leg in its OWN subprocess: its failure can never
             # discard the good primary number above
@@ -187,11 +274,22 @@ def run_orchestrator(args) -> None:
                             "--batch", str(args.batch),
                             "--iters", str(max(args.iters // 2, 5)),
                             "--warmup", str(args.warmup), "--dtype", "fp32"]
-                cmp_res, cmp_err = _spawn(cmp_argv, dict(os.environ), args.timeout)
+                cmp_res, cmp_err = _spawn(cmp_argv, env, args.timeout)
                 if cmp_res is not None and cmp_res.get("value"):
                     result["fp32_images_per_sec"] = cmp_res["value"]
-                    result["bf16_fp32_ratio"] = round(
-                        result["value"] / cmp_res["value"], 2)
+                    # compare like with like: both legs' loop numbers when both
+                    # loops are healthy, else both step numbers — never a mix of
+                    # methodologies
+                    if not result.get("suspect") and not cmp_res.get("suspect"):
+                        num, den, basis = (result["images_per_sec_loop"],
+                                           cmp_res["images_per_sec_loop"], "loop")
+                    else:
+                        num, den, basis = (result.get("images_per_sec_step"),
+                                           cmp_res.get("images_per_sec_step"),
+                                           "step")
+                    if num and den:
+                        result["bf16_fp32_ratio"] = round(num / den, 2)
+                        result["bf16_fp32_ratio_basis"] = basis
                 elif cmp_err:
                     print(f"bench: fp32 comparison leg failed: {cmp_err}",
                           file=sys.stderr)

@@ -112,6 +112,9 @@ class DistriOptimizer(Optimizer):
             target = jax.device_put(batch.target, self._batch_sh)
         return inp, target
 
+    def _put_input(self, batch):
+        return jax.device_put(batch.input, self._batch_sh)
+
     def _optimize_impl(self):
         # compile path sets mesh/shardings before the first _put_batch
         logger.info("DistriOptimizer: mesh=%s sync=%s",

@@ -161,10 +161,10 @@ class Plateau(LearningRateSchedule):
             raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
         if factor >= 1.0:
             raise ValueError("Plateau factor must be < 1.0")
-        if monitor not in ("score", "loss", "Loss"):
-            raise ValueError(
-                f"monitor must be 'score' (validation metric) or 'loss'/'Loss' "
-                f"(training loss), got {monitor!r}")
+        # monitor: "score" (first configured validation metric), "loss"/"Loss"
+        # (training loss), or the NAME of a validation method (e.g.
+        # "Top1Accuracy") — naming one decouples the monitored metric from the
+        # order methods were listed in set_validation.
         self.monitor = monitor
         self.factor = factor
         self.patience = patience

@@ -145,10 +145,7 @@ class Engine:
                 )
                 _STATE.distributed_initialized = True
 
-            if cfg.backend not in ("auto", None):
-                devices = jax.devices(cfg.backend)
-            else:
-                devices = jax.devices()
+            devices = cls._discover_devices_bounded(cfg.backend)
             cfg.node_number = node_number or jax.process_count()
             cfg.core_number = core_number or jax.local_device_count()
             if core_number is not None:
@@ -182,6 +179,47 @@ class Engine:
                 "Engine initialized: backend=%s processes=%d local_devices=%d mesh=%s",
                 cfg.backend, cfg.node_number, cfg.core_number,
                 getattr(_STATE.mesh, "shape", None))
+
+    @classmethod
+    def _discover_devices_bounded(cls, backend: str | None):
+        """Backend discovery under a watchdog. On some deployments TPU runtime
+        attach (``jax.devices()`` → PJRT client construction) can hang
+        indefinitely; a bare call would freeze every framework entry point with
+        no message. Bound it with ``BIGDL_INIT_TIMEOUT`` (seconds, default 120;
+        <= 0 disables the watchdog) and fail loudly with a remediation hint."""
+        import jax
+
+        timeout = float(_env("BIGDL_INIT_TIMEOUT", "120"))
+
+        def _discover():
+            if backend not in ("auto", None):
+                return jax.devices(backend)
+            return jax.devices()
+
+        if timeout <= 0:
+            return _discover()
+
+        result: dict = {}
+
+        def _worker():
+            try:
+                result["devices"] = _discover()
+            except BaseException as e:  # re-raised on the caller thread
+                result["error"] = e
+
+        t = threading.Thread(target=_worker, name="bigdl-engine-init", daemon=True)
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            raise RuntimeError(
+                f"Engine.init: backend discovery for {backend!r} did not complete "
+                f"within {timeout:.0f}s (BIGDL_INIT_TIMEOUT). The accelerator "
+                f"runtime is likely hung or unreachable. Raise BIGDL_INIT_TIMEOUT "
+                f"if the backend is just slow to attach, or set JAX_PLATFORMS=cpu "
+                f"/ BIGDL_BACKEND=cpu to run on CPU.")
+        if "error" in result:
+            raise result["error"]
+        return result["devices"]
 
     @classmethod
     def _build_mesh(cls, devices, mesh_shape, mesh_axes):

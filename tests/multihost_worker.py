@@ -1,0 +1,66 @@
+"""Worker process for the 2-process jax.distributed test (not a pytest file).
+
+Usage: python multihost_worker.py <coordinator_port> <process_id> <out_file>
+
+Each process exposes 4 virtual CPU devices; together they form the 8-device
+global mesh. Training runs through Engine.init(coordinator_address=...) +
+DistriOptimizer — the real multi-host code path (SURVEY.md §5.8: the analog of
+the reference's Spark cluster attach + DistriOptimizer loop).
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    port, pid, out_file = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # cross-process CPU collectives need the gloo transport
+    os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init(backend="cpu", seed=0,
+                coordinator_address=f"localhost:{port}",
+                node_number=2, process_id=pid)
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert Engine.mesh().devices.size == 8
+
+    rng = np.random.default_rng(0)  # same data on every process (SPMD contract)
+    samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                      np.int32(rng.integers(0, 3))) for _ in range(64)]
+    data = DataSet.array(samples, distributed=True) >> SampleToMiniBatch(16)
+    model = nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU()) \
+        .add(nn.Linear(16, 3)).add(nn.LogSoftMax())
+    opt = DistriOptimizer(model, data, nn.ClassNLLCriterion())
+    opt.set_optim_method(SGD(learningrate=0.1, momentum=0.9, dampening=0.0))
+    opt.set_end_when(Trigger.max_iteration(4))
+    opt.optimize()
+
+    loss = float(opt.state["loss"])
+    with open(out_file, "w") as f:
+        json.dump({"process_id": pid, "loss": loss,
+                   "neval": opt.state["neval"],
+                   "process_count": jax.process_count(),
+                   "global_devices": jax.device_count()}, f)
+    print(f"worker {pid}: loss={loss}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,188 @@
+"""Round-3 verdict fixes: Engine.init watchdog, optimizer-state continuation,
+lazy (batched) loss fetching, named Plateau monitor, batched validation fetch."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.optim import LocalOptimizer, SGD, Top1Accuracy, Loss, Trigger
+from bigdl_tpu.optim.schedules import Plateau
+
+
+def _toy_data(n=64, dim=8, classes=3, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    samples = [Sample(rng.normal(size=(dim,)).astype(np.float32),
+                      np.int32(rng.integers(0, classes))) for _ in range(n)]
+    return DataSet.array(samples) >> SampleToMiniBatch(batch)
+
+
+def _toy_model(dim=8, classes=3):
+    return nn.Sequential().add(nn.Linear(dim, classes)).add(nn.LogSoftMax())
+
+
+class TestInitWatchdog:
+    def test_timeout_raises_with_diagnostic(self, monkeypatch):
+        Engine.reset()
+        monkeypatch.setenv("BIGDL_INIT_TIMEOUT", "0.2")
+
+        def hang(*a, **kw):
+            time.sleep(10)
+
+        monkeypatch.setattr(jax, "devices", hang)
+        with pytest.raises(RuntimeError, match="BIGDL_INIT_TIMEOUT"):
+            Engine.init()
+        assert not Engine.is_initialized()
+
+    def test_discovery_error_propagates(self, monkeypatch):
+        Engine.reset()
+
+        def boom(*a, **kw):
+            raise ValueError("no such backend")
+
+        monkeypatch.setattr(jax, "devices", boom)
+        with pytest.raises(ValueError, match="no such backend"):
+            Engine.init()
+
+    def test_zero_timeout_disables_watchdog(self, monkeypatch):
+        Engine.reset()
+        monkeypatch.setenv("BIGDL_INIT_TIMEOUT", "0")
+        Engine.init()
+        assert Engine.is_initialized()
+
+
+class TestOptimizerStateContinuation:
+    def test_momentum_survives_reoptimize(self):
+        """A second optimize() on the same Optimizer must carry the SGD momentum
+        slots forward (round-2 bench bug: the timed leg re-ran init_state)."""
+        Engine.init(seed=0)
+        data = _toy_data()
+        opt = LocalOptimizer(_toy_model(), data, nn.ClassNLLCriterion())
+        method = SGD(learningrate=0.1, momentum=0.9, dampening=0.0)
+        opt.set_optim_method(method)
+        opt.set_end_when(Trigger.max_iteration(3))
+        opt.optimize()
+        v1 = [np.asarray(x) for x in jax.tree_util.tree_leaves(opt._final_ostate["v"])]
+        assert any(np.abs(l).max() > 0 for l in v1)  # momentum accumulated
+
+        # continuation: init_state must NOT be re-run (it would zero the slots)
+        def forbidden(params):
+            raise AssertionError("init_state re-run on continuation")
+
+        method.init_state = forbidden
+        opt.set_end_when(Trigger.max_iteration(6))
+        opt.optimize()
+        assert opt.state["neval"] >= 6
+        v2 = [np.asarray(x) for x in jax.tree_util.tree_leaves(opt._final_ostate["v"])]
+        # slots kept evolving from v1, not from zero
+        assert any(np.abs(a - b).max() > 0 for a, b in zip(v1, v2))
+
+
+class TestLazyLossFetch:
+    def test_log_every_preserves_exact_summaries(self, tmp_path):
+        """With log_every=5 the loss is fetched in batches, but every iteration's
+        exact loss must still land in the event file."""
+        from bigdl_tpu.visualization import TrainSummary
+
+        Engine.init(seed=0)
+        ts = TrainSummary(str(tmp_path), "lazy")
+        opt = LocalOptimizer(_toy_model(), _toy_data(), nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.log_every = 5
+        opt.set_end_when(Trigger.max_iteration(12))
+        opt.set_train_summary(ts)
+        opt.optimize()
+        ts.close()
+        losses = ts.read_scalar("Loss")
+        steps = sorted(s for s, _, _ in losses)
+        assert steps == list(range(1, 13))
+        # monotone-ish decrease on this separable toy: first > last
+        vals = {s: v for s, v, _ in losses}
+        assert vals[12] < vals[1]
+        assert "loss" in opt.state and np.isfinite(opt.state["loss"])
+
+    def test_state_loss_matches_eager(self):
+        """log_every=4 and log_every=1 runs produce identical final params and
+        final loss (fetch cadence must not change the math)."""
+        finals = []
+        for le in (1, 4):
+            Engine.reset()
+            Engine.init(seed=0)
+            opt = LocalOptimizer(_toy_model(), _toy_data(), nn.ClassNLLCriterion())
+            opt.set_optim_method(SGD(learningrate=0.1))
+            opt.log_every = le
+            opt.set_end_when(Trigger.max_iteration(8))
+            opt.optimize()
+            finals.append((opt.state["loss"],
+                           [np.asarray(x) for x in
+                            jax.tree_util.tree_leaves(opt.model.get_params())]))
+        assert finals[0][0] == pytest.approx(finals[1][0], rel=1e-6)
+        for x, y in zip(finals[0][1], finals[1][1]):
+            np.testing.assert_allclose(x, y, rtol=1e-6)
+
+
+class TestNamedPlateauMonitor:
+    def test_monitor_by_validation_method_name(self):
+        """Plateau(monitor='Loss(val)') must track the NAMED method, not whatever
+        was first in the set_validation list (round-2 weak #7)."""
+        Engine.init(seed=0)
+        data = _toy_data()
+        method = Top1Accuracy()
+        # epsilon huge → every round after the first counts as "no improvement"
+        sched = Plateau(monitor=method.name, factor=0.5, patience=0, mode="max",
+                        epsilon=1e9)
+        opt = LocalOptimizer(_toy_model(), data, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.4, learningrate_schedule=sched))
+        # Loss listed FIRST: positional coupling would monitor it instead
+        opt.set_validation(Trigger.several_iteration(2), data,
+                           [Loss(nn.ClassNLLCriterion()), method])
+        opt.set_end_when(Trigger.max_iteration(8))
+        opt.optimize()
+        assert method.name in opt.state.get("scores", {})
+        # patience=0 + never-improving epsilon → LR must have decayed
+        assert sched.current_lr < 0.4
+
+    def test_unknown_monitor_name_raises(self):
+        Engine.init(seed=0)
+        data = _toy_data()
+        sched = Plateau(monitor="NoSuchMetric", factor=0.5, patience=0)
+        opt = LocalOptimizer(_toy_model(), data, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1, learningrate_schedule=sched))
+        opt.set_validation(Trigger.several_iteration(2), data, [Top1Accuracy()])
+        opt.set_end_when(Trigger.max_iteration(4))
+        with pytest.raises(ValueError, match="NoSuchMetric"):
+            opt.optimize()
+
+
+class TestBatchedValidationFetch:
+    def test_validation_results_unchanged(self):
+        """Chunked device_get path must produce the same validation metrics as a
+        reference per-batch evaluation."""
+        Engine.init(seed=0)
+        data = _toy_data(n=128, batch=8)  # 16 batches → crosses the chunk boundary
+        model = _toy_model()
+        opt = LocalOptimizer(model, data, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_validation(Trigger.several_iteration(4), data,
+                           [Top1Accuracy(), Loss(nn.ClassNLLCriterion())])
+        opt.set_end_when(Trigger.max_iteration(4))
+        opt.optimize()
+        scores = opt.state["scores"]
+        assert "Top1Accuracy" in scores
+
+        # oracle: direct forward over the same data
+        from bigdl_tpu.optim.evaluator import cached_forward_jit
+        fwd = cached_forward_jit(model)
+        params, mstate = model.get_params(), model.get_state()
+        correct = total = 0
+        for b in data.data(train=False):
+            out = np.asarray(fwd(params, mstate, jnp.asarray(b.input)))
+            pred = out[: b.valid].argmax(axis=1)
+            correct += (pred == np.asarray(b.target)[: b.valid]).sum()
+            total += b.valid
+        assert scores["Top1Accuracy"] == pytest.approx(correct / total, abs=1e-6)

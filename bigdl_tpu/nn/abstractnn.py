@@ -43,7 +43,20 @@ def _is_array(x) -> bool:
     return isinstance(x, (jnp.ndarray, np.ndarray))
 
 
-class AbstractModule:
+class RecordsInit(type):
+    """Metaclass recording the constructor arguments of every instance as
+    ``_init_args = (args, kwargs)``. The portable serializer (utils/serializer.py)
+    rebuilds modules from these — a reflection-driven analog of the reference's
+    per-layer protobuf converters (SURVEY.md §2.5 Protobuf serializer)."""
+
+    def __call__(cls, *args, **kwargs):
+        obj = super().__call__(*args, **kwargs)
+        if "_init_args" not in obj.__dict__:
+            obj.__dict__["_init_args"] = (args, kwargs)
+        return obj
+
+
+class AbstractModule(metaclass=RecordsInit):
     """Base class of all layers and containers."""
 
     _instance_counter = 0
@@ -241,6 +254,15 @@ class AbstractModule:
         self._forward_time = 0.0
         self._backward_time = 0.0
 
+    # -------------------------------------------------------------- quantize
+    def quantize(self) -> "AbstractModule":
+        """Return an int8-quantized copy for inference (reference
+        ``module.quantize()`` — SURVEY.md §2.1 Quantized layers): Linear /
+        SpatialConvolution become int8-weight modules running int8×int8→int32
+        contractions on the MXU with an fp32 dequant epilogue."""
+        from bigdl_tpu.nn.quantized import quantize_module
+        return quantize_module(self)
+
     # -------------------------------------------------------------- graph
     def inputs(self, *nodes):
         """Torch-style node wiring: ``layer.inputs(nodeA, nodeB)`` returns a graph
@@ -276,19 +298,31 @@ class AbstractModule:
     def __repr__(self) -> str:
         return f"{type(self).__name__}"
 
-    # serialization (pickle-safe: drop jit caches) -------------------------
+    # serialization --------------------------------------------------------
+    # Two formats, mirroring the reference's split (SURVEY.md §2.5): ``save`` =
+    # in-version pickle (fast, Python-bound, like Java serialization);
+    # ``save_module`` = portable versioned archive (refactor- and
+    # version-tolerant, like the protobuf ``saveModule``). ``load`` sniffs.
     def save(self, path: str, overwrite: bool = True) -> "AbstractModule":
-        """Persist this module (params + structure) — reference ``Module.save``."""
+        """Persist this module via pickle — reference ``Module.save``."""
         from bigdl_tpu.utils import file as _file
         _file.save(self, path, overwrite=overwrite)
         return self
 
-    save_module = save  # reference ``saveModule`` alias
+    def save_module(self, path: str, overwrite: bool = True) -> "AbstractModule":
+        """Persist in the portable versioned format — reference ``saveModule``."""
+        from bigdl_tpu.utils import serializer
+        serializer.save_module(self, path, overwrite=overwrite)
+        return self
 
     @staticmethod
     def load(path: str) -> "AbstractModule":
         from bigdl_tpu.utils import file as _file
-        obj = _file.load(path)
+        from bigdl_tpu.utils import serializer
+        if serializer.is_portable_file(path):
+            obj = serializer.load_module(path)
+        else:
+            obj = _file.load(path)
         if not isinstance(obj, AbstractModule):
             raise TypeError(f"{path} does not contain a module (got {type(obj)})")
         return obj

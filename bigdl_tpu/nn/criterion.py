@@ -23,7 +23,10 @@ import jax.numpy as jnp
 from bigdl_tpu.utils.table import Table
 
 
-class AbstractCriterion:
+from bigdl_tpu.nn.abstractnn import RecordsInit
+
+
+class AbstractCriterion(metaclass=RecordsInit):
     def __init__(self) -> None:
         self.output = None
         self.grad_input = None

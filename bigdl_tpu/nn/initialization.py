@@ -16,7 +16,10 @@ import numpy as np
 from bigdl_tpu.utils.random_generator import RandomGenerator
 
 
-class InitializationMethod:
+from bigdl_tpu.nn.abstractnn import RecordsInit
+
+
+class InitializationMethod(metaclass=RecordsInit):
     def init(self, shape, fan_in: int, fan_out: int) -> np.ndarray:
         raise NotImplementedError
 

@@ -14,7 +14,7 @@ from bigdl_tpu.nn.containers import (
 from bigdl_tpu.nn.convolution import (
     SpatialConvolution, SpatialDilatedConvolution, SpatialFullConvolution,
 )
-from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.embedding import HashBucketEmbedding, LookupTable
 from bigdl_tpu.nn.graph import Graph, Input, ModuleNode, StaticGraph
 from bigdl_tpu.nn.normalization import (
     Add, BatchNormalization, CAdd, CMul, Dropout, GaussianDropout, GaussianNoise, Mul,
@@ -36,6 +36,7 @@ from bigdl_tpu.nn.initialization import (
     RandomNormal, RandomUniform, Xavier, Zeros,
 )
 from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.quantized import QuantizedLinear, QuantizedSpatialConvolution
 from bigdl_tpu.nn.pooling import SpatialAveragePooling, SpatialMaxPooling
 from bigdl_tpu.nn.shape_ops import (
     Contiguous, Flatten, Narrow, Padding, Replicate, Reshape, Select, SpatialZeroPadding,

@@ -1,0 +1,150 @@
+"""Int8 quantized inference tests (bigquant analog, SURVEY.md §2.1/§2.4):
+per-channel weight quantization accuracy, module.quantize() deep conversion,
+LeNet accuracy-drop bound, inference-only enforcement, serialization."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.nn.quantized import (
+    QuantizedLinear, QuantizedSpatialConvolution, _quantize_weight,
+)
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _x(*shape, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(size=shape).astype(np.float32))
+
+
+class TestWeightQuantization:
+    def test_per_channel_roundtrip_error_bounded(self):
+        w = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+        w_q, scale = _quantize_weight(w)
+        assert w_q.dtype == np.int8
+        assert scale.shape == (8,)
+        deq = w_q.astype(np.float32) * scale[:, None]
+        # max error <= scale/2 per channel (symmetric rounding)
+        assert np.all(np.abs(deq - w) <= scale[:, None] / 2 + 1e-7)
+
+    def test_zero_channel_safe(self):
+        w = np.zeros((4, 8), np.float32)
+        w_q, scale = _quantize_weight(w)
+        assert np.all(w_q == 0) and np.all(scale == 1.0)
+
+
+class TestQuantizedLayers:
+    def test_linear_close_to_float(self):
+        RandomGenerator.set_seed(0)
+        m = nn.Linear(32, 16).evaluate()
+        q = QuantizedLinear.from_float(m).evaluate()
+        x = _x(4, 32)
+        y_f = np.asarray(m.forward(x))
+        y_q = np.asarray(q.forward(x))
+        # int8 weight+activation: ~1% relative error is expected headroom
+        rel = np.abs(y_q - y_f) / (np.abs(y_f).max() + 1e-6)
+        assert rel.max() < 0.05
+
+    def test_conv_close_to_float(self):
+        RandomGenerator.set_seed(0)
+        m = nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1).evaluate()
+        q = QuantizedSpatialConvolution.from_float(m).evaluate()
+        x = _x(2, 3, 8, 8)
+        y_f = np.asarray(m.forward(x))
+        y_q = np.asarray(q.forward(x))
+        rel = np.abs(y_q - y_f) / (np.abs(y_f).max() + 1e-6)
+        assert rel.max() < 0.05
+
+    def test_training_raises(self):
+        q = QuantizedLinear.from_float(nn.Linear(4, 2))
+        q.training()
+        with pytest.raises(Exception, match="inference-only"):
+            q.forward(_x(2, 4))
+
+    def test_int32_accumulation_path(self):
+        """The contraction must accumulate in int32 (no fp32 matmul in disguise)."""
+        q = QuantizedLinear(4, 2, with_bias=False)
+        q._params = {"weight_q": jnp.full((2, 4), 100, jnp.int8),
+                     "w_scale": jnp.ones((2,), jnp.float32)}
+        x = jnp.full((1, 4), 100.0)  # activations quantize to ~127
+        out = np.asarray(q.evaluate().forward(x))
+        # 4 * 127 * 100 = 50800 > int16 range: correct only with int32 accum
+        assert np.all(out > 30000)
+
+
+class TestModuleQuantize:
+    def test_deep_conversion_sequential(self):
+        RandomGenerator.set_seed(1)
+        model = (nn.Sequential()
+                 .add(nn.SpatialConvolution(1, 4, 3, 3))
+                 .add(nn.ReLU())
+                 .add(nn.Flatten())
+                 .add(nn.Linear(4 * 6 * 6, 10))
+                 .add(nn.LogSoftMax()))
+        q = model.quantize()
+        kinds = [type(m).__name__ for m in q.modules]
+        assert kinds == ["QuantizedSpatialConvolution", "ReLU", "Flatten",
+                        "QuantizedLinear", "LogSoftMax"]
+        # original untouched
+        assert type(model.modules[0]).__name__ == "SpatialConvolution"
+
+    def test_graph_conversion(self):
+        RandomGenerator.set_seed(1)
+        inp = nn.Input()
+        a = nn.Linear(4, 8).inputs(inp)
+        b = nn.ReLU().inputs(a)
+        c = nn.Linear(8, 3).inputs(b)
+        g = nn.Graph(inp, c)
+        q = g.quantize()
+        kinds = sorted(type(m).__name__ for m in q.modules)
+        assert kinds == ["QuantizedLinear", "QuantizedLinear", "ReLU"]
+        x = _x(2, 4)
+        y_f = np.asarray(g.evaluate().forward(x))
+        y_q = np.asarray(q.evaluate().forward(x))
+        assert np.abs(y_q - y_f).max() / (np.abs(y_f).max() + 1e-6) < 0.1
+
+    def test_lenet_accuracy_drop_bounded(self):
+        """Quantized LeNet agrees with float LeNet on >=98% of synthetic
+        predictions (the reference's quantize() accuracy-drop contract)."""
+        Engine.init(seed=0)
+        from bigdl_tpu.models.lenet import LeNet5
+        RandomGenerator.set_seed(0)
+        model = LeNet5(10).evaluate()
+        q = model.quantize().evaluate()
+        x = _x(64, 1, 28, 28)
+        logits_f = np.asarray(model.forward(x))
+        logits_q = np.asarray(q.forward(x))
+        # untrained random weights on random inputs have tiny argmax margins, so
+        # bound the logit error tightly and the flip rate loosely
+        rel = np.abs(logits_q - logits_f) / (np.abs(logits_f).max() + 1e-6)
+        assert rel.max() < 0.05, f"logit relative error {rel.max()}"
+        agreement = (logits_f.argmax(axis=1) == logits_q.argmax(axis=1)).mean()
+        assert agreement >= 0.9, f"prediction agreement {agreement}"
+
+    def test_quantized_predict_pipeline(self):
+        """predict() works end-to-end through a quantized model."""
+        RandomGenerator.set_seed(0)
+        model = nn.Sequential().add(nn.Linear(8, 3)).add(nn.LogSoftMax())
+        q = model.quantize()
+        out = q.predict(np.asarray(_x(6, 8)), batch_size=6)
+        assert np.asarray(out).shape == (6, 3)
+
+
+class TestQuantizedSerialization:
+    def test_roundtrip(self, tmp_path):
+        RandomGenerator.set_seed(0)
+        q = QuantizedLinear.from_float(nn.Linear(6, 4))
+        p = str(tmp_path / "q.bigdl")
+        q.save_module(p)
+        loaded = nn.AbstractModule.load(p)
+        assert isinstance(loaded, QuantizedLinear)
+        np.testing.assert_array_equal(
+            np.asarray(loaded.get_params()["weight_q"]),
+            np.asarray(q.get_params()["weight_q"]))
+        assert loaded.get_params()["weight_q"].dtype == jnp.int8
+        x = _x(2, 6)
+        np.testing.assert_allclose(np.asarray(q.evaluate().forward(x)),
+                                   np.asarray(loaded.evaluate().forward(x)),
+                                   rtol=1e-6)

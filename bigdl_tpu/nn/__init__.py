@@ -8,9 +8,10 @@ from bigdl_tpu.nn.activation import (
     SoftPlus, SoftSign, Sqrt, Square, Swish, Tanh,
 )
 from bigdl_tpu.nn.containers import (
-    CAddTable, CMulTable, Concat, ConcatTable, Echo, FlattenTable, Identity, JoinTable,
-    MapTable, ParallelTable, SelectTable, Sequential,
+    Bottle, CAddTable, CMulTable, Concat, ConcatTable, Echo, FlattenTable, Identity,
+    JoinTable, MapTable, ParallelTable, SelectTable, Sequential,
 )
+from bigdl_tpu.nn.cosine import Cosine, CosineDistance
 from bigdl_tpu.nn.convolution import (
     SpatialConvolution, SpatialDilatedConvolution, SpatialFullConvolution,
 )
@@ -26,10 +27,14 @@ from bigdl_tpu.nn.recurrent import (
 )
 from bigdl_tpu.nn.criterion import (
     AbsCriterion, AbstractCriterion, BCECriterion, BCECriterionWithLogits,
-    ClassNLLCriterion, CosineEmbeddingCriterion, CrossEntropyCriterion,
-    DistKLDivCriterion, HingeEmbeddingCriterion, L1Cost, MarginCriterion, MSECriterion,
-    MultiCriterion, MultiLabelSoftMarginCriterion, ParallelCriterion, SmoothL1Criterion,
-    TimeDistributedCriterion,
+    ClassNLLCriterion, ClassSimplexCriterion, CosineDistanceCriterion,
+    CosineEmbeddingCriterion, CosineProximityCriterion, CrossEntropyCriterion,
+    DistKLDivCriterion, HingeEmbeddingCriterion, KullbackLeiblerDivergenceCriterion,
+    L1Cost, L1HingeEmbeddingCriterion, MarginCriterion, MarginRankingCriterion,
+    MeanAbsolutePercentageCriterion, MeanSquaredLogarithmicCriterion, MSECriterion,
+    MultiCriterion, MultiLabelMarginCriterion, MultiLabelSoftMarginCriterion,
+    MultiMarginCriterion, ParallelCriterion, PoissonCriterion, SmoothL1Criterion,
+    SoftMarginCriterion, TimeDistributedCriterion,
 )
 from bigdl_tpu.nn.initialization import (
     BilinearFiller, ConstInitMethod, InitializationMethod, MsraFiller, Ones,

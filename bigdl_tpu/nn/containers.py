@@ -176,6 +176,30 @@ class Echo(AbstractModule):
         return input, state
 
 
+class Bottle(Container):
+    """Run the wrapped module on a view with leading dims collapsed: input
+    (d1, ..., dk, rest...) is reshaped so the child sees ``n_input_dims`` dims,
+    and the child's output gets the leading dims restored (reference
+    ``<dl>/nn/Bottle.scala`` — unverified). One reshape in, one out — both free
+    under XLA (layout-only)."""
+
+    def __init__(self, module: AbstractModule, n_input_dims: int = 2):
+        super().__init__(module)
+        self.n_input_dims = n_input_dims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        n_lead = x.ndim - (self.n_input_dims - 1)
+        lead = x.shape[:n_lead]
+        if n_lead > 1:
+            x = x.reshape((-1,) + x.shape[n_lead:])
+        out, new_s = self.modules[0].apply(params["0"], state["0"], x,
+                                           training=training, rng=rng)
+        if n_lead > 1:
+            out = out.reshape(lead + out.shape[1:])
+        return out, {"0": new_s}
+
+
 class MapTable(Container):
     """Apply ONE shared child to every element of the input Table (shared params)."""
 

@@ -227,6 +227,215 @@ class CosineEmbeddingCriterion(AbstractCriterion):
         return _reduce(loss, self.size_average)
 
 
+class MarginRankingCriterion(AbstractCriterion):
+    """Ranking hinge over a pair of score tensors: ``max(0, -y*(x1-x2)+margin)``
+    (reference ``<dl>/nn/MarginRankingCriterion.scala`` — unverified). Input is a
+    Table/tuple (x1, x2); target ∈ {-1, 1}."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__()
+        self.margin, self.size_average = margin, size_average
+
+    def apply(self, input, target):
+        x1, x2 = (input[1], input[2]) if isinstance(input, Table) else (input[0], input[1])
+        t = jnp.reshape(target, x1.shape)
+        loss = jnp.maximum(0.0, -t * (x1 - x2) + self.margin)
+        return _reduce(loss, self.size_average)
+
+
+class MultiMarginCriterion(AbstractCriterion):
+    """Multi-class hinge (reference ``MultiMarginCriterion`` — unverified):
+    ``mean_j(max(0, margin - x[y] + x[j])^p)`` over j != y. 0-based targets by
+    default (framework convention); ``one_based=True`` for Torch parity."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True, one_based: bool = False):
+        super().__init__()
+        if p not in (1, 2):
+            raise ValueError("p must be 1 or 2")
+        self.p, self.margin, self.size_average = p, margin, size_average
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.one_based = one_based
+
+    def apply(self, input, target):
+        x = input if input.ndim == 2 else input[None]
+        t = jnp.reshape(target, (-1,)).astype(jnp.int32)
+        if self.one_based:
+            t = t - 1
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, t[:, None], axis=1)
+        loss = jnp.maximum(0.0, self.margin - correct + x)
+        if self.p == 2:
+            loss = jnp.square(loss)
+        if self.weights is not None:
+            loss = loss * self.weights[t][:, None]
+        # zero out the j == y term
+        mask = jnp.arange(c)[None, :] != t[:, None]
+        per_sample = jnp.sum(loss * mask, axis=1) / c
+        return jnp.mean(per_sample) if self.size_average else jnp.sum(per_sample)
+
+
+class MultiLabelMarginCriterion(AbstractCriterion):
+    """Multi-label multi-class hinge (reference ``MultiLabelMarginCriterion`` —
+    unverified; torch ``multilabel_margin_loss`` semantics). ``target`` rows
+    list label indices, padded with the sentinel 0 (1-based labels) or -1
+    (``one_based=False``); labels after the first sentinel are ignored.
+
+    Memory note: the vectorized hinge materializes an (n, L, c) tensor where L
+    is the target width (= c under torch-shape targets), i.e. O(n*c^2) — fine
+    for the typical multi-label class counts this loss targets (<= a few
+    thousand classes), not for extreme-classification c."""
+
+    def __init__(self, size_average: bool = True, one_based: bool = False):
+        super().__init__()
+        self.size_average = size_average
+        self.one_based = one_based
+
+    def apply(self, input, target):
+        x = input if input.ndim == 2 else input[None]
+        t = target if target.ndim == 2 else target[None]
+        t = t.astype(jnp.int32)
+        n, c = x.shape
+        sentinel = 0 if self.one_based else -1
+        # valid prefix: labels before the first sentinel
+        is_pad = (t == sentinel)
+        valid = jnp.cumsum(is_pad, axis=1) == 0
+        idx = jnp.clip(t - (1 if self.one_based else 0), 0, c - 1)
+        # is_target[b, j] = j appears in the valid label prefix of row b
+        onehot = jax.nn.one_hot(idx, c, dtype=x.dtype) * valid[..., None]
+        is_target = jnp.clip(jnp.sum(onehot, axis=1), 0.0, 1.0)
+        x_target = jnp.take_along_axis(x, idx, axis=1)  # (n, L)
+        # hinge of every valid target score against every non-target class
+        margins = jnp.maximum(
+            0.0, 1.0 - x_target[:, :, None] + x[:, None, :])  # (n, L, c)
+        mask = valid[:, :, None] * (1.0 - is_target)[:, None, :]
+        per_sample = jnp.sum(margins * mask, axis=(1, 2)) / c
+        return jnp.mean(per_sample) if self.size_average else jnp.sum(per_sample)
+
+
+class SoftMarginCriterion(AbstractCriterion):
+    """``mean(log(1 + exp(-y * x)))``, target ∈ {-1, 1} (reference
+    ``SoftMarginCriterion`` — unverified)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        # logaddexp is the overflow-safe log(1 + exp(z)) (cf. BCECriterionWithLogits)
+        return _reduce(jnp.logaddexp(0.0, -input * target), self.size_average)
+
+
+class CosineDistanceCriterion(AbstractCriterion):
+    """``1 - cos(x, y)`` between prediction and target tensors (reference
+    ``CosineDistanceCriterion`` — unverified)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        cos = jnp.sum(input * target, -1) / jnp.clip(
+            jnp.linalg.norm(input, axis=-1) * jnp.linalg.norm(target, axis=-1),
+            1e-12)
+        return _reduce(1.0 - cos, self.size_average)
+
+
+class L1HingeEmbeddingCriterion(AbstractCriterion):
+    """L1 distance hinge over a pair: ``d = |x1 - x2|_1``; loss ``d`` if y=1 else
+    ``max(0, margin - d)`` (reference ``L1HingeEmbeddingCriterion`` — unverified)."""
+
+    def __init__(self, margin: float = 1.0):
+        super().__init__()
+        self.margin = margin
+
+    def apply(self, input, target):
+        x1, x2 = (input[1], input[2]) if isinstance(input, Table) else (input[0], input[1])
+        d = jnp.sum(jnp.abs(x1 - x2), axis=-1)
+        t = jnp.reshape(target, d.shape)
+        loss = jnp.where(t > 0, d, jnp.maximum(0.0, self.margin - d))
+        return jnp.mean(loss)
+
+
+class PoissonCriterion(AbstractCriterion):
+    """Poisson NLL over positive rates: ``mean(pred - target * log(pred))``
+    (keras-style; reference keras loss set — unverified)."""
+
+    def apply(self, input, target):
+        return jnp.mean(input - target * jnp.log(jnp.clip(input, 1e-12)))
+
+
+class CosineProximityCriterion(AbstractCriterion):
+    """Negative mean cosine proximity of l2-normalised tensors (keras
+    ``cosine_proximity``; reference keras loss set — unverified)."""
+
+    def apply(self, input, target):
+        xn = input / jnp.clip(jnp.linalg.norm(input, axis=-1, keepdims=True), 1e-12)
+        tn = target / jnp.clip(jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-12)
+        return -jnp.mean(jnp.sum(xn * tn, axis=-1))
+
+
+class MeanAbsolutePercentageCriterion(AbstractCriterion):
+    """MAPE: ``100 * mean(|t - x| / clip(|t|))`` (keras-style)."""
+
+    def apply(self, input, target):
+        return 100.0 * jnp.mean(
+            jnp.abs(target - input) / jnp.clip(jnp.abs(target), 1e-7))
+
+
+class MeanSquaredLogarithmicCriterion(AbstractCriterion):
+    """MSLE: ``mean((log(1+t) - log(1+x))^2)`` (keras-style)."""
+
+    def apply(self, input, target):
+        return jnp.mean(jnp.square(
+            jnp.log1p(jnp.clip(target, 0.0)) - jnp.log1p(jnp.clip(input, 0.0))))
+
+
+class KullbackLeiblerDivergenceCriterion(AbstractCriterion):
+    """KL(target ‖ input) over probability distributions (keras ``kld``; the
+    log-prob-input variant is :class:`DistKLDivCriterion`)."""
+
+    def apply(self, input, target):
+        t = jnp.clip(target, 1e-7, 1.0)
+        p = jnp.clip(input, 1e-7, 1.0)
+        return jnp.mean(jnp.sum(t * jnp.log(t / p), axis=-1))
+
+
+class ClassSimplexCriterion(AbstractCriterion):
+    """MSE against regular-simplex target embeddings (reference
+    ``ClassSimplexCriterion`` — unverified): class ``y`` maps to the ``y``-th
+    vertex of a regular (nClasses-1)-simplex in R^nClasses."""
+
+    def __init__(self, n_classes: int, size_average: bool = True,
+                 one_based: bool = False):
+        super().__init__()
+        if n_classes < 2:
+            raise ValueError("n_classes must be >= 2")
+        self.n_classes = n_classes
+        self.size_average = size_average
+        self.one_based = one_based
+        self._simplex = jnp.asarray(self._build_simplex(n_classes))
+
+    @staticmethod
+    def _build_simplex(k: int):
+        import numpy as _np
+        # Gram-Schmidt construction of k unit vectors with equal pairwise distance
+        a = _np.zeros((k, k), _np.float32)
+        for i in range(k):
+            for j in range(i):
+                a[i, j] = -(1.0 / k + _np.dot(a[i], a[j])) / a[j, j] if a[j, j] != 0 else 0.0
+            rest = 1.0 - _np.sum(a[i] ** 2)
+            a[i, i] = _np.sqrt(max(rest, 0.0))
+        return a
+
+    def apply(self, input, target):
+        t = jnp.reshape(target, (-1,)).astype(jnp.int32)
+        if self.one_based:
+            t = t - 1
+        goal = self._simplex[t]
+        return _reduce(jnp.square(input - goal), self.size_average)
+
+
 class ParallelCriterion(AbstractCriterion):
     """Weighted sum of criterions over (Table input, Table target) pairs."""
 

@@ -65,3 +65,34 @@ class LookupTable(TensorModule):
 
     def __repr__(self):
         return f"LookupTable({self.n_index} -> {self.n_output})"
+
+
+class HashBucketEmbedding(LookupTable):
+    """Embedding over hashed ids: arbitrary (possibly unbounded) non-negative
+    integer ids are mixed with a Fibonacci multiplicative hash and mapped into
+    ``n_buckets`` rows. The analog of the reference recommendation examples'
+    hashing trick for out-of-vocabulary users/items (SURVEY.md §2.5 Examples:
+    NCF / Wide&Deep), without the host-side feature dictionary.
+
+    Always zero-based (ids are raw hashes, not Torch 1-based vocab indices).
+    """
+
+    def __init__(self, n_buckets: int, n_output: int,
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__(n_buckets, n_output, w_init=w_init, zero_based=True)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        h = input.astype(jnp.uint32)
+        # murmur3-style 32-bit finalizer: full avalanche, so every bucket in
+        # [0, n_buckets) is reachable for any n_buckets up to 2^32 — a handful
+        # of fused integer ops on the VPU
+        h = h ^ (h >> jnp.uint32(16))
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> jnp.uint32(13))
+        h = h * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> jnp.uint32(16))
+        bucket = (h % jnp.uint32(self.n_index)).astype(jnp.int32)
+        return super().apply(params, state, bucket, training=training, rng=rng)
+
+    def __repr__(self):
+        return f"HashBucketEmbedding({self.n_index} buckets -> {self.n_output})"

@@ -12,5 +12,6 @@ from bigdl_tpu.optim.schedules import (
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     AccuracyResult, HitRatio, Loss, LossResult, MAE, NDCG, Top1Accuracy, Top5Accuracy,
+    TreeNNAccuracy,
     TopKAccuracy, ValidationMethod, ValidationResult,
 )

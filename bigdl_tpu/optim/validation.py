@@ -89,6 +89,27 @@ class TopKAccuracy(ValidationMethod):
         return AccuracyResult(correct.sum(), len(correct))
 
 
+class TreeNNAccuracy(ValidationMethod):
+    """Top-1 accuracy on the tree ROOT node's prediction (reference
+    ``<dl>/optim/ValidationMethod.scala`` TreeNNAccuracy, used by the treeLSTM
+    sentiment example — unverified). ``output`` is (N, nodes, classes); the
+    root is the FIRST node; (N, classes) outputs degrade to plain Top-1.
+    ``target`` may be per-node (N, nodes) — the root column is used — or (N,)."""
+
+    def __init__(self, one_based: bool = False):
+        self.one_based = one_based
+        self.name = "TreeNNAccuracy"
+
+    def apply(self, output, target, valid=None):
+        out = np.asarray(output)
+        t = np.asarray(target)
+        if out.ndim == 3:
+            out = out[:, 0, :]
+        if t.ndim == 2:
+            t = t[:, 0]
+        return Top1Accuracy(self.one_based).apply(out, t, valid)
+
+
 class Top1Accuracy(TopKAccuracy):
     def __init__(self, one_based: bool = False):
         super().__init__(1, one_based)

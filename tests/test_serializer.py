@@ -120,6 +120,11 @@ EXAMPLES = {
     "Identity": (lambda: nn.Identity(), _x(2, 3)),
     "Echo": (lambda: nn.Echo(), _x(2, 3)),
     "MapTable": (lambda: nn.MapTable(nn.ReLU()), T(_x(2, 3), _x(2, 4))),
+    "Bottle": (lambda: nn.Bottle(nn.Linear(4, 2)), _x(3, 5, 4)),
+    "Cosine": (lambda: nn.Cosine(4, 3), _x(2, 4)),
+    "CosineDistance": (lambda: nn.CosineDistance(), T(_x(2, 4), _x(2, 4, seed=1))),
+    "HashBucketEmbedding": (lambda: nn.HashBucketEmbedding(16, 4),
+                            jnp.asarray([[5, 99999], [123456789, 0]], jnp.int32)),
     # recurrent
     "RnnCell": (lambda: nn.RnnCell(4, 3), T(_x(2, 4), _x(2, 3))),
     "LSTM": (lambda: nn.LSTM(4, 3), T(_x(2, 4), _x(2, 3), _x(2, 3, seed=1))),

@@ -74,6 +74,8 @@ def _build_registry() -> dict[str, type]:
         _scan(klayers, prefix="keras.")
     except ImportError:  # keras API optional
         pass
+    import bigdl_tpu.utils.tf.ops as tfops
+    _scan(tfops, prefix="tf.")
     return reg
 
 

@@ -1,0 +1,3 @@
+from bigdl_tpu.utils.tf.loader import TFImportError, load_frozen_graph
+
+__all__ = ["TFImportError", "load_frozen_graph"]

@@ -1,0 +1,136 @@
+"""TF frozen-graph import round-trip (SURVEY.md §2.5/§4 import oracles):
+build a tiny TF model covering the supported op set, freeze it, import to
+nn.Graph, and compare outputs against TF's own execution."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax.numpy as jnp  # noqa: E402
+
+from bigdl_tpu.utils.tf import TFImportError, load_frozen_graph  # noqa: E402
+
+
+def _freeze(fn, spec):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+    cf = tf.function(fn).get_concrete_function(spec)
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    out_name = frozen.outputs[0].name.split(":")[0]
+    in_name = frozen.inputs[0].name.split(":")[0]
+    return gd, in_name, out_name, frozen
+
+
+def _make_cnn():
+    rng = np.random.default_rng(0)
+    w1 = tf.Variable(rng.normal(scale=0.2, size=(3, 3, 3, 8)).astype(np.float32))
+    b1 = tf.Variable(rng.normal(size=(8,)).astype(np.float32))
+    scale = tf.Variable(np.abs(rng.normal(size=(8,))).astype(np.float32) + 0.5)
+    offset = tf.Variable(rng.normal(size=(8,)).astype(np.float32))
+    mean = tf.Variable(rng.normal(size=(8,)).astype(np.float32))
+    var = tf.Variable(np.abs(rng.normal(size=(8,))).astype(np.float32) + 0.5)
+    w2 = tf.Variable(rng.normal(scale=0.2, size=(3, 3, 8, 12)).astype(np.float32))
+    w3 = tf.Variable(rng.normal(scale=0.2, size=(1, 1, 8, 12)).astype(np.float32))
+    wd = tf.Variable(rng.normal(scale=0.2, size=(24, 5)).astype(np.float32))
+    bd = tf.Variable(rng.normal(size=(5,)).astype(np.float32))
+
+    def f(x):
+        y = tf.nn.conv2d(x, w1, strides=1, padding="SAME")
+        y = tf.nn.bias_add(y, b1)
+        y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+            y, scale, offset, mean=mean, variance=var, is_training=False)
+        y = tf.nn.relu(y)
+        y = tf.nn.max_pool2d(y, 2, 2, "VALID")            # (1, 8, 8, 8)
+        a = tf.nn.conv2d(y, w2, strides=2, padding="SAME")  # (1, 4, 4, 12)
+        a = tf.nn.relu6(a)
+        b = tf.nn.conv2d(y, w3, strides=2, padding="SAME")  # (1, 4, 4, 12)
+        b = tf.nn.avg_pool2d(b, 2, 2, "SAME")              # (1, 2, 2, 12)
+        a = tf.nn.avg_pool2d(a, 2, 2, "SAME")              # (1, 2, 2, 12)
+        c = tf.concat([a, b], axis=3)                      # (1, 2, 2, 24)
+        m = tf.reduce_mean(c, axis=[1, 2])                 # (1, 24)
+        logits = tf.matmul(m, wd) + bd
+        return tf.nn.softmax(logits)
+
+    return f
+
+
+class TestFrozenGraphImport:
+    def test_cnn_matches_tf(self):
+        fn = _make_cnn()
+        spec = tf.TensorSpec([1, 16, 16, 3], tf.float32)
+        gd, in_name, out_name, frozen = _freeze(fn, spec)
+
+        g = load_frozen_graph(gd, outputs=[out_name], inputs=[in_name])
+        x = np.random.default_rng(1).normal(size=(1, 16, 16, 3)).astype(np.float32)
+        ref = frozen(tf.constant(x))[0].numpy()
+        ours = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_elementwise_and_shape_ops(self):
+        w = tf.Variable(np.random.default_rng(0)
+                        .normal(size=(6, 4)).astype(np.float32))
+
+        def f(x):
+            y = tf.pad(x, [[0, 0], [1, 1]])                  # (N, 6)
+            y = tf.matmul(y, w)
+            y = tf.tanh(y) + tf.sigmoid(y) * 0.5
+            y = y - tf.reduce_mean(y, axis=1, keepdims=True)
+            y = tf.reshape(y, [-1, 2, 2])
+            y = tf.squeeze(tf.expand_dims(y, 1), axis=1)
+            return y
+
+        spec = tf.TensorSpec([2, 4], tf.float32)
+        gd, in_name, out_name, frozen = _freeze(f, spec)
+        # ExpandDims appears as a Reshape in frozen graphs of static shapes —
+        # if not, the loader raises and this test will say which op is missing
+        g = load_frozen_graph(gd, outputs=[out_name], inputs=[in_name])
+        x = np.random.default_rng(1).normal(size=(2, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(g.evaluate().forward(jnp.asarray(x))),
+                                   frozen(tf.constant(x))[0].numpy(),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_depthwise_conv(self):
+        w = tf.Variable(np.random.default_rng(0)
+                        .normal(scale=0.3, size=(3, 3, 4, 2)).astype(np.float32))
+
+        def f(x):
+            return tf.nn.depthwise_conv2d(x, w, strides=[1, 1, 1, 1],
+                                          padding="SAME")
+
+        spec = tf.TensorSpec([1, 8, 8, 4], tf.float32)
+        gd, in_name, out_name, frozen = _freeze(f, spec)
+        g = load_frozen_graph(gd, outputs=[out_name], inputs=[in_name])
+        x = np.random.default_rng(1).normal(size=(1, 8, 8, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(g.evaluate().forward(jnp.asarray(x))),
+                                   frozen(tf.constant(x))[0].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_unsupported_op_fails_loudly(self):
+        def f(x):
+            return tf.linalg.svd(x)[0]  # no converter for Svd
+
+        spec = tf.TensorSpec([3, 3], tf.float32)
+        gd, in_name, out_name, _ = _freeze(f, spec)
+        with pytest.raises(TFImportError, match="unsupported op"):
+            load_frozen_graph(gd, outputs=[out_name])
+
+    def test_imported_graph_is_first_class(self, tmp_path):
+        """The imported model serializes, reloads, and quantize()s like any
+        native module."""
+        fn = _make_cnn()
+        spec = tf.TensorSpec([1, 16, 16, 3], tf.float32)
+        gd, in_name, out_name, _ = _freeze(fn, spec)
+        g = load_frozen_graph(gd, outputs=[out_name], inputs=[in_name])
+
+        from bigdl_tpu import nn
+        p = str(tmp_path / "imported.bigdl")
+        g.save_module(p)
+        loaded = nn.AbstractModule.load(p)
+        x = jnp.asarray(np.random.default_rng(2)
+                        .normal(size=(1, 16, 16, 3)).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(loaded.evaluate().forward(x)),
+                                   np.asarray(g.evaluate().forward(x)),
+                                   rtol=1e-6)

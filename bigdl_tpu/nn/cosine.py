@@ -20,6 +20,13 @@ from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform
 from bigdl_tpu.utils.table import Table
 
 
+def cosine_similarity(x, y, axis: int = -1, eps: float = 1e-12):
+    """Shared clipped cosine similarity (layers + criterions use this one
+    definition so epsilon/broadcasting fixes land everywhere at once)."""
+    return jnp.sum(x * y, axis) / jnp.clip(
+        jnp.linalg.norm(x, axis=axis) * jnp.linalg.norm(y, axis=axis), eps)
+
+
 class Cosine(TensorModule):
     """``out[b, o] = cos(x[b], w[o])`` with learnable prototypes
     ``w: (output_size, input_size)``."""
@@ -39,6 +46,10 @@ class Cosine(TensorModule):
         self.zero_grad_parameters()
 
     def apply(self, params, state, input, *, training=False, rng=None):
+        if input.ndim > 2:
+            raise ValueError(
+                f"Cosine expects (N, {self.input_size}) or ({self.input_size},), "
+                f"got {input.shape}; wrap with Bottle for higher-rank inputs")
         x = input if input.ndim == 2 else input[None]
         w = params["weight"]
         xn = x / jnp.clip(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
@@ -58,6 +69,4 @@ class CosineDistance(AbstractModule):
     def apply(self, params, state, input, *, training=False, rng=None):
         x1, x2 = (input[1], input[2]) if isinstance(input, Table) \
             else (input[0], input[1])
-        cos = jnp.sum(x1 * x2, -1) / jnp.clip(
-            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
-        return cos, state
+        return cosine_similarity(x1, x2), state

@@ -335,10 +335,8 @@ class CosineDistanceCriterion(AbstractCriterion):
         self.size_average = size_average
 
     def apply(self, input, target):
-        cos = jnp.sum(input * target, -1) / jnp.clip(
-            jnp.linalg.norm(input, axis=-1) * jnp.linalg.norm(target, axis=-1),
-            1e-12)
-        return _reduce(1.0 - cos, self.size_average)
+        from bigdl_tpu.nn.cosine import cosine_similarity
+        return _reduce(1.0 - cosine_similarity(input, target), self.size_average)
 
 
 class L1HingeEmbeddingCriterion(AbstractCriterion):
@@ -370,9 +368,8 @@ class CosineProximityCriterion(AbstractCriterion):
     ``cosine_proximity``; reference keras loss set — unverified)."""
 
     def apply(self, input, target):
-        xn = input / jnp.clip(jnp.linalg.norm(input, axis=-1, keepdims=True), 1e-12)
-        tn = target / jnp.clip(jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-12)
-        return -jnp.mean(jnp.sum(xn * tn, axis=-1))
+        from bigdl_tpu.nn.cosine import cosine_similarity
+        return -jnp.mean(cosine_similarity(input, target))
 
 
 class MeanAbsolutePercentageCriterion(AbstractCriterion):

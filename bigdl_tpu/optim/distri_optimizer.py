@@ -93,11 +93,23 @@ class DistriOptimizer(Optimizer):
         self._shardings = (param_sh, mstate_sh, ostate_sh)
 
         step = self._make_step_fn()
+        out_sh = (param_sh, mstate_sh, ostate_sh, None)
+        if self.check_numerics:
+            from jax.experimental import checkify
+
+            checked = checkify.checkify(step, errors=checkify.float_checks)
+
+            def step_with_err(*args):
+                err, out = checked(*args)
+                return (*out, err)
+
+            step = step_with_err
+            out_sh = (*out_sh, None)
         return jax.jit(
             step,
             in_shardings=(param_sh, mstate_sh, ostate_sh, None,
                           self._batch_sh, self._batch_sh, None),
-            out_shardings=(param_sh, mstate_sh, ostate_sh, None),
+            out_shardings=out_sh,
             donate_argnums=(0, 1, 2),
         )
 

@@ -85,6 +85,10 @@ class Optimizer:
         # per-iteration device sync for true step-time metrics (debug only —
         # defeats async dispatch)
         self.sync_metrics: bool = os.environ.get("BIGDL_SYNC_METRICS", "0") == "1"
+        # numerics sanitizer (SURVEY.md §5.2 analog): compile the step under
+        # checkify float checks; NaN/inf anywhere in the step raises with the
+        # generating op's location. Debug-only — adds checking ops to the trace.
+        self.check_numerics: bool = os.environ.get("BIGDL_CHECK_NUMERICS", "0") == "1"
         self._step_cache = None
 
     # fluent config (reference API shape) ----------------------------------
@@ -101,6 +105,16 @@ class Optimizer:
         if depth < 0:
             raise ValueError("prefetch depth must be >= 0")
         self.prefetch_depth = depth
+        return self
+
+    def set_check_numerics(self, enabled: bool = True) -> "Optimizer":
+        """Enable the numerics sanitizer: every step runs under
+        ``jax.experimental.checkify`` float checks, and a NaN/inf produced
+        anywhere in forward/backward/update raises at the next loss flush with
+        the location of the generating op (the reference has no sanitizer —
+        SURVEY.md §5.2 — this is the functional-JAX upgrade)."""
+        self.check_numerics = enabled
+        self._step_cache = None
         return self
 
     def set_profile(self, trace_dir: str, start_iter: int = 10,
@@ -201,7 +215,18 @@ class Optimizer:
         return step
 
     def _compile_step(self):
-        return jax.jit(self._make_step_fn(), donate_argnums=(0, 1, 2))
+        step = self._make_step_fn()
+        if self.check_numerics:
+            from jax.experimental import checkify
+
+            checked = checkify.checkify(step, errors=checkify.float_checks)
+
+            def step_with_err(*args):
+                err, out = checked(*args)
+                return (*out, err)
+
+            return jax.jit(step_with_err, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _make_eval_fn(self):
         from bigdl_tpu.optim.evaluator import cached_forward_jit
@@ -250,6 +275,9 @@ class Optimizer:
                 self._load_latest_checkpoint()
 
     def _has_checkpoint(self) -> bool:
+        # land any in-flight write; a FAILED write logs (older files may still
+        # offer a valid, if stale, recovery point for the retry loop)
+        self._join_checkpoint_writer(raise_error=False)
         return (self.checkpoint_path is not None
                 and os.path.isdir(self.checkpoint_path)
                 and any(p.startswith("checkpoint") and p.endswith(".pkl")
@@ -331,8 +359,12 @@ class Optimizer:
 
                     step_idx = jnp.asarray(state["neval"] - 1, jnp.int32)
                     with self.metrics.timer("step_dispatch"):
-                        params, mstate, ostate, loss = step_fn(
+                        out = step_fn(
                             params, mstate, ostate, step_idx, inp, target, base_rng)
+                    if self.check_numerics:
+                        params, mstate, ostate, loss, err = out
+                    else:
+                        (params, mstate, ostate, loss), err = out, None
                     run_iters += 1
                     if self.sync_metrics:
                         with self.metrics.timer("step_device"):
@@ -351,12 +383,14 @@ class Optimizer:
                         # throughput window — one-time costs must not be billed to
                         # steady-state throughput (round-2 bench bug).
                         val = float(jax.device_get(loss))
+                        if err is not None:
+                            jax.device_get(err).throw()
                         state["loss"] = val
                         self._write_iter_summary(state["neval"], val, state)
                         records = 0
                         window_t0 = time.perf_counter()
                     else:
-                        pending.append((state["neval"], loss, batch.valid))
+                        pending.append((state["neval"], loss, batch.valid, err))
                     if state["neval"] % self.log_every == 0:
                         # fetch all complete losses in one round trip; the newest
                         # stays pending so the fetch never stalls on the in-flight
@@ -398,6 +432,7 @@ class Optimizer:
 
         self._stop_profiler_if_active()  # endWhen fired inside the trace window
         self._flush_pending(pending, state, keep_last=False)
+        self._join_checkpoint_writer()  # optimize() returning implies ckpt durable
         self.model.set_params(jax.device_get(params))
         self.model.set_state(jax.device_get(mstate))
         self._final_ostate = jax.device_get(ostate)
@@ -415,9 +450,12 @@ class Optimizer:
         if not to_fetch:
             return 0
         with self.metrics.timer("loss_fetch"):
-            vals = jax.device_get([l for _, l, _ in to_fetch])
+            vals, errs = jax.device_get(
+                ([l for _, l, _, _ in to_fetch], [e for _, _, _, e in to_fetch]))
         records = 0
-        for (it, _, valid), v in zip(to_fetch, vals):
+        for (it, _, valid, _), v, err in zip(to_fetch, vals, errs):
+            if err is not None:
+                err.throw()  # checkify sanitizer: NaN/inf with op location
             state["loss"] = float(v)
             records += valid
             self._write_iter_summary(it, float(v), state)
@@ -557,6 +595,10 @@ class Optimizer:
         return os.path.join(self.checkpoint_path, f"checkpoint{tag}.pkl")
 
     def _save_checkpoint(self, params, mstate, ostate, state) -> None:
+        """Fetch on the loop thread (consistent snapshot), write on a background
+        thread — the disk write must not stall the step loop (the reference's
+        driver-side save had the same property via Spark async jobs; orbax-style
+        async is the same split). At most one write is in flight."""
         os.makedirs(self.checkpoint_path, exist_ok=True)
         payload = {
             "params": jax.device_get(params),
@@ -568,13 +610,39 @@ class Optimizer:
         if getattr(sched, "stateful", False):
             payload["sched_state"] = sched.state_dict()
         path = self._ckpt_file(state)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(payload, f)
-        os.replace(tmp, path)
-        logger.info("checkpoint written: %s", path)
+        self._join_checkpoint_writer()
+
+        def _write():
+            try:
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    pickle.dump(payload, f)
+                os.replace(tmp, path)
+                logger.info("checkpoint written: %s", path)
+            except BaseException as e:  # surfaced at the next join
+                self._ckpt_error = e
+
+        import threading
+        t = threading.Thread(target=_write, name="bigdl-ckpt-writer", daemon=False)
+        t.start()
+        self._ckpt_thread = t
+
+    def _join_checkpoint_writer(self, raise_error: bool = True) -> None:
+        t = getattr(self, "_ckpt_thread", None)
+        if t is not None:
+            t.join()
+            self._ckpt_thread = None
+        err = getattr(self, "_ckpt_error", None)
+        if err is not None:
+            # a failed write must not read as a durable checkpoint (the retry
+            # loop would silently resume from a stale file)
+            self._ckpt_error = None
+            if raise_error:
+                raise RuntimeError("background checkpoint write failed") from err
+            logger.error("background checkpoint write failed: %r", err)
 
     def _load_latest_checkpoint(self) -> None:
+        self._join_checkpoint_writer()  # in-flight write must land before reading
         cand = sorted(
             (p for p in os.listdir(self.checkpoint_path) if p.startswith("checkpoint")
              and p.endswith(".pkl")),

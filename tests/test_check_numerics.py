@@ -1,0 +1,81 @@
+"""Numerics sanitizer (SURVEY.md §5.2 analog): checkify-compiled steps raise on
+NaN/inf with the generating op's location instead of silently training garbage."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+
+def _data(n=32, dim=4, classes=3, batch=8, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return DataSet.array(
+        [Sample((scale * rng.normal(size=(dim,))).astype(np.float32),
+                np.int32(rng.integers(0, classes))) for _ in range(n)]
+    ) >> SampleToMiniBatch(batch)
+
+
+class TestCheckNumerics:
+    def test_nan_raises_with_location(self):
+        Engine.init(seed=0)
+        # Log of a signed pre-activation produces NaNs immediately
+        model = (nn.Sequential().add(nn.Linear(4, 3)).add(nn.Log())
+                 .add(nn.LogSoftMax()))
+        opt = (LocalOptimizer(model, _data(), nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_check_numerics(True)
+               .set_end_when(Trigger.max_iteration(4)))
+        # the retry loop must not swallow it: no checkpoint configured → reraises
+        with pytest.raises(Exception, match="(?i)nan"):
+            opt.optimize()
+
+    def test_clean_training_unaffected(self):
+        Engine.init(seed=0)
+        model = nn.Sequential().add(nn.Linear(4, 3)).add(nn.LogSoftMax())
+        opt = (LocalOptimizer(model, _data(), nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_check_numerics(True)
+               .set_end_when(Trigger.max_iteration(6)))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+        assert opt.state["neval"] >= 6
+
+    def test_distributed_sanitizer(self):
+        """DistriOptimizer honors check_numerics: clean run works, NaN raises."""
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+        Engine.init(seed=0)
+        model = nn.Sequential().add(nn.Linear(4, 3)).add(nn.LogSoftMax())
+        data = _data(batch=16)
+        opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_check_numerics(True)
+               .set_end_when(Trigger.max_iteration(3)))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+
+        bad = (nn.Sequential().add(nn.Linear(4, 3)).add(nn.Log())
+               .add(nn.LogSoftMax()))
+        opt2 = (DistriOptimizer(bad, data, nn.ClassNLLCriterion())
+                .set_optim_method(SGD(learningrate=0.1))
+                .set_check_numerics(True)
+                .set_end_when(Trigger.max_iteration(3)))
+        with pytest.raises(Exception, match="(?i)nan"):
+            opt2.optimize()
+
+    def test_same_math_as_unchecked(self):
+        finals = []
+        for check in (False, True):
+            Engine.reset()
+            Engine.init(seed=0)
+            model = nn.Sequential().add(nn.Linear(4, 3)).add(nn.LogSoftMax())
+            opt = (LocalOptimizer(model, _data(), nn.ClassNLLCriterion())
+                   .set_optim_method(SGD(learningrate=0.1))
+                   .set_check_numerics(check)
+                   .set_end_when(Trigger.max_iteration(5)))
+            opt.optimize()
+            finals.append(opt.state["loss"])
+        assert finals[0] == pytest.approx(finals[1], rel=1e-6)

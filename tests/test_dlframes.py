@@ -1,0 +1,78 @@
+"""sklearn estimator wrappers (Spark-ML dlframes analog, SURVEY.md §2.5):
+contract compliance (clone/pipeline/CV) and real learning on separable data."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.dlframes import DLClassifier, DLRegressor
+
+
+def _blobs(n=120, dim=6, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=3.0, size=(classes, dim))
+    y = rng.integers(0, classes, size=n)
+    X = centers[y] + rng.normal(scale=0.5, size=(n, dim))
+    return X.astype(np.float32), y
+
+
+def _clf(dim=6, classes=3, **kw):
+    return DLClassifier(
+        model_fn=lambda: (nn.Sequential().add(nn.Linear(dim, 16)).add(nn.ReLU())
+                          .add(nn.Linear(16, classes)).add(nn.LogSoftMax())),
+        criterion_fn=nn.ClassNLLCriterion,
+        batch_size=24, max_epoch=25, learning_rate=0.01, **kw)
+
+
+class TestClassifier:
+    def test_fit_predict_score(self):
+        Engine.init(seed=0)
+        X, y = _blobs()
+        clf = _clf().fit(X, y)
+        acc = clf.score(X, y)
+        assert acc > 0.9, acc
+        proba = clf.predict_proba(X[:5])
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_label_mapping_non_contiguous(self):
+        """Arbitrary label values (7, 20, 42) map through classes_ correctly."""
+        Engine.init(seed=0)
+        X, y = _blobs()
+        y_mapped = np.asarray([7, 20, 42])[y]
+        clf = _clf().fit(X, y_mapped)
+        assert set(np.unique(clf.predict(X))) <= {7, 20, 42}
+        assert clf.score(X, y_mapped) > 0.9
+
+    def test_sklearn_clone_and_pipeline(self):
+        from sklearn.base import clone
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+
+        Engine.init(seed=0)
+        X, y = _blobs()
+        clf = _clf()
+        c2 = clone(clf)  # params survive cloning (BaseEstimator contract)
+        assert c2.get_params()["max_epoch"] == 25
+        pipe = Pipeline([("scale", StandardScaler()), ("net", _clf())])
+        pipe.fit(X, y)
+        assert pipe.score(X, y) > 0.9
+
+    def test_unfitted_raises(self):
+        with pytest.raises(RuntimeError, match="not fitted"):
+            _clf().predict(np.zeros((2, 6), np.float32))
+
+
+class TestRegressor:
+    def test_learns_linear_map(self):
+        Engine.init(seed=0)
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        w = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+        y = X @ w + 0.7
+        reg = DLRegressor(
+            model_fn=lambda: nn.Sequential().add(nn.Linear(4, 1)),
+            criterion_fn=nn.MSECriterion,
+            batch_size=32, max_epoch=40, learning_rate=0.05)
+        reg.fit(X, y)
+        r2 = reg.score(X, y)
+        assert r2 > 0.98, r2

@@ -194,6 +194,52 @@ def _measure_direct_step(opt, batch: int, iters: int) -> float:
     return batch * iters / dt
 
 
+def _measure_int8_infer(model_name: str, batch: int, iters: int) -> dict:
+    """Inference micro-bench: bf16 forward vs int8-quantized forward on the
+    same model (bigquant-analog done-criterion: int8 must not be slower)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    Engine.init(compute_dtype=jnp.bfloat16)
+    model, _, _ = _build(model_name, batch, n_batches=1, dtype="bf16")
+    model.evaluate()
+    qmodel = model.quantize().evaluate()
+    shape = (batch, 3, 224, 224) if model_name == "resnet50" else (batch, 1, 28, 28)
+    x = jax.device_put(np.random.default_rng(0)
+                       .normal(size=shape).astype(np.float32))
+
+    def timed(m, cast_bf16):
+        params = jax.device_put(m.get_params())
+        mstate = jax.device_put(m.get_state())
+
+        def fwd(p, s, xx):
+            if cast_bf16:
+                from bigdl_tpu.nn.precision import cast_floating
+                p = cast_floating(p, jnp.bfloat16)
+                xx = cast_floating(xx, jnp.bfloat16)
+            out, _ = m.apply(p, s, xx, training=False, rng=None)
+            return out
+        jit_fwd = jax.jit(fwd)
+        jax.block_until_ready(jit_fwd(params, mstate, x))  # compile
+        float(jnp.sum(jit_fwd(params, mstate, x)))         # sync
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = jit_fwd(params, mstate, x)
+        float(jnp.sum(out))  # terminal sync
+        return batch * iters / (time.perf_counter() - t0)
+
+    bf16_ips = timed(model, cast_bf16=True)
+    int8_ips = timed(qmodel, cast_bf16=False)
+    return {"bf16_infer_ips": round(bf16_ips, 1),
+            "int8_infer_ips": round(int8_ips, 1),
+            "int8_bf16_ratio": round(int8_ips / bf16_ips, 2)}
+
+
 def run_worker(args) -> None:
     """The measured child process: ONE dtype, one JSON line, exit.
 
@@ -333,10 +379,16 @@ def main():
                    action="store_false")
     p.add_argument("--timeout", type=int, default=1500,
                    help="per-attempt subprocess timeout (s)")
+    p.add_argument("--int8-infer", action="store_true",
+                   help="inference micro-bench: bf16 vs int8-quantized forward")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
     args = p.parse_args()
-    if args.run:
+    if args.int8_infer:
+        res = _measure_int8_infer(args.model, args.batch, max(args.iters, 10))
+        res["metric"] = f"{args.model}_int8_vs_bf16_infer"
+        print(json.dumps(res))
+    elif args.run:
         run_worker(args)
     else:
         run_orchestrator(args)

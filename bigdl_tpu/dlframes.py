@@ -66,6 +66,9 @@ class DLEstimator(BaseEstimator):
             raise ValueError("model_fn and criterion_fn are required")
         X = np.asarray(X, np.float32)
         y = np.asarray(y, self._label_dtype())
+        if len(X) != len(y):
+            raise ValueError(
+                f"inconsistent sample counts: X has {len(X)}, y has {len(y)}")
         if y.ndim == 1 and np.issubdtype(y.dtype, np.floating):
             # regression targets must match the model's (N, 1) output — a bare
             # (N,) target would silently broadcast the loss to (N, N)

@@ -42,13 +42,13 @@ def synthetic_interactions(user_count: int, item_count: int, n: int, seed=0):
     user_cluster = rng.integers(0, n_clusters, size=user_count)
     item_cluster = rng.integers(0, n_clusters, size=item_count)
     users = rng.integers(0, user_count, size=n)
+    members = [np.flatnonzero(item_cluster == c) for c in range(n_clusters)]
     # positive items: 80% from the user's cluster, 20% uniform
     pos_items = np.empty(n, np.int64)
     for idx in range(n):
-        if rng.random() < 0.8:
-            members = np.flatnonzero(item_cluster == user_cluster[users[idx]])
-            pos_items[idx] = rng.choice(members) if len(members) else \
-                rng.integers(0, item_count)
+        own = members[user_cluster[users[idx]]]
+        if rng.random() < 0.8 and len(own):
+            pos_items[idx] = rng.choice(own)
         else:
             pos_items[idx] = rng.integers(0, item_count)
     return users, pos_items, user_cluster, item_cluster

@@ -14,6 +14,7 @@ from bigdl_tpu.nn.containers import (
 from bigdl_tpu.nn.cosine import Cosine, CosineDistance
 from bigdl_tpu.nn.convolution import (
     SpatialConvolution, SpatialDilatedConvolution, SpatialFullConvolution,
+    TemporalConvolution,
 )
 from bigdl_tpu.nn.embedding import HashBucketEmbedding, LookupTable
 from bigdl_tpu.nn.graph import Graph, Input, ModuleNode, StaticGraph
@@ -42,7 +43,10 @@ from bigdl_tpu.nn.initialization import (
 )
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.quantized import QuantizedLinear, QuantizedSpatialConvolution
-from bigdl_tpu.nn.pooling import SpatialAveragePooling, SpatialMaxPooling
+from bigdl_tpu.nn.sparse import SparseEmbeddingSum, SparseLinear
+from bigdl_tpu.nn.pooling import (
+    SpatialAveragePooling, SpatialMaxPooling, TemporalMaxPooling,
+)
 from bigdl_tpu.nn.shape_ops import (
     Contiguous, Flatten, Narrow, Padding, Replicate, Reshape, Select, SpatialZeroPadding,
     SplitTable, Squeeze, Transpose, Unsqueeze, View,

@@ -191,3 +191,56 @@ class SpatialFullConvolution(TensorModule):
         if squeeze:
             out = out[0]
         return out, state
+
+
+class TemporalConvolution(TensorModule):
+    """1-D convolution over time (reference ``<dl>/nn/TemporalConvolution.scala``
+    — unverified): input (N, T, input_frame_size) → (N, (T-kw)//dw+1,
+    output_frame_size). One NWC conv lowered onto the MXU."""
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1, with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.with_bias = with_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        fan_in = self.input_frame_size * self.kernel_w
+        w = self.w_init.init((self.kernel_w, self.input_frame_size,
+                              self.output_frame_size),
+                             fan_in=fan_in, fan_out=self.output_frame_size)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            b = self.b_init.init((self.output_frame_size,), fan_in=fan_in,
+                                 fan_out=self.output_frame_size)
+            self._params["bias"] = jnp.asarray(b)
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]
+        out = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.stride_w,),
+            padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        if self.with_bias:
+            out = out + params["bias"]
+        if squeeze:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return (f"TemporalConvolution({self.input_frame_size} -> "
+                f"{self.output_frame_size}, {self.kernel_w}, {self.stride_w})")

@@ -95,15 +95,7 @@ class DistriOptimizer(Optimizer):
         step = self._make_step_fn()
         out_sh = (param_sh, mstate_sh, ostate_sh, None)
         if self.check_numerics:
-            from jax.experimental import checkify
-
-            checked = checkify.checkify(step, errors=checkify.float_checks)
-
-            def step_with_err(*args):
-                err, out = checked(*args)
-                return (*out, err)
-
-            step = step_with_err
+            step = self._wrap_checkify(step)
             out_sh = (*out_sh, None)
         return jax.jit(
             step,

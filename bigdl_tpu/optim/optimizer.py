@@ -214,18 +214,32 @@ class Optimizer:
 
         return step
 
+    def _wrap_checkify(self, step):
+        """Sanitizer wrap shared by Local and Distri compile paths: the step
+        grows a 5th output (the checkify error) that _optimize_impl unpacks.
+        float_checks flags NaN production; overflow to inf is NOT a NaN, so a
+        diverging run is additionally guarded by an explicit finite-loss check."""
+        from jax.experimental import checkify
+
+        def step_guarded(*args):
+            new_p, new_ms, new_os, loss = step(*args)
+            checkify.check(jnp.isfinite(loss),
+                           "non-finite loss (divergence): {loss}", loss=loss)
+            return new_p, new_ms, new_os, loss
+
+        checked = checkify.checkify(
+            step_guarded, errors=checkify.float_checks | checkify.user_checks)
+
+        def step_with_err(*args):
+            err, out = checked(*args)
+            return (*out, err)
+
+        return step_with_err
+
     def _compile_step(self):
         step = self._make_step_fn()
         if self.check_numerics:
-            from jax.experimental import checkify
-
-            checked = checkify.checkify(step, errors=checkify.float_checks)
-
-            def step_with_err(*args):
-                err, out = checked(*args)
-                return (*out, err)
-
-            return jax.jit(step_with_err, donate_argnums=(0, 1, 2))
+            return jax.jit(self._wrap_checkify(step), donate_argnums=(0, 1, 2))
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _make_eval_fn(self):
@@ -415,7 +429,8 @@ class Optimizer:
                             logger.info("Epoch %d iter %d: loss %.6f",
                                         state["epoch"], state["neval"], state["loss"])
 
-                    self._fire_triggers(params, mstate, ostate, state, boundary=False)
+                    self._fire_triggers(params, mstate, ostate, state,
+                                        boundary=False, pending=pending)
                     state["neval"] += 1
             if stop:
                 break
@@ -426,7 +441,8 @@ class Optimizer:
             # full flush so Plateau(loss) sees the latest value; the records stay
             # in the running window (the next log boundary bills them)
             records += self._flush_pending(pending, state, keep_last=False)
-            self._fire_triggers(params, mstate, ostate, state, boundary=True)
+            self._fire_triggers(params, mstate, ostate, state, boundary=True,
+                                pending=pending)
             if self.end_when(state):
                 break
 
@@ -494,7 +510,8 @@ class Optimizer:
             return True
         return (scope == "epoch") == boundary
 
-    def _fire_triggers(self, params, mstate, ostate, state, boundary: bool) -> None:
+    def _fire_triggers(self, params, mstate, ostate, state, boundary: bool,
+                       pending: Optional[list] = None) -> None:
         # Stateful-schedule (Plateau) cadence: monitor='score' is fed after each
         # validation round; monitor='loss' is fed exactly once per epoch boundary
         # (whether or not validation is configured) — never both for one metric.
@@ -511,6 +528,11 @@ class Optimizer:
         if self.checkpoint_trigger is not None and self.checkpoint_path is not None \
                 and self._in_scope(self.checkpoint_trigger, boundary) \
                 and self.checkpoint_trigger(state):
+            if self.check_numerics and pending:
+                # a deferred checkify error must throw BEFORE the write — a
+                # NaN-poisoned checkpoint would become the retry loop's
+                # deterministic-failure resume point
+                self._flush_pending(pending, state, keep_last=False)
             self._save_checkpoint(params, mstate, ostate, state)
         # scalar summaries (Loss/LearningRate/Throughput) are written by
         # _flush_pending with exact per-iteration values; only the opt-in

@@ -66,6 +66,41 @@ class TestCheckNumerics:
         with pytest.raises(Exception, match="(?i)nan"):
             opt2.optimize()
 
+    def test_no_poisoned_checkpoint(self, tmp_path, monkeypatch):
+        """Divergence (inf/NaN) with a checkpoint trigger: any checkpoint that
+        lands on disk must hold finite params — the deferred error throws
+        before the write."""
+        import pickle
+
+        monkeypatch.setenv("BIGDL_FAILURE_RETRY_TIMES", "0")
+        Engine.reset()
+        Engine.init(seed=0)
+        rng = np.random.default_rng(0)
+        from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+        from bigdl_tpu.dataset.sample import Sample
+        reg_data = DataSet.array(
+            [Sample(rng.normal(size=(4,)).astype(np.float32),
+                    rng.normal(size=(1,)).astype(np.float32))
+             for _ in range(32)]) >> SampleToMiniBatch(8)
+        model = nn.Sequential().add(nn.Linear(4, 1))
+        opt = (LocalOptimizer(model, reg_data, nn.MSECriterion())
+               .set_optim_method(SGD(learningrate=1e12))  # guaranteed blow-up
+               .set_check_numerics(True)
+               .set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+               .set_end_when(Trigger.max_iteration(8)))
+        opt.log_every = 100  # force the deferred (pending) error path
+        with pytest.raises(Exception):
+            opt.optimize()
+        import os
+        for f in os.listdir(tmp_path):
+            if not f.endswith(".pkl"):
+                continue
+            with open(tmp_path / f, "rb") as fh:
+                payload = pickle.load(fh)
+            import jax
+            for leaf in jax.tree_util.tree_leaves(payload["params"]):
+                assert np.isfinite(np.asarray(leaf)).all(), f
+
     def test_same_math_as_unchecked(self):
         finals = []
         for check in (False, True):

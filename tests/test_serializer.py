@@ -66,6 +66,8 @@ EXAMPLES = {
     "SpatialFullConvolution": (
         lambda: nn.SpatialFullConvolution(2, 4, 3, 3), _x(1, 2, 6, 6)),
     "SpatialMaxPooling": (lambda: nn.SpatialMaxPooling(2, 2), _x(1, 2, 6, 6)),
+    "TemporalConvolution": (lambda: nn.TemporalConvolution(4, 6, 3), _x(2, 8, 4)),
+    "TemporalMaxPooling": (lambda: nn.TemporalMaxPooling(2), _x(2, 8, 4)),
     "SpatialAveragePooling": (lambda: nn.SpatialAveragePooling(2, 2), _x(1, 2, 6, 6)),
     "LookupTable": (lambda: nn.LookupTable(10, 4),
                     jnp.asarray([[1, 2], [3, 4]], jnp.int32)),
@@ -125,6 +127,10 @@ EXAMPLES = {
     "CosineDistance": (lambda: nn.CosineDistance(), T(_x(2, 4), _x(2, 4, seed=1))),
     "HashBucketEmbedding": (lambda: nn.HashBucketEmbedding(16, 4),
                             jnp.asarray([[5, 99999], [123456789, 0]], jnp.int32)),
+    "SparseLinear": (lambda: nn.SparseLinear(20, 3),
+                     jnp.asarray([[1, 5, -1], [0, -1, -1]], jnp.int32)),
+    "SparseEmbeddingSum": (lambda: nn.SparseEmbeddingSum(20, 4),
+                           jnp.asarray([[1, 5, -1], [0, -1, -1]], jnp.int32)),
     # recurrent
     "RnnCell": (lambda: nn.RnnCell(4, 3), T(_x(2, 4), _x(2, 3))),
     "LSTM": (lambda: nn.LSTM(4, 3), T(_x(2, 4), _x(2, 3), _x(2, 3, seed=1))),

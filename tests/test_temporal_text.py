@@ -1,0 +1,86 @@
+"""Temporal conv/pooling layers (torch oracles) + the text-classification
+example end-to-end (SURVEY.md §2.5 Examples)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _np(*shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestTemporalConvolution:
+    def test_torch_oracle(self):
+        RandomGenerator.set_seed(0)
+        m = nn.TemporalConvolution(4, 6, kernel_w=3, stride_w=2).evaluate()
+        x = _np(2, 9, 4)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        # torch conv1d: input (N, C, T), weight (out, in, k); ours (k, in, out)
+        w = np.asarray(m.get_params()["weight"]).transpose(2, 1, 0)
+        b = np.asarray(m.get_params()["bias"])
+        ref = F.conv1d(torch.tensor(x).permute(0, 2, 1), torch.tensor(w),
+                       torch.tensor(b), stride=2).permute(0, 2, 1).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_2d_input_squeeze(self):
+        RandomGenerator.set_seed(0)
+        m = nn.TemporalConvolution(4, 6, 3).evaluate()
+        out = m.forward(jnp.asarray(_np(8, 4)))
+        assert out.shape == (6, 6)
+
+    def test_gradients(self):
+        RandomGenerator.set_seed(0)
+        m = nn.TemporalConvolution(4, 6, 3)
+        x = jnp.asarray(_np(2, 8, 4))
+        y = m.training().forward(x)
+        gi = m.backward(x, jnp.ones_like(y))
+        assert gi.shape == x.shape and np.abs(np.asarray(gi)).max() > 0
+
+
+class TestTemporalMaxPooling:
+    def test_torch_oracle(self):
+        m = nn.TemporalMaxPooling(3, 2).evaluate()
+        x = _np(2, 9, 4)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        ref = F.max_pool1d(torch.tensor(x).permute(0, 2, 1), 3,
+                           stride=2).permute(0, 2, 1).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_global_pool(self):
+        m = nn.TemporalMaxPooling(-1).evaluate()
+        x = _np(2, 9, 4)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        assert out.shape == (2, 1, 4)
+        np.testing.assert_allclose(out[:, 0], x.max(axis=1), rtol=1e-6)
+
+
+class TestTextClassifierExample:
+    def test_end_to_end_learns(self):
+        from bigdl_tpu.models.textclassifier.train import main
+
+        Engine.reset()
+        Engine.init(seed=0)
+        RandomGenerator.set_seed(0)
+        acc = main(["--max-epoch", "3", "--sentences", "1024",
+                    "--classes", "4"])
+        assert acc > 0.45, acc  # class prior is 0.25
+
+    def test_model_shapes(self):
+        from bigdl_tpu.models.textclassifier import TextClassifier
+
+        Engine.init(seed=0)
+        RandomGenerator.set_seed(0)
+        m = TextClassifier(vocab_size=100, class_num=3, seq_len=32).evaluate()
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, 100, (2, 32)),
+                          jnp.int32)
+        out = m.forward(ids)
+        assert out.shape == (2, 3)
+        np.testing.assert_allclose(np.exp(np.asarray(out)).sum(axis=1), 1.0,
+                                   rtol=1e-5)

@@ -8,8 +8,14 @@ from bigdl_tpu.nn.activation import (
     SoftPlus, SoftSign, Sqrt, Square, Swish, Tanh,
 )
 from bigdl_tpu.nn.containers import (
-    Bottle, CAddTable, CMulTable, Concat, ConcatTable, Echo, FlattenTable, Identity,
-    JoinTable, MapTable, ParallelTable, SelectTable, Sequential,
+    Bottle, CAddTable, CDivTable, CMaxTable, CMinTable, CMulTable, CSubTable, Concat,
+    ConcatTable, Echo, FlattenTable, Identity, JoinTable, MapTable, ParallelTable,
+    SelectTable, Sequential,
+)
+from bigdl_tpu.nn.misc import (
+    Bilinear, DotProduct, Euclidean, HardShrink, Max, Maxout, Mean, Min, MM, MV,
+    Negative, RReLU, SoftShrink, SpatialUpSamplingBilinear, SpatialUpSamplingNearest,
+    Sum, Threshold,
 )
 from bigdl_tpu.nn.cosine import Cosine, CosineDistance
 from bigdl_tpu.nn.convolution import (
@@ -44,6 +50,7 @@ from bigdl_tpu.nn.initialization import (
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.quantized import QuantizedLinear, QuantizedSpatialConvolution
 from bigdl_tpu.nn.sparse import SparseEmbeddingSum, SparseLinear
+from bigdl_tpu.nn.tree import BinaryTreeLSTM
 from bigdl_tpu.nn.pooling import (
     SpatialAveragePooling, SpatialMaxPooling, TemporalMaxPooling,
 )

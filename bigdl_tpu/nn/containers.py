@@ -112,6 +112,44 @@ class CMulTable(AbstractModule):
         return out, state
 
 
+class CSubTable(AbstractModule):
+    """Element-wise difference x1 - x2 of a Table pair."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        return xs[0] - xs[1], state
+
+
+class CDivTable(AbstractModule):
+    """Element-wise quotient x1 / x2 of a Table pair."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        return xs[0] / xs[1], state
+
+
+class CMaxTable(AbstractModule):
+    """Element-wise maximum over a Table of tensors."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.maximum(out, x)
+        return out, state
+
+
+class CMinTable(AbstractModule):
+    """Element-wise minimum over a Table of tensors."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.minimum(out, x)
+        return out, state
+
+
 class JoinTable(AbstractModule):
     """Concatenate a Table of tensors along ``dimension`` (1-based; n_input_dims lets
     batched input shift the axis, reference semantics)."""

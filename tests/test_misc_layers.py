@@ -1,0 +1,186 @@
+"""Round-3 misc layer sweep with torch oracles where torch has the op."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.random_generator import RandomGenerator
+from bigdl_tpu.utils.table import T
+
+
+def _np(*shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestActivations:
+    def test_threshold_oracle(self):
+        x = _np(3, 4)
+        out = np.asarray(nn.Threshold(0.2, -5.0).evaluate().forward(jnp.asarray(x)))
+        ref = F.threshold(torch.tensor(x), 0.2, -5.0).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_hardshrink_oracle(self):
+        x = _np(3, 4)
+        out = np.asarray(nn.HardShrink(0.4).evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, F.hardshrink(torch.tensor(x), 0.4).numpy(),
+                                   rtol=1e-6)
+
+    def test_softshrink_oracle(self):
+        x = _np(3, 4)
+        out = np.asarray(nn.SoftShrink(0.4).evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, F.softshrink(torch.tensor(x), 0.4).numpy(),
+                                   rtol=1e-6)
+
+    def test_rrelu_eval_oracle(self):
+        x = _np(3, 4)
+        m = nn.RReLU(0.1, 0.3).evaluate()
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        ref = F.rrelu(torch.tensor(x), 0.1, 0.3, training=False).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_rrelu_training_in_range(self):
+        RandomGenerator.set_seed(0)
+        x = -np.abs(_np(50, 50)) - 0.1  # all negative
+        m = nn.RReLU(0.1, 0.3).training()
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        slope = out / x
+        assert slope.min() >= 0.1 - 1e-6 and slope.max() <= 0.3 + 1e-6
+        assert slope.std() > 0.01  # actually random, not a constant
+
+    def test_negative(self):
+        x = _np(2, 3)
+        np.testing.assert_allclose(
+            np.asarray(nn.Negative().evaluate().forward(jnp.asarray(x))), -x)
+
+
+class TestReductionsAndTableOps:
+    def test_reductions(self):
+        x = _np(3, 4)
+        np.testing.assert_allclose(
+            np.asarray(nn.Max(2).evaluate().forward(jnp.asarray(x))), x.max(1),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(nn.Min(1).evaluate().forward(jnp.asarray(x))), x.min(0),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(nn.Mean(2).evaluate().forward(jnp.asarray(x))), x.mean(1),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(nn.Sum(2).evaluate().forward(jnp.asarray(x))), x.sum(1),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(nn.Sum(2, size_average=True).evaluate()
+                       .forward(jnp.asarray(x))), x.mean(1), rtol=1e-6)
+
+    def test_negative_dim_with_batch_hint(self):
+        """dim=-1 with n_input_dims set must not double-shift (review fix)."""
+        x = _np(8, 3, 4)
+        out = np.asarray(nn.Sum(-1, n_input_dims=2).evaluate()
+                         .forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, x.sum(-1), rtol=1e-5)
+        out2 = np.asarray(nn.Max(1, n_input_dims=2).evaluate()
+                          .forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out2, x.max(1), rtol=1e-6)
+
+    def test_table_algebra(self):
+        a, b = _np(2, 3), np.abs(_np(2, 3, seed=1)) + 0.5
+        ja, jb = jnp.asarray(a), jnp.asarray(b)
+        np.testing.assert_allclose(
+            np.asarray(nn.CSubTable().evaluate().forward(T(ja, jb))), a - b,
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(nn.CDivTable().evaluate().forward(T(ja, jb))), a / b,
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(nn.CMaxTable().evaluate().forward(T(ja, jb))),
+            np.maximum(a, b), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(nn.CMinTable().evaluate().forward(T(ja, jb))),
+            np.minimum(a, b), rtol=1e-6)
+
+    def test_mm_mv_dot(self):
+        a, b = _np(2, 3, 4), _np(2, 4, 5, seed=1)
+        np.testing.assert_allclose(
+            np.asarray(nn.MM().evaluate().forward(T(jnp.asarray(a), jnp.asarray(b)))),
+            a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(nn.MM(trans_a=True).evaluate().forward(
+                T(jnp.asarray(_np(2, 4, 3)), jnp.asarray(b)))),
+            _np(2, 4, 3).transpose(0, 2, 1) @ b, rtol=1e-5)
+        v = _np(2, 4, seed=2)
+        np.testing.assert_allclose(
+            np.asarray(nn.MV().evaluate().forward(T(jnp.asarray(a), jnp.asarray(v)))),
+            np.einsum("bij,bj->bi", a, v), rtol=1e-5)
+        x, y = _np(3, 5), _np(3, 5, seed=1)
+        np.testing.assert_allclose(
+            np.asarray(nn.DotProduct().evaluate().forward(
+                T(jnp.asarray(x), jnp.asarray(y)))),
+            (x * y).sum(1), rtol=1e-5)
+
+
+class TestParamLayers:
+    def test_bilinear_torch_oracle(self):
+        RandomGenerator.set_seed(0)
+        m = nn.Bilinear(3, 4, 2).evaluate()
+        x1, x2 = _np(5, 3), _np(5, 4, seed=1)
+        out = np.asarray(m.forward(T(jnp.asarray(x1), jnp.asarray(x2))))
+        w = torch.tensor(np.asarray(m.get_params()["weight"]))
+        b = torch.tensor(np.asarray(m.get_params()["bias"]))
+        ref = F.bilinear(torch.tensor(x1), torch.tensor(x2), w, b).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+
+    def test_euclidean_oracle(self):
+        RandomGenerator.set_seed(0)
+        m = nn.Euclidean(4, 3).evaluate()
+        x = _np(2, 4)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        w = np.asarray(m.get_params()["weight"])
+        ref = np.sqrt(((x[:, None, :] - w[None]) ** 2).sum(-1) + 1e-12)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_maxout_equals_reshape_max(self):
+        RandomGenerator.set_seed(0)
+        m = nn.Maxout(4, 3, 2).evaluate()
+        x = _np(5, 4)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        w = np.asarray(m.get_params()["weight"])
+        b = np.asarray(m.get_params()["bias"])
+        ref = (x @ w.T + b).reshape(5, 3, 2).max(-1)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+        assert out.shape == (5, 3)
+
+
+class TestUpsampling:
+    def test_nearest_torch_oracle(self):
+        x = _np(1, 2, 3, 3)
+        out = np.asarray(nn.SpatialUpSamplingNearest(2).evaluate()
+                         .forward(jnp.asarray(x)))
+        ref = F.interpolate(torch.tensor(x), scale_factor=2,
+                            mode="nearest").numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_bilinear_torch_oracle(self):
+        x = _np(1, 2, 4, 4)
+        out = np.asarray(nn.SpatialUpSamplingBilinear(2).evaluate()
+                         .forward(jnp.asarray(x)))
+        ref = F.interpolate(torch.tensor(x), scale_factor=2, mode="bilinear",
+                            align_corners=True).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+
+    def test_gradients_flow(self):
+        import jax
+        RandomGenerator.set_seed(0)
+        for m, x in [(nn.Bilinear(3, 4, 2), T(jnp.asarray(_np(2, 3)),
+                                              jnp.asarray(_np(2, 4, seed=1)))),
+                     (nn.Maxout(4, 3, 2), jnp.asarray(_np(2, 4))),
+                     (nn.Euclidean(4, 3), jnp.asarray(_np(2, 4)))]:
+            def loss(p):
+                out, _ = m.apply(p, {}, x, training=True)
+                return jnp.sum(out)
+            g = jax.grad(loss)(m.get_params())
+            leaves = jax.tree_util.tree_leaves(g)
+            assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+            assert any(np.abs(np.asarray(l)).max() > 0 for l in leaves)

@@ -131,6 +131,31 @@ EXAMPLES = {
                      jnp.asarray([[1, 5, -1], [0, -1, -1]], jnp.int32)),
     "SparseEmbeddingSum": (lambda: nn.SparseEmbeddingSum(20, 4),
                            jnp.asarray([[1, 5, -1], [0, -1, -1]], jnp.int32)),
+    # misc zoo sweep (round 3)
+    "CSubTable": (lambda: nn.CSubTable(), T(_x(2, 3), _x(2, 3, seed=1))),
+    "CDivTable": (lambda: nn.CDivTable(),
+                  T(_x(2, 3), jnp.abs(_x(2, 3, seed=1)) + 1.0)),
+    "CMaxTable": (lambda: nn.CMaxTable(), T(_x(2, 3), _x(2, 3, seed=1))),
+    "CMinTable": (lambda: nn.CMinTable(), T(_x(2, 3), _x(2, 3, seed=1))),
+    "Max": (lambda: nn.Max(2), _x(3, 4)),
+    "Min": (lambda: nn.Min(2), _x(3, 4)),
+    "Mean": (lambda: nn.Mean(2), _x(3, 4)),
+    "Sum": (lambda: nn.Sum(2), _x(3, 4)),
+    "Threshold": (lambda: nn.Threshold(0.1, -1.0), _x(2, 3)),
+    "HardShrink": (lambda: nn.HardShrink(0.4), _x(2, 3)),
+    "SoftShrink": (lambda: nn.SoftShrink(0.4), _x(2, 3)),
+    "RReLU": (lambda: nn.RReLU(), _x(2, 3)),
+    "Negative": (lambda: nn.Negative(), _x(2, 3)),
+    "DotProduct": (lambda: nn.DotProduct(), T(_x(2, 4), _x(2, 4, seed=1))),
+    "MM": (lambda: nn.MM(), T(_x(2, 3, 4), _x(2, 4, 5, seed=1))),
+    "MV": (lambda: nn.MV(), T(_x(2, 3, 4), _x(2, 4, seed=1))),
+    "Euclidean": (lambda: nn.Euclidean(4, 3), _x(2, 4)),
+    "Bilinear": (lambda: nn.Bilinear(3, 4, 2), T(_x(2, 3), _x(2, 4, seed=1))),
+    "Maxout": (lambda: nn.Maxout(4, 3, 2), _x(2, 4)),
+    "SpatialUpSamplingNearest": (lambda: nn.SpatialUpSamplingNearest(2),
+                                 _x(1, 2, 3, 3)),
+    "SpatialUpSamplingBilinear": (lambda: nn.SpatialUpSamplingBilinear(2),
+                                  _x(1, 2, 3, 3)),
     # recurrent
     "RnnCell": (lambda: nn.RnnCell(4, 3), T(_x(2, 4), _x(2, 3))),
     "LSTM": (lambda: nn.LSTM(4, 3), T(_x(2, 4), _x(2, 3), _x(2, 3, seed=1))),
@@ -141,6 +166,9 @@ EXAMPLES = {
     "BiRecurrent": (lambda: nn.BiRecurrent(nn.GRU(4, 3)), _x(2, 5, 4)),
     "TimeDistributed": (lambda: nn.TimeDistributed(nn.Linear(4, 2)), _x(2, 5, 4)),
     "Masking": (lambda: nn.Masking(0.0), _x(2, 3)),
+    "BinaryTreeLSTM": (
+        lambda: nn.BinaryTreeLSTM(4, 3),
+        T(_x(1, 3, 4), jnp.asarray([[[1, 2], [-1, -1], [-1, -1]]], jnp.int32))),
     # graph (custom topology serialization)
     "Graph": ("graph", None),
     "StaticGraph": ("graph", None),

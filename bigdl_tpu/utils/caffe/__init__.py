@@ -1,0 +1,3 @@
+from bigdl_tpu.utils.caffe.loader import CaffeImportError, load_caffe
+
+__all__ = ["CaffeImportError", "load_caffe"]

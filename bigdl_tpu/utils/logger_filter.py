@@ -1,0 +1,45 @@
+"""LoggerFilter — tame noisy third-party logs.
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/utils/LoggerFilter.scala`` —
+unverified, mount empty): the reference redirects chatty Spark/BigDL log4j
+output to a file, keeping the console for training progress. The analog here
+quiets the noisy Python loggers (jax compilation chatter, TF import noise)
+and optionally redirects them to a file.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_NOISY = ("jax", "jax._src", "tensorflow", "absl", "orbax")
+
+
+class LoggerFilter:
+    _handlers: list[tuple[logging.Logger, logging.Handler]] = []
+
+    @classmethod
+    def redirect(cls, path: str | None = None,
+                 level: int = logging.ERROR,
+                 loggers: tuple[str, ...] = _NOISY) -> None:
+        """Raise ``loggers`` to ``level`` on the console; with ``path``, send
+        their full output to a file instead of dropping it (reference
+        ``LoggerFilter.redirect`` semantics)."""
+        for name in loggers:
+            lg = logging.getLogger(name)
+            lg.setLevel(level if path is None else logging.DEBUG)
+            if path is not None:
+                h = logging.FileHandler(path)
+                h.setLevel(logging.DEBUG)
+                lg.addHandler(h)
+                lg.propagate = False
+                cls._handlers.append((lg, h))
+
+    disable = redirect  # reference alias (``LoggerFilter.disable``)
+
+    @classmethod
+    def restore(cls) -> None:
+        for lg, h in cls._handlers:
+            lg.removeHandler(h)
+            lg.propagate = True
+            lg.setLevel(logging.NOTSET)
+        cls._handlers.clear()

@@ -76,6 +76,8 @@ def _build_registry() -> dict[str, type]:
         pass
     import bigdl_tpu.utils.tf.ops as tfops
     _scan(tfops, prefix="tf.")
+    import bigdl_tpu.utils.caffe.ops as caffeops
+    _scan(caffeops, prefix="caffe.")
     return reg
 
 

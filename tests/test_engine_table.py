@@ -69,3 +69,33 @@ class TestRandomGenerator:
         RandomGenerator.set_seed(7)
         k2 = RandomGenerator.next_key()
         assert np.array_equal(np.asarray(k1), np.asarray(k2))
+
+
+class TestLoggerFilter:
+    def test_redirect_and_restore(self, tmp_path):
+        import logging
+
+        from bigdl_tpu.utils.logger_filter import LoggerFilter
+
+        lg = logging.getLogger("jax")
+        LoggerFilter.redirect(str(tmp_path / "noisy.log"),
+                              loggers=("jax",))
+        try:
+            lg.info("to file only")
+            assert not lg.propagate
+        finally:
+            LoggerFilter.restore()
+        assert lg.propagate
+        import os
+        assert os.path.exists(tmp_path / "noisy.log")
+
+    def test_quiet_without_file(self):
+        import logging
+
+        from bigdl_tpu.utils.logger_filter import LoggerFilter
+
+        LoggerFilter.disable(loggers=("absl",))
+        try:
+            assert logging.getLogger("absl").level == logging.ERROR
+        finally:
+            LoggerFilter.restore()

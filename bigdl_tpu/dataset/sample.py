@@ -97,10 +97,13 @@ class SampleToMiniBatch(Transformer):
 
     @staticmethod
     def _stack(samples: Sequence[Sample], batch_size: int, valid: Optional[int] = None):
+        # native GIL-free copy when available (runs in the prefetch producer
+        # thread — overlap with the main thread is the point); numpy otherwise
+        from bigdl_tpu.native import pack_batch
         n_f = len(samples[0].feature)
-        feats = tuple(np.stack([s.feature[i] for s in samples]) for i in range(n_f))
+        feats = tuple(pack_batch([s.feature[i] for s in samples]) for i in range(n_f))
         n_l = len(samples[0].label)
-        labels = tuple(np.stack([s.label[i] for s in samples]) for i in range(n_l))
+        labels = tuple(pack_batch([s.label[i] for s in samples]) for i in range(n_l))
         input = feats[0] if n_f == 1 else feats
         target = (labels[0] if n_l == 1 else labels) if n_l else None
         return MiniBatch(input, target, valid if valid is not None else len(samples))

@@ -25,7 +25,8 @@ from bigdl_tpu.nn.convolution import (
 from bigdl_tpu.nn.embedding import HashBucketEmbedding, LookupTable
 from bigdl_tpu.nn.graph import Graph, Input, ModuleNode, StaticGraph
 from bigdl_tpu.nn.normalization import (
-    Add, BatchNormalization, CAdd, CMul, Dropout, GaussianDropout, GaussianNoise, Mul,
+    Add, BatchNormalization, CAdd, CMul, Dropout, GaussianDropout, GaussianNoise,
+    LayerNorm, Mul,
     Normalize, SpatialBatchNormalization, SpatialCrossMapLRN, SpatialDropout2D,
 )
 from bigdl_tpu.nn.recurrent import (

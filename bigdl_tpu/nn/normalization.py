@@ -104,6 +104,31 @@ class BatchNormalization(TensorModule):
         return f"{type(self).__name__}({self.n_output})"
 
 
+class LayerNorm(TensorModule):
+    """LayerNorm over the last axis, served by the fused Pallas kernel on TPU
+    (kernels/layernorm.py) and the jnp reference elsewhere. Not in the
+    reference's zoo (pre-dates it) — provided for the attention stack."""
+
+    def __init__(self, n_output: int, eps: float = 1e-5):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.reset()
+
+    def reset(self) -> None:
+        self._params = {"weight": jnp.ones((self.n_output,), jnp.float32),
+                        "bias": jnp.zeros((self.n_output,), jnp.float32)}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.kernels import fused_layer_norm
+        return fused_layer_norm(input, params["weight"], params["bias"],
+                                self.eps), state
+
+    def __repr__(self):
+        return f"LayerNorm({self.n_output})"
+
+
 class SpatialBatchNormalization(BatchNormalization):
     """BN over channel axis of NCHW input (reference ``nn.SpatialBatchNormalization``)."""
 

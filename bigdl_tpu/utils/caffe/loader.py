@@ -57,6 +57,10 @@ def _pair(param, generic, h_field, w_field, default=None):
     return default, default
 
 
+# train/eval-only layers: pass through / drop at import time
+_DROPPED_TYPES = ("Accuracy", "SoftmaxWithLoss", "Silence")
+
+
 class _CaffeImporter:
     def __init__(self, net, weights_by_name):
         self.net = net
@@ -81,17 +85,24 @@ class _CaffeImporter:
                     blob_node[top] = node
                 input_nodes.append(node)
                 continue
+            if layer.type in _DROPPED_TYPES:
+                # train/eval-only layers pass their first RESOLVABLE bottom
+                # through; unresolvable bottoms (e.g. 'label' with no producer
+                # in a deploy import) are exactly why these are dropped early,
+                # before bottom validation
+                known = [b for b in layer.bottom if b in blob_node]
+                if known:
+                    for top in layer.top:
+                        blob_node[top] = blob_node[known[0]]
+                continue
             for b in layer.bottom:
                 if b not in blob_node:
                     raise CaffeImportError(
                         f"layer {layer.name!r}: unknown bottom blob {b!r}")
             bottoms = [blob_node[b] for b in layer.bottom]
             module = self._convert(layer)
-            if module is None:  # dropped layers (train-only): pass through
-                node = bottoms[0]
-            else:
-                module.set_name(layer.name)
-                node = module.inputs(*bottoms)
+            module.set_name(layer.name)
+            node = module.inputs(*bottoms)
             for top in layer.top:
                 blob_node[top] = node
 
@@ -101,12 +112,14 @@ class _CaffeImporter:
         consumed = {b for l in self.net.layer for b in l.bottom if l.type != "Input"}
         out_blobs = [t for l in self.net.layer for t in l.top
                      if t not in consumed and l.type != "Input"]
-        # dedupe, keep order
+        # dedupe by NODE (dropped layers alias their input node under several
+        # top blob names), keep order
         seen, outputs = set(), []
         for t in out_blobs:
-            if t not in seen:
-                seen.add(t)
-                outputs.append(blob_node[t])
+            node = blob_node[t]
+            if id(node) not in seen:
+                seen.add(id(node))
+                outputs.append(node)
         return nn.Graph(input_nodes if len(input_nodes) > 1 else input_nodes[0],
                         outputs if len(outputs) > 1 else outputs[0])
 
@@ -174,11 +187,11 @@ class _CaffeImporter:
             pw = int(p.pad_w) if p.HasField("pad_w") else int(p.pad)
             cls = nn.SpatialMaxPooling if p.pool == p.MAX \
                 else nn.SpatialAveragePooling
-            m = cls(kw, kh, sw, sh, pw, ph)
-            # Caffe pooling rounds output sizes UP by default (round_mode CEIL)
-            if p.round_mode == p.CEIL:
-                m.ceil()
-            return m
+            # Caffe pooling rounds output sizes UP by default (round_mode CEIL).
+            # Constructor arg, NOT .ceil() post-construction — the portable
+            # serializer rebuilds from recorded constructor args only.
+            return cls(kw, kh, sw, sh, pw, ph,
+                       ceil_mode=(p.round_mode == p.CEIL))
         if t == "ReLU":
             slope = layer.relu_param.negative_slope
             return nn.LeakyReLU(slope) if slope else nn.ReLU()
@@ -231,9 +244,6 @@ class _CaffeImporter:
             beta = blobs[1] if layer.scale_param.bias_term and len(blobs) > 1 \
                 else None
             return CaffeScale(blobs[0], beta)
-        if t in ("Accuracy", "SoftmaxWithLoss", "Silence"):
-            return None  # train/eval-only layers: pass through / drop
-
         raise CaffeImportError(
             f"unsupported Caffe layer type {t!r} at {layer.name!r} — add a "
             f"converter in bigdl_tpu/utils/caffe/loader.py")
@@ -255,6 +265,14 @@ def load_caffe(prototxt_path: str, caffemodel_path: str | None = None):
         wnet = pb2.NetParameter()
         with open(caffemodel_path, "rb") as f:
             wnet.ParseFromString(f.read())
+        if not wnet.layer:
+            # classic BVLC-zoo models serialize as V1LayerParameter under
+            # field 2 ("layers"), which this minimal schema doesn't model —
+            # fail clearly instead of blaming the user for a missing file
+            raise CaffeImportError(
+                f"{caffemodel_path}: no modern 'layer' entries found — this is "
+                f"likely a legacy V1 caffemodel ('layers' field); upgrade it "
+                f"with Caffe's upgrade_net_proto_binary tool first")
         for layer in wnet.layer:
             if layer.blobs:
                 weights_by_name[layer.name] = [_blob_array(b)

@@ -15,7 +15,8 @@ _NOISY = ("jax", "jax._src", "tensorflow", "absl", "orbax")
 
 
 class LoggerFilter:
-    _handlers: list[tuple[logging.Logger, logging.Handler]] = []
+    _handlers: list[tuple[logging.Logger, logging.Handler, bool]] = []
+    _saved_levels: list[tuple[logging.Logger, int]] = []
 
     @classmethod
     def redirect(cls, path: str | None = None,
@@ -26,20 +27,25 @@ class LoggerFilter:
         ``LoggerFilter.redirect`` semantics)."""
         for name in loggers:
             lg = logging.getLogger(name)
+            cls._saved_levels.append((lg, lg.level))
             lg.setLevel(level if path is None else logging.DEBUG)
             if path is not None:
                 h = logging.FileHandler(path)
                 h.setLevel(logging.DEBUG)
                 lg.addHandler(h)
+                cls._handlers.append((lg, h, lg.propagate))
                 lg.propagate = False
-                cls._handlers.append((lg, h))
 
     disable = redirect  # reference alias (``LoggerFilter.disable``)
 
     @classmethod
     def restore(cls) -> None:
-        for lg, h in cls._handlers:
+        for lg, h, was_propagating in cls._handlers:
             lg.removeHandler(h)
-            lg.propagate = True
-            lg.setLevel(logging.NOTSET)
+            h.close()
+            lg.propagate = was_propagating
         cls._handlers.clear()
+        # reversed: nested redirects must unwind to the ORIGINAL levels
+        for lg, lvl in reversed(cls._saved_levels):
+            lg.setLevel(lvl)
+        cls._saved_levels.clear()

@@ -31,7 +31,7 @@ def _build_fixture(tmp_path):
     gamma = rng.normal(size=(8,)).astype(np.float32)
     beta = rng.normal(size=(8,)).astype(np.float32)
     w2 = rng.normal(scale=0.2, size=(8, 3, 1, 1)).astype(np.float32)
-    wip = rng.normal(scale=0.1, size=(5, 16 * 4 * 4)).astype(np.float32)
+    wip = rng.normal(scale=0.1, size=(5, 16 * 3 * 3)).astype(np.float32)
     bip = rng.normal(size=(5,)).astype(np.float32)
 
     net = pb2.NetParameter()
@@ -75,7 +75,7 @@ def _build_fixture(tmp_path):
     l = layer("pool2", "Pooling", ["cat"], ["pool2"])
     l.pooling_param.pool = pb2.PoolingParameter.AVE
     l.pooling_param.kernel_size = 2
-    l.pooling_param.stride = 1  # 4x4 → wait; set below properly
+    l.pooling_param.stride = 1  # (4x4) k2 s1 → (3x3); ip input = 16*3*3
 
     l = layer("ip", "InnerProduct", ["pool2"], ["ip"])
     l.inner_product_param.num_output = 5
@@ -126,18 +126,6 @@ def _torch_oracle(x, w):
 class TestCaffeImport:
     def test_fixture_matches_torch(self, tmp_path):
         proto, model, w = _build_fixture(tmp_path)
-        # fix the ip weight size: pool2 output is (2, 16, 3, 3)
-        w["wip"] = w["wip"][:, : 16 * 3 * 3]
-        wnet = pb2.NetParameter()
-        with open(model, "rb") as f:
-            wnet.ParseFromString(f.read())
-        for l in wnet.layer:
-            if l.name == "ip":
-                del l.blobs[:]
-                _fill_blob(l.blobs.add(), w["wip"])
-                _fill_blob(l.blobs.add(), w["bip"])
-        with open(model, "wb") as f:
-            f.write(wnet.SerializeToString())
 
         g = load_caffe(proto, model)
         x = np.random.default_rng(1).normal(size=(2, 3, 8, 8)).astype(np.float32)
@@ -169,6 +157,14 @@ class TestCaffeImport:
         ref = F.max_pool2d(torch.tensor(x), 3, 2, ceil_mode=True).numpy()
         assert out.shape == ref.shape == (1, 2, 4, 4)
         np.testing.assert_allclose(out, ref, rtol=1e-6)
+        # ceil mode must survive the portable serializer (constructor-arg
+        # capture — a post-construction .ceil() toggle would be lost)
+        sp = str(tmp_path / "pool.bigdl")
+        g.save_module(sp)
+        loaded = nn.AbstractModule.load(sp)
+        out2 = np.asarray(loaded.evaluate().forward(jnp.asarray(x)))
+        assert out2.shape == (1, 2, 4, 4)
+        np.testing.assert_allclose(out2, ref, rtol=1e-6)
 
     def test_eltwise_coeff_subtraction_and_rejection(self, tmp_path):
         from google.protobuf import text_format
@@ -218,6 +214,40 @@ class TestCaffeImport:
         np.testing.assert_allclose(
             out, F.softmax(torch.tensor(x), dim=1).numpy(), rtol=1e-5)
 
+    def test_train_only_layers_dropped_with_unresolved_label(self, tmp_path):
+        """Deploy import of a TRAIN prototxt: SoftmaxWithLoss/Accuracy bottoms
+        include a 'label' blob no input produces — they must drop cleanly."""
+        net = pb2.NetParameter()
+        net.input.append("data")
+        net.input_shape.add().dim.extend([2, 4])
+        l = net.layer.add()
+        l.name, l.type = "ip", "InnerProduct"
+        l.bottom.append("data")
+        l.top.append("ip")
+        l.inner_product_param.num_output = 3
+        for nm, ty in [("loss", "SoftmaxWithLoss"), ("acc", "Accuracy")]:
+            l = net.layer.add()
+            l.name, l.type = nm, ty
+            l.bottom.extend(["ip", "label"])
+            l.top.append(nm)
+        wnet = pb2.NetParameter()
+        lw = wnet.layer.add()
+        lw.name = "ip"
+        _fill_blob(lw.blobs.add(),
+                   np.random.default_rng(0).normal(size=(3, 4))
+                   .astype(np.float32))
+        _fill_blob(lw.blobs.add(), np.zeros(3, np.float32))
+        from google.protobuf import text_format
+        p = str(tmp_path / "train.prototxt")
+        mp = str(tmp_path / "train.caffemodel")
+        with open(p, "w") as f:
+            f.write(text_format.MessageToString(net))
+        with open(mp, "wb") as f:
+            f.write(wnet.SerializeToString())
+        g = load_caffe(p, mp)
+        out = g.evaluate().forward(jnp.asarray(np.ones((2, 4), np.float32)))
+        assert np.asarray(out).shape == (2, 3)
+
     def test_unknown_bottom_raises_import_error(self, tmp_path):
         net = pb2.NetParameter()
         net.input.append("data")
@@ -254,17 +284,6 @@ class TestCaffeImport:
 
     def test_imported_graph_serializes(self, tmp_path):
         proto, model, w = _build_fixture(tmp_path)
-        w["wip"] = w["wip"][:, : 16 * 3 * 3]
-        wnet = pb2.NetParameter()
-        with open(model, "rb") as f:
-            wnet.ParseFromString(f.read())
-        for l in wnet.layer:
-            if l.name == "ip":
-                del l.blobs[:]
-                _fill_blob(l.blobs.add(), w["wip"])
-                _fill_blob(l.blobs.add(), w["bip"])
-        with open(model, "wb") as f:
-            f.write(wnet.SerializeToString())
         g = load_caffe(proto, model)
         p = str(tmp_path / "imported.bigdl")
         g.save_module(p)

@@ -1,0 +1,126 @@
+"""MoE / expert parallelism (no reference counterpart — TPU-build headroom like
+ring attention): dense-dispatch correctness vs a routed-loop oracle, capacity
+drop semantics, training, and dp x ep sharded execution on the CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.parallel import MoE, expert_parallel_rules
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _x(*shape, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).normal(size=shape)
+                       .astype(np.float32))
+
+
+class TestMoECorrectness:
+    def test_matches_routed_loop_oracle(self):
+        RandomGenerator.set_seed(0)
+        m = MoE(8, 16, n_experts=4, capacity_factor=8.0).evaluate()  # no drops
+        x = _x(12, 8)
+        out = np.asarray(m.forward(x))
+        p = {k: np.asarray(v) for k, v in m.get_params().items()}
+        logits = np.asarray(x) @ p["w_gate"]
+        probs = np.exp(logits - logits.max(1, keepdims=True))
+        probs /= probs.sum(1, keepdims=True)
+        ref = np.zeros_like(np.asarray(x))
+        for t in range(12):
+            e = int(probs[t].argmax())
+            h = np.maximum(np.asarray(x)[t] @ p["w1"][e] + p["b1"][e], 0.0)
+            ref[t] = (h @ p["w2"][e] + p["b2"][e]) * probs[t, e]
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_capacity_drops_to_zero(self):
+        """Tokens over capacity contribute exactly zero output (GShard drop)."""
+        RandomGenerator.set_seed(0)
+        m = MoE(8, 16, n_experts=2, capacity_factor=0.1).evaluate()  # cap=1
+        x = _x(20, 8)
+        out = np.asarray(m.forward(x))
+        # at most 2 tokens (1 per expert) can be non-zero
+        nonzero_rows = (np.abs(out).sum(axis=1) > 1e-7).sum()
+        assert nonzero_rows <= 2
+
+    def test_3d_input_and_aux_loss(self):
+        RandomGenerator.set_seed(0)
+        m = MoE(8, 16, n_experts=4)
+        x = _x(2, 6, 8)
+        out = m.training().forward(x)
+        assert out.shape == (2, 6, 8)
+        aux = float(m.get_state()["aux_loss"])
+        assert np.isfinite(aux) and aux >= 1.0 - 1e-5  # ≥1 by Cauchy-Schwarz
+
+    def test_gradients_reach_experts_and_gate(self):
+        RandomGenerator.set_seed(0)
+        m = MoE(8, 16, n_experts=4, capacity_factor=8.0)
+        x = _x(12, 8)
+
+        def loss(p):
+            out, _ = m.apply(p, m.get_state(), x, training=True)
+            return jnp.sum(jnp.square(out))
+
+        g = jax.grad(loss)(m.get_params())
+        for k in ("w_gate", "w1", "w2"):
+            assert np.abs(np.asarray(g[k])).max() > 0, k
+
+
+class TestExpertParallel:
+    def test_dp_ep_training_on_mesh(self):
+        """dp x ep: batch sharded over 'data', expert params sharded over
+        'model' via expert_parallel_rules — the step compiles and trains."""
+        from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+        from bigdl_tpu.dataset.sample import Sample
+        from bigdl_tpu.optim import SGD, Trigger
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+        Engine.reset()
+        Engine.init(mesh_shape=(2, 4), mesh_axes=("data", "model"), seed=0)
+        RandomGenerator.set_seed(0)
+        rng = np.random.default_rng(0)
+        samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                          np.int32(rng.integers(0, 3))) for _ in range(64)]
+        data = DataSet.array(samples, distributed=True) >> SampleToMiniBatch(16)
+        model = (nn.Sequential()
+                 .add(MoE(8, 16, n_experts=4))
+                 .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+        rules = expert_parallel_rules("0", axis="model")
+        opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion(),
+                               parameter_sync="zero1")
+               .set_optim_method(SGD(learningrate=0.05, momentum=0.9,
+                                     dampening=0.0))
+               .set_tensor_parallel(rules)
+               .set_end_when(Trigger.max_iteration(4)))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+        assert opt.state["neval"] >= 4
+
+    def test_rules_shard_expert_dim(self):
+        from bigdl_tpu.parallel import TPRules
+
+        Engine.reset()
+        Engine.init(mesh_shape=(2, 4), mesh_axes=("data", "model"))
+        RandomGenerator.set_seed(0)
+        m = MoE(8, 16, n_experts=4)
+        rules = expert_parallel_rules(axis="model")
+        sh = rules.param_shardings({"moe": m.get_params()}, Engine.mesh())
+        assert "model" in str(sh["moe"]["w1"].spec)
+        assert sh["moe"]["w_gate"].spec == ()  # gate replicated (default)
+
+
+class TestSerialization:
+    def test_moe_roundtrip(self, tmp_path):
+        from bigdl_tpu.utils import serializer
+
+        RandomGenerator.set_seed(0)
+        serializer.register(MoE)
+        m = MoE(8, 16, n_experts=4)
+        p = str(tmp_path / "moe.bigdl")
+        m.save_module(p)
+        loaded = nn.AbstractModule.load(p)
+        x = _x(6, 8)
+        np.testing.assert_allclose(np.asarray(m.evaluate().forward(x)),
+                                   np.asarray(loaded.evaluate().forward(x)),
+                                   rtol=1e-6)

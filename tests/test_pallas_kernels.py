@@ -1,0 +1,101 @@
+"""Pallas kernel layer (kernels/layernorm.py): interpreter-mode equality with
+the jnp reference and torch, gradient correctness through the custom VJP."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+from bigdl_tpu.kernels import fused_layer_norm
+from bigdl_tpu.kernels.layernorm import _reference_layer_norm
+
+
+def _np(*shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestFusedLayerNorm:
+    def test_pallas_interpret_matches_reference(self):
+        x = jnp.asarray(_np(16, 64))
+        g = jnp.asarray(np.abs(_np(64, seed=1)) + 0.5)
+        b = jnp.asarray(_np(64, seed=2))
+        out_pallas = fused_layer_norm(x, g, b, 1e-5, True)   # forced pallas
+        out_ref = _reference_layer_norm(x, g, b, 1e-5)
+        np.testing.assert_allclose(np.asarray(out_pallas), np.asarray(out_ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_matches_torch(self):
+        x, g, b = _np(8, 32), np.abs(_np(32, seed=1)) + 0.5, _np(32, seed=2)
+        out = fused_layer_norm(jnp.asarray(x), jnp.asarray(g), jnp.asarray(b),
+                               1e-5, True)
+        ref = F.layer_norm(torch.tensor(x), (32,), torch.tensor(g),
+                           torch.tensor(b), 1e-5).numpy()
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+    def test_3d_input(self):
+        x = jnp.asarray(_np(2, 6, 32))
+        g = jnp.ones((32,))
+        b = jnp.zeros((32,))
+        out = fused_layer_norm(x, g, b, 1e-5, True)
+        assert out.shape == (2, 6, 32)
+        np.testing.assert_allclose(np.asarray(out).mean(-1), 0.0, atol=1e-5)
+
+    def test_gradients_match_reference(self):
+        x, g, b = (jnp.asarray(_np(8, 32)),
+                   jnp.asarray(np.abs(_np(32, seed=1)) + 0.5),
+                   jnp.asarray(_np(32, seed=2)))
+
+        def loss_fused(x, g, b):
+            return jnp.sum(jnp.square(fused_layer_norm(x, g, b, 1e-5, True)))
+
+        def loss_ref(x, g, b):
+            return jnp.sum(jnp.square(_reference_layer_norm(x, g, b, 1e-5)))
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, g, b)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, g, b)
+        for a, r in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_under_jit(self):
+        x = jnp.asarray(_np(8, 128))
+        g, b = jnp.ones((128,)), jnp.zeros((128,))
+        f = jax.jit(lambda x: fused_layer_norm(x, g, b, 1e-5, True))
+        np.testing.assert_allclose(
+            np.asarray(f(x)),
+            np.asarray(_reference_layer_norm(x, g, b, 1e-5)),
+            rtol=1e-5, atol=1e-6)
+
+
+class TestLayerNormModule:
+    def test_layer_oracle(self):
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        RandomGenerator.set_seed(0)
+        m = nn.LayerNorm(16).evaluate()
+        x = _np(4, 16)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        ref = F.layer_norm(torch.tensor(x), (16,)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_trains_in_model(self):
+        from bigdl_tpu import Engine
+        from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+        from bigdl_tpu.dataset.sample import Sample
+        from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+        Engine.init(seed=0)
+        rng = np.random.default_rng(0)
+        data = DataSet.array(
+            [Sample(rng.normal(size=(8,)).astype(np.float32),
+                    np.int32(rng.integers(0, 3))) for _ in range(32)]
+        ) >> SampleToMiniBatch(8)
+        model = (nn.Sequential().add(nn.Linear(8, 16)).add(nn.LayerNorm(16))
+                 .add(nn.ReLU()).add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+        opt = (LocalOptimizer(model, data, nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_end_when(Trigger.max_iteration(6)))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])

@@ -79,6 +79,7 @@ EXAMPLES = {
     "MultiHeadAttention": (lambda: nn.MultiHeadAttention(8, 2), _x(2, 5, 8)),
     # normalization-ish
     "BatchNormalization": (lambda: nn.BatchNormalization(4), _x(3, 4)),
+    "LayerNorm": (lambda: nn.LayerNorm(4), _x(3, 4)),
     "SpatialBatchNormalization": (lambda: nn.SpatialBatchNormalization(2),
                                   _x(2, 2, 4, 4)),
     "Dropout": (lambda: nn.Dropout(0.4), _x(2, 3)),

@@ -82,8 +82,9 @@ def pack_batch(arrays) -> np.ndarray:
     if lib is None or n < 2:
         return np.stack([np.asarray(a) for a in arrays])
     # NB: np.ascontiguousarray promotes 0-d to 1-d — only call it when needed
-    if first.dtype == object:
+    if first.dtype.hasobject:
         # raw memcpy of PyObject* slots would skip refcounting → corruption
+        # (hasobject also catches structured dtypes with embedded object fields)
         return np.stack([np.asarray(a) for a in arrays])
     mats = [m if m.flags.c_contiguous else np.ascontiguousarray(m)
             for m in (np.asarray(a) for a in arrays)]
@@ -99,8 +100,11 @@ def pack_batch(arrays) -> np.ndarray:
 def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """dst[i] = src[idx[i]] over leading-axis rows (fancy-index analog)."""
     src = np.asarray(src)
-    if src.dtype == object:
-        return src[np.asarray(idx)]
+    if src.dtype.hasobject:
+        idx = np.asarray(idx)
+        if len(idx) and (idx.min() < 0 or idx.max() >= len(src)):
+            raise IndexError(f"gather_rows: index out of range [0, {len(src)})")
+        return src[idx]
     src = np.ascontiguousarray(src)
     idx = np.ascontiguousarray(idx, np.int64)
     # bounds policy is identical on both paths: negatives rejected (numpy's

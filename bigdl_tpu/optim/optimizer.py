@@ -67,6 +67,7 @@ class Optimizer:
         # Reference parity: checkpoints are versioned per iteration by default;
         # over_write_checkpoint() opts into a single rolling file.
         self.overwrite_checkpoint: bool = False
+        self.checkpoint_backend: str = "pickle"
         self.train_summary = None
         self.val_summary = None
         self.summary_trigger: Optional[Trigger] = None
@@ -137,8 +138,15 @@ class Optimizer:
         self.val_trigger, self.val_dataset, self.val_methods = trigger, dataset, methods
         return self
 
-    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+    def set_checkpoint(self, path: str, trigger: Trigger,
+                       backend: str = "pickle") -> "Optimizer":
+        """``backend``: "pickle" (default — single file, background-thread
+        write) or "orbax" (orbax-checkpoint AsyncCheckpointer — per-leaf
+        tensorstore layout, async device fetch, the multi-host-ready format)."""
+        if backend not in ("pickle", "orbax"):
+            raise ValueError("checkpoint backend must be 'pickle' or 'orbax'")
         self.checkpoint_path, self.checkpoint_trigger = path, trigger
+        self.checkpoint_backend = backend
         return self
 
     def over_write_checkpoint(self, overwrite: bool = True) -> "Optimizer":
@@ -292,10 +300,14 @@ class Optimizer:
         # land any in-flight write; a FAILED write logs (older files may still
         # offer a valid, if stale, recovery point for the retry loop)
         self._join_checkpoint_writer(raise_error=False)
-        return (self.checkpoint_path is not None
-                and os.path.isdir(self.checkpoint_path)
-                and any(p.startswith("checkpoint") and p.endswith(".pkl")
-                        for p in os.listdir(self.checkpoint_path)))
+        if self.checkpoint_path is None or not os.path.isdir(self.checkpoint_path):
+            return False
+        names = os.listdir(self.checkpoint_path)
+        if self.checkpoint_backend == "orbax":
+            return any(p.startswith("ckpt_orbax") and p.endswith(".meta.json")
+                       for p in names)  # committed = meta marker present
+        return any(p.startswith("checkpoint") and p.endswith(".pkl")
+                   for p in names)
 
     def _optimize_impl(self) -> AbstractModule:
         sched = getattr(self.optim_method, "learningrate_schedule", None)
@@ -619,9 +631,13 @@ class Optimizer:
     def _save_checkpoint(self, params, mstate, ostate, state) -> None:
         """Fetch on the loop thread (consistent snapshot), write on a background
         thread — the disk write must not stall the step loop (the reference's
-        driver-side save had the same property via Spark async jobs; orbax-style
-        async is the same split). At most one write is in flight."""
+        driver-side save had the same property via Spark async jobs). With
+        backend="orbax" the write goes through orbax's AsyncCheckpointer
+        instead. At most one write is in flight either way."""
         os.makedirs(self.checkpoint_path, exist_ok=True)
+        if self.checkpoint_backend == "orbax":
+            self._save_checkpoint_orbax(params, mstate, ostate, state)
+            return
         payload = {
             "params": jax.device_get(params),
             "mstate": jax.device_get(mstate),
@@ -649,7 +665,87 @@ class Optimizer:
         t.start()
         self._ckpt_thread = t
 
+    def _save_checkpoint_orbax(self, params, mstate, ostate, state) -> None:
+        import json
+
+        import orbax.checkpoint as ocp
+
+        ckptr = getattr(self, "_orbax_ckptr", None)
+        if ckptr is None:
+            ckptr = self._orbax_ckptr = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler())
+        tag = "" if self.overwrite_checkpoint else f".{state['neval']}"
+        d = os.path.abspath(
+            os.path.join(self.checkpoint_path, f"ckpt_orbax{tag}"))
+        self._join_checkpoint_writer()  # one write in flight; commits its meta
+        meta = {"state": dict(state)}
+        sched = getattr(self.optim_method, "learningrate_schedule", None)
+        if getattr(sched, "stateful", False):
+            meta["sched_state"] = sched.state_dict()
+        payload = {"params": params, "mstate": mstate, "ostate": ostate}
+        ckptr.save(d, args=ocp.args.StandardSave(payload), force=True)
+        # `.meta.json` is the COMMIT MARKER: written by the next join, only
+        # after wait_until_finished confirms the array save is durable — a
+        # crash mid-save leaves a dir without meta, which the loader skips
+        self._orbax_pending_meta = (d, meta)
+        logger.info("orbax checkpoint saving: %s", d)
+
+    def _load_latest_checkpoint_orbax(self) -> bool:
+        import json
+
+        import orbax.checkpoint as ocp
+
+        # only COMMITTED checkpoints (meta marker present) are candidates —
+        # crash-interrupted saves (orbax tmp dirs, array dirs without meta)
+        # must not shadow older valid ones
+        cand = sorted(
+            (p for p in os.listdir(self.checkpoint_path)
+             if p.startswith("ckpt_orbax") and not p.endswith(".meta.json")
+             and "tmp" not in p
+             and os.path.exists(os.path.join(self.checkpoint_path,
+                                             p + ".meta.json"))),
+            key=lambda p: os.path.getmtime(os.path.join(self.checkpoint_path, p)))
+        if not cand:
+            return False
+        d = os.path.abspath(os.path.join(self.checkpoint_path, cand[-1]))
+        ckptr = ocp.StandardCheckpointer()
+        payload = ckptr.restore(d)
+        with open(d + ".meta.json") as f:
+            meta = json.load(f)
+        self.model.set_params(payload["params"])
+        self.model.set_state(payload["mstate"])
+        self._resume_ostate = payload["ostate"]
+        self.state = meta["state"]
+        sched = getattr(self.optim_method, "learningrate_schedule", None)
+        if getattr(sched, "stateful", False) and "sched_state" in meta:
+            sched.load_state_dict(meta["sched_state"])
+        logger.info("resumed from orbax checkpoint %s at iter %d", d,
+                    self.state.get("neval", 0))
+        return True
+
     def _join_checkpoint_writer(self, raise_error: bool = True) -> None:
+        ckptr = getattr(self, "_orbax_ckptr", None)
+        if ckptr is not None:
+            import json
+            pending = getattr(self, "_orbax_pending_meta", None)
+            self._orbax_pending_meta = None
+            try:
+                ckptr.wait_until_finished()
+            except Exception as e:
+                # same contract as the pickle path: a failed background write
+                # surfaces here (or logs, when the retry loop is probing) and
+                # never gets a commit marker
+                if raise_error:
+                    raise RuntimeError(
+                        "background orbax checkpoint write failed") from e
+                logger.error("background orbax checkpoint write failed: %r", e)
+            else:
+                if pending is not None:
+                    d, meta = pending
+                    tmp = d + ".meta.json.tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(meta, f)
+                    os.replace(tmp, d + ".meta.json")
         t = getattr(self, "_ckpt_thread", None)
         if t is not None:
             t.join()
@@ -665,6 +761,11 @@ class Optimizer:
 
     def _load_latest_checkpoint(self) -> None:
         self._join_checkpoint_writer()  # in-flight write must land before reading
+        if self.checkpoint_backend == "orbax":
+            if self._load_latest_checkpoint_orbax():
+                return
+            raise RuntimeError(
+                f"no orbax checkpoint found under {self.checkpoint_path}")
         cand = sorted(
             (p for p in os.listdir(self.checkpoint_path) if p.startswith("checkpoint")
              and p.endswith(".pkl")),

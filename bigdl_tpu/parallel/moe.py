@@ -51,14 +51,15 @@ class MoE(TensorModule):
     def reset(self) -> None:
         d, h, e = self.input_size, self.hidden_size, self.n_experts
 
-        def mk(shape):
-            return jnp.asarray(self.w_init.init(shape, fan_in=d, fan_out=h))
+        def mk(shape, fan_in, fan_out):
+            return jnp.asarray(self.w_init.init(shape, fan_in=fan_in,
+                                                fan_out=fan_out))
 
         self._params = {
-            "w_gate": mk((d, e)),
-            "w1": mk((e, d, h)),
+            "w_gate": mk((d, e), d, e),
+            "w1": mk((e, d, h), d, h),
             "b1": jnp.zeros((e, h), jnp.float32),
-            "w2": mk((e, h, d)),
+            "w2": mk((e, h, d), h, d),
             "b2": jnp.zeros((e, d), jnp.float32),
         }
         self._state = {"aux_loss": jnp.zeros((), jnp.float32)}
@@ -125,10 +126,13 @@ def expert_parallel_rules(moe_path_prefix: str = "", axis: str = "model",
     """TPRules sharding an MoE block's expert-indexed params over ``axis`` —
     expert parallelism through the same mechanism as tensor parallelism. The
     gate stays replicated; w1/b1/w2/b2 shard on the expert dim."""
+    import re as _re
     r = rules if rules is not None else TPRules()
-    pre = f"{moe_path_prefix}." if moe_path_prefix else ""
-    r.add(f"{pre}w1", P(axis, None, None))
-    r.add(f"{pre}b1", P(axis, None))
-    r.add(f"{pre}w2", P(axis, None, None))
-    r.add(f"{pre}b2", P(axis, None))
+    # anchored + escaped (TPRules convention, cf. megatron_mlp_rules): prefix
+    # "1" must not also match paths under "11"
+    pre = f"(^|/){_re.escape(moe_path_prefix)}/" if moe_path_prefix else "(^|/)"
+    r.add(f"{pre}w1$", P(axis, None, None))
+    r.add(f"{pre}b1$", P(axis, None))
+    r.add(f"{pre}w2$", P(axis, None, None))
+    r.add(f"{pre}b2$", P(axis, None))
     return r

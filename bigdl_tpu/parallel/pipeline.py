@@ -70,7 +70,16 @@ class GPipe(Container):
         mesh = Engine.mesh() if Engine.is_initialized() else None
         axes = dict(mesh.shape) if mesh is not None else {}
         if axes.get(self.axis_name, 1) == s and s > 1:
-            return self._apply_sharded(params, input, training, mesh), state
+            # under dp x pp the batch stays sharded over `data` inside the
+            # shard_map (replicating it would all-gather and nullify dp)
+            data_axis = Engine.DATA_AXIS if Engine.DATA_AXIS in axes else None
+            d = axes.get(data_axis, 1) if data_axis else 1
+            if d > 1 and (b % d != 0 or (b // d) % m != 0):
+                raise ValueError(
+                    f"batch {b} must divide by data size {d} and the local "
+                    f"batch by n_microbatches {m}")
+            return self._apply_sharded(params, input, training, mesh,
+                                       data_axis if d > 1 else None), state
 
         # sequential fallback: same stage composition, no communication
         y = input
@@ -78,9 +87,10 @@ class GPipe(Container):
             y = self._stage_apply(params[str(i)], y, training)
         return y, state
 
-    def _apply_sharded(self, params, x, training, mesh):
+    def _apply_sharded(self, params, x, training, mesh, data_axis=None):
         s, m = self.n_stages, self.n_microbatches
         axis = self.axis_name
+        x_spec = P(data_axis) if data_axis else P()
         # stack per-stage params on a leading stage dim (sharded over `pipe`)
         stacked = jax.tree_util.tree_map(
             lambda *ls: jnp.stack(ls), *[params[str(i)] for i in range(s)])
@@ -119,7 +129,7 @@ class GPipe(Container):
 
         spec_p = jax.tree_util.tree_map(lambda _: P(axis), stacked)
         fn = jax.shard_map(body, mesh=mesh,
-                           in_specs=(spec_p, P()), out_specs=P())
+                           in_specs=(spec_p, x_spec), out_specs=x_spec)
         return fn(stacked, x)
 
     def __repr__(self):

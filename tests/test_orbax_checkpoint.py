@@ -1,0 +1,109 @@
+"""Orbax checkpoint backend (SURVEY.md §5.4 — async per-leaf tensorstore
+layout): save during training, resume, and retry-from-checkpoint."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+
+def _data(n=64, dim=6, classes=3, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return DataSet.array(
+        [Sample(rng.normal(size=(dim,)).astype(np.float32),
+                np.int32(rng.integers(0, classes))) for _ in range(n)]
+    ) >> SampleToMiniBatch(batch)
+
+
+def _model():
+    return nn.Sequential().add(nn.Linear(6, 3)).add(nn.LogSoftMax())
+
+
+class TestOrbaxBackend:
+    def test_save_and_resume(self, tmp_path):
+        Engine.init(seed=0)
+        opt = (LocalOptimizer(_model(), _data(), nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1, momentum=0.9,
+                                     dampening=0.0))
+               .set_checkpoint(str(tmp_path), Trigger.several_iteration(2),
+                               backend="orbax")
+               .set_end_when(Trigger.max_iteration(5)))
+        opt.optimize()
+        dirs = [p for p in os.listdir(tmp_path)
+                if p.startswith("ckpt_orbax") and not p.endswith(".meta.json")]
+        assert len(dirs) >= 2  # iters 2 and 4
+
+        # resume into a FRESH optimizer
+        opt2 = (LocalOptimizer(_model(), _data(), nn.ClassNLLCriterion())
+                .set_optim_method(SGD(learningrate=0.1, momentum=0.9,
+                                      dampening=0.0))
+                .set_checkpoint(str(tmp_path), Trigger.several_iteration(2),
+                                backend="orbax"))
+        opt2._load_latest_checkpoint()
+        assert opt2.state["neval"] == 4
+        # resumed params equal the checkpointed ones, and training continues
+        opt2.set_end_when(Trigger.max_iteration(8))
+        opt2.optimize()
+        assert opt2.state["neval"] >= 8
+        assert np.isfinite(opt2.state["loss"])
+
+    def test_retry_uses_orbax_checkpoint(self, tmp_path, monkeypatch):
+        """The failure-retry loop recovers from an orbax checkpoint."""
+        monkeypatch.setenv("BIGDL_FAILURE_RETRY_TIMES", "2")
+        monkeypatch.setenv("BIGDL_FAILURE_RETRY_INTERVAL", "0")
+        Engine.reset()
+        Engine.init(seed=0)
+        opt = (LocalOptimizer(_model(), _data(), nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_checkpoint(str(tmp_path), Trigger.several_iteration(2),
+                               backend="orbax")
+               .set_end_when(Trigger.max_iteration(6)))
+
+        calls = {"n": 0}
+        orig = opt._optimize_impl
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("injected failure")
+            return orig()
+
+        monkeypatch.setattr(opt, "_optimize_impl", flaky)
+        opt.optimize()  # first run completes; call again to exercise retry
+        opt.set_end_when(Trigger.max_iteration(12))
+        opt.optimize()
+        assert opt.state["neval"] >= 12
+
+    def test_interrupted_save_skipped_on_resume(self, tmp_path):
+        """A crash-interrupted save (array dir without the .meta.json commit
+        marker) must not shadow an older committed checkpoint."""
+        import time
+
+        Engine.reset()
+        Engine.init(seed=0)
+        opt = (LocalOptimizer(_model(), _data(), nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_checkpoint(str(tmp_path), Trigger.several_iteration(2),
+                               backend="orbax")
+               .set_end_when(Trigger.max_iteration(3)))
+        opt.optimize()
+        # fake an interrupted newer save: dir present, no commit marker
+        time.sleep(0.05)
+        os.makedirs(tmp_path / "ckpt_orbax.999")
+        opt2 = (LocalOptimizer(_model(), _data(), nn.ClassNLLCriterion())
+                .set_optim_method(SGD(learningrate=0.1))
+                .set_checkpoint(str(tmp_path), Trigger.several_iteration(2),
+                                backend="orbax"))
+        opt2._load_latest_checkpoint()   # must pick the committed iter-2 ckpt
+        assert opt2.state["neval"] == 2
+
+    def test_invalid_backend_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="backend"):
+            LocalOptimizer(_model(), _data(), nn.ClassNLLCriterion()) \
+                .set_checkpoint(str(tmp_path), Trigger.every_epoch(),
+                                backend="zip")

@@ -68,6 +68,15 @@ EXAMPLES = {
     "SpatialMaxPooling": (lambda: nn.SpatialMaxPooling(2, 2), _x(1, 2, 6, 6)),
     "TemporalConvolution": (lambda: nn.TemporalConvolution(4, 6, 3), _x(2, 8, 4)),
     "TemporalMaxPooling": (lambda: nn.TemporalMaxPooling(2), _x(2, 8, 4)),
+    "VolumetricConvolution": (lambda: nn.VolumetricConvolution(2, 3, 2, 2, 2),
+                              _x(1, 2, 4, 5, 5)),
+    "VolumetricMaxPooling": (lambda: nn.VolumetricMaxPooling(2, 2, 2),
+                             _x(1, 2, 4, 6, 6)),
+    "VolumetricAveragePooling": (lambda: nn.VolumetricAveragePooling(2, 2, 2),
+                                 _x(1, 2, 4, 6, 6)),
+    "RoiPooling": (lambda: nn.RoiPooling(2, 2),
+                   T(_x(1, 2, 8, 8),
+                     jnp.asarray([[0, 1.0, 1.0, 6.0, 6.0]], jnp.float32))),
     "SpatialAveragePooling": (lambda: nn.SpatialAveragePooling(2, 2), _x(1, 2, 6, 6)),
     "LookupTable": (lambda: nn.LookupTable(10, 4),
                     jnp.asarray([[1, 2], [3, 4]], jnp.int32)),

@@ -1,0 +1,122 @@
+"""Volumetric (3-D) layers with torch oracles + RoiPooling (RoiAlign redesign)
+with a hand numpy oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.random_generator import RandomGenerator
+from bigdl_tpu.utils.table import T
+
+
+def _np(*shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestVolumetric:
+    def test_conv3d_torch_oracle(self):
+        RandomGenerator.set_seed(0)
+        m = nn.VolumetricConvolution(2, 4, 3, 3, 3, d_t=2, pad_t=1,
+                                     pad_w=1, pad_h=1).evaluate()
+        x = _np(2, 2, 6, 8, 8)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        w = np.asarray(m.get_params()["weight"])
+        b = np.asarray(m.get_params()["bias"])
+        ref = F.conv3d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                       stride=(2, 1, 1), padding=1).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_maxpool3d_torch_oracle(self):
+        m = nn.VolumetricMaxPooling(2, 2, 2).evaluate()
+        x = _np(1, 3, 4, 6, 6)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        ref = F.max_pool3d(torch.tensor(x), 2).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_avgpool3d_torch_oracle(self):
+        m = nn.VolumetricAveragePooling(2, 2, 2, pad_t=1, pad_w=1,
+                                        pad_h=1).evaluate()
+        x = _np(1, 3, 4, 6, 6)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        ref = F.avg_pool3d(torch.tensor(x), 2, padding=1,
+                           count_include_pad=True).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_conv3d_gradients(self):
+        RandomGenerator.set_seed(0)
+        m = nn.VolumetricConvolution(2, 3, 2, 2, 2)
+        x = jnp.asarray(_np(1, 2, 4, 5, 5))
+        y = m.training().forward(x)
+        gi = m.backward(x, jnp.ones_like(y))
+        assert gi.shape == x.shape and np.abs(np.asarray(gi)).max() > 0
+
+
+def _roi_align_oracle(feats, rois, ph, pw, scale, ns, mode):
+    """Direct numpy transcription of the RoiAlign spec."""
+    r = len(rois)
+    n, c, h, w = feats.shape
+    out = np.zeros((r, c, ph, pw), np.float32)
+    for ri, roi in enumerate(rois):
+        b = int(roi[0])
+        x1, y1, x2, y2 = [v * scale for v in roi[1:]]
+        bw = max(x2 - x1, 1e-6) / pw
+        bh = max(y2 - y1, 1e-6) / ph
+        for i in range(ph):
+            for j in range(pw):
+                vals = []
+                for sy in range(ns):
+                    for sx in range(ns):
+                        y = np.clip(y1 + i * bh + (sy + 0.5) / ns * bh, 0, h - 1)
+                        x = np.clip(x1 + j * bw + (sx + 0.5) / ns * bw, 0, w - 1)
+                        y0, x0 = int(np.floor(y)), int(np.floor(x))
+                        y1i, x1i = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+                        wy, wx = y - y0, x - x0
+                        v = ((1 - wy) * (1 - wx) * feats[b, :, y0, x0]
+                             + (1 - wy) * wx * feats[b, :, y0, x1i]
+                             + wy * (1 - wx) * feats[b, :, y1i, x0]
+                             + wy * wx * feats[b, :, y1i, x1i])
+                        vals.append(v)
+                vals = np.stack(vals)
+                out[ri, :, i, j] = vals.mean(0) if mode == "avg" else vals.max(0)
+    return out
+
+
+class TestRoiPooling:
+    @pytest.mark.parametrize("mode", ["avg", "max"])
+    def test_matches_numpy_oracle(self, mode):
+        feats = _np(2, 3, 10, 12)
+        rois = np.asarray([[0, 1.0, 1.0, 8.0, 6.0],
+                           [1, 0.0, 0.0, 11.0, 9.0],
+                           [0, 4.0, 2.0, 6.5, 8.5]], np.float32)
+        m = nn.RoiPooling(3, 4, spatial_scale=1.0, sampling_ratio=2,
+                          mode=mode).evaluate()
+        out = np.asarray(m.forward(T(jnp.asarray(feats), jnp.asarray(rois))))
+        ref = _roi_align_oracle(feats, rois, 3, 4, 1.0, 2, mode)
+        assert out.shape == (3, 3, 3, 4)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_spatial_scale(self):
+        feats = _np(1, 2, 8, 8)
+        rois = np.asarray([[0, 0.0, 0.0, 16.0, 16.0]], np.float32)
+        m = nn.RoiPooling(2, 2, spatial_scale=0.5).evaluate()  # /2 → whole map
+        out = np.asarray(m.forward(T(jnp.asarray(feats), jnp.asarray(rois))))
+        ref = _roi_align_oracle(feats, rois, 2, 2, 0.5, 2, "avg")
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_gradients_flow_to_features(self):
+        feats = jnp.asarray(_np(1, 2, 8, 8))
+        rois = jnp.asarray([[0, 1.0, 1.0, 6.0, 6.0]], jnp.float32)
+        m = nn.RoiPooling(2, 2)
+
+        def loss(f):
+            out, _ = m.apply({}, {}, T(f, rois))
+            return jnp.sum(out)
+
+        g = np.asarray(jax.grad(loss)(feats))
+        assert np.abs(g).sum() > 0
+        # gradient confined to the roi's support (plus bilinear halo)
+        assert np.abs(g[0, :, :, 7]).sum() == pytest.approx(0.0, abs=1e-6)

@@ -26,7 +26,7 @@ def _act(name: Optional[str]):
         "relu": N.ReLU, "tanh": N.Tanh, "sigmoid": N.Sigmoid,
         "hard_sigmoid": N.HardSigmoid, "softmax": N.SoftMax,
         "softplus": N.SoftPlus, "softsign": N.SoftSign, "elu": N.ELU,
-        "gelu": N.GELU, "swish": N.Swish,
+        "gelu": N.GELU, "swish": N.Swish, "log_softmax": N.LogSoftMax,
     }
     if name not in table:
         raise ValueError(f"unknown activation {name!r}")
@@ -346,3 +346,101 @@ class SimpleRNN(_RecurrentLayer):
     @property
     def _cell(self):
         return N.RnnCell
+
+
+class Convolution1D(KerasLayer):
+    """1-D conv on (steps, features) — keras-1.2 ``Convolution1D``. Maps onto
+    the native NWC TemporalConvolution (one MXU contraction)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation: Optional[str] = None, border_mode: str = "valid",
+                 subsample_length: int = 1, bias: bool = True, init=None, **kw):
+        super().__init__(**kw)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode must be valid|same, got {border_mode!r}")
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample_length = subsample_length
+        self.bias = bias
+        self.init = init
+
+    def build(self, input_shape):
+        steps, features = input_shape
+        conv = N.TemporalConvolution(features, self.nb_filter,
+                                     self.filter_length,
+                                     self.subsample_length,
+                                     with_bias=self.bias, w_init=self.init)
+        if self.border_mode == "same":
+            # exact TF/keras SAME split: total needed pad depends on steps and
+            # stride (left = needed // 2), NOT a fixed (k-1)//2 each side
+            k, s = self.filter_length, self.subsample_length
+            out = -(-steps // s)
+            needed = max((out - 1) * s + k - steps, 0)
+            left = needed // 2
+            seq = N.Sequential()
+            if left:
+                seq.add(N.Padding(1, -left, num_input_dims=2))
+            if needed - left:
+                seq.add(N.Padding(1, needed - left, num_input_dims=2))
+            conv = seq.add(conv)
+        return self._with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        steps, _ = input_shape
+        k, s = self.filter_length, self.subsample_length
+        if self.border_mode == "same":
+            return ((steps + s - 1) // s, self.nb_filter)
+        return ((steps - k) // s + 1, self.nb_filter)
+
+
+class _Pooling1D(KerasLayer):
+    def __init__(self, pool_length: int = 2, stride: Optional[int] = None,
+                 **kw):
+        super().__init__(**kw)
+        self.pool_length = pool_length
+        self.stride = stride if stride is not None else pool_length
+
+    def compute_output_shape(self, input_shape):
+        steps, f = input_shape
+        return ((steps - self.pool_length) // self.stride + 1, f)
+
+
+class MaxPooling1D(_Pooling1D):
+    def build(self, input_shape):
+        return N.TemporalMaxPooling(self.pool_length, self.stride)
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    def build(self, input_shape):
+        return N.Sequential().add(N.TemporalMaxPooling(-1)).add(
+            N.Reshape([input_shape[1]]))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[1],)
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    def build(self, input_shape):
+        c, h, w = input_shape
+        return N.Sequential().add(N.SpatialMaxPooling(w, h)) \
+                             .add(N.Reshape([c]))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class LayerNormalization(KerasLayer):
+    """LayerNorm over the trailing feature axis (served by the Pallas kernel
+    on TPU)."""
+
+    def __init__(self, epsilon: float = 1e-5, **kw):
+        super().__init__(**kw)
+        self.epsilon = epsilon
+
+    def build(self, input_shape):
+        return N.LayerNorm(input_shape[-1], eps=self.epsilon)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape

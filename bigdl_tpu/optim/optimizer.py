@@ -674,9 +674,12 @@ class Optimizer:
         if ckptr is None:
             ckptr = self._orbax_ckptr = ocp.AsyncCheckpointer(
                 ocp.StandardCheckpointHandler())
-        tag = "" if self.overwrite_checkpoint else f".{state['neval']}"
+        # ALWAYS a fresh step-tagged dir — overwrite mode must not save over
+        # the only committed checkpoint (force=True deletes it before the new
+        # write is durable); rolling semantics happen as cleanup AFTER the next
+        # commit instead (_join_checkpoint_writer)
         d = os.path.abspath(
-            os.path.join(self.checkpoint_path, f"ckpt_orbax{tag}"))
+            os.path.join(self.checkpoint_path, f"ckpt_orbax.{state['neval']}"))
         self._join_checkpoint_writer()  # one write in flight; commits its meta
         meta = {"state": dict(state)}
         sched = getattr(self.optim_method, "learningrate_schedule", None)
@@ -689,6 +692,24 @@ class Optimizer:
         # crash mid-save leaves a dir without meta, which the loader skips
         self._orbax_pending_meta = (d, meta)
         logger.info("orbax checkpoint saving: %s", d)
+
+    def _orbax_prune_older(self, keep_dir: str) -> None:
+        """Rolling (over_write_checkpoint) semantics: once a new checkpoint is
+        COMMITTED, older ones are pruned — meta marker first, so a crash
+        mid-prune never leaves a marker pointing at a removed dir."""
+        import shutil
+        keep = os.path.basename(keep_dir)
+        for p in os.listdir(self.checkpoint_path):
+            if not p.startswith("ckpt_orbax") or p.endswith(".meta.json") \
+                    or p == keep:
+                continue
+            full = os.path.join(self.checkpoint_path, p)
+            try:
+                if os.path.exists(full + ".meta.json"):
+                    os.remove(full + ".meta.json")
+                shutil.rmtree(full, ignore_errors=True)
+            except OSError:
+                logger.warning("failed to prune old checkpoint %s", full)
 
     def _load_latest_checkpoint_orbax(self) -> bool:
         import json
@@ -746,6 +767,8 @@ class Optimizer:
                     with open(tmp, "w") as f:
                         json.dump(meta, f)
                     os.replace(tmp, d + ".meta.json")
+                    if self.overwrite_checkpoint:
+                        self._orbax_prune_older(d)
         t = getattr(self, "_ckpt_thread", None)
         if t is not None:
             t.join()

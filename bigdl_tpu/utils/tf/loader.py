@@ -206,6 +206,11 @@ class _Importer:
             if pads is None:
                 raise TFImportError(f"{node.name}: dynamic paddings")
             return wire(O.TFPad(pads), node.input[0])
+        if op == "Transpose":
+            perm = self.const_value(node.input[1])
+            if perm is None:
+                raise TFImportError(f"{node.name}: dynamic transpose perm")
+            return wire(O.TFTranspose(np.atleast_1d(perm)), node.input[0])
         if op == "ExpandDims":
             axis = self.const_value(node.input[1])
             if axis is None:

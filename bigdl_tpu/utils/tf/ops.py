@@ -156,6 +156,15 @@ class TFPad(TensorModule):
         return jnp.pad(input, self.paddings), state
 
 
+class TFTranspose(TensorModule):
+    def __init__(self, perm: Sequence[int]):
+        super().__init__()
+        self.perm = tuple(int(p) for p in perm)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.transpose(input, self.perm), state
+
+
 class TFExpandDims(TensorModule):
     def __init__(self, axis: int):
         super().__init__()

@@ -1,0 +1,107 @@
+"""Round-3 Keras API additions: Convolution1D, 1-D/global poolings,
+LayerNormalization — shape inference + torch/keras-semantics oracles."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from bigdl_tpu import Engine
+from bigdl_tpu.nn.keras import layers as kl
+from bigdl_tpu.nn.keras.topology import Sequential
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _np(*shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def _build(layer, input_shape):
+    RandomGenerator.set_seed(0)
+    m = layer.build(input_shape)
+    return m.evaluate()
+
+
+class TestConvolution1D:
+    def test_valid_shapes_and_values(self):
+        layer = kl.Convolution1D(6, 3, subsample_length=2)
+        m = _build(layer, (9, 4))
+        x = _np(2, 9, 4)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        assert out.shape[1:] == layer.compute_output_shape((9, 4))
+        # oracle through torch conv1d
+        w = np.asarray(m.get_params()["weight"]).transpose(2, 1, 0)
+        b = np.asarray(m.get_params()["bias"])
+        ref = F.conv1d(torch.tensor(x).permute(0, 2, 1), torch.tensor(w),
+                       torch.tensor(b), stride=2).permute(0, 2, 1).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("k,s,steps", [(3, 1, 8), (4, 2, 9), (5, 3, 10),
+                                           (3, 2, 8), (2, 3, 10)])
+    def test_same_mode_matches_tf(self, k, s, steps):
+        """Values, not just lengths: the SAME pad split must equal TF's
+        (left = needed // 2 where needed depends on steps and stride)."""
+        tf_mod = pytest.importorskip("tensorflow")
+        layer = kl.Convolution1D(6, k, border_mode="same", subsample_length=s)
+        m = _build(layer, (steps, 4))
+        x = _np(2, steps, 4)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        assert out.shape[1:] == layer.compute_output_shape((steps, 4))
+        conv = m.modules[-1] if hasattr(m, "modules") else m
+        w = np.asarray(conv.get_params()["weight"])
+        b = np.asarray(conv.get_params()["bias"])
+        ref = tf_mod.nn.conv1d(x, w, stride=s, padding="SAME").numpy() + b
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestPooling1D:
+    def test_maxpool1d(self):
+        layer = kl.MaxPooling1D(3, 2)
+        m = _build(layer, (9, 4))
+        x = _np(2, 9, 4)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        ref = F.max_pool1d(torch.tensor(x).permute(0, 2, 1), 3,
+                           stride=2).permute(0, 2, 1).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+        assert out.shape[1:] == layer.compute_output_shape((9, 4))
+
+    def test_global_poolings(self):
+        x = _np(2, 7, 4)
+        g1 = _build(kl.GlobalMaxPooling1D(), (7, 4))
+        np.testing.assert_allclose(np.asarray(g1.forward(jnp.asarray(x))),
+                                   x.max(axis=1), rtol=1e-6)
+        xc = _np(2, 3, 5, 6)
+        g2 = _build(kl.GlobalMaxPooling2D(), (3, 5, 6))
+        np.testing.assert_allclose(np.asarray(g2.forward(jnp.asarray(xc))),
+                                   xc.max(axis=(2, 3)), rtol=1e-6)
+
+
+class TestLayerNormalization:
+    def test_oracle(self):
+        m = _build(kl.LayerNormalization(), (8,))
+        x = _np(4, 8)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        ref = F.layer_norm(torch.tensor(x), (8,)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestInModel:
+    def test_text_cnn_compiles_and_fits(self):
+        """The keras text-CNN idiom end-to-end through compile/fit."""
+        Engine.init(seed=0)
+        RandomGenerator.set_seed(0)
+        model = Sequential()
+        model.add(kl.Convolution1D(8, 3, activation="relu",
+                                   input_shape=(12, 5)))
+        model.add(kl.GlobalMaxPooling1D())
+        model.add(kl.Dense(3, activation="log_softmax"))
+        from bigdl_tpu import nn
+        model.compile(optimizer="adam", loss=nn.ClassNLLCriterion())
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 12, 5)).astype(np.float32)
+        y = rng.integers(0, 3, size=(64,)).astype(np.int32)
+        model.fit(x, y, batch_size=16, nb_epoch=2)
+        pred = model.predict(x[:4], batch_size=4)
+        assert np.asarray(pred).shape == (4, 3)

@@ -107,3 +107,28 @@ class TestOrbaxBackend:
             LocalOptimizer(_model(), _data(), nn.ClassNLLCriterion()) \
                 .set_checkpoint(str(tmp_path), Trigger.every_epoch(),
                                 backend="zip")
+
+
+class TestOverwriteMode:
+    def test_rolling_keeps_exactly_latest_committed(self, tmp_path):
+        Engine.reset()
+        Engine.init(seed=0)
+        opt = (LocalOptimizer(_model(), _data(), nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_checkpoint(str(tmp_path), Trigger.several_iteration(2),
+                               backend="orbax")
+               .over_write_checkpoint()
+               .set_end_when(Trigger.max_iteration(7)))
+        opt.optimize()
+        dirs = [p for p in os.listdir(tmp_path)
+                if p.startswith("ckpt_orbax") and not p.endswith(".meta.json")]
+        metas = [p for p in os.listdir(tmp_path) if p.endswith(".meta.json")]
+        # pruning runs at the commit AFTER each save, so at most the latest
+        # committed plus one in-flight survivor remain — never a full history
+        assert len(dirs) <= 2 and len(metas) <= 2
+        opt2 = (LocalOptimizer(_model(), _data(), nn.ClassNLLCriterion())
+                .set_optim_method(SGD(learningrate=0.1))
+                .set_checkpoint(str(tmp_path), Trigger.several_iteration(2),
+                                backend="orbax"))
+        opt2._load_latest_checkpoint()
+        assert opt2.state["neval"] == 6

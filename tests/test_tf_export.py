@@ -1,0 +1,112 @@
+"""TF frozen-graph export (saveTF analog): exported GraphDef runs under TF and
+matches the native forward; export→import round-trips through our own loader."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax.numpy as jnp  # noqa: E402
+
+from bigdl_tpu import nn  # noqa: E402
+from bigdl_tpu.utils.random_generator import RandomGenerator  # noqa: E402
+from bigdl_tpu.utils.tf import (  # noqa: E402
+    TFExportError, load_frozen_graph, save_tf,
+)
+
+
+def _run_tf(pb_path, x, input_name="input", output_name="output"):
+    gd = tf.compat.v1.GraphDef()
+    with open(pb_path, "rb") as f:
+        gd.ParseFromString(f.read())
+    g = tf.Graph()
+    with g.as_default():
+        tf.import_graph_def(gd, name="")
+    with tf.compat.v1.Session(graph=g) as sess:
+        return sess.run(f"{output_name}:0", {f"{input_name}:0": x})
+
+
+def _cnn():
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1))
+            .add(nn.SpatialBatchNormalization(8))
+            .add(nn.ReLU())
+            .add(nn.SpatialMaxPooling(2, 2))
+            .add(nn.SpatialConvolution(8, 4, 3, 3))
+            .add(nn.Tanh())
+            .add(nn.SpatialAveragePooling(2, 2))
+            .add(nn.Flatten())
+            .add(nn.Linear(4 * 3 * 3, 5))
+            .add(nn.SoftMax()))
+
+
+class TestSaveTF:
+    def test_cnn_runs_under_tf(self, tmp_path):
+        RandomGenerator.set_seed(0)
+        model = _cnn().evaluate()
+        # give BN non-trivial running stats
+        st = model.get_state()
+        rng = np.random.default_rng(1)
+        st["1"]["running_mean"] = jnp.asarray(rng.normal(size=8)
+                                              .astype(np.float32))
+        st["1"]["running_var"] = jnp.asarray(
+            (np.abs(rng.normal(size=8)) + 0.5).astype(np.float32))
+        model.set_state(st)
+        p = str(tmp_path / "model.pb")
+        save_tf(model, p, [None, 3, 16, 16])
+        x = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+        ref = np.asarray(model.forward(jnp.asarray(x)))
+        out = _run_tf(p, x)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_graph_model_with_branches(self, tmp_path):
+        RandomGenerator.set_seed(0)
+        inp = nn.Input()
+        a = nn.Linear(6, 8).inputs(inp)
+        a = nn.ReLU().inputs(a)
+        b = nn.Linear(6, 8).inputs(inp)
+        s = nn.CAddTable().inputs(a, b)
+        j = nn.JoinTable(2).inputs(s, a)
+        out = nn.Linear(16, 3).inputs(j)
+        out = nn.LogSoftMax().inputs(out)
+        model = nn.Graph(inp, out).evaluate()
+        p = str(tmp_path / "graph.pb")
+        save_tf(model, p, [None, 6])
+        x = np.random.default_rng(1).normal(size=(4, 6)).astype(np.float32)
+        ref = np.asarray(model.forward(jnp.asarray(x)))
+        np.testing.assert_allclose(_run_tf(p, x), ref, rtol=1e-4, atol=1e-5)
+
+    def test_export_import_roundtrip(self, tmp_path):
+        """Our exporter's output re-imports through our own loader."""
+        RandomGenerator.set_seed(0)
+        model = (nn.Sequential().add(nn.Linear(6, 8)).add(nn.ReLU())
+                 .add(nn.Linear(8, 3)).add(nn.SoftMax())).evaluate()
+        p = str(tmp_path / "rt.pb")
+        save_tf(model, p, [2, 6])
+        g = load_frozen_graph(p, outputs=["output"], inputs=["input"])
+        x = np.random.default_rng(2).normal(size=(2, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(g.evaluate().forward(jnp.asarray(x))),
+            np.asarray(model.forward(jnp.asarray(x))), rtol=1e-5, atol=1e-6)
+
+    def test_cnn_export_import_roundtrip(self, tmp_path):
+        """Spatial models round-trip through our own importer (the exporter's
+        NHWC boundary Transposes must be importable)."""
+        RandomGenerator.set_seed(0)
+        model = (nn.Sequential().add(nn.SpatialConvolution(1, 4, 3, 3))
+                 .add(nn.ReLU()).add(nn.SpatialMaxPooling(2, 2))
+                 .add(nn.Flatten()).add(nn.Linear(4 * 13 * 13, 10))
+                 .add(nn.SoftMax())).evaluate()
+        p = str(tmp_path / "cnn_rt.pb")
+        save_tf(model, p, [None, 1, 28, 28])
+        g = load_frozen_graph(p, outputs=["output"], inputs=["input"])
+        x = np.random.default_rng(3).normal(size=(2, 1, 28, 28)) \
+            .astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(g.evaluate().forward(jnp.asarray(x))),
+            np.asarray(model.forward(jnp.asarray(x))), rtol=1e-4, atol=1e-5)
+
+    def test_unsupported_layer_fails_loudly(self, tmp_path):
+        model = nn.Sequential().add(nn.LSTM(4, 4))
+        with pytest.raises(TFExportError, match="no TF export rule"):
+            save_tf(model, str(tmp_path / "x.pb"), [1, 4])

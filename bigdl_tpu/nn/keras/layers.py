@@ -373,12 +373,11 @@ class Convolution1D(KerasLayer):
                                      self.subsample_length,
                                      with_bias=self.bias, w_init=self.init)
         if self.border_mode == "same":
-            # exact TF/keras SAME split: total needed pad depends on steps and
-            # stride (left = needed // 2), NOT a fixed (k-1)//2 each side
+            # exact TF/keras SAME split (shared helper — pooling.py)
+            from bigdl_tpu.nn.pooling import _same_pad
             k, s = self.filter_length, self.subsample_length
-            out = -(-steps // s)
-            needed = max((out - 1) * s + k - steps, 0)
-            left = needed // 2
+            left, right = _same_pad(steps, k, s)
+            needed = left + right
             seq = N.Sequential()
             if left:
                 seq.add(N.Padding(1, -left, num_input_dims=2))

@@ -180,12 +180,18 @@ def save_tf(module, path: str, input_shape, input_name: str = "input",
     dynamic batch dim).
     """
     tf = _require_tf()
+    was_training = module.is_training()
     module.evaluate()
-    graph = tf.Graph()
-    with graph.as_default():
-        x = tf.compat.v1.placeholder(tf.float32, input_shape, name=input_name)
-        y = _emit(module, x, tf)
-        tf.identity(y, name=output_name)
-    gd = graph.as_graph_def()
-    with open(path, "wb") as f:
-        f.write(gd.SerializeToString())
+    try:
+        graph = tf.Graph()
+        with graph.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, input_shape,
+                                         name=input_name)
+            y = _emit(module, x, tf)
+            tf.identity(y, name=output_name)
+        gd = graph.as_graph_def()
+        with open(path, "wb") as f:
+            f.write(gd.SerializeToString())
+    finally:
+        if was_training:  # exporting mid-training must not flip the mode
+            module.training()

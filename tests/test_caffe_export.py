@@ -1,0 +1,71 @@
+"""Caffe exporter (CaffePersister analog): export → load_caffe round-trips
+exactly, including branches, BatchNorm+Scale, ceil pooling, and LRN."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.caffe import (
+    CaffeExportError, load_caffe, save_caffe,
+)
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _x(*shape, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).normal(size=shape)
+                       .astype(np.float32))
+
+
+class TestSaveCaffe:
+    def test_cnn_roundtrip(self, tmp_path):
+        RandomGenerator.set_seed(0)
+        model = (nn.Sequential()
+                 .add(nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1))
+                 .add(nn.SpatialBatchNormalization(8))
+                 .add(nn.ReLU())
+                 .add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True))
+                 .add(nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0))
+                 .add(nn.Dropout(0.4))
+                 .add(nn.Linear(8 * 4 * 4, 5))
+                 .add(nn.SoftMax())).evaluate()
+        st = model.get_state()
+        rng = np.random.default_rng(1)
+        st["1"]["running_mean"] = jnp.asarray(rng.normal(size=8)
+                                              .astype(np.float32))
+        st["1"]["running_var"] = jnp.asarray(
+            (np.abs(rng.normal(size=8)) + 0.5).astype(np.float32))
+        model.set_state(st)
+        proto = str(tmp_path / "m.prototxt")
+        weights = str(tmp_path / "m.caffemodel")
+        save_caffe(model, proto, weights, [2, 3, 8, 8])
+        loaded = load_caffe(proto, weights)
+        x = _x(2, 3, 8, 8, seed=2)
+        np.testing.assert_allclose(
+            np.asarray(loaded.evaluate().forward(x)),
+            np.asarray(model.forward(x)), rtol=1e-4, atol=1e-5)
+
+    def test_graph_with_branches_roundtrip(self, tmp_path):
+        RandomGenerator.set_seed(0)
+        inp = nn.Input()
+        a = nn.SpatialConvolution(2, 4, 1, 1).inputs(inp)
+        b = nn.SpatialConvolution(2, 4, 3, 3, pad_w=1, pad_h=1).inputs(inp)
+        s = nn.CAddTable().inputs(a, b)
+        r = nn.ReLU().inputs(s)
+        j = nn.JoinTable(2).inputs(r, a)
+        model = nn.Graph(inp, j).evaluate()
+        proto = str(tmp_path / "g.prototxt")
+        weights = str(tmp_path / "g.caffemodel")
+        save_caffe(model, proto, weights, [1, 2, 6, 6])
+        loaded = load_caffe(proto, weights)
+        x = _x(1, 2, 6, 6, seed=3)
+        np.testing.assert_allclose(
+            np.asarray(loaded.evaluate().forward(x)),
+            np.asarray(model.forward(x)), rtol=1e-4, atol=1e-5)
+
+    def test_unsupported_layer_fails_loudly(self, tmp_path):
+        model = nn.Sequential().add(nn.LSTM(4, 4))
+        with pytest.raises(CaffeExportError, match="no Caffe export rule"):
+            save_caffe(model, str(tmp_path / "x.prototxt"),
+                       str(tmp_path / "x.caffemodel"), [1, 4])

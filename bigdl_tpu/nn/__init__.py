@@ -3,18 +3,21 @@
 from bigdl_tpu.nn.abstractnn import AbstractModule, Container, TensorModule
 from bigdl_tpu.nn.attention import MultiHeadAttention
 from bigdl_tpu.nn.activation import (
-    Abs, AddConstant, Clamp, ELU, Exp, GELU, HardSigmoid, HardTanh, LeakyReLU, Log,
-    LogSoftMax, MulConstant, Power, PReLU, ReLU, ReLU6, Sigmoid, SoftMax, SoftMin,
-    SoftPlus, SoftSign, Sqrt, Square, Swish, Tanh,
+    Abs, AddConstant, BinaryThreshold, Clamp, ELU, Exp, GELU, HardSigmoid, HardTanh,
+    LeakyReLU, Log, LogSigmoid, LogSoftMax, MulConstant, Power, PReLU, ReLU, ReLU6,
+    Sigmoid, SoftMax, SoftMin, SoftPlus, SoftSign, Sqrt, Square, Swish, Tanh,
+    TanhShrink,
 )
 from bigdl_tpu.nn.containers import (
-    Bottle, CAddTable, CDivTable, CMaxTable, CMinTable, CMulTable, CSubTable, Concat,
-    ConcatTable, Echo, FlattenTable, Identity, JoinTable, MapTable, ParallelTable,
+    BifurcateSplitTable, Bottle, CAddTable, CAveTable, CDivTable, CMaxTable, CMinTable,
+    CMulTable, CSubTable, Concat, ConcatTable, Echo, FlattenTable, Identity, JoinTable,
+    MapTable, MaskedSelect, MixtureTable, NarrowTable, Pack, ParallelTable,
     SelectTable, Sequential,
 )
 from bigdl_tpu.nn.misc import (
-    Bilinear, DotProduct, Euclidean, HardShrink, Max, Maxout, Mean, Min, MM, MV,
-    Negative, RReLU, SoftShrink, SpatialUpSamplingBilinear, SpatialUpSamplingNearest,
+    Bilinear, DotProduct, Euclidean, GaussianSampler, GradientReversal, HardShrink,
+    Highway, L1Penalty, Max, Maxout, Mean, Min, MM, MV, Negative, PairwiseDistance,
+    RReLU, Scale, SoftShrink, SpatialUpSamplingBilinear, SpatialUpSamplingNearest,
     Sum, Threshold,
 )
 from bigdl_tpu.nn.cosine import Cosine, CosineDistance
@@ -60,6 +63,7 @@ from bigdl_tpu.nn.pooling import (
     SpatialAveragePooling, SpatialMaxPooling, TemporalMaxPooling,
 )
 from bigdl_tpu.nn.shape_ops import (
-    Contiguous, Flatten, Narrow, Padding, Replicate, Reshape, Select, SpatialZeroPadding,
-    SplitTable, Squeeze, Transpose, Unsqueeze, View,
+    Contiguous, Flatten, Index, InferReshape, Narrow, Padding, Replicate, Reshape,
+    Reverse, Select, SpatialZeroPadding, SplitTable, Squeeze, Tile, Transpose,
+    Unsqueeze, View,
 )

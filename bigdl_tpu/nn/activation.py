@@ -202,3 +202,26 @@ class SoftMin(TensorModule):
 
     def apply(self, params, state, input, *, training=False, rng=None):
         return jax.nn.softmax(-input.astype(jnp.float32), axis=-1), state
+
+
+class BinaryThreshold(TensorModule):
+    """1 where input > th else 0 (reference ``BinaryThreshold``)."""
+
+    def __init__(self, th: float = 1e-6, ip: bool = False):
+        super().__init__()
+        self.th = th
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return (input > self.th).astype(input.dtype), state
+
+
+class LogSigmoid(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.log_sigmoid(input), state
+
+
+class TanhShrink(TensorModule):
+    """x - tanh(x) (reference ``TanhShrink``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input - jnp.tanh(input), state

@@ -254,3 +254,122 @@ class MapTable(Container):
             o, s = m.apply(params["0"], s, x, training=training, rng=r)
             outs.append(o)
         return T(*outs), {"0": s}
+
+
+class NarrowTable(AbstractModule):
+    """Select ``length`` consecutive entries of the input Table starting at
+    ``offset`` (1-based; reference ``NarrowTable``). length=1 returns the bare
+    element, matching the reference's unwrap behavior for singleton narrows."""
+
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__()
+        self.offset, self.length = offset, length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        start = self.offset - 1
+        length = self.length
+        if length < 0:  # same convention as Narrow: count back from the end
+            length = len(xs) - start + length + 1
+        picked = xs[start:start + length]
+        if len(picked) == 1:
+            return picked[0], state
+        return T(*picked), state
+
+
+class Pack(AbstractModule):
+    """Stack the entries of a Table along a NEW dim (1-based; reference
+    ``Pack``)."""
+
+    def __init__(self, dim: int = 1):
+        super().__init__()
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        return jnp.stack(xs, axis=self.dim - 1), state
+
+
+class CAveTable(AbstractModule):
+    """Elementwise average of the Table entries (reference ``CAveTable``)."""
+
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out / float(len(xs)), state
+
+
+class BifurcateSplitTable(AbstractModule):
+    """Split a tensor into a Table of two halves along dim (1-based; reference
+    ``BifurcateSplitTable`` — the dim's size must be even)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dimension - 1 if self.dimension > 0 else input.ndim + self.dimension
+        n = input.shape[axis]
+        if n % 2 != 0:
+            raise ValueError(
+                f"BifurcateSplitTable: dim {self.dimension} has odd size {n}")
+        a, b = jnp.split(input, 2, axis=axis)
+        return T(a, b), state
+
+
+class MixtureTable(AbstractModule):
+    """Mixture-of-experts blend: input Table = (gater (N,E), experts); output =
+    sum_e gater[:, e] * expert_e (reference ``MixtureTable``). Experts may be a
+    Table of E tensors (stacked on a new expert axis) or a single pre-stacked
+    tensor whose expert axis is ``dim`` (1-based counting batch first,
+    default 2). The stack-and-contract is one einsum on the MXU."""
+
+    def __init__(self, dim: int = 2):
+        super().__init__()
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        gater, experts = xs[0], xs[1]
+        if isinstance(experts, Table):
+            stacked = jnp.stack(experts.values(), axis=self.dim - 1)
+        elif isinstance(experts, (list, tuple)):
+            stacked = jnp.stack(list(experts), axis=self.dim - 1)
+        else:
+            stacked = experts                      # already (N, ..E.., ...)
+        axis = self.dim - 1
+        shape = [1] * stacked.ndim
+        shape[0], shape[axis] = gater.shape[0], gater.shape[1]
+        g = gater.reshape(shape)
+        return jnp.sum(g * stacked, axis=axis), state
+
+
+class MaskedSelect(AbstractModule):
+    """Select input[0] values where the input[1] mask is nonzero.
+
+    TPU-native redesign of the reference ``MaskedSelect``: the reference returns
+    a dynamically-sized 1-D tensor, which XLA cannot express inside a traced
+    program (no dynamic shapes on TPU). Eagerly (outside jit) this returns the
+    exact torch-style dynamic result; inside a trace it raises with guidance to
+    use a static-shape masking pattern (``jnp.where`` / sort-by-mask) instead.
+    """
+
+    def forward(self, input):
+        # eager host path — bypasses the jitted-apply facade on purpose
+        xs = input.values() if isinstance(input, Table) else list(input)
+        import numpy as np
+        xv = np.asarray(xs[0])
+        mv = np.asarray(xs[1]).astype(bool)
+        self.output = jnp.asarray(xv[mv])
+        return self.output
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        raise TypeError(
+            "MaskedSelect produces a data-dependent shape and cannot run "
+            "inside jit on TPU; call .forward() eagerly (host) or restructure "
+            "with jnp.where for a static-shape pipeline")

@@ -14,7 +14,9 @@ from typing import Optional
 import jax.numpy as jnp
 
 from bigdl_tpu.nn.abstractnn import AbstractModule, TensorModule
-from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform, Zeros
+from bigdl_tpu.nn.initialization import (
+    InitializationMethod, RandomUniform, Xavier, Zeros,
+)
 from bigdl_tpu.utils.table import Table
 
 
@@ -318,3 +320,184 @@ class SpatialUpSamplingBilinear(TensorModule):
         if squeeze:
             out = out[0]
         return out, state
+
+
+# ----------------------------------------------------------------- grad tricks
+import jax as _jax
+
+
+@_jax.custom_vjp
+def _grad_reverse(x, lam):
+    return x
+
+
+def _grad_reverse_fwd(x, lam):
+    return x, lam
+
+
+def _grad_reverse_bwd(lam, g):
+    return (-lam * g, None)
+
+
+_grad_reverse.defvjp(_grad_reverse_fwd, _grad_reverse_bwd)
+
+
+class GradientReversal(TensorModule):
+    """Identity forward; backward multiplies the gradient by ``-lambda``
+    (reference ``GradientReversal`` — domain-adversarial training). Implemented
+    as a ``jax.custom_vjp`` so it works inside the one-jit training step."""
+
+    def __init__(self, the_lambda: float = 1.0):
+        super().__init__()
+        self.the_lambda = float(the_lambda)
+
+    def set_lambda(self, lam: float) -> "GradientReversal":
+        self.the_lambda = float(lam)
+        self._apply_cache = {}  # lambda is baked into the trace — invalidate
+        return self
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return _grad_reverse(input, self.the_lambda), state
+
+
+@_jax.custom_vjp
+def _l1_penalty(x, strength):
+    return x
+
+
+def _l1_penalty_fwd(x, strength):
+    return x, (jnp.sign(x), strength)
+
+
+def _l1_penalty_bwd(res, g):
+    sign, strength = res
+    return (g + strength * sign.astype(g.dtype), None)
+
+
+_l1_penalty.defvjp(_l1_penalty_fwd, _l1_penalty_bwd)
+
+
+class L1Penalty(TensorModule):
+    """Identity forward that adds an L1 sparsity gradient ``l1weight*sign(x)``
+    on the way back (reference ``L1Penalty(l1weight, sizeAverage)``)."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True):
+        super().__init__()
+        self.l1weight = float(l1weight)
+        self.size_average = size_average
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        strength = self.l1weight
+        if self.size_average:
+            strength = strength / input.size
+        if training:
+            return _l1_penalty(input, strength), state
+        return input, state
+
+
+class Scale(AbstractModule):
+    """Elementwise affine y = x * w + b with weight/bias of shape ``size``
+    broadcast over the batch (reference ``Scale`` = CMul + CAdd fused; the
+    Caffe ``Scale`` layer analog)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.reset()
+
+    def reset(self) -> None:
+        self._params = {"weight": jnp.ones(self.size, jnp.float32),
+                        "bias": jnp.zeros(self.size, jnp.float32)}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        w, b = params["weight"], params["bias"]
+        # broadcast (size) against (N, *size)-or-compatible input, torch-style
+        shape = (1,) * (input.ndim - w.ndim) + w.shape
+        return input * w.reshape(shape) + b.reshape(shape), state
+
+
+class PairwiseDistance(AbstractModule):
+    """p-norm distance between the two entries of a Table pair → (N,)
+    (reference ``PairwiseDistance(norm)``; torch ``nn.PairwiseDistance``)."""
+
+    def __init__(self, norm: int = 2):
+        super().__init__()
+        self.norm = norm
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        d = xs[0] - xs[1]
+        if d.ndim == 1:
+            d = d[None]
+        p = float(self.norm)
+        out = jnp.sum(jnp.abs(d) ** p + 1e-12, axis=-1) ** (1.0 / p)
+        return out, state
+
+
+class GaussianSampler(AbstractModule):
+    """Reparameterised sample from N(mu, exp(log_var)) given a Table
+    (mu, log_var) (reference ``GaussianSampler`` — the VAE sampling layer)."""
+
+    def needs_rng(self) -> bool:
+        return True
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        mu, log_var = xs[0], xs[1]
+        if rng is None:
+            return mu, state  # eval mode: the mean is the sample
+        eps = _jax.random.normal(rng, mu.shape, mu.dtype)
+        return mu + jnp.exp(0.5 * log_var) * eps, state
+
+
+class Highway(AbstractModule):
+    """Highway layer: ``t*g(Wx+b) + (1-t)*x`` with transform gate
+    ``t = sigmoid(Wt x + bt)`` (reference ``Highway(size, withBias,
+    activation)``). Two matmuls on the MXU, gating fused by XLA."""
+
+    def __init__(self, size: int, with_bias: bool = True, activation=None,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.size = size
+        self.with_bias = with_bias
+        # Parameter-free AbstractModule or None → tanh. Parametric activations
+        # (PReLU…) would need their params registered on this leaf module to
+        # train; reject them loudly rather than silently freezing them.
+        if activation is not None and activation.get_params():
+            raise ValueError(
+                "Highway only supports parameter-free activations (got "
+                f"{type(activation).__name__} with trainable params); apply "
+                "parametric activations as a separate layer after Highway")
+        self.activation = activation
+        self.w_init = w_init or Xavier()
+        self.b_init = b_init or Zeros()
+        self.reset()
+
+    def reset(self) -> None:
+        s = self.size
+        self._params = {
+            "weight": jnp.asarray(self.w_init.init((s, s), fan_in=s, fan_out=s)),
+            "gate_weight": jnp.asarray(self.w_init.init((s, s), fan_in=s, fan_out=s)),
+        }
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(
+                self.b_init.init((s,), fan_in=s, fan_out=s))
+            # negative gate bias opens the carry path early (standard practice)
+            self._params["gate_bias"] = jnp.full((s,), -1.0, jnp.float32)
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        h = input @ params["weight"].T
+        t = input @ params["gate_weight"].T
+        if self.with_bias:
+            h = h + params["bias"]
+            t = t + params["gate_bias"]
+        if self.activation is None:
+            h = jnp.tanh(h)
+        else:
+            h, _ = self.activation.apply({}, {}, h, training=training, rng=None)
+        t = _jax.nn.sigmoid(t)
+        return t * h + (1.0 - t) * input, state

@@ -179,3 +179,62 @@ class Replicate(TensorModule):
         if self.n_input_dims > 0 and input.ndim == self.n_input_dims + 1:
             axis += 1
         return jnp.repeat(jnp.expand_dims(input, axis), self.n_features, axis=axis), state
+
+
+class Tile(TensorModule):
+    """Repeat input ``copies`` times along dim (1-based; reference ``Tile``)."""
+
+    def __init__(self, dim: int = 1, copies: int = 2):
+        super().__init__()
+        self.dim, self.copies = dim, copies
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
+        reps = [1] * input.ndim
+        reps[axis] = self.copies
+        return jnp.tile(input, reps), state
+
+
+class Reverse(TensorModule):
+    """Flip along dim (1-based; reference ``Reverse``)."""
+
+    def __init__(self, dimension: int = 1, is_inplace: bool = False):
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dimension - 1 if self.dimension > 0 else input.ndim + self.dimension
+        return jnp.flip(input, axis=axis), state
+
+
+class Index(AbstractModule):
+    """Index select: input Table = (source, indices); gathers along dim
+    (1-based; reference ``Index``). Indices are 0-based here, consistent with
+    this framework's labels."""
+
+    def __init__(self, dimension: int = 1):
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        src, idx = xs[0], xs[1]
+        axis = self.dimension - 1 if self.dimension > 0 else src.ndim + self.dimension
+        return jnp.take(src, idx.astype(jnp.int32), axis=axis), state
+
+
+class InferReshape(TensorModule):
+    """Reshape where one target dim may be -1 (inferred) and 0 copies the
+    corresponding input dim (reference ``InferReshape``)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        in_shape = input.shape[1:] if self.batch_mode else input.shape
+        target = [in_shape[i] if s == 0 else s for i, s in enumerate(self.size)]
+        if self.batch_mode:
+            target = [input.shape[0]] + target
+        return input.reshape(tuple(target)), state

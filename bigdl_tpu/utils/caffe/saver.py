@@ -8,8 +8,11 @@ Scope mirrors the importer's layer set (the NCHW zoo): Linear → InnerProduct,
 SpatialConvolution → Convolution, Max/Avg pooling (incl. ceil/floor round
 mode), ReLU/Dropout/Softmax, JoinTable → Concat, CAdd/CMul/CMaxTable →
 Eltwise, SpatialCrossMapLRN → LRN, SpatialBatchNormalization → BatchNorm (+
-Scale when affine), Sequential and Graph containers. Unsupported layers fail
-loudly. Export → ``load_caffe`` round-trips exactly.
+Scale when affine), Sequential and Graph containers, plus the importer's
+adapter modules (CaffeSoftmax/CaffeScale/CaffeGlobalPool → their source
+layers; CSubTable → Eltwise SUM with coeff [1,-1]) so ``load_caffe`` →
+``save_caffe`` stays closed. Unsupported layers fail loudly. Export →
+``load_caffe`` round-trips exactly.
 """
 
 from __future__ import annotations
@@ -151,6 +154,25 @@ class _Exporter:
             return name
         if t in ("Identity", "Contiguous"):
             return bottom
+        # importer-produced adapter modules (utils/caffe/ops.py) — exact Caffe
+        # layers, so the import → export round trip stays closed
+        if t == "CaffeSoftmax":
+            l, name = self._layer("prob", "Softmax", [bottom])
+            l.softmax_param.axis = module.axis
+            return name
+        if t == "CaffeScale":
+            blobs = [params["gamma"]]
+            if "beta" in params:
+                blobs.append(params["beta"])
+            l, name = self._layer("scale", "Scale", [bottom], blobs)
+            l.scale_param.bias_term = "beta" in params
+            return name
+        if t == "CaffeGlobalPool":
+            l, name = self._layer("pool", "Pooling", [bottom])
+            p = l.pooling_param
+            p.pool = p.MAX if module.kind == "max" else p.AVE
+            p.global_pooling = True
+            return name
 
         raise CaffeExportError(
             f"layer {t!r} has no Caffe export rule — add one in "
@@ -188,6 +210,11 @@ class _Exporter:
                 e = l.eltwise_param
                 e.operation = {"CAddTable": e.SUM, "CMulTable": e.PROD,
                                "CMaxTable": e.MAX}[tname]
+                values[node.id] = name
+            elif tname == "CSubTable":
+                l, name = self._layer("elt", "Eltwise", ins)
+                l.eltwise_param.operation = l.eltwise_param.SUM
+                l.eltwise_param.coeff.extend([1.0, -1.0])
                 values[node.id] = name
             else:
                 if len(ins) != 1:

@@ -69,3 +69,44 @@ class TestSaveCaffe:
         with pytest.raises(CaffeExportError, match="no Caffe export rule"):
             save_caffe(model, str(tmp_path / "x.prototxt"),
                        str(tmp_path / "x.caffemodel"), [1, 4])
+
+
+class TestImportThenExport:
+    """load_caffe -> save_caffe must stay closed over the importer's adapter
+    modules (CaffeSoftmax/CaffeScale/CaffeGlobalPool, CSubTable)."""
+
+    def test_adapter_modules_roundtrip(self, tmp_path):
+        from bigdl_tpu.utils.caffe.ops import (
+            CaffeGlobalPool, CaffeScale, CaffeSoftmax,
+        )
+        RandomGenerator.set_seed(0)
+        g = np.random.default_rng(0).normal(size=(3,)).astype(np.float32)
+        b = np.random.default_rng(1).normal(size=(3,)).astype(np.float32)
+        model = nn.Sequential().add(nn.SpatialConvolution(2, 3, 3, 3, pad_w=1,
+                                                          pad_h=1))
+        model.add(CaffeScale(g, b)).add(CaffeGlobalPool("avg"))
+        model.add(CaffeSoftmax(axis=1)).evaluate()
+        proto = str(tmp_path / "a.prototxt")
+        weights = str(tmp_path / "a.caffemodel")
+        save_caffe(model, proto, weights, [2, 2, 6, 6])
+        loaded = load_caffe(proto, weights)
+        x = _x(2, 2, 6, 6, seed=5)
+        np.testing.assert_allclose(
+            np.asarray(loaded.evaluate().forward(x)),
+            np.asarray(model.forward(x)), rtol=1e-4, atol=1e-5)
+
+    def test_csub_graph_roundtrip(self, tmp_path):
+        RandomGenerator.set_seed(0)
+        inp = nn.Input()
+        a = nn.SpatialConvolution(2, 4, 1, 1).inputs(inp)
+        b = nn.SpatialConvolution(2, 4, 1, 1).inputs(inp)
+        d = nn.CSubTable().inputs(a, b)
+        model = nn.Graph(inp, nn.ReLU().inputs(d)).evaluate()
+        proto = str(tmp_path / "s.prototxt")
+        weights = str(tmp_path / "s.caffemodel")
+        save_caffe(model, proto, weights, [1, 2, 5, 5])
+        loaded = load_caffe(proto, weights)
+        x = _x(1, 2, 5, 5, seed=6)
+        np.testing.assert_allclose(
+            np.asarray(loaded.evaluate().forward(x)),
+            np.asarray(model.forward(x)), rtol=1e-4, atol=1e-5)

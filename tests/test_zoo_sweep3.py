@@ -1,0 +1,235 @@
+"""Round-3 second layer sweep: elementwise, grad-trick, table and shape layers
+(SURVEY.md §2.1 layer zoo). Torch oracles where torch has the op."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.random_generator import RandomGenerator
+from bigdl_tpu.utils.table import T
+
+
+def _np(*shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestActivationsExt:
+    def test_binary_threshold(self):
+        x = _np(3, 4)
+        out = np.asarray(nn.BinaryThreshold(0.1).evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, (x > 0.1).astype(np.float32))
+
+    def test_logsigmoid_oracle(self):
+        x = _np(3, 4)
+        out = np.asarray(nn.LogSigmoid().evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, F.logsigmoid(torch.tensor(x)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_tanhshrink_oracle(self):
+        x = _np(3, 4)
+        out = np.asarray(nn.TanhShrink().evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, F.tanhshrink(torch.tensor(x)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestGradTricks:
+    def test_gradient_reversal(self):
+        m = nn.GradientReversal(the_lambda=2.0)
+        x = jnp.asarray(_np(3, 4))
+        out = m.forward(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+        gi = m.backward(x, jnp.ones_like(x))
+        np.testing.assert_allclose(np.asarray(gi), -2.0 * np.ones((3, 4)),
+                                   rtol=1e-6)
+
+    def test_gradient_reversal_inside_jit_grad(self):
+        m = nn.GradientReversal(the_lambda=0.5)
+
+        @jax.jit
+        def loss(x):
+            out, _ = m.apply({}, {}, x, training=True)
+            return jnp.sum(out)
+
+        g = jax.grad(loss)(jnp.ones((2, 2)))
+        np.testing.assert_allclose(np.asarray(g), -0.5 * np.ones((2, 2)))
+
+    def test_l1_penalty(self):
+        m = nn.L1Penalty(l1weight=0.3).training()
+        x = jnp.asarray(_np(3, 4))
+        np.testing.assert_allclose(np.asarray(m.forward(x)), np.asarray(x))
+        gi = m.backward(x, jnp.zeros_like(x))
+        np.testing.assert_allclose(np.asarray(gi),
+                                   0.3 * np.sign(np.asarray(x)), rtol=1e-6)
+        # eval mode: pure identity, no sparsity gradient
+        gi_eval = nn.L1Penalty(0.3).evaluate().backward(x, jnp.zeros_like(x))
+        np.testing.assert_allclose(np.asarray(gi_eval), np.zeros((3, 4)))
+
+
+class TestScaleHighwaySampler:
+    def test_scale(self):
+        m = nn.Scale((3, 1, 1))
+        w = _np(3, 1, 1, seed=1)
+        b = _np(3, 1, 1, seed=2)
+        m.set_params({"weight": jnp.asarray(w), "bias": jnp.asarray(b)})
+        x = _np(2, 3, 4, 4)
+        out = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, x * w[None] + b[None],
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_highway_carry_behavior(self):
+        RandomGenerator.set_seed(0)
+        m = nn.Highway(8)
+        # force the gate fully closed -> output == input (carry path)
+        p = m.get_params()
+        p["gate_weight"] = jnp.zeros_like(p["gate_weight"])
+        p["gate_bias"] = jnp.full_like(p["gate_bias"], -1e9)
+        m.set_params(p)
+        x = jnp.asarray(_np(4, 8))
+        np.testing.assert_allclose(np.asarray(m.evaluate().forward(x)),
+                                   np.asarray(x), rtol=1e-6)
+
+    def test_gaussian_sampler_stats(self):
+        RandomGenerator.set_seed(0)
+        m = nn.GaussianSampler().training()
+        mu = np.full((20000,), 1.5, np.float32)
+        log_var = np.full((20000,), np.log(0.25), np.float32)
+        out = np.asarray(m.forward(T(jnp.asarray(mu), jnp.asarray(log_var))))
+        assert abs(out.mean() - 1.5) < 0.02
+        assert abs(out.std() - 0.5) < 0.02
+        # eval mode returns the mean
+        out_eval = np.asarray(m.evaluate().forward(
+            T(jnp.asarray(mu), jnp.asarray(log_var))))
+        np.testing.assert_allclose(out_eval, mu)
+
+    def test_pairwise_distance_oracle(self):
+        a, b = _np(5, 7), _np(5, 7, seed=1)
+        out = np.asarray(nn.PairwiseDistance(2).evaluate()
+                         .forward(T(jnp.asarray(a), jnp.asarray(b))))
+        ref = F.pairwise_distance(torch.tensor(a), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestTableOps:
+    def test_narrow_table(self):
+        xs = [jnp.asarray(_np(2, 2, seed=i)) for i in range(4)]
+        out = nn.NarrowTable(2, 2).evaluate().forward(T(*xs))
+        got = out.values()
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(xs[1]))
+        np.testing.assert_allclose(np.asarray(got[1]), np.asarray(xs[2]))
+        single = nn.NarrowTable(3).evaluate().forward(T(*xs))
+        np.testing.assert_allclose(np.asarray(single), np.asarray(xs[2]))
+
+    def test_pack(self):
+        xs = [jnp.asarray(_np(2, 3, seed=i)) for i in range(3)]
+        out = np.asarray(nn.Pack(1).evaluate().forward(T(*xs)))
+        np.testing.assert_allclose(out, np.stack([np.asarray(x) for x in xs], 0))
+
+    def test_cave_table(self):
+        a, b, c = (_np(2, 3, seed=i) for i in range(3))
+        out = np.asarray(nn.CAveTable().evaluate().forward(
+            T(jnp.asarray(a), jnp.asarray(b), jnp.asarray(c))))
+        np.testing.assert_allclose(out, (a + b + c) / 3, rtol=1e-6)
+
+    def test_bifurcate_split(self):
+        x = _np(4, 6)
+        out = nn.BifurcateSplitTable(2).evaluate().forward(jnp.asarray(x))
+        a, b = out.values()
+        np.testing.assert_allclose(np.asarray(a), x[:, :3])
+        np.testing.assert_allclose(np.asarray(b), x[:, 3:])
+
+    def test_mixture_table(self):
+        g = np.abs(_np(4, 3))
+        g = g / g.sum(1, keepdims=True)
+        experts = [_np(4, 5, seed=i) for i in range(3)]
+        out = np.asarray(nn.MixtureTable().evaluate().forward(
+            T(jnp.asarray(g), T(*[jnp.asarray(e) for e in experts]))))
+        ref = sum(g[:, i:i + 1] * experts[i] for i in range(3))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_masked_select_eager(self):
+        x = _np(3, 4)
+        mask = (x > 0).astype(np.float32)
+        out = np.asarray(nn.MaskedSelect().evaluate().forward(
+            T(jnp.asarray(x), jnp.asarray(mask))))
+        np.testing.assert_allclose(out, x[x > 0])
+
+
+class TestShapeOpsExt:
+    def test_tile(self):
+        x = _np(2, 3)
+        out = np.asarray(nn.Tile(2, 3).evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, np.tile(x, (1, 3)))
+
+    def test_reverse(self):
+        x = _np(2, 5)
+        out = np.asarray(nn.Reverse(2).evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, x[:, ::-1])
+
+    def test_index(self):
+        x = _np(5, 3)
+        idx = np.array([3, 0, 1], np.int32)
+        out = np.asarray(nn.Index(1).evaluate().forward(
+            T(jnp.asarray(x), jnp.asarray(idx))))
+        np.testing.assert_allclose(out, x[idx])
+
+    def test_infer_reshape(self):
+        x = _np(2, 3, 4)
+        out = np.asarray(nn.InferReshape([0, -1], batch_mode=True)
+                         .evaluate().forward(jnp.asarray(x)))
+        assert out.shape == (2, 3, 4) or out.shape == (2, 3, 4)
+        out2 = np.asarray(nn.InferReshape([-1]).evaluate().forward(jnp.asarray(x)))
+        assert out2.shape == (24,)
+        out3 = np.asarray(nn.InferReshape([6, -1]).evaluate()
+                          .forward(jnp.asarray(x)))
+        assert out3.shape == (6, 4)
+
+
+class TestTrainThrough:
+    def test_highway_trains_in_sequential(self):
+        """New layers must compose with the one-jit training step."""
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        RandomGenerator.set_seed(0)
+        model = nn.Sequential()
+        model.add(nn.Linear(6, 8)).add(nn.Highway(8)).add(nn.L1Penalty(1e-4))
+        model.add(nn.Linear(8, 3)).add(nn.LogSoftMax())
+        x = _np(32, 6)
+        y = np.random.default_rng(0).integers(0, 3, size=(32,)).astype(np.int32)
+        ds = DataSet.array([MiniBatch(x[i:i + 8], y[i:i + 8])
+                            for i in range(0, 32, 8)])
+        opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_iteration(6))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+
+
+class TestReviewFixes3:
+    def test_gradient_reversal_set_lambda_after_trace(self):
+        m = nn.GradientReversal(1.0)
+        x = jnp.asarray(_np(2, 3))
+        m.backward(x, jnp.ones_like(x))  # bakes lambda=1 into the trace
+        m.set_lambda(3.0)
+        gi = m.backward(x, jnp.ones_like(x))
+        np.testing.assert_allclose(np.asarray(gi), -3.0 * np.ones((2, 3)))
+
+    def test_mixture_table_tensor_experts(self):
+        g = np.abs(_np(4, 3))
+        g = g / g.sum(1, keepdims=True)
+        experts = _np(4, 3, 5, seed=1)  # pre-stacked, expert axis = dim 2
+        out = np.asarray(nn.MixtureTable(2).evaluate().forward(
+            T(jnp.asarray(g), jnp.asarray(experts))))
+        ref = np.einsum("ne,nef->nf", g, experts)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_highway_rejects_parametric_activation(self):
+        with pytest.raises(ValueError, match="parameter-free"):
+            nn.Highway(8, activation=nn.PReLU())

@@ -105,15 +105,14 @@ class DistriOptimizer(Optimizer):
             donate_argnums=(0, 1, 2),
         )
 
-    def _put_batch(self, batch):
+    def _place_batch(self, batch):
         n_dev = int(dict(self._mesh.shape)[Engine.DATA_AXIS])
         bsz = batch.size()
         if bsz % n_dev != 0:
             raise ValueError(
                 f"batch size {bsz} not divisible by data-parallel size {n_dev}")
-        with self.metrics.timer("put_batch"):
-            inp = jax.device_put(batch.input, self._batch_sh)
-            target = jax.device_put(batch.target, self._batch_sh)
+        inp = jax.device_put(batch.input, self._batch_sh)
+        target = jax.device_put(batch.target, self._batch_sh)
         return inp, target
 
     def _put_input(self, batch):

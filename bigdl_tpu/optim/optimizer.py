@@ -90,6 +90,18 @@ class Optimizer:
         # checkify float checks; NaN/inf anywhere in the step raises with the
         # generating op's location. Debug-only — adds checking ops to the trace.
         self.check_numerics: bool = os.environ.get("BIGDL_CHECK_NUMERICS", "0") == "1"
+        # Device-side batch cache (the reference's cached-RDD analog, SURVEY
+        # §2.2 CachedDistriDataSet): for in-memory datasets that re-yield the
+        # SAME MiniBatch objects every epoch, each distinct batch is transferred
+        # host→device once and the placed buffers are reused. On deployments
+        # where the host↔device link is slow relative to compute (measured here:
+        # dispatch-side timers hide a ~25 MB/s effective transfer path that
+        # serializes with the compute stream), repeated per-epoch transfers
+        # dominate the step; caching removes them entirely. Bounded by
+        # BIGDL_DEVICE_CACHE_MB (default 2048); BIGDL_DEVICE_CACHE=0 disables.
+        self.device_cache_mb: float = float(
+            os.environ.get("BIGDL_DEVICE_CACHE_MB", "2048"))
+        self._device_batch_cache: Optional[dict] = None
         self._step_cache = None
 
     # fluent config (reference API shape) ----------------------------------
@@ -254,11 +266,51 @@ class Optimizer:
         from bigdl_tpu.optim.evaluator import cached_forward_jit
         return cached_forward_jit(self.model)
 
+    def _setup_device_cache(self) -> None:
+        """Enable the device batch cache when the dataset re-yields identical
+        MiniBatch objects (plain LocalDataSet — transformed pipelines build
+        fresh batches every epoch, which would grow the cache unboundedly) and
+        the whole dataset fits the configured budget. Re-validates whenever the
+        dataset object changes (a kept cache must never outlive its dataset's
+        eligibility)."""
+        ds = self.dataset
+        if self._device_batch_cache is not None \
+                and getattr(self, "_device_cache_ds", None) is ds:
+            return
+        self._device_batch_cache = None
+        self._device_cache_ds = ds
+        if os.environ.get("BIGDL_DEVICE_CACHE", "1") == "0":
+            return
+        from bigdl_tpu.dataset.dataset import LocalDataSet, TransformedDataSet
+        if isinstance(ds, TransformedDataSet) or not isinstance(ds, LocalDataSet):
+            return
+        try:
+            total = sum(getattr(b.input, "nbytes", 0)
+                        + getattr(b.target, "nbytes", 0) for b in ds._data)
+        except Exception:
+            return
+        if total <= self.device_cache_mb * 1e6:
+            logger.info("device batch cache enabled (%.0f MB in-memory dataset)",
+                        total / 1e6)
+            self._device_batch_cache = {}
+
     def _put_batch(self, batch: MiniBatch):
         # runs in the prefetch producer thread: assembly already happened in the
-        # dataset iterator; this just enqueues the h2d DMA
+        # dataset iterator; this just enqueues the h2d DMA (once per distinct
+        # batch when the device cache is on)
+        cache = self._device_batch_cache
+        if cache is not None:
+            hit = cache.get(id(batch))
+            if hit is not None and hit[0] is batch:
+                return hit[1]
         with self.metrics.timer("put_batch"):
-            return jax.device_put(batch.input), jax.device_put(batch.target)
+            placed = self._place_batch(batch)
+        if cache is not None:
+            cache[id(batch)] = (batch, placed)
+        return placed
+
+    def _place_batch(self, batch: MiniBatch):
+        return jax.device_put(batch.input), jax.device_put(batch.target)
 
     def _put_input(self, batch: MiniBatch):
         """Inputs-only placement for the eval path (targets stay on host there)."""
@@ -339,6 +391,7 @@ class Optimizer:
             self._step_cache_dtype = cdt
         step_fn = self._step_cache
         base_rng = RandomGenerator.next_key()
+        self._setup_device_cache()
 
         from bigdl_tpu.dataset.prefetch import PrefetchingFeed
 

@@ -186,3 +186,63 @@ class TestBatchedValidationFetch:
             correct += (pred == np.asarray(b.target)[: b.valid]).sum()
             total += b.valid
         assert scores["Top1Accuracy"] == pytest.approx(correct / total, abs=1e-6)
+
+
+class TestDeviceBatchCache:
+    """Device-side batch cache (cached-RDD analog): in-memory datasets place
+    each distinct MiniBatch once; streamed/transformed pipelines never cache."""
+
+    def _mk(self, n=4):
+        import numpy as np
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        rng = np.random.default_rng(0)
+        batches = [MiniBatch(rng.normal(size=(8, 6)).astype(np.float32),
+                             rng.integers(0, 3, size=(8,)).astype(np.int32))
+                   for _ in range(n)]
+        model = nn.Sequential().add(nn.Linear(6, 3)).add(nn.LogSoftMax())
+        ds = DataSet.array(batches)
+        opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1))
+        return opt, batches
+
+    def test_cache_hits_across_epochs(self):
+        from bigdl_tpu.optim.trigger import Trigger
+        opt, batches = self._mk(4)
+        opt.set_end_when(Trigger.max_iteration(12))  # 3 epochs over 4 batches
+        opt.optimize()
+        assert opt._device_batch_cache is not None
+        assert len(opt._device_batch_cache) == 4  # one entry per distinct batch
+        placed_first = opt._device_batch_cache[id(batches[0])][1]
+        assert opt._put_batch(batches[0]) is placed_first  # identity reuse
+
+    def test_cache_disabled_by_env(self, monkeypatch):
+        from bigdl_tpu.optim.trigger import Trigger
+        monkeypatch.setenv("BIGDL_DEVICE_CACHE", "0")
+        opt, _ = self._mk(2)
+        opt.set_end_when(Trigger.max_iteration(2))
+        opt.optimize()
+        assert opt._device_batch_cache is None
+
+    def test_cache_respects_budget(self):
+        opt, _ = self._mk(2)
+        opt.device_cache_mb = 1e-9  # dataset exceeds the budget
+        opt._setup_device_cache()
+        assert opt._device_batch_cache is None
+
+    def test_transformed_dataset_not_cached(self):
+        from bigdl_tpu.dataset.transformer import Transformer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        class Ident(Transformer):
+            def __call__(self, it):
+                return iter(list(it))
+
+        opt, _ = self._mk(2)
+        opt.dataset = opt.dataset >> Ident()
+        opt.set_end_when(Trigger.max_iteration(2))
+        opt.optimize()
+        assert opt._device_batch_cache is None

@@ -17,24 +17,28 @@ from bigdl_tpu.nn.containers import (
 from bigdl_tpu.nn.misc import (
     Bilinear, DotProduct, Euclidean, GaussianSampler, GradientReversal, HardShrink,
     Highway, L1Penalty, Max, Maxout, Mean, Min, MM, MV, Negative, PairwiseDistance,
-    RReLU, Scale, SoftShrink, SpatialUpSamplingBilinear, SpatialUpSamplingNearest,
-    Sum, Threshold,
+    RReLU, ResizeBilinear, Scale, SoftShrink, SpatialUpSamplingBilinear,
+    SpatialUpSamplingNearest, Sum, Threshold, UpSampling1D, UpSampling2D,
+    UpSampling3D, Cropping2D, Cropping3D,
 )
 from bigdl_tpu.nn.cosine import Cosine, CosineDistance
 from bigdl_tpu.nn.convolution import (
-    SpatialConvolution, SpatialDilatedConvolution, SpatialFullConvolution,
+    LocallyConnected1D, LocallyConnected2D, SpatialConvolution,
+    SpatialDilatedConvolution, SpatialFullConvolution, SpatialShareConvolution,
     TemporalConvolution,
 )
 from bigdl_tpu.nn.embedding import HashBucketEmbedding, LookupTable
 from bigdl_tpu.nn.graph import Graph, Input, ModuleNode, StaticGraph
 from bigdl_tpu.nn.normalization import (
     Add, BatchNormalization, CAdd, CMul, Dropout, GaussianDropout, GaussianNoise,
-    LayerNorm, Mul,
-    Normalize, SpatialBatchNormalization, SpatialCrossMapLRN, SpatialDropout2D,
+    LayerNorm, Mul, Normalize, SpatialBatchNormalization,
+    SpatialContrastiveNormalization, SpatialCrossMapLRN,
+    SpatialDivisiveNormalization, SpatialDropout1D, SpatialDropout2D,
+    SpatialDropout3D, SpatialSubtractiveNormalization, SpatialWithinChannelLRN,
 )
 from bigdl_tpu.nn.recurrent import (
-    BiRecurrent, Cell, GRU, LSTM, LSTMPeephole, Masking, Recurrent, RnnCell,
-    TimeDistributed,
+    BiRecurrent, Cell, ConvLSTMPeephole, GRU, LSTM, LSTMPeephole, Masking,
+    Recurrent, RecurrentDecoder, RnnCell, TimeDistributed,
 )
 from bigdl_tpu.nn.criterion import (
     AbsCriterion, AbstractCriterion, BCECriterion, BCECriterionWithLogits,
@@ -46,6 +50,9 @@ from bigdl_tpu.nn.criterion import (
     MultiCriterion, MultiLabelMarginCriterion, MultiLabelSoftMarginCriterion,
     MultiMarginCriterion, ParallelCriterion, PoissonCriterion, SmoothL1Criterion,
     SoftMarginCriterion, TimeDistributedCriterion,
+    CategoricalCrossEntropy, DiceCoefficientCriterion, GaussianCriterion,
+    KLDCriterion, SmoothL1CriterionWithWeights, SoftmaxWithCriterion,
+    TimeDistributedMaskCriterion, TransformerCriterion,
 )
 from bigdl_tpu.nn.initialization import (
     BilinearFiller, ConstInitMethod, InitializationMethod, MsraFiller, Ones,
@@ -57,7 +64,8 @@ from bigdl_tpu.nn.sparse import SparseEmbeddingSum, SparseLinear
 from bigdl_tpu.nn.roi import RoiPooling
 from bigdl_tpu.nn.tree import BinaryTreeLSTM
 from bigdl_tpu.nn.volumetric import (
-    VolumetricAveragePooling, VolumetricConvolution, VolumetricMaxPooling,
+    VolumetricAveragePooling, VolumetricConvolution, VolumetricFullConvolution,
+    VolumetricMaxPooling,
 )
 from bigdl_tpu.nn.pooling import (
     SpatialAveragePooling, SpatialMaxPooling, TemporalMaxPooling,

@@ -178,8 +178,18 @@ class SpatialFullConvolution(TensorModule):
         kh, kw = self.kh, self.kw
         pad = [(kh - 1 - self.pad_h, kh - 1 - self.pad_h + self.adj_h),
                (kw - 1 - self.pad_w, kw - 1 - self.pad_w + self.adj_w)]
+        # lax convs are correlations; the transpose of a correlation applies the
+        # SPATIALLY FLIPPED kernel (torch/Caffe deconv semantics)
+        w = jnp.flip(params["weight"], (-2, -1))
+        if self.n_group > 1:
+            # grouped deconv: torch keeps (I, O/g) with groups sliced along I;
+            # lax wants rhs (I/g, O) with group j in O-slice j — rearrange
+            g = self.n_group
+            i, og = w.shape[0], w.shape[1]
+            w = w.reshape(g, i // g, og, kh, kw).transpose(1, 0, 2, 3, 4) \
+                 .reshape(i // g, g * og, kh, kw)
         out = lax.conv_general_dilated(
-            x, params["weight"],
+            x, w,
             window_strides=(1, 1),
             padding=pad,
             lhs_dilation=(self.dh, self.dw),
@@ -244,3 +254,119 @@ class TemporalConvolution(TensorModule):
     def __repr__(self):
         return (f"TemporalConvolution({self.input_frame_size} -> "
                 f"{self.output_frame_size}, {self.kernel_w}, {self.stride_w})")
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Reference ``SpatialShareConvolution``: a SpatialConvolution variant whose
+    only upstream difference is sharing the im2col workspace across replica
+    threads. XLA owns all workspace memory on TPU, so the compute is identical;
+    the type is kept distinct for API and serialization parity."""
+
+
+class LocallyConnected2D(TensorModule):
+    """Unshared convolution (reference ``LocallyConnected2D``): each output
+    location has its own filter bank. TPU-native: extract patches with
+    ``conv_general_dilated_patches`` (one fused gather) and contract location-
+    wise with a single batched einsum on the MXU — no per-location loop."""
+
+    def __init__(self, n_input_plane: int, input_width: int, input_height: int,
+                 n_output_plane: int, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.input_width, self.input_height = input_width, input_height
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.with_bias = with_bias
+        self.out_h = (input_height + 2 * pad_h - kernel_h) // stride_h + 1
+        self.out_w = (input_width + 2 * pad_w - kernel_w) // stride_w + 1
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        k = self.n_input_plane * self.kernel_h * self.kernel_w
+        n_loc = self.out_h * self.out_w
+        w = self.w_init.init((n_loc, self.n_output_plane, k),
+                             fan_in=k, fan_out=self.n_output_plane)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(self.b_init.init(
+                (n_loc, self.n_output_plane), fan_in=k,
+                fan_out=self.n_output_plane))
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        # patches: (N, C*kh*kw, OH, OW), feature dim ordered (c, kh, kw) —
+        # matches the (n_loc, o, c*kh*kw) weight layout's contraction dim
+        patches = lax.conv_general_dilated_patches(
+            x, (self.kernel_h, self.kernel_w),
+            (self.stride_h, self.stride_w),
+            [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        n = patches.shape[0]
+        p = patches.reshape(n, patches.shape[1], -1)        # (N, K, P)
+        out = jnp.einsum("nkp,pok->npo", p, params["weight"])
+        if self.with_bias:
+            out = out + params["bias"][None]
+        out = jnp.transpose(out, (0, 2, 1)).reshape(
+            n, self.n_output_plane, self.out_h, self.out_w)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class LocallyConnected1D(TensorModule):
+    """Unshared temporal convolution (reference ``LocallyConnected1D``):
+    input (N, T, C) like TemporalConvolution, per-output-frame filters."""
+
+    def __init__(self, n_input_frame: int, input_frame_size: int,
+                 output_frame_size: int, kernel_w: int, stride_w: int = 1,
+                 with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.n_input_frame = n_input_frame
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w, self.stride_w = kernel_w, stride_w
+        self.with_bias = with_bias
+        self.n_output_frame = (n_input_frame - kernel_w) // stride_w + 1
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        k = self.kernel_w * self.input_frame_size
+        w = self.w_init.init((self.n_output_frame, self.output_frame_size, k),
+                             fan_in=k, fan_out=self.output_frame_size)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(self.b_init.init(
+                (self.n_output_frame, self.output_frame_size),
+                fan_in=k, fan_out=self.output_frame_size))
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]
+        idx = (jnp.arange(self.n_output_frame)[:, None] * self.stride_w
+               + jnp.arange(self.kernel_w)[None, :])          # (OT, kw)
+        patches = x[:, idx, :]                                # (N, OT, kw, C)
+        p = patches.reshape(x.shape[0], self.n_output_frame, -1)
+        out = jnp.einsum("npk,pok->npo", p, params["weight"])
+        if self.with_bias:
+            out = out + params["bias"][None]
+        if squeeze:
+            out = out[0]
+        return out, state

@@ -497,3 +497,201 @@ class MultiCriterion(AbstractCriterion):
 class L1Cost(AbstractCriterion):
     def apply(self, input, target):
         return jnp.sum(jnp.abs(input))
+
+
+class KLDCriterion(AbstractCriterion):
+    """Gaussian KL divergence to the unit prior given a Table (mean, log_var)
+    (reference ``KLDCriterion`` — the VAE regulariser; target is ignored):
+    ``0.5 * sum(mu^2 + exp(log_var) - 1 - log_var)``."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        mu, log_var = xs[0], xs[1]
+        kl = 0.5 * jnp.sum(jnp.square(mu) + jnp.exp(log_var) - 1.0 - log_var,
+                           axis=-1)
+        return jnp.mean(kl) if self.size_average else jnp.sum(kl)
+
+
+class GaussianCriterion(AbstractCriterion):
+    """Negative log-likelihood of ``target`` under N(mean, exp(log_var)) given a
+    Table (mean, log_var) (reference ``GaussianCriterion``)."""
+
+    def __init__(self, size_average: bool = False):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        mu, log_var = xs[0], xs[1]
+        nll = 0.5 * (jnp.log(2.0 * jnp.pi) + log_var
+                     + jnp.square(target - mu) / jnp.exp(log_var))
+        return _reduce(nll, self.size_average)
+
+
+class DiceCoefficientCriterion(AbstractCriterion):
+    """1 - Sørensen–Dice overlap (reference ``DiceCoefficientCriterion`` —
+    segmentation loss): per-sample ``1 - 2·Σxy / (Σx + Σy + ε)``, averaged."""
+
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        super().__init__()
+        self.size_average = size_average
+        self.epsilon = epsilon
+
+    def apply(self, input, target):
+        x = input.reshape(input.shape[0], -1)
+        y = target.reshape(target.shape[0], -1).astype(x.dtype)
+        inter = jnp.sum(x * y, axis=1)
+        denom = jnp.sum(x, axis=1) + jnp.sum(y, axis=1) + self.epsilon
+        loss = 1.0 - 2.0 * inter / denom
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class SoftmaxWithCriterion(AbstractCriterion):
+    """Fused softmax + multinomial logistic loss over logits, Caffe
+    ``SoftmaxWithLoss`` semantics (reference ``SoftmaxWithCriterion``):
+    optional ``ignore_label`` and normalize modes ``valid`` (default: divide by
+    non-ignored count), ``full`` (all), ``batch_size``, ``none``."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "valid", one_based: bool = False):
+        super().__init__()
+        if normalize_mode not in ("valid", "full", "batch_size", "none"):
+            raise ValueError(f"unknown normalize_mode {normalize_mode!r}")
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+        self.one_based = one_based
+
+    def apply(self, input, target):
+        logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=1) \
+            if input.ndim > 1 else jax.nn.log_softmax(input)
+        # channel dim = axis 1 (NC or NCHW); move classes last, flatten the rest
+        logp = jnp.moveaxis(logp, 1, -1).reshape(-1, input.shape[1])
+        idx = _class_index(jnp.reshape(target, (-1,)), self.one_based)
+        picked = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        if self.ignore_label is not None:
+            ignore = _class_index(jnp.asarray(self.ignore_label), self.one_based)
+            mask = (idx != ignore).astype(logp.dtype)
+            # ignore labels may be out of class range (Caffe's 255): clamp the
+            # gather index to 0 for masked rows so no NaN leaks through 0*NaN
+            idx = jnp.where(idx != ignore, idx, 0)
+            picked = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+            picked = picked * mask
+            valid = jnp.sum(mask)
+        else:
+            valid = jnp.asarray(picked.shape[0], picked.dtype)
+        total = jnp.sum(picked)
+        if self.normalize_mode == "valid":
+            return total / jnp.maximum(valid, 1.0)
+        if self.normalize_mode == "full":
+            return total / picked.shape[0]
+        if self.normalize_mode == "batch_size":
+            return total / input.shape[0]
+        return total
+
+
+class CategoricalCrossEntropy(AbstractCriterion):
+    """Keras-style categorical cross-entropy: probabilities vs one-hot targets
+    (reference ``CategoricalCrossEntropy``)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        p = jnp.clip(input, 1e-8, 1.0)
+        loss = -jnp.sum(target * jnp.log(p), axis=-1)
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class TimeDistributedMaskCriterion(AbstractCriterion):
+    """TimeDistributedCriterion that skips padded timesteps (reference
+    ``TimeDistributedMaskCriterion(criterion, paddingValue)``): timesteps whose
+    target equals ``padding_value`` contribute nothing, and the mean runs over
+    the non-padded count only. The inner criterion must be class-index based
+    (ClassNLL / CrossEntropy — the padded-label use case)."""
+
+    def __init__(self, criterion: AbstractCriterion, padding_value: int = 0):
+        super().__init__()
+        if isinstance(criterion, CrossEntropyCriterion):
+            self._logits = True
+        elif isinstance(criterion, ClassNLLCriterion):
+            self._logits = not criterion.logprob_as_input
+        else:
+            raise TypeError(
+                "TimeDistributedMaskCriterion supports class-index criterions "
+                f"(ClassNLL/CrossEntropy), got {type(criterion).__name__}")
+        inner = criterion.inner if isinstance(criterion, CrossEntropyCriterion) \
+            else criterion
+        self.one_based = inner.one_based
+        self.padding_value = padding_value
+
+    def apply(self, input, target):
+        logp = input.reshape(-1, input.shape[-1])
+        if self._logits:
+            logp = jax.nn.log_softmax(logp.astype(jnp.float32), axis=-1)
+        raw = jnp.reshape(target, (-1,))
+        mask = (raw != self.padding_value).astype(logp.dtype)
+        idx = _class_index(raw, self.one_based)
+        idx = jnp.where(mask > 0, idx, 0)  # padded rows pick class 0, masked out
+        picked = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        return jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class SmoothL1CriterionWithWeights(AbstractCriterion):
+    """Fast-RCNN bbox regression loss (reference
+    ``SmoothL1CriterionWithWeights(sigma, num)``): target is a Table
+    (t, inside_w, outside_w); ``sum(outside_w * smoothL1(inside_w*(x-t)))/num``
+    with the sigma-scaled Huber transition at ``1/sigma^2``."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        super().__init__()
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def apply(self, input, target):
+        if isinstance(target, Table):
+            t, iw, ow = target.values()
+        elif isinstance(target, (tuple, list)) and len(target) == 3:
+            t, iw, ow = target
+        else:
+            t, iw, ow = target, None, None
+        d = input - t
+        if iw is not None:
+            d = d * iw
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / self.sigma2,
+                         0.5 * self.sigma2 * jnp.square(d),
+                         ad - 0.5 / self.sigma2)
+        if ow is not None:
+            loss = loss * ow
+        total = jnp.sum(loss)
+        return total / self.num if self.num > 0 else total
+
+
+class TransformerCriterion(AbstractCriterion):
+    """Apply (frozen) transform modules to input and/or target before an inner
+    criterion (reference ``TransformerCriterion`` — perceptual-loss pattern).
+    The transforms' parameters are captured as constants: they do not train
+    through the loss, matching the upstream frozen-feature-extractor usage."""
+
+    def __init__(self, criterion: AbstractCriterion,
+                 input_transformer=None, target_transformer=None):
+        super().__init__()
+        self.criterion = criterion
+        self.input_transformer = input_transformer
+        self.target_transformer = target_transformer
+
+    def _run(self, module, x):
+        if module is None:
+            return x
+        out, _ = module.apply(module.get_params(), module.get_state(), x,
+                              training=False, rng=None)
+        return out
+
+    def apply(self, input, target):
+        return self.criterion.apply(self._run(self.input_transformer, input),
+                                    self._run(self.target_transformer, target))

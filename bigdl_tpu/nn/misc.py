@@ -501,3 +501,124 @@ class Highway(AbstractModule):
             h, _ = self.activation.apply({}, {}, h, training=training, rng=None)
         t = _jax.nn.sigmoid(t)
         return t * h + (1.0 - t) * input, state
+
+
+class UpSampling1D(TensorModule):
+    """Repeat each temporal step ``length`` times: (N, T, C) → (N, T*length, C)
+    (reference ``UpSampling1D``; keras temporal convention)."""
+
+    def __init__(self, length: int = 2):
+        super().__init__()
+        self.length = int(length)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = input.ndim - 2
+        return jnp.repeat(input, self.length, axis=axis), state
+
+
+class UpSampling2D(TensorModule):
+    """Nearest-neighbor upsample NCHW by (size_h, size_w) (reference
+    ``UpSampling2D``)."""
+
+    def __init__(self, size=(2, 2)):
+        super().__init__()
+        self.size = (int(size[0]), int(size[1]))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = jnp.repeat(input, self.size[0], axis=-2)
+        return jnp.repeat(out, self.size[1], axis=-1), state
+
+
+class UpSampling3D(TensorModule):
+    """Nearest-neighbor upsample NCDHW by (d, h, w) (reference
+    ``UpSampling3D``)."""
+
+    def __init__(self, size=(2, 2, 2)):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = jnp.repeat(input, self.size[0], axis=-3)
+        out = jnp.repeat(out, self.size[1], axis=-2)
+        return jnp.repeat(out, self.size[2], axis=-1), state
+
+
+def _bilinear_resize(x, oh, ow, align_corners):
+    """NCHW bilinear resize via two gathers + lerp (XLA fuses the weights)."""
+    n, c, h, w = x.shape
+
+    def grid(out_size, in_size):
+        if align_corners and out_size > 1:
+            return jnp.linspace(0.0, in_size - 1.0, out_size)
+        # half-pixel centers (torch align_corners=False / TF half_pixel)
+        scale = in_size / out_size
+        return jnp.clip((jnp.arange(out_size) + 0.5) * scale - 0.5,
+                        0.0, in_size - 1.0)
+
+    ys, xs_ = grid(oh, h), grid(ow, w)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs_).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs_ - x0)[None, None, None, :]
+    g = lambda yy, xx: x[:, :, yy, :][:, :, :, xx]
+    out = ((1 - wy) * (1 - wx) * g(y0, x0) + (1 - wy) * wx * g(y0, x1)
+           + wy * (1 - wx) * g(y1, x0) + wy * wx * g(y1, x1))
+    return out.astype(x.dtype)
+
+
+class ResizeBilinear(TensorModule):
+    """Bilinear resize to an arbitrary (output_height, output_width), NCHW
+    (reference ``ResizeBilinear(outputHeight, outputWidth, alignCorners)``)."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False):
+        super().__init__()
+        self.output_height = int(output_height)
+        self.output_width = int(output_width)
+        self.align_corners = align_corners
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        out = _bilinear_resize(x, self.output_height, self.output_width,
+                               self.align_corners)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class Cropping2D(TensorModule):
+    """Crop (top, bottom) rows and (left, right) cols off NCHW input
+    (reference ``Cropping2D``)."""
+
+    def __init__(self, height_crop=(0, 0), width_crop=(0, 0)):
+        super().__init__()
+        self.height_crop = (int(height_crop[0]), int(height_crop[1]))
+        self.width_crop = (int(width_crop[0]), int(width_crop[1]))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        (t, b), (l, r) = self.height_crop, self.width_crop
+        h, w = input.shape[-2], input.shape[-1]
+        return input[..., t:h - b or None, l:w - r or None], state
+
+
+class Cropping3D(TensorModule):
+    """Crop symmetric-pair extents off the three spatial dims of NCDHW input
+    (reference ``Cropping3D``)."""
+
+    def __init__(self, dim1_crop=(0, 0), dim2_crop=(0, 0), dim3_crop=(0, 0)):
+        super().__init__()
+        self.dim1_crop = tuple(int(v) for v in dim1_crop)
+        self.dim2_crop = tuple(int(v) for v in dim2_crop)
+        self.dim3_crop = tuple(int(v) for v in dim3_crop)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        (a0, a1), (b0, b1), (c0, c1) = \
+            self.dim1_crop, self.dim2_crop, self.dim3_crop
+        d, h, w = input.shape[-3], input.shape[-2], input.shape[-1]
+        return input[..., a0:d - a1 or None, b0:h - b1 or None,
+                     c0:w - c1 or None], state

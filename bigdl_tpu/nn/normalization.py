@@ -347,3 +347,180 @@ class Add(TensorModule):
 
     def apply(self, params, state, input, *, training=False, rng=None):
         return input + params["bias"], state
+
+
+class SpatialWithinChannelLRN(TensorModule):
+    """Within-channel local response normalisation (reference
+    ``SpatialWithinChannelLRN``; Caffe WITHIN_CHANNEL mode):
+    ``out = x / (1 + alpha/size^2 * sum_{size x size window} x^2) ** beta``
+    per channel, SAME spatial padding. One ``reduce_window`` — XLA fuses it."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75):
+        super().__init__()
+        if size % 2 == 0:
+            raise ValueError("LRN window size must be odd")
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        sq = jnp.square(x)
+        s = self.size
+        window = (1, 1, s, s)
+        sums = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, window, (1, 1, 1, 1), "SAME")
+        denom = (1.0 + (self.alpha / (s * s)) * sums) ** self.beta
+        out = x / denom
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+def _check_odd_kernel(kernel, who: str) -> None:
+    kh, kw = kernel.shape
+    if kh % 2 == 0 or kw % 2 == 0:
+        raise ValueError(
+            f"{who}: kernel must have odd dimensions for SAME-centered "
+            f"neighborhoods, got {kh}x{kw}")
+
+
+def _neighborhood_mean(x, kernel, channels):
+    """Border-corrected weighted neighborhood mean over ALL channels of NCHW
+    ``x``: conv with the (normalised) kernel summed across channels, divided by
+    the conv of ones (edge correction), giving a (N, 1, H, W) mean map."""
+    kh, kw = kernel.shape
+    k = (kernel / (kernel.sum() * channels)).astype(x.dtype)
+    w = jnp.broadcast_to(k[None, None], (1, channels, kh, kw))
+    pad = [(kh // 2, kh // 2), (kw // 2, kw // 2)]
+    mean = jax.lax.conv_general_dilated(
+        x, w, (1, 1), pad, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ones = jnp.ones_like(x)
+    coef = jax.lax.conv_general_dilated(
+        ones, w, (1, 1), pad, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return mean / coef
+
+
+class SpatialSubtractiveNormalization(TensorModule):
+    """Subtract the weighted neighborhood mean (reference
+    ``SpatialSubtractiveNormalization(nInputPlane, kernel)``; lua-torch
+    semantics with border coefficient correction). Default kernel: 9x9 ones."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        import numpy as _np
+        self.kernel = _np.asarray(
+            kernel if kernel is not None else _np.ones((9, 9)), _np.float32)
+        if self.kernel.ndim == 1:  # separable 1-D kernel → outer product
+            self.kernel = _np.outer(self.kernel, self.kernel).astype(_np.float32)
+        _check_odd_kernel(self.kernel, "SpatialSubtractiveNormalization")
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        mean = _neighborhood_mean(x, jnp.asarray(self.kernel), self.n_input_plane)
+        out = x - mean  # (N,1,H,W) broadcasts over channels
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class SpatialDivisiveNormalization(TensorModule):
+    """Divide by the local std-dev estimate (reference
+    ``SpatialDivisiveNormalization``). With ``threshold`` given, lua-torch
+    Threshold semantics: stds <= threshold are replaced by ``thresval``
+    (default = threshold). Without it, the divisor is floored by its
+    per-sample mean — a robust default for zero-variance regions."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float | None = None, thresval: float | None = None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        import numpy as _np
+        self.kernel = _np.asarray(
+            kernel if kernel is not None else _np.ones((9, 9)), _np.float32)
+        if self.kernel.ndim == 1:
+            self.kernel = _np.outer(self.kernel, self.kernel).astype(_np.float32)
+        _check_odd_kernel(self.kernel, "SpatialDivisiveNormalization")
+        self.threshold = threshold
+        self.thresval = thresval if thresval is not None else threshold
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        var = _neighborhood_mean(jnp.square(x), jnp.asarray(self.kernel),
+                                 self.n_input_plane)
+        localstd = jnp.sqrt(jnp.maximum(var, 0.0))            # (N,1,H,W)
+        if self.threshold is not None:
+            divisor = jnp.where(localstd > self.threshold, localstd,
+                                self.thresval)
+        else:
+            floor = jnp.mean(localstd, axis=(1, 2, 3), keepdims=True)
+            divisor = jnp.maximum(localstd, floor)
+        out = x / divisor
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class SpatialContrastiveNormalization(TensorModule):
+    """Subtractive then divisive normalisation (reference
+    ``SpatialContrastiveNormalization``)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float | None = None, thresval: float | None = None):
+        super().__init__()
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel,
+                                                threshold, thresval)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out, _ = self.sub.apply({}, {}, input, training=training, rng=None)
+        out, _ = self.div.apply({}, {}, out, training=training, rng=None)
+        return out, state
+
+
+class SpatialDropout1D(TensorModule):
+    """Drop whole feature channels of (N, T, C) input (reference
+    ``SpatialDropout1D``; keras temporal convention)."""
+
+    def __init__(self, init_p: float = 0.5):
+        super().__init__()
+        self.p = init_p
+
+    def needs_rng(self) -> bool:
+        return True
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return input, state
+        keep = 1.0 - self.p
+        shape = (input.shape[0], 1, input.shape[-1]) if input.ndim == 3 \
+            else (1, input.shape[-1])
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, input / keep, 0.0), state
+
+
+class SpatialDropout3D(TensorModule):
+    """Drop whole channels of NCDHW input (reference ``SpatialDropout3D``)."""
+
+    def __init__(self, init_p: float = 0.5):
+        super().__init__()
+        self.p = init_p
+
+    def needs_rng(self) -> bool:
+        return True
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return input, state
+        keep = 1.0 - self.p
+        mask_shape = input.shape[:2] + (1,) * (input.ndim - 2)
+        mask = jax.random.bernoulli(rng, keep, mask_shape)
+        return jnp.where(mask, input / keep, 0.0), state

@@ -44,6 +44,12 @@ class Cell(TensorModule):
     def init_hidden(self, batch_size: int):
         raise NotImplementedError
 
+    def init_hidden_from(self, x0):
+        """Zero hidden state shaped for step-0 input ``x0`` (cells whose state
+        shape depends on the input, e.g. ConvLSTM feature maps, override this;
+        the default delegates to ``init_hidden(batch)``)."""
+        return self.init_hidden(x0.shape[0])
+
     def cell_apply(self, params, x, hidden, *, training=False, rng=None):
         raise NotImplementedError
 
@@ -207,7 +213,6 @@ def _scan_cell(cell: "Cell", cparams, x, *, training: bool, rng):
     Returns the (N, T, H) output sequence. Per-step rng is derived by ``fold_in`` on the
     step index so the scan body stays pure.
     """
-    batch = x.shape[0]
     xs = jnp.swapaxes(x, 0, 1)  # (T, N, F)
     steps = jnp.arange(xs.shape[0])
 
@@ -217,7 +222,7 @@ def _scan_cell(cell: "Cell", cparams, x, *, training: bool, rng):
         out, new_h = cell.cell_apply(cparams, x_t, h, training=training, rng=r)
         return new_h, out
 
-    _, outs = jax.lax.scan(step, cell.init_hidden(batch), (xs, steps))
+    _, outs = jax.lax.scan(step, cell.init_hidden_from(x[:, 0]), (xs, steps))
     return jnp.swapaxes(outs, 0, 1)
 
 
@@ -328,3 +333,118 @@ class Masking(TensorModule):
     def apply(self, params, state, input, *, training=False, rng=None):
         keep = jnp.any(input != self.mask_value, axis=-1, keepdims=True)
         return jnp.where(keep, input, 0.0), state
+
+
+class RecurrentDecoder(Recurrent):
+    """Decoder recurrence (reference ``RecurrentDecoder(outputLength)``): the
+    cell's output at step t is fed back as its input at step t+1; the single
+    (N, F) input seeds step 0. Output: (N, outputLength, F). The feedback loop
+    is one ``lax.scan`` whose carry holds (hidden, last_output) — same O(1)
+    compile cost as Recurrent. The cell's input and hidden sizes must match."""
+
+    def __init__(self, output_length: int, cell: Optional[Cell] = None):
+        super().__init__(cell)
+        if output_length < 1:
+            raise ValueError("output_length must be >= 1")
+        self.output_length = output_length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        cell, cparams = self.cell, params["0"]
+        x0 = input[:, 0] if input.ndim == 3 else input  # accept (N,1,F) too
+        steps = jnp.arange(self.output_length)
+
+        def step(carry, i):
+            hidden, x = carry
+            r = jax.random.fold_in(rng, i) if rng is not None else None
+            out, new_hidden = cell.cell_apply(cparams, x, hidden,
+                                              training=training, rng=r)
+            return (new_hidden, out), out
+
+        hidden0 = cell.init_hidden_from(x0)
+        _, outs = jax.lax.scan(step, (hidden0, x0), steps)
+        return jnp.swapaxes(outs, 0, 1), state
+
+    def __repr__(self):
+        inner = repr(self.cell) if self.modules else ""
+        return f"RecurrentDecoder({self.output_length}, {inner})"
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM cell with peephole connections (reference
+    ``ConvLSTMPeephole(inputSize, outputSize, kernelI, kernelC, stride)``):
+    hidden state and cell state are NCHW feature maps; the four gates come from
+    two SAME-padded convolutions (input→4C and hidden→4C) — two conv GEMMs per
+    step on the MXU, peepholes as per-channel elementwise products."""
+
+    def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
+                 kernel_c: int = 3, stride: int = 1,
+                 w_init: Optional[InitializationMethod] = None,
+                 with_peephole: bool = True):
+        super().__init__()
+        if stride != 1:
+            raise ValueError(
+                "ConvLSTMPeephole feedback requires stride 1 (hidden and input "
+                "maps must stay the same spatial size)")
+        self.input_size, self.hidden_size = input_size, output_size
+        self.output_size = output_size
+        self.kernel_i, self.kernel_c = kernel_i, kernel_c
+        self.with_peephole = with_peephole
+        self.w_init = w_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        ci, co = self.input_size, self.output_size
+        ki, kc = self.kernel_i, self.kernel_c
+        init = self.w_init
+        fan_i, fan_c = ci * ki * ki, co * kc * kc
+        self._params = {
+            "w_ih": jnp.asarray(init.init((4 * co, ci, ki, ki),
+                                          fan_in=fan_i, fan_out=4 * co)),
+            "w_hh": jnp.asarray(init.init((4 * co, co, kc, kc),
+                                          fan_in=fan_c, fan_out=4 * co)),
+            "bias": jnp.zeros((4 * co,), jnp.float32),
+        }
+        if self.with_peephole:
+            for k in ("w_ci", "w_cf", "w_co"):
+                self._params[k] = jnp.asarray(
+                    init.init((co,), fan_in=co, fan_out=co))
+        self.zero_grad_parameters()
+
+    def init_hidden(self, batch_size: int):
+        raise TypeError("ConvLSTMPeephole hidden shape depends on the input "
+                        "feature map; Recurrent derives it via init_hidden_from")
+
+    def init_hidden_from(self, x0):
+        n, _, h, w = x0.shape
+        z = jnp.zeros((n, self.output_size, h, w), x0.dtype)
+        return (z, z)
+
+    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+        h, c = hidden
+        gates = (
+            jax.lax.conv_general_dilated(
+                x, params["w_ih"], (1, 1), "SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            + jax.lax.conv_general_dilated(
+                h, params["w_hh"], (1, 1), "SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            + params["bias"][None, :, None, None])
+        i_g, f_g, g_g, o_g = jnp.split(gates, 4, axis=1)
+        if self.with_peephole:
+            peep = lambda k: params[k][None, :, None, None]
+            i_g = jax.nn.sigmoid(i_g + c * peep("w_ci"))
+            f_g = jax.nn.sigmoid(f_g + c * peep("w_cf"))
+        else:
+            i_g, f_g = jax.nn.sigmoid(i_g), jax.nn.sigmoid(f_g)
+        g_g = jnp.tanh(g_g)
+        new_c = f_g * c + i_g * g_g
+        if self.with_peephole:
+            o_g = jax.nn.sigmoid(o_g + new_c * params["w_co"][None, :, None, None])
+        else:
+            o_g = jax.nn.sigmoid(o_g)
+        new_h = o_g * jnp.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+    def __repr__(self):
+        return (f"ConvLSTMPeephole({self.input_size}, {self.output_size}, "
+                f"{self.kernel_i}, {self.kernel_c})")

@@ -179,6 +179,68 @@ EXAMPLES = {
     "BinaryTreeLSTM": (
         lambda: nn.BinaryTreeLSTM(4, 3),
         T(_x(1, 3, 4), jnp.asarray([[[1, 2], [-1, -1], [-1, -1]]], jnp.int32))),
+    # round-3 second sweep: elementwise / grad-trick / table / shape layers
+    "BinaryThreshold": (lambda: nn.BinaryThreshold(0.1), _x(2, 3)),
+    "LogSigmoid": (lambda: nn.LogSigmoid(), _x(2, 3)),
+    "TanhShrink": (lambda: nn.TanhShrink(), _x(2, 3)),
+    "GradientReversal": (lambda: nn.GradientReversal(0.7), _x(2, 3)),
+    "L1Penalty": (lambda: nn.L1Penalty(0.01), _x(2, 3)),
+    "Scale": (lambda: nn.Scale((3,)), _x(2, 3)),
+    "PairwiseDistance": (lambda: nn.PairwiseDistance(2),
+                         T(_x(2, 4), _x(2, 4, seed=1))),
+    "GaussianSampler": (lambda: nn.GaussianSampler(),
+                        T(_x(2, 4), _x(2, 4, seed=1))),
+    "Highway": (lambda: nn.Highway(4), _x(2, 4)),
+    "NarrowTable": (lambda: nn.NarrowTable(1, 2),
+                    T(_x(2, 3), _x(2, 3, seed=1), _x(2, 3, seed=2))),
+    "Pack": (lambda: nn.Pack(1), T(_x(2, 3), _x(2, 3, seed=1))),
+    "CAveTable": (lambda: nn.CAveTable(), T(_x(2, 3), _x(2, 3, seed=1))),
+    "BifurcateSplitTable": (lambda: nn.BifurcateSplitTable(2), _x(2, 6)),
+    "MixtureTable": (lambda: nn.MixtureTable(),
+                     T(jnp.abs(_x(2, 2)) + 0.1,
+                       T(_x(2, 4), _x(2, 4, seed=1)))),
+    "MaskedSelect": (lambda: nn.MaskedSelect(),
+                     T(_x(2, 3), jnp.asarray(np.asarray(_x(2, 3)) > 0,
+                                             jnp.float32))),
+    "Tile": (lambda: nn.Tile(2, 3), _x(2, 3)),
+    "Reverse": (lambda: nn.Reverse(2), _x(2, 3)),
+    "Index": (lambda: nn.Index(1),
+              T(_x(4, 3), jnp.asarray([2, 0], jnp.int32))),
+    "InferReshape": (lambda: nn.InferReshape([6, -1]), _x(2, 3, 4)),
+    # round-3 third sweep: conv variants / spatial norms / resize / crop
+    "SpatialShareConvolution": (
+        lambda: nn.SpatialShareConvolution(2, 3, 3, 3, pad_w=1, pad_h=1),
+        _x(1, 2, 5, 5)),
+    "LocallyConnected1D": (lambda: nn.LocallyConnected1D(6, 3, 4, 3),
+                           _x(2, 6, 3)),
+    "LocallyConnected2D": (
+        lambda: nn.LocallyConnected2D(2, 5, 5, 3, 3, 3), _x(2, 2, 5, 5)),
+    "VolumetricFullConvolution": (
+        lambda: nn.VolumetricFullConvolution(2, 3, 2, 2, 2, dt=2, dw=2, dh=2),
+        _x(1, 2, 3, 3, 3)),
+    "SpatialWithinChannelLRN": (lambda: nn.SpatialWithinChannelLRN(3),
+                                _x(1, 2, 5, 5)),
+    "SpatialSubtractiveNormalization": (
+        lambda: nn.SpatialSubtractiveNormalization(2), _x(1, 2, 9, 9)),
+    "SpatialDivisiveNormalization": (
+        lambda: nn.SpatialDivisiveNormalization(2), _x(1, 2, 9, 9)),
+    "SpatialContrastiveNormalization": (
+        lambda: nn.SpatialContrastiveNormalization(2), _x(1, 2, 9, 9)),
+    "SpatialDropout1D": (lambda: nn.SpatialDropout1D(0.3), _x(2, 4, 3)),
+    "SpatialDropout3D": (lambda: nn.SpatialDropout3D(0.3), _x(1, 2, 3, 3, 3)),
+    "UpSampling1D": (lambda: nn.UpSampling1D(2), _x(2, 3, 4)),
+    "UpSampling2D": (lambda: nn.UpSampling2D((2, 2)), _x(1, 2, 3, 3)),
+    "UpSampling3D": (lambda: nn.UpSampling3D((2, 2, 2)), _x(1, 2, 2, 2, 2)),
+    "ResizeBilinear": (lambda: nn.ResizeBilinear(5, 7), _x(1, 2, 3, 4)),
+    "Cropping2D": (lambda: nn.Cropping2D((1, 1), (1, 1)), _x(1, 2, 5, 5)),
+    "Cropping3D": (lambda: nn.Cropping3D((1, 0), (0, 1), (1, 1)),
+                   _x(1, 2, 4, 4, 4)),
+    # round-3 recurrent sweep
+    "RecurrentDecoder": (lambda: nn.RecurrentDecoder(3, nn.RnnCell(4, 4)),
+                         _x(2, 4)),
+    "ConvLSTMPeephole": (
+        lambda: nn.Recurrent(nn.ConvLSTMPeephole(2, 3, 3, 3)),
+        _x(1, 2, 2, 4, 4)),
     # graph (custom topology serialization)
     "Graph": ("graph", None),
     "StaticGraph": ("graph", None),

@@ -1,0 +1,202 @@
+"""Round-3 recurrent/criterion sweep: RecurrentDecoder, ConvLSTMPeephole, and
+the VAE / segmentation / Caffe-style / masked criterions (SURVEY.md §2.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.random_generator import RandomGenerator
+from bigdl_tpu.utils.table import T
+
+
+def _np(*shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestRecurrentDecoder:
+    def test_feedback_unroll_matches_manual(self):
+        RandomGenerator.set_seed(0)
+        cell = nn.RnnCell(4, 4)  # feedback needs input size == hidden size
+        dec = nn.RecurrentDecoder(3, cell).evaluate()
+        x0 = _np(2, 4)
+        out = np.asarray(dec.forward(jnp.asarray(x0)))
+        assert out.shape == (2, 3, 4)
+        # manual unroll with the same params
+        p = cell.get_params()
+        h = np.zeros((2, 4), np.float32)
+        x = x0
+        for t in range(3):
+            h = np.tanh(x @ np.asarray(p["w_ih"]).T + np.asarray(p["b_ih"])
+                        + h @ np.asarray(p["w_hh"]).T + np.asarray(p["b_hh"]))
+            np.testing.assert_allclose(out[:, t], h, rtol=1e-4, atol=1e-5)
+            x = h
+
+    def test_accepts_seeded_sequence_input(self):
+        RandomGenerator.set_seed(0)
+        dec = nn.RecurrentDecoder(2, nn.LSTM(3, 3)).evaluate()
+        out = dec.forward(jnp.asarray(_np(2, 1, 3)))  # (N, 1, F) seed
+        assert out.shape == (2, 2, 3)
+
+
+class TestConvLSTM:
+    def test_shapes_and_state(self):
+        RandomGenerator.set_seed(0)
+        cell = nn.ConvLSTMPeephole(2, 4, 3, 3)
+        rec = nn.Recurrent(cell).evaluate()
+        x = _np(2, 5, 2, 6, 6)  # (N, T, C, H, W)
+        out = np.asarray(rec.forward(jnp.asarray(x)))
+        assert out.shape == (2, 5, 4, 6, 6)
+        assert np.isfinite(out).all()
+
+    def test_gradients_flow(self):
+        RandomGenerator.set_seed(0)
+        cell = nn.ConvLSTMPeephole(2, 3, 3, 3)
+        rec = nn.Recurrent(cell)
+
+        def loss(p):
+            out, _ = rec.apply(p, {}, jnp.asarray(_np(1, 3, 2, 4, 4)),
+                               training=True)
+            return jnp.sum(jnp.square(out))
+
+        g = jax.grad(loss)(rec.get_params())
+        leaves = jax.tree_util.tree_leaves(g)
+        assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+        assert any(float(jnp.abs(l).max()) > 0 for l in leaves)
+
+    def test_stride_rejected(self):
+        with pytest.raises(ValueError, match="stride 1"):
+            nn.ConvLSTMPeephole(2, 3, 3, 3, stride=2)
+
+
+class TestVAECriterions:
+    def test_kld_closed_form(self):
+        mu = _np(4, 6)
+        lv = _np(4, 6, seed=1)
+        out = float(nn.KLDCriterion().forward(
+            T(jnp.asarray(mu), jnp.asarray(lv)), jnp.zeros(())))
+        ref = 0.5 * np.sum(mu ** 2 + np.exp(lv) - 1.0 - lv, axis=-1).mean()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_gaussian_nll_oracle(self):
+        mu, lv, x = _np(4, 6), _np(4, 6, seed=1), _np(4, 6, seed=2)
+        out = float(nn.GaussianCriterion().forward(
+            T(jnp.asarray(mu), jnp.asarray(lv)), jnp.asarray(x)))
+        ref = F.gaussian_nll_loss(
+            torch.tensor(mu), torch.tensor(x), torch.tensor(np.exp(lv)),
+            full=True, reduction="sum", eps=0.0).item()
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_vae_trains(self):
+        """GaussianSampler + KLD + reconstruction — the full VAE slice."""
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        RandomGenerator.set_seed(0)
+        inp = nn.Input()
+        enc = nn.Linear(8, 16).inputs(inp)
+        encr = nn.ReLU().inputs(enc)
+        mu = nn.Linear(16, 4).inputs(encr)
+        lv = nn.Linear(16, 4).inputs(encr)
+        z = nn.GaussianSampler().inputs(mu, lv)
+        dec = nn.Linear(4, 8).inputs(z)
+        model = nn.Graph(inp, dec)
+        x = _np(64, 8)
+        ds = DataSet.array([MiniBatch(x[i:i + 16], x[i:i + 16])
+                            for i in range(0, 64, 16)])
+        opt = LocalOptimizer(model, ds, nn.MSECriterion())
+        opt.set_optim_method(SGD(learningrate=0.05))
+        opt.set_end_when(Trigger.max_iteration(8))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+
+
+class TestCaffeStyleCriterions:
+    def test_softmax_with_criterion_matches_cross_entropy(self):
+        logits = _np(6, 5)
+        y = np.random.default_rng(0).integers(0, 5, size=(6,)).astype(np.int32)
+        out = float(nn.SoftmaxWithCriterion().forward(
+            jnp.asarray(logits), jnp.asarray(y)))
+        ref = F.cross_entropy(torch.tensor(logits),
+                              torch.tensor(y.astype(np.int64))).item()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_softmax_with_ignore_label(self):
+        logits = _np(6, 5)
+        y = np.array([0, 1, 2, 3, 4, 2], np.int32)
+        out = float(nn.SoftmaxWithCriterion(ignore_label=2).forward(
+            jnp.asarray(logits), jnp.asarray(y)))
+        keep = y != 2
+        ref = F.cross_entropy(torch.tensor(logits[keep]),
+                              torch.tensor(y[keep].astype(np.int64))).item()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_softmax_with_spatial_input(self):
+        logits = _np(2, 4, 3, 3)
+        y = np.random.default_rng(1).integers(0, 4, size=(2, 3, 3)).astype(np.int32)
+        out = float(nn.SoftmaxWithCriterion().forward(
+            jnp.asarray(logits), jnp.asarray(y)))
+        ref = F.cross_entropy(torch.tensor(logits),
+                              torch.tensor(y.astype(np.int64))).item()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_dice(self):
+        x = np.abs(_np(3, 10))
+        y = (np.abs(_np(3, 10, seed=1)) > 0.5).astype(np.float32)
+        out = float(nn.DiceCoefficientCriterion(epsilon=1.0).forward(
+            jnp.asarray(x), jnp.asarray(y)))
+        inter = (x * y).sum(1)
+        ref = (1 - 2 * inter / (x.sum(1) + y.sum(1) + 1.0)).mean()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_categorical_cross_entropy(self):
+        p = np.abs(_np(4, 3)) + 0.1
+        p = p / p.sum(1, keepdims=True)
+        y = np.eye(3, dtype=np.float32)[[0, 2, 1, 0]]
+        out = float(nn.CategoricalCrossEntropy().forward(
+            jnp.asarray(p), jnp.asarray(y)))
+        ref = -np.mean(np.log(p[np.arange(4), [0, 2, 1, 0]]))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+class TestMaskedAndWeighted:
+    def test_time_distributed_mask(self):
+        logits = _np(2, 4, 5)
+        y = np.array([[1, 2, 0, 0], [3, 4, 1, 0]], np.int32)  # 0 = padding
+        out = float(nn.TimeDistributedMaskCriterion(
+            nn.CrossEntropyCriterion(), padding_value=0).forward(
+            jnp.asarray(logits), jnp.asarray(y)))
+        keep = y.reshape(-1) != 0
+        ref = F.cross_entropy(
+            torch.tensor(logits.reshape(-1, 5)[keep]),
+            torch.tensor(y.reshape(-1)[keep].astype(np.int64))).item()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_smooth_l1_with_weights(self):
+        x, t = _np(3, 4), _np(3, 4, seed=1)
+        iw = np.abs(_np(3, 4, seed=2))
+        ow = np.abs(_np(3, 4, seed=3))
+        sigma, num = 2.0, 3
+        out = float(nn.SmoothL1CriterionWithWeights(sigma, num).forward(
+            jnp.asarray(x), T(jnp.asarray(t), jnp.asarray(iw), jnp.asarray(ow))))
+        d = iw * (x - t)
+        s2 = sigma * sigma
+        l = np.where(np.abs(d) < 1 / s2, 0.5 * s2 * d * d,
+                     np.abs(d) - 0.5 / s2)
+        np.testing.assert_allclose(out, (ow * l).sum() / num, rtol=1e-5)
+
+    def test_transformer_criterion(self):
+        RandomGenerator.set_seed(0)
+        feat = nn.Sequential().add(nn.Linear(6, 4)).add(nn.ReLU())
+        crit = nn.TransformerCriterion(nn.MSECriterion(), feat, feat)
+        x, t = _np(3, 6), _np(3, 6, seed=1)
+        out = float(crit.forward(jnp.asarray(x), jnp.asarray(t)))
+        fx = np.asarray(feat.evaluate().forward(jnp.asarray(x)))
+        ft = np.asarray(feat.evaluate().forward(jnp.asarray(t)))
+        np.testing.assert_allclose(out, np.mean((fx - ft) ** 2), rtol=1e-5)

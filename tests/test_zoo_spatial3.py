@@ -1,0 +1,253 @@
+"""Round-3 third layer sweep: conv variants, 3-D deconv, spatial norms,
+upsampling/resize/crop (SURVEY.md §2.1). Torch oracles where torch has the op."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _np(*shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestConvVariants:
+    def test_share_convolution_matches_spatial(self):
+        RandomGenerator.set_seed(0)
+        a = nn.SpatialConvolution(2, 4, 3, 3, pad_w=1, pad_h=1)
+        b = nn.SpatialShareConvolution(2, 4, 3, 3, pad_w=1, pad_h=1)
+        b.set_params(a.get_params())
+        x = jnp.asarray(_np(2, 2, 6, 6))
+        np.testing.assert_allclose(np.asarray(a.evaluate().forward(x)),
+                                   np.asarray(b.evaluate().forward(x)),
+                                   rtol=1e-6)
+
+    def test_locally_connected_2d_oracle(self):
+        """Validate the patch-einsum against an explicit unfold computation."""
+        RandomGenerator.set_seed(0)
+        m = nn.LocallyConnected2D(2, 6, 5, 3, 2, 2, stride_w=2, stride_h=1,
+                                  pad_w=1, pad_h=0)
+        x = _np(2, 2, 5, 6)  # NCHW: H=5 (input_height), W=6 (input_width)
+        out = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+        w = np.asarray(m.get_params()["weight"])   # (P, O, C*kh*kw)
+        b = np.asarray(m.get_params()["bias"])     # (P, O)
+        # torch unfold gives (N, C*kh*kw, P) with (c, kh, kw) feature order
+        patches = F.unfold(torch.tensor(x), kernel_size=(2, 2),
+                           stride=(1, 2), padding=(0, 1)).numpy()
+        ref = np.einsum("nkp,pok->npo", patches, w) + b[None]
+        ref = ref.transpose(0, 2, 1).reshape(out.shape)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+        assert out.shape == (2, 3, m.out_h, m.out_w)
+
+    def test_locally_connected_1d(self):
+        RandomGenerator.set_seed(0)
+        m = nn.LocallyConnected1D(7, 3, 4, kernel_w=3, stride_w=2)
+        x = _np(2, 7, 3)
+        out = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+        w = np.asarray(m.get_params()["weight"])
+        b = np.asarray(m.get_params()["bias"])
+        n_out = (7 - 3) // 2 + 1
+        ref = np.zeros((2, n_out, 4), np.float32)
+        for p in range(n_out):
+            patch = x[:, p * 2:p * 2 + 3, :].reshape(2, -1)
+            ref[:, p, :] = patch @ w[p].T + b[p]
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestVolumetricFull:
+    def test_conv_transpose3d_oracle(self):
+        RandomGenerator.set_seed(0)
+        m = nn.VolumetricFullConvolution(2, 3, 2, 3, 3, dt=2, dw=1, dh=2,
+                                         pad_t=1, pad_w=1, pad_h=0)
+        x = _np(1, 2, 4, 5, 6)
+        out = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+        w = np.asarray(m.get_params()["weight"])   # (I, O, kt, kh, kw)
+        b = np.asarray(m.get_params()["bias"])
+        ref = F.conv_transpose3d(
+            torch.tensor(x), torch.tensor(w), torch.tensor(b),
+            stride=(2, 2, 1), padding=(1, 0, 1)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+
+class TestSpatialNorms:
+    def test_within_channel_lrn_constant(self):
+        # constant input: denom = (1 + alpha*c^2)^beta everywhere (SAME border
+        # effects only change the SUM, which the interior window saturates)
+        x = np.full((1, 2, 9, 9), 2.0, np.float32)
+        out = np.asarray(nn.SpatialWithinChannelLRN(3, alpha=1.0, beta=0.5)
+                         .evaluate().forward(jnp.asarray(x)))
+        interior = out[0, 0, 4, 4]
+        np.testing.assert_allclose(interior, 2.0 / np.sqrt(1 + 4.0), rtol=1e-5)
+
+    def test_subtractive_norm_zeroes_constant(self):
+        x = np.full((1, 3, 8, 8), 5.0, np.float32)
+        out = np.asarray(nn.SpatialSubtractiveNormalization(3, np.ones((5, 5)))
+                         .evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, np.zeros_like(x), atol=1e-5)
+
+    def test_divisive_norm_scale_invariant_direction(self):
+        x = _np(1, 2, 8, 8)
+        m = nn.SpatialDivisiveNormalization(2, np.ones((5, 5)))
+        out1 = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+        out2 = np.asarray(m.evaluate().forward(jnp.asarray(10.0 * x)))
+        # dividing by the local std makes the output scale-invariant
+        np.testing.assert_allclose(out1, out2, rtol=1e-4, atol=1e-5)
+
+    def test_contrastive_composes(self):
+        x = _np(1, 2, 8, 8)
+        sub = nn.SpatialSubtractiveNormalization(2).evaluate()
+        div = nn.SpatialDivisiveNormalization(2).evaluate()
+        both = nn.SpatialContrastiveNormalization(2).evaluate()
+        ref = np.asarray(div.forward(sub.forward(jnp.asarray(x))))
+        np.testing.assert_allclose(np.asarray(both.forward(jnp.asarray(x))),
+                                   ref, rtol=1e-5, atol=1e-6)
+
+    def test_spatial_dropout_1d_3d(self):
+        RandomGenerator.set_seed(0)
+        x = np.ones((4, 6, 8), np.float32)
+        out = np.asarray(nn.SpatialDropout1D(0.5).training()
+                         .forward(jnp.asarray(x)))
+        # whole channels dropped: each (n, :, c) column is all-0 or all-2
+        col = out.reshape(4, 6, 8)
+        assert ((col == 0).all(1) | (col == 2).all(1)).all()
+        x3 = np.ones((2, 4, 3, 3, 3), np.float32)
+        out3 = np.asarray(nn.SpatialDropout3D(0.5).training()
+                          .forward(jnp.asarray(x3)))
+        flat = out3.reshape(2, 4, -1)
+        assert ((flat == 0).all(-1) | (flat == 2).all(-1)).all()
+
+
+class TestResizeCrop:
+    def test_upsampling_1d_2d_3d(self):
+        x = _np(2, 3, 4)
+        out = np.asarray(nn.UpSampling1D(2).evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, np.repeat(x, 2, axis=1))
+        x2 = _np(2, 3, 4, 5)
+        out2 = np.asarray(nn.UpSampling2D((2, 3)).evaluate()
+                          .forward(jnp.asarray(x2)))
+        np.testing.assert_allclose(
+            out2, np.repeat(np.repeat(x2, 2, axis=2), 3, axis=3))
+        x3 = _np(1, 2, 3, 3, 3)
+        out3 = np.asarray(nn.UpSampling3D((2, 2, 2)).evaluate()
+                          .forward(jnp.asarray(x3)))
+        assert out3.shape == (1, 2, 6, 6, 6)
+
+    @pytest.mark.parametrize("align", [False, True])
+    def test_resize_bilinear_oracle(self, align):
+        x = _np(2, 3, 5, 7)
+        out = np.asarray(nn.ResizeBilinear(8, 11, align_corners=align)
+                         .evaluate().forward(jnp.asarray(x)))
+        ref = F.interpolate(torch.tensor(x), size=(8, 11), mode="bilinear",
+                            align_corners=align).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_cropping(self):
+        x = _np(2, 3, 6, 8)
+        out = np.asarray(nn.Cropping2D((1, 2), (3, 0)).evaluate()
+                         .forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, x[:, :, 1:4, 3:])
+        x3 = _np(1, 2, 4, 5, 6)
+        out3 = np.asarray(nn.Cropping3D((1, 1), (0, 2), (2, 1)).evaluate()
+                          .forward(jnp.asarray(x3)))
+        np.testing.assert_allclose(out3, x3[:, :, 1:3, 0:3, 2:5])
+
+
+class TestFullConvFlipFix:
+    def test_conv_transpose2d_oracle(self):
+        """SpatialFullConvolution must match torch deconv (kernel-flip fix)."""
+        RandomGenerator.set_seed(0)
+        m = nn.SpatialFullConvolution(2, 3, 3, 3, dw=2, dh=2, pad_w=1, pad_h=1)
+        x = _np(1, 2, 5, 5)
+        out = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+        w = np.asarray(m.get_params()["weight"])
+        b = np.asarray(m.get_params()["bias"])
+        ref = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                 torch.tensor(b), stride=2, padding=1).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+
+class TestReviewFixesSpatial:
+    def test_softmax_with_out_of_range_ignore_label(self):
+        logits = _np(4, 3)
+        y = np.array([0, 1, 255, 2], np.int32)  # Caffe-style ignore=255
+        out = float(nn.SoftmaxWithCriterion(ignore_label=255).forward(
+            jnp.asarray(logits), jnp.asarray(y)))
+        assert np.isfinite(out)
+        keep = y != 255
+        ref = F.cross_entropy(torch.tensor(logits[keep]),
+                              torch.tensor(y[keep].astype(np.int64))).item()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_grouped_deconv2d_oracle(self):
+        RandomGenerator.set_seed(0)
+        m = nn.SpatialFullConvolution(4, 6, 3, 3, dw=2, dh=2, pad_w=1, pad_h=1,
+                                      n_group=2)
+        x = _np(1, 4, 5, 5)
+        out = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+        w = np.asarray(m.get_params()["weight"])
+        b = np.asarray(m.get_params()["bias"])
+        ref = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                 torch.tensor(b), stride=2, padding=1,
+                                 groups=2).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_grouped_deconv3d_oracle(self):
+        RandomGenerator.set_seed(0)
+        m = nn.VolumetricFullConvolution(4, 6, 2, 2, 2, dt=2, dw=2, dh=2,
+                                         n_group=2)
+        x = _np(1, 4, 3, 3, 3)
+        out = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+        w = np.asarray(m.get_params()["weight"])
+        b = np.asarray(m.get_params()["bias"])
+        ref = F.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                                 torch.tensor(b), stride=2, groups=2).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_divisive_norm_thresval(self):
+        x = np.zeros((1, 1, 9, 9), np.float32)
+        x[0, 0, 4, 4] = 1.0
+        m = nn.SpatialDivisiveNormalization(1, np.ones((3, 3)),
+                                            threshold=1e6, thresval=2.0)
+        out = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+        # every localstd <= 1e6 -> divisor == thresval everywhere
+        np.testing.assert_allclose(out, x / 2.0, rtol=1e-6)
+
+    def test_even_kernel_rejected(self):
+        with pytest.raises(ValueError, match="odd"):
+            nn.SpatialSubtractiveNormalization(2, np.ones((8, 8)))
+        with pytest.raises(ValueError, match="odd"):
+            nn.SpatialDivisiveNormalization(2, np.ones((4, 5)))
+
+    def test_device_cache_revalidates_on_dataset_swap(self):
+        import numpy as _np2
+        from bigdl_tpu import nn as _nn
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.dataset.transformer import Transformer
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        class Ident(Transformer):
+            def __call__(self, it):
+                return iter(list(it))
+
+        rng = _np2.random.default_rng(0)
+        batches = [MiniBatch(rng.normal(size=(4, 5)).astype(_np2.float32),
+                             rng.integers(0, 2, size=(4,)).astype(_np2.int32))
+                   for _ in range(2)]
+        model = _nn.Sequential().add(_nn.Linear(5, 2)).add(_nn.LogSoftMax())
+        ds = DataSet.array(batches)
+        opt = LocalOptimizer(model, ds, _nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_iteration(2))
+        opt.optimize()
+        assert opt._device_batch_cache is not None
+        opt.dataset = ds >> Ident()  # now yields fresh objects every epoch
+        opt.set_end_when(Trigger.max_iteration(4))
+        opt.optimize()
+        assert opt._device_batch_cache is None  # guard re-ran, cache dropped

@@ -1,4 +1,4 @@
-"""Inception-v1 ImageNet training main (reference parity: ``<dl>/models/inception/
+"""Inception-v1/v2 ImageNet training main (reference parity: ``<dl>/models/inception/
 TrainInceptionV1.scala`` — unverified, SURVEY.md §2.5; baseline config #3). With aux heads
 the loss is ``ParallelCriterion`` (main ×1.0, aux ×0.3) with the target repeated, matching
 the reference. No ImageNet on disk here → synthetic fallback keeps the main runnable.
@@ -13,11 +13,12 @@ import numpy as np
 
 
 def build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(description="Inception-v1 training")
+    p = argparse.ArgumentParser(description="Inception-v1/v2 training")
     p.add_argument("-f", "--folder", default=None)
     p.add_argument("-b", "--batch-size", type=int, default=32)
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument("--no-aux", action="store_true", help="NoAuxClassifier variant")
+    p.add_argument("--v2", action="store_true", help="BN-Inception (Inception_v2)")
     p.add_argument("--max-iteration", type=int, default=4)
     p.add_argument("--learning-rate", type=float, default=0.01)
     p.add_argument("--momentum", type=float, default=0.9)
@@ -33,7 +34,10 @@ def main(argv=None):
 
     from bigdl_tpu import nn
     from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
-    from bigdl_tpu.models.inception import Inception_v1, Inception_v1_NoAuxClassifier
+    from bigdl_tpu.models.inception import (
+        Inception_v1, Inception_v1_NoAuxClassifier, Inception_v2,
+        Inception_v2_NoAuxClassifier,
+    )
     from bigdl_tpu.optim import DistriOptimizer, LocalOptimizer, SGD, Trigger
     from bigdl_tpu.utils.engine import Engine
 
@@ -61,10 +65,12 @@ def main(argv=None):
                      >> SampleToMiniBatch(args.batch_size))
 
     if args.no_aux:
-        model = Inception_v1_NoAuxClassifier(args.classes)
+        model = (Inception_v2_NoAuxClassifier(args.classes) if args.v2
+                 else Inception_v1_NoAuxClassifier(args.classes))
         criterion = nn.ClassNLLCriterion()
     else:
-        model = Inception_v1(args.classes)
+        model = (Inception_v2(args.classes) if args.v2
+                 else Inception_v1(args.classes))
         criterion = (nn.ParallelCriterion(repeat_target=True)
                      .add(nn.ClassNLLCriterion(), 1.0)
                      .add(nn.ClassNLLCriterion(), 0.3)

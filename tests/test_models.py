@@ -152,3 +152,27 @@ class TestTrainMains:
         m = main(["--max-epoch", "1", "--depth", "20", "--synthetic-size", "128",
                   "-b", "32"])
         assert m is not None
+
+
+class TestInceptionV2:
+    def test_no_aux_forward(self):
+        from bigdl_tpu.models.inception import Inception_v2_NoAuxClassifier
+        m = Inception_v2_NoAuxClassifier(1000)
+        out = _fwd(m, (1, 3, 224, 224))
+        assert out.shape == (1, 1000)
+        # BN-Inception parameter count ballpark (~11.2M)
+        assert 10_000_000 < m.n_parameters() < 13_000_000
+
+    def test_aux_heads(self):
+        from bigdl_tpu.models.inception import Inception_v2
+        out = _fwd(Inception_v2(1000), (1, 3, 224, 224))
+        shapes = [o.shape for o in out.values()]
+        assert shapes == [(1, 1000)] * 3
+
+    def test_train_main_smoke(self):
+        # v2's reduction blocks need the canonical 224 path (stride-2 concat
+        # shapes only align for /32-divisible inputs)
+        from bigdl_tpu.models.inception.train import main
+        main(["--v2", "--no-aux", "--classes", "4", "--batch-size", "2",
+              "--synthetic-size", "4", "--image-size", "224",
+              "--max-iteration", "1"])

@@ -1,0 +1,17 @@
+# Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
+.PHONY: test dist bench multichip clean
+
+test:
+	python -m pytest tests/ -x -q
+
+dist:
+	bash make-dist.sh
+
+bench:
+	python bench.py
+
+multichip:
+	python -m bigdl_tpu.cli dryrun-multichip -n 8
+
+clean:
+	rm -rf dist build *.egg-info

@@ -1,0 +1,97 @@
+"""``bigdl-tpu`` command-line entry point — the reference's spark-submit /
+``scripts/bigdl.sh`` launcher analog (SURVEY.md §2.5 Build system, L8).
+
+The reference launches training through ``spark-submit`` with env setup done by
+``bigdl.sh`` and per-app scopt CLIs. TPU-native there is no cluster submitter:
+one console script fans out to the model training mains (each keeping its
+reference-style argparse options), the benchmark, and the multi-chip dry run.
+Environment flags (the ``bigdl.*`` property tier) are plain ``BIGDL_*`` env
+vars — see ``conf/bigdl-tpu.conf`` for the reference list.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+# subcommand → (module with main(argv), description)
+_TRAIN_MAINS = {
+    "lenet": ("bigdl_tpu.models.lenet.train", "LeNet-5 / MNIST"),
+    "resnet": ("bigdl_tpu.models.resnet.train", "ResNet CIFAR/ImageNet"),
+    "inception": ("bigdl_tpu.models.inception.train", "Inception-v1/v2 ImageNet"),
+    "vgg": ("bigdl_tpu.models.vgg.train", "VGG / CIFAR-10"),
+    "rnn": ("bigdl_tpu.models.rnn.train", "PTB LSTM language model"),
+    "autoencoder": ("bigdl_tpu.models.autoencoder.train", "MNIST autoencoder"),
+    "ncf": ("bigdl_tpu.models.ncf.train", "Neural Collaborative Filtering"),
+    "widedeep": ("bigdl_tpu.models.widedeep.train", "Wide & Deep recommender"),
+    "textclassifier": ("bigdl_tpu.models.textclassifier.train",
+                       "temporal-CNN text classification"),
+    "treelstm": ("bigdl_tpu.models.treelstm.train", "binary TreeLSTM sentiment"),
+}
+
+
+def _run_module(modname: str, argv) -> int:
+    import importlib
+
+    mod = importlib.import_module(modname)
+    out = mod.main(argv)
+    return out if isinstance(out, int) else 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    p = argparse.ArgumentParser(
+        prog="bigdl-tpu",
+        description="TPU-native BigDL: train models, benchmark, validate "
+                    "multi-chip sharding")
+    sub = p.add_subparsers(dest="command")
+
+    train = sub.add_parser("train", help="run a model training main")
+    train.add_argument("model", choices=sorted(_TRAIN_MAINS))
+    train.add_argument("rest", nargs=argparse.REMAINDER,
+                       help="arguments forwarded to the model's own CLI")
+
+    sub.add_parser("bench", help="single-chip ResNet-50 benchmark (bench.py)")
+    dry = sub.add_parser("dryrun-multichip",
+                         help="compile+run one sharded step on an n-device mesh")
+    dry.add_argument("-n", "--n-devices", type=int, default=8)
+    sub.add_parser("models", help="list available training mains")
+    sub.add_parser("env", help="print the BIGDL_* environment flags in effect")
+
+    args = p.parse_args(argv)
+    if args.command == "train":
+        mod, _ = _TRAIN_MAINS[args.model]
+        return _run_module(mod, args.rest)
+    if args.command == "bench":
+        from bigdl_tpu import benchmark
+        benchmark.main()
+        return 0
+    if args.command == "dryrun-multichip":
+        import os
+        # virtual CPU mesh: override any preset accelerator platform — this
+        # subcommand validates shardings, not hardware
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.n_devices}"
+            ).strip()
+        from bigdl_tpu import dryrun
+        dryrun.dryrun_multichip(args.n_devices)
+        return 0
+    if args.command == "models":
+        for name, (_, desc) in sorted(_TRAIN_MAINS.items()):
+            print(f"  {name:<16} {desc}")
+        return 0
+    if args.command == "env":
+        import os
+        for key in sorted(k for k in os.environ if k.startswith("BIGDL_")):
+            print(f"{key}={os.environ[key]}")
+        return 0
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

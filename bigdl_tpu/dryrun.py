@@ -1,0 +1,201 @@
+"""Driver contract implementations (packaged; repo-root ``__graft_entry__.py``
+is the driver-contract shim re-exporting these).
+
+- ``entry()`` → (jittable forward fn, example args) on the flagship model.
+- ``dryrun_multichip(n)`` → build an n-device mesh, jit the FULL training step over it with
+  real shardings (data-parallel batch, replicated params for now; ZeRO-1/TP/SP axes arrive
+  with DistriOptimizer growth), run ONE step on tiny shapes.
+"""
+
+from __future__ import annotations
+
+
+
+def entry():
+    """Jittable forward step of the flagship model + example args (single chip)."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.utils.engine import Engine
+
+    if not Engine.is_initialized():
+        Engine.init()
+    model = LeNet5(10).evaluate()
+    params = model.get_params()
+    mstate = model.get_state()
+
+    def forward(params, x):
+        out, _ = model.apply(params, mstate, x, training=False, rng=None)
+        return out
+
+    x = jnp.zeros((8, 1, 28, 28), jnp.float32)
+    return forward, (params, x)
+
+
+def dryrun_multichip(n_devices: int) -> None:
+    """Compile + execute one data-parallel training step over an n-device mesh."""
+    import os
+
+    import jax
+
+    # This image preloads jax._src at interpreter startup, which swallows JAX_PLATFORMS/
+    # XLA_FLAGS set by the caller. Re-assert both through the config API before any
+    # device access (no-op if a backend is already live).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # no virtual topology configured by the caller: build our own n-device
+        # CPU mesh (this dryrun validates shardings, not hardware)
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+        platforms = "cpu"
+    else:
+        platforms = os.environ.get("JAX_PLATFORMS", "cpu")
+    try:
+        jax.config.update("jax_platforms", platforms)
+    except Exception:
+        pass  # backend already initialised — selection is final
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.mnist import load_mnist, to_samples
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
+    from bigdl_tpu.parallel import megatron_mlp_rules
+    from bigdl_tpu.utils.engine import Engine
+
+    devices = jax.devices()
+    assert len(devices) >= n_devices, (
+        f"need {n_devices} devices, have {len(devices)} "
+        "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    losses = {}
+
+    # 1) pure data parallel, both parameter-sync modes (allreduce / ZeRO-1)
+    Engine.reset()
+    Engine.init(mesh_shape=(n_devices,), mesh_axes=(Engine.DATA_AXIS,))
+    imgs, labels = load_mnist(None, "train", synthetic_size=4 * n_devices)
+    data = DataSet.array(to_samples(imgs, labels),
+                         distributed=True) >> SampleToMiniBatch(4 * n_devices)
+    for sync in ("allreduce", "zero1"):
+        model = LeNet5(10)
+        opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion(),
+                               parameter_sync=sync)
+               .set_optim_method(SGD(learningrate=0.05, momentum=0.9, dampening=0.0))
+               .set_end_when(Trigger.max_iteration(1)))
+        opt.optimize()
+        losses[f"dp/{sync}"] = opt.state["loss"]
+
+    # 2) dp × tp: Megatron-style column/row-parallel MLP over the model axis
+    tp = 2 if n_devices % 2 == 0 else 1
+    if tp > 1:
+        Engine.reset()
+        Engine.init(mesh_shape=(n_devices // tp, tp),
+                    mesh_axes=(Engine.DATA_AXIS, Engine.MODEL_AXIS))
+        rng = np.random.default_rng(0)
+        samples = [Sample(rng.normal(size=(16,)).astype(np.float32),
+                          np.int32(rng.integers(0, 4)))
+                   for _ in range(4 * n_devices)]
+        data = DataSet.array(samples, distributed=True) \
+            >> SampleToMiniBatch(2 * n_devices)
+        model = (nn.Sequential()
+                 .add(nn.Linear(16, 4 * tp)).add(nn.ReLU())
+                 .add(nn.Linear(4 * tp, 4)).add(nn.LogSoftMax()))
+        opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion(),
+                               parameter_sync="zero1")
+               .set_optim_method(SGD(learningrate=0.05, momentum=0.9, dampening=0.0))
+               .set_end_when(Trigger.max_iteration(1))
+               .set_tensor_parallel(megatron_mlp_rules("0", "2")))
+        opt.optimize()
+        losses["dp x tp/zero1"] = opt.state["loss"]
+
+    # 3) dp x ep: Switch-style MoE with expert params sharded over `model`
+    if tp > 1:
+        from bigdl_tpu.parallel import MoE, expert_parallel_rules
+        Engine.reset()
+        Engine.init(mesh_shape=(n_devices // tp, tp),
+                    mesh_axes=(Engine.DATA_AXIS, Engine.MODEL_AXIS))
+        rng = np.random.default_rng(2)
+        samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                          np.int32(rng.integers(0, 3)))
+                   for _ in range(4 * n_devices)]
+        data = DataSet.array(samples, distributed=True) \
+            >> SampleToMiniBatch(2 * n_devices)
+        model = (nn.Sequential().add(MoE(8, 16, n_experts=2 * tp))
+                 .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+        opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion(),
+                               parameter_sync="zero1")
+               .set_optim_method(SGD(learningrate=0.05, momentum=0.9,
+                                     dampening=0.0))
+               .set_tensor_parallel(expert_parallel_rules("0"))
+               .set_end_when(Trigger.max_iteration(1)))
+        opt.optimize()
+        losses["dp x ep/moe"] = opt.state["loss"]
+
+    # 4) dp x pp: GPipe schedule over the pipe axis
+    pp = 4 if n_devices % 4 == 0 else (2 if n_devices % 2 == 0 else 1)
+    if pp > 1:
+        from bigdl_tpu.parallel import GPipe
+        Engine.reset()
+        Engine.init(mesh_shape=(n_devices // pp, pp),
+                    mesh_axes=(Engine.DATA_AXIS, Engine.PIPE_AXIS))
+        rng = np.random.default_rng(3)
+        samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                          np.int32(rng.integers(0, 3)))
+                   for _ in range(4 * n_devices)]
+        data = DataSet.array(samples, distributed=True) \
+            >> SampleToMiniBatch(2 * n_devices)
+        stage = nn.Sequential().add(nn.Linear(8, 8)).add(nn.Tanh())
+        model = (nn.Sequential()
+                 .add(GPipe(stage, n_stages=pp, n_microbatches=2))
+                 .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+        opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.05, momentum=0.9,
+                                     dampening=0.0))
+               .set_end_when(Trigger.max_iteration(1)))
+        opt.optimize()
+        losses["dp x pp/gpipe"] = opt.state["loss"]
+
+    # 5) sequence parallel: causal ring attention over the seq axis
+    Engine.reset()
+    Engine.init(mesh_shape=(1, n_devices),
+                mesh_axes=(Engine.DATA_AXIS, Engine.SEQ_AXIS))
+    rng = np.random.default_rng(1)
+    t = 2 * n_devices
+    samples = [Sample(rng.normal(size=(t, 8)).astype(np.float32),
+                      np.int32(rng.integers(0, 4))) for _ in range(8)]
+    data = DataSet.array(samples, distributed=True) >> SampleToMiniBatch(4)
+    model = (nn.Sequential()
+             .add(nn.MultiHeadAttention(8, 2, causal=True, attention_impl="ring"))
+             .add(nn.Select(2, -1))
+             .add(nn.Linear(8, 4)).add(nn.LogSoftMax()))
+    opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(learningrate=0.05, momentum=0.9, dampening=0.0))
+           .set_end_when(Trigger.max_iteration(1)))
+    opt.optimize()
+    losses["sp/ring-attention"] = opt.state["loss"]
+
+    # provenance so each round's artifact is self-identifying (round-2 advisor:
+    # byte-identical dryrun outputs across rounds were indistinguishable from
+    # stale copies). True multi-PROCESS coordination is exercised separately by
+    # tests/test_multihost.py (2-process jax.distributed + DistriOptimizer).
+    import subprocess
+    try:
+        commit = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10).stdout.strip() or "unknown"
+    except Exception:
+        commit = "unknown"
+    kind = jax.devices()[0].device_kind
+    print(f"dryrun_multichip({n_devices}): OK — dp, dp x tp (Megatron MLP), "
+          f"dp x ep (MoE), dp x pp (GPipe), sp (ring attention); "
+          f"losses={losses}; "
+          f"provenance=commit:{commit},device:{kind},platform:"
+          f"{jax.devices()[0].platform}")
+
+
+if __name__ == "__main__":
+    import sys
+    dryrun_multichip(int(sys.argv[1]) if len(sys.argv) > 1 else 8)

@@ -1,0 +1,11 @@
+from bigdl_tpu.dataset.dataset import (
+    AbstractDataSet, DataSet, DistributedDataSet, LocalDataSet, TransformedDataSet,
+    is_distributed,
+)
+from bigdl_tpu.dataset.sample import MiniBatch, Sample, SampleToMiniBatch
+from bigdl_tpu.dataset.transformer import (
+    ChainedTransformer, Identity, MapTransformer, Transformer,
+)
+from bigdl_tpu.dataset.text import (
+    Dictionary, LabeledSentenceToSample, SentenceTokenizer, TextToLabeledSentence,
+)

@@ -1,0 +1,119 @@
+"""CIFAR-10 loading.
+
+Reference parity (SURVEY.md §2.2/§2.5; the reference's VGG/ResNet CIFAR trainings read the
+binary CIFAR-10 set via ``<dl>/models/vgg/Utils.scala``-style loaders — unverified, mount
+empty): loads the python-pickle or binary CIFAR-10 distributions if present under
+``folder``; with no dataset on disk and no network (this environment), falls back to a
+deterministic synthetic 10-class set with CIFAR-like statistics so end-to-end trainings
+remain runnable and assertable.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+
+# per-channel mean/std of the real training set (BGR order matches reference pipelines)
+TRAIN_MEAN = (0.4914, 0.4822, 0.4465)
+TRAIN_STD = (0.2470, 0.2435, 0.2616)
+
+
+def synthetic_cifar10(n: int, seed: int = 0):
+    """Learnable synthetic stand-in: smooth 3-channel class prototypes + noise."""
+    rng = np.random.default_rng(seed)
+    protos = np.random.default_rng(4321).uniform(0, 1, size=(10, 3, 32, 32)).astype(
+        np.float32)
+    for _ in range(3):
+        protos = (protos + np.roll(protos, 1, 2) + np.roll(protos, -1, 2)
+                  + np.roll(protos, 1, 3) + np.roll(protos, -1, 3)) / 5.0
+    labels = rng.integers(0, 10, size=n)
+    imgs = protos[labels] + rng.normal(0, 0.15, size=(n, 3, 32, 32)).astype(np.float32)
+    return np.clip(imgs, 0, 1).astype(np.float32), labels.astype(np.int32)
+
+
+def _load_python_batches(folder: str, split: str):
+    names = ([f"data_batch_{i}" for i in range(1, 6)] if split == "train"
+             else ["test_batch"])
+    root = folder
+    sub = os.path.join(folder, "cifar-10-batches-py")
+    if os.path.isdir(sub):
+        root = sub
+    xs, ys = [], []
+    for name in names:
+        path = os.path.join(root, name)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        xs.append(np.asarray(d[b"data"], np.uint8).reshape(-1, 3, 32, 32))
+        ys.append(np.asarray(d[b"labels"], np.int64))
+    return np.concatenate(xs) / np.float32(255.0), np.concatenate(ys).astype(np.int32)
+
+
+def _load_binary_batches(folder: str, split: str):
+    names = ([f"data_batch_{i}.bin" for i in range(1, 6)] if split == "train"
+             else ["test_batch.bin"])
+    root = folder
+    sub = os.path.join(folder, "cifar-10-batches-bin")
+    if os.path.isdir(sub):
+        root = sub
+    xs, ys = [], []
+    for name in names:
+        path = os.path.join(root, name)
+        if not os.path.exists(path):
+            return None
+        raw = np.fromfile(path, np.uint8).reshape(-1, 3073)  # 1 label + 3072 pixels
+        ys.append(raw[:, 0].astype(np.int64))
+        xs.append(raw[:, 1:].reshape(-1, 3, 32, 32))
+    return np.concatenate(xs) / np.float32(255.0), np.concatenate(ys).astype(np.int32)
+
+
+def load_cifar10(folder: str | None = None, split: str = "train",
+                 synthetic_size: int | None = None):
+    """Return ``(images float32 NCHW in [0,1], labels int32)``.
+
+    With an explicit ``folder`` the python-pickle then binary layouts are tried and a
+    missing/unreadable dataset is an error — never a silent synthetic substitution.
+    Synthetic data is used only when no folder is given (this offline environment).
+    """
+    if folder:
+        loaded = _load_python_batches(folder, split) or _load_binary_batches(folder, split)
+        if loaded is None:
+            raise FileNotFoundError(
+                f"no CIFAR-10 batches (python or binary layout) under {folder!r}")
+        return loaded
+    n = synthetic_size or (2048 if split == "train" else 512)
+    return synthetic_cifar10(n, seed=0 if split == "train" else 1)
+
+
+def normalize(images: np.ndarray) -> np.ndarray:
+    mean = np.asarray(TRAIN_MEAN, np.float32).reshape(1, 3, 1, 1)
+    std = np.asarray(TRAIN_STD, np.float32).reshape(1, 3, 1, 1)
+    return (images - mean) / std
+
+
+def to_samples(images: np.ndarray, labels: np.ndarray) -> list[Sample]:
+    return [Sample(images[i], labels[i]) for i in range(len(images))]
+
+
+def train_val_sets(folder: str | None, batch_size: int, distributed: bool = False,
+                   synthetic_size: int = 1024):
+    """Normalized train/val MiniBatch datasets — the shared pipeline of the CIFAR
+    training mains (resnet/vgg)."""
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.sample import SampleToMiniBatch
+
+    imgs, labels = load_cifar10(folder, "train", synthetic_size=synthetic_size)
+    timgs, tlabels = load_cifar10(folder, "test",
+                                  synthetic_size=max(synthetic_size // 4, 256))
+    train_set = (DataSet.array(to_samples(normalize(imgs), labels),
+                               distributed=distributed)
+                 >> SampleToMiniBatch(batch_size))
+    test_set = (DataSet.array(to_samples(normalize(timgs), tlabels),
+                              distributed=distributed)
+                >> SampleToMiniBatch(batch_size))
+    return train_set, test_set

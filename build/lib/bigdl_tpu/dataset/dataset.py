@@ -1,0 +1,104 @@
+"""DataSet abstraction.
+
+Reference parity (SURVEY.md §2.2, expected ``<dl>/dataset/DataSet.scala`` — unverified):
+``LocalDataSet`` (in-memory array + transformer chain) and ``DistributedDataSet`` (cached
+per-partition RDD with in-place shuffle); factories ``DataSet.array``, ``DataSet.rdd``.
+
+TPU-native: data preparation is host-side; the *distribution* concern moves out of the
+dataset and into the trainer (which shards each MiniBatch over the mesh's data axis).
+``DistributedDataSet`` here is a thin marker wrapper telling ``Optimizer`` to pick the
+distributed training path, mirroring the reference's factory dispatch (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+class AbstractDataSet:
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        raise NotImplementedError
+
+    def data(self, train: bool) -> Iterator:
+        """One pass over the (transformed) data. Trainer handles epoch looping."""
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "AbstractDataSet":
+        return TransformedDataSet(self, transformer)
+
+    def __rshift__(self, transformer: Transformer) -> "AbstractDataSet":
+        """``dataset >> transformer`` — the reference's ``dataset -> transformer``."""
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    def __init__(self, data: Sequence):
+        self._data = list(data)
+        self._order = np.arange(len(self._data))
+
+    def size(self) -> int:
+        return len(self._data)
+
+    def shuffle(self) -> None:
+        perm = RandomGenerator.numpy().permutation(len(self._data))
+        self._order = self._order[perm]
+
+    def data(self, train: bool) -> Iterator:
+        for i in self._order:
+            yield self._data[i]
+
+
+class TransformedDataSet(AbstractDataSet):
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self) -> int:
+        return self.base.size()
+
+    def shuffle(self) -> None:
+        self.base.shuffle()
+
+    def data(self, train: bool) -> Iterator:
+        return self.transformer(self.base.data(train))
+
+    def is_distributed(self) -> bool:
+        return is_distributed(self.base)
+
+
+class DistributedDataSet(LocalDataSet):
+    """Marker dataset: train with DistriOptimizer over the device mesh."""
+
+
+class DataSet:
+    """Factory namespace (reference ``DataSet.array`` / ``DataSet.rdd`` /
+    ``DataSet.imageFolder``)."""
+
+    @staticmethod
+    def array(data: Iterable, distributed: bool = False) -> AbstractDataSet:
+        return DistributedDataSet(list(data)) if distributed else LocalDataSet(list(data))
+
+    @staticmethod
+    def image_folder(root: str, num_workers: int = 8, one_based: bool = False,
+                     distributed: bool = False) -> AbstractDataSet:
+        """On-disk ``root/<class>/<image>`` source streaming ImageFeatures
+        (dataset/image_folder.py) — compose vision transformers + SampleToMiniBatch."""
+        from bigdl_tpu.dataset.image_folder import ImageFolderDataSet
+        return ImageFolderDataSet(root, num_workers=num_workers,
+                                  one_based=one_based, distributed=distributed)
+
+
+def is_distributed(dataset: AbstractDataSet) -> bool:
+    if isinstance(dataset, DistributedDataSet):
+        return True
+    if isinstance(dataset, TransformedDataSet):
+        return dataset.is_distributed()
+    return bool(getattr(dataset, "distributed", False))

@@ -1,0 +1,75 @@
+"""MNIST loading (reference parity: ``pyspark/bigdl/dataset/mnist.py`` — unverified).
+
+Reads the standard idx-format files if present; with no dataset on disk and no network
+(this environment), falls back to a deterministic synthetic set: 10 fixed class prototypes
++ noise. The synthetic task is genuinely learnable, so end-to-end training tests can assert
+loss ↓ / accuracy ↑ without the real data.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+
+TRAIN_MEAN, TRAIN_STD = 0.13066047740240005, 0.3081078
+
+_IMAGES = {"train": "train-images-idx3-ubyte", "test": "t10k-images-idx3-ubyte"}
+_LABELS = {"train": "train-labels-idx1-ubyte", "test": "t10k-labels-idx1-ubyte"}
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def _find(folder: str, base: str):
+    for cand in (base, base + ".gz"):
+        p = os.path.join(folder, cand)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def synthetic_mnist(n: int, seed: int = 0):
+    """Deterministic learnable stand-in: blurred class-prototype images + noise.
+
+    The 10 prototypes are FIXED (independent of ``seed``) so train/test splits share the
+    same class structure; ``seed`` only varies the labels/noise draw.
+    """
+    rng = np.random.default_rng(seed)
+    protos = np.random.default_rng(1234).uniform(0, 1, size=(10, 28, 28)).astype(np.float32)
+    # low-pass the prototypes so they have MNIST-like smooth structure
+    for _ in range(3):
+        protos = (protos + np.roll(protos, 1, 1) + np.roll(protos, -1, 1)
+                  + np.roll(protos, 1, 2) + np.roll(protos, -1, 2)) / 5.0
+    labels = rng.integers(0, 10, size=n)
+    imgs = protos[labels] + rng.normal(0, 0.15, size=(n, 28, 28)).astype(np.float32)
+    imgs = np.clip(imgs, 0, 1)
+    return (imgs * 255).astype(np.uint8), labels.astype(np.int32)
+
+
+def load_mnist(folder: str | None = None, split: str = "train",
+               synthetic_size: int = 2048):
+    """Return (images uint8 (N,28,28), labels int32 (N,)). Falls back to synthetic."""
+    if folder:
+        img_p = _find(folder, _IMAGES[split])
+        lab_p = _find(folder, _LABELS[split])
+        if img_p and lab_p:
+            return _read_idx(img_p), _read_idx(lab_p).astype(np.int32)
+    return synthetic_mnist(synthetic_size, seed=0 if split == "train" else 1)
+
+
+def to_samples(images: np.ndarray, labels: np.ndarray,
+               mean: float = TRAIN_MEAN, std: float = TRAIN_STD):
+    """Normalize and wrap as Samples with NCHW (1, 28, 28) features."""
+    imgs = (images.astype(np.float32) / 255.0 - mean) / std
+    return [Sample(imgs[i][None, :, :], np.int32(labels[i])) for i in range(len(labels))]

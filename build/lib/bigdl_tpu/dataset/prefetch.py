@@ -1,0 +1,122 @@
+"""Background batch pipeline — overlap host data work with device compute.
+
+Reference parity (SURVEY.md §7.4): the reference leans on Spark to materialise partitions
+ahead of the training loop; its per-iteration cost hides batch assembly behind cluster
+scheduling. On TPU the analog is a host-side producer thread: while the chip executes step
+``k`` (dispatch is async), the producer decodes/stacks batch ``k+1`` **and** starts its
+host→device transfer, so the step loop never waits on the feed in steady state. This is
+SURVEY §7.4's named "most likely real-world bottleneck" for the ResNet-50 north star.
+
+Design:
+- ``PrefetchingFeed`` wraps a fresh dataset iterator per epoch. A daemon producer thread
+  pulls ``MiniBatch``es, calls ``put_fn`` (the trainer's sharding-aware ``device_put``)
+  and parks up to ``depth`` placed batches in a bounded queue. ``device_put`` only
+  *enqueues* a DMA, so the producer is never blocked on the device — the queue depth
+  bounds device-memory overcommit to ``depth`` batches.
+- Exceptions in the producer surface in the consumer (training loop) with their original
+  traceback as ``__cause__``.
+- ``close()`` (also on ``__exit__`` / generator abandonment) stops the producer promptly —
+  mid-epoch breaks (endWhen triggers) must not leak threads.
+- ``depth=0`` degrades to fully synchronous iteration (debug / determinism studies).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator
+
+_END = object()
+
+
+class PrefetchingFeed:
+    """Iterate ``(batch, placed)`` pairs with a background producer.
+
+    ``make_iter``: zero-arg callable returning the epoch's batch iterator.
+    ``put_fn``: MiniBatch → device-placed pytree (e.g. trainer's ``_put_batch``).
+    ``depth``: producer queue bound (placed batches in flight); 0 = synchronous.
+    """
+
+    def __init__(self, make_iter: Callable[[], Iterator], put_fn: Callable,
+                 depth: int = 2):
+        if depth < 0:
+            raise ValueError(f"depth must be >= 0, got {depth}")
+        self.make_iter = make_iter
+        self.put_fn = put_fn
+        self.depth = depth
+        self._queue: queue.Queue | None = None
+        self._stop: threading.Event | None = None
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------- producer
+    @staticmethod
+    def _put_responsive(q: queue.Queue, stop: threading.Event, item) -> None:
+        """Blocking put that stays responsive to close(). Never gives up while
+        the feed is live: the consumer is either draining (put succeeds) or
+        closing (stop fires) — dropping the item would deadlock the consumer."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def _produce(self, it, q: queue.Queue, stop: threading.Event) -> None:
+        try:
+            for batch in it:
+                if stop.is_set():
+                    return
+                placed = self.put_fn(batch)
+                self._put_responsive(q, stop, (batch, placed))
+                if stop.is_set():
+                    return
+            self._put_responsive(q, stop, _END)
+        except BaseException as e:  # surfaced in the consumer
+            self._put_responsive(q, stop, e)
+
+    # ------------------------------------------------------------- consumer
+    def __iter__(self):
+        if self.depth == 0:
+            for batch in self.make_iter():
+                yield batch, self.put_fn(batch)
+            return
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self.depth)
+        self._thread = threading.Thread(
+            target=self._produce, args=(self.make_iter(), self._queue, self._stop),
+            name="bigdl-prefetch", daemon=True)
+        self._thread.start()
+        try:
+            while True:
+                item = self._queue.get()
+                if item is _END:
+                    return
+                if isinstance(item, BaseException):
+                    # re-raise the producer's exception with its original type
+                    # (trainer retry/divisibility contracts depend on it); the
+                    # producer traceback is already attached to the object
+                    raise item
+                yield item
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._queue is not None:
+            # unblock a producer stuck on put()
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

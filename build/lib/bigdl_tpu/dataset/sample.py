@@ -1,0 +1,109 @@
+"""Sample and MiniBatch.
+
+Reference parity (SURVEY.md §2.2, expected ``<dl>/dataset/Sample.scala``, ``MiniBatch.scala``
+— unverified): a ``Sample`` is (feature tensors, label tensors) with contiguous storage; a
+``MiniBatch`` stacks samples with optional padding; ``SampleToMiniBatch`` is the batching
+transformer.
+
+TPU-native: host-side numpy until the trainer's device put; batches keep STATIC shapes
+(fixed batch size — the final partial batch is padded up and carries an explicit valid-count
+so jit never sees a new shape; the reference padded too, for a different reason).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class Sample:
+    def __init__(self, feature, label=None):
+        self.feature = (tuple(np.asarray(f) for f in feature)
+                        if isinstance(feature, (tuple, list))
+                        else (np.asarray(feature),))
+        if label is None:
+            self.label = ()
+        else:
+            self.label = (tuple(np.asarray(l) for l in label)
+                          if isinstance(label, (tuple, list))
+                          else (np.asarray(label),))
+
+    @property
+    def features(self):
+        return self.feature
+
+    @property
+    def labels(self):
+        return self.label
+
+    def __repr__(self):
+        fs = ",".join(str(f.shape) for f in self.feature)
+        ls = ",".join(str(l.shape) for l in self.label)
+        return f"Sample(feature={fs}, label={ls})"
+
+
+class MiniBatch:
+    """Stacked batch. ``size`` is the padded batch size; ``valid`` the real sample count."""
+
+    def __init__(self, input, target=None, valid: Optional[int] = None):
+        self.input = input
+        self.target = target
+        self.valid = valid if valid is not None else _batch_dim(input)
+
+    def size(self) -> int:
+        return _batch_dim(self.input)
+
+    def __repr__(self):
+        return f"MiniBatch(size={self.size()}, valid={self.valid})"
+
+
+def _batch_dim(x) -> int:
+    if isinstance(x, (tuple, list)):
+        return _batch_dim(x[0])
+    return int(np.asarray(x).shape[0])
+
+
+class SampleToMiniBatch(Transformer):
+    """Group Samples into fixed-size MiniBatches.
+
+    ``pad_last=True`` (default) repeats trailing samples so every batch has exactly
+    ``batch_size`` rows (static shapes for XLA) and records ``valid`` for correct metrics;
+    ``pad_last=False`` drops the final partial batch (training-loop default).
+    """
+
+    def __init__(self, batch_size: int, pad_last: bool = True):
+        assert batch_size > 0
+        self.batch_size = batch_size
+        self.pad_last = pad_last
+
+    def __call__(self, prev: Iterator) -> Iterator:
+        return self._gen(prev)
+
+    def _gen(self, prev: Iterator):
+        buf: list[Sample] = []
+        for s in prev:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._stack(buf, self.batch_size)
+                buf = []
+        if buf and self.pad_last:
+            valid = len(buf)
+            while len(buf) < self.batch_size:
+                buf.append(buf[valid - 1])
+            yield self._stack(buf, self.batch_size, valid)
+
+    @staticmethod
+    def _stack(samples: Sequence[Sample], batch_size: int, valid: Optional[int] = None):
+        # native GIL-free copy when available (runs in the prefetch producer
+        # thread — overlap with the main thread is the point); numpy otherwise
+        from bigdl_tpu.native import pack_batch
+        n_f = len(samples[0].feature)
+        feats = tuple(pack_batch([s.feature[i] for s in samples]) for i in range(n_f))
+        n_l = len(samples[0].label)
+        labels = tuple(pack_batch([s.label[i] for s in samples]) for i in range(n_l))
+        input = feats[0] if n_f == 1 else feats
+        target = (labels[0] if n_l == 1 else labels) if n_l else None
+        return MiniBatch(input, target, valid if valid is not None else len(samples))

@@ -1,0 +1,141 @@
+"""Text pipeline: dictionary, tokenizers, sentence→sample transformers, PTB loading.
+
+Reference parity (SURVEY.md §2.2, expected ``<dl>/dataset/text/`` with ``Dictionary``,
+``SentenceTokenizer``, ``TextToLabeledSentence``, ``LabeledSentenceToSample`` — unverified,
+mount empty): the reference tokenizes text, builds a frequency-capped dictionary, converts
+token streams into (input, shifted-target) LM samples. PTB reading for the LSTM LM
+(baseline config #4) follows ``example/languagemodel``'s data prep.
+
+With no dataset on disk (no network here), ``load_ptb`` falls back to a deterministic
+synthetic Markov corpus with a learnable bigram structure, so LM perplexity is a real
+training signal.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class Dictionary:
+    """Token ↔ index mapping with frequency-capped vocabulary (reference ``Dictionary``).
+
+    Index 0 is reserved for the unknown token (the reference reserves an <unk> slot).
+    """
+
+    UNK = "<unk>"
+
+    def __init__(self, tokens: Iterable[str] | None = None,
+                 vocab_size: int | None = None):
+        self._word2idx: dict[str, int] = {self.UNK: 0}
+        self._idx2word: list[str] = [self.UNK]
+        if tokens is not None:
+            self.build(tokens, vocab_size)
+
+    def build(self, tokens: Iterable[str], vocab_size: int | None = None) -> "Dictionary":
+        from collections import Counter
+        counts = Counter(tokens)
+        counts.pop(self.UNK, None)
+        most = counts.most_common(None if vocab_size is None else vocab_size - 1)
+        for w, _ in most:
+            self._word2idx[w] = len(self._idx2word)
+            self._idx2word.append(w)
+        return self
+
+    def get_index(self, word: str) -> int:
+        return self._word2idx.get(word, 0)
+
+    def get_word(self, index: int) -> str:
+        return self._idx2word[index] if 0 <= index < len(self._idx2word) else self.UNK
+
+    def vocab_size(self) -> int:
+        return len(self._idx2word)
+
+    def __len__(self) -> int:
+        return len(self._idx2word)
+
+
+class SentenceTokenizer(Transformer):
+    """Split sentences into lowercase word tokens (reference ``SentenceTokenizer``)."""
+
+    def __init__(self, pattern: str = r"[A-Za-z0-9<>']+"):
+        self.pattern = re.compile(pattern)
+
+    def __call__(self, prev: Iterator) -> Iterator:
+        for sentence in prev:
+            yield self.pattern.findall(sentence.lower())
+
+
+class TextToLabeledSentence(Transformer):
+    """tokens → (input tokens, next-token labels) for LM training."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def __call__(self, prev: Iterator) -> Iterator:
+        for tokens in prev:
+            idx = np.asarray([self.dictionary.get_index(t) for t in tokens], np.int32)
+            if len(idx) < 2:
+                continue
+            yield idx[:-1], idx[1:]
+
+
+class LabeledSentenceToSample(Transformer):
+    def __call__(self, prev: Iterator) -> Iterator:
+        for inp, lbl in prev:
+            yield Sample(inp, lbl)
+
+
+def ptb_windows(ids: np.ndarray, bptt: int):
+    """Slice a token-id stream into (input, target) windows of length ``bptt``."""
+    n = (len(ids) - 1) // bptt
+    xs = ids[:n * bptt].reshape(n, bptt)
+    ys = ids[1:n * bptt + 1].reshape(n, bptt)
+    return xs.astype(np.int32), ys.astype(np.int32)
+
+
+def synthetic_ptb(n_tokens: int, vocab_size: int = 1000, seed: int = 0) -> np.ndarray:
+    """Deterministic Markov-chain corpus: each token strongly predicts its successor."""
+    rng = np.random.default_rng(seed)
+    # sparse transition structure: each word has 4 likely successors
+    succ = np.random.default_rng(99).integers(1, vocab_size, size=(vocab_size, 4))
+    ids = np.empty(n_tokens, np.int32)
+    ids[0] = 1
+    noise = rng.random(n_tokens)
+    choice = rng.integers(0, 4, size=n_tokens)
+    rand_tok = rng.integers(1, vocab_size, size=n_tokens)
+    for i in range(1, n_tokens):
+        ids[i] = succ[ids[i - 1], choice[i]] if noise[i] > 0.1 else rand_tok[i]
+    return ids
+
+
+def load_ptb(folder: str | None = None, split: str = "train",
+             dictionary: Dictionary | None = None, vocab_size: int = 10000,
+             synthetic_size: int | None = None):
+    """Return ``(token ids int32, Dictionary)`` for a PTB split.
+
+    Reads ``ptb.<split>.txt`` under ``folder`` if present; otherwise a synthetic corpus.
+    The train split builds the dictionary; pass it back in for valid/test.
+    """
+    path = folder and os.path.join(folder, f"ptb.{split}.txt")
+    if path and os.path.exists(path):
+        with open(path) as f:
+            words = f.read().replace("\n", " <eos> ").split()
+        if dictionary is None:
+            dictionary = Dictionary(words, vocab_size)
+        ids = np.asarray([dictionary.get_index(w) for w in words], np.int32)
+        return ids, dictionary
+    n = synthetic_size or (20000 if split == "train" else 2000)
+    vocab = min(vocab_size, 1000)
+    if dictionary is None:
+        dictionary = Dictionary()
+        dictionary._idx2word = [Dictionary.UNK] + [f"w{i}" for i in range(1, vocab)]
+        dictionary._word2idx = {w: i for i, w in enumerate(dictionary._idx2word)}
+    ids = synthetic_ptb(n, vocab, seed=0 if split == "train" else 1)
+    return ids, dictionary

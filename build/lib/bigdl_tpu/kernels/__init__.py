@@ -1,0 +1,3 @@
+from bigdl_tpu.kernels.layernorm import fused_layer_norm
+
+__all__ = ["fused_layer_norm"]

@@ -1,0 +1,107 @@
+"""Pallas TPU kernels — fused LayerNorm.
+
+This is the framework's Pallas layer (SURVEY.md §7.1: "Pallas reserved for true
+gaps"): XLA fuses most elementwise chains into adjacent matmuls on its own, but
+row-normalisation is a 3-pass pattern (mean, variance, scale) the compiler
+sometimes leaves as separate HBM round trips on large rows. The kernel below
+does all three passes in one VMEM residency per row-block: a (block_rows, H)
+tile is loaded once, reduced on the VPU, normalised, scaled, and written once.
+
+Semantics: forward is the Pallas kernel on TPU (interpreter elsewhere/on CPU
+tests); the backward pass is the standard recompute-form VJP in plain jnp —
+rematerialisation is the TPU-idiomatic trade (one extra fused forward instead
+of stashing normalised activations in HBM).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _reference_layer_norm(x, gamma, beta, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    return (x - mean) * inv * gamma + beta
+
+
+def _pallas_layer_norm(x2d, gamma, beta, eps, block_rows, interpret):
+    from jax.experimental import pallas as pl
+
+    n, h = x2d.shape
+
+    def kernel(x_ref, g_ref, b_ref, o_ref):
+        x = x_ref[:].astype(jnp.float32)        # (block_rows, H) in VMEM
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+        inv = jax.lax.rsqrt(var + eps)
+        o_ref[:] = ((x - mean) * inv * g_ref[:] + b_ref[:]).astype(o_ref.dtype)
+
+    grid = (n // block_rows,)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, h), x2d.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x2d, gamma, beta)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_layer_norm(x, gamma, beta, eps: float = 1e-5,
+                     force_pallas: bool | None = None):
+    """LayerNorm over the last axis. ``force_pallas``: None = pallas on TPU,
+    reference jnp elsewhere; True = pallas (interpreted off-TPU — tests);
+    False = reference."""
+    return _fln_fwd(x, gamma, beta, eps, force_pallas)[0]
+
+
+def _fln_fwd(x, gamma, beta, eps, force_pallas):
+    use_pallas = _on_tpu() if force_pallas is None else force_pallas
+    h = x.shape[-1]
+    lead = x.shape[:-1]
+    out = None
+    if use_pallas:
+        n = 1
+        for d in lead:
+            n *= d
+        x2d = x.reshape(n, h)
+        # block over rows: biggest power-of-two divisor up to 256 keeps the
+        # tile in VMEM for any realistic H while aligning to the 8-sublane tile
+        block = 1
+        while block < 256 and n % (block * 2) == 0:
+            block *= 2
+        try:
+            out = _pallas_layer_norm(x2d, gamma, beta, eps, block,
+                                     interpret=not _on_tpu()).reshape(x.shape)
+        except Exception:  # pallas unavailable (platform/version) → reference
+            out = None
+    if out is None:
+        out = _reference_layer_norm(x, gamma, beta, eps)
+    return out, (x, gamma, beta)
+
+
+def _fln_bwd(eps, force_pallas, res, g):
+    x, gamma, beta = res
+    # recompute-form VJP of the reference formula (rematerialisation)
+    _, vjp = jax.vjp(lambda xx, gg, bb: _reference_layer_norm(xx, gg, bb, eps),
+                     x, gamma, beta)
+    return vjp(g)
+
+
+fused_layer_norm.defvjp(_fln_fwd, _fln_bwd)

@@ -1,0 +1,20 @@
+"""MNIST autoencoder.
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/models/autoencoder/Autoencoder.scala`` —
+unverified, mount empty): 784 → Linear(784, classNum) → ReLU → Linear(classNum, 784) →
+Sigmoid, trained with MSECriterion reconstructing the input.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def Autoencoder(class_num: int = 32) -> nn.Sequential:
+    """``class_num`` is the bottleneck width (reference naming)."""
+    return (nn.Sequential()
+            .add(nn.Reshape([28 * 28]))
+            .add(nn.Linear(28 * 28, class_num))
+            .add(nn.ReLU())
+            .add(nn.Linear(class_num, 28 * 28))
+            .add(nn.Sigmoid()))

@@ -1,0 +1,55 @@
+"""Autoencoder MNIST training main (reference parity: ``<dl>/models/autoencoder/Train.scala``
+— unverified, SURVEY.md §2.5). Reconstruction target = input; MSE loss.
+``python -m bigdl_tpu.models.autoencoder.train``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="MNIST autoencoder training")
+    p.add_argument("-f", "--folder", default=None)
+    p.add_argument("-b", "--batch-size", type=int, default=128)
+    p.add_argument("--bottleneck", type=int, default=32)
+    p.add_argument("--max-epoch", type=int, default=1)
+    p.add_argument("--learning-rate", type=float, default=0.01)
+    p.add_argument("--distributed", action="store_true")
+    p.add_argument("--synthetic-size", type=int, default=2048)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.dataset.mnist import load_mnist
+    from bigdl_tpu.models.autoencoder import Autoencoder
+    from bigdl_tpu.optim import Adam, DistriOptimizer, LocalOptimizer, Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    if not Engine.is_initialized():
+        Engine.init()
+
+    imgs, _ = load_mnist(args.folder, "train", synthetic_size=args.synthetic_size)
+    flat = (imgs.astype(np.float32) / 255.0).reshape(len(imgs), -1)
+    samples = [Sample(x, x) for x in flat]
+    train_set = (DataSet.array(samples, distributed=args.distributed)
+                 >> SampleToMiniBatch(args.batch_size))
+
+    model = Autoencoder(args.bottleneck)
+    cls = DistriOptimizer if args.distributed else LocalOptimizer
+    optimizer = (cls(model, train_set, nn.MSECriterion())
+                 .set_optim_method(Adam(learningrate=args.learning_rate))
+                 .set_end_when(Trigger.max_epoch(args.max_epoch)))
+    trained = optimizer.optimize()
+    print(f"final loss: {optimizer.state['loss']:.6f}")
+    return trained
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+from bigdl_tpu.models.inception.inception import (
+    Inception_Layer_v1, Inception_Layer_v2, Inception_v1,
+    Inception_v1_NoAuxClassifier, Inception_v2, Inception_v2_NoAuxClassifier,
+)

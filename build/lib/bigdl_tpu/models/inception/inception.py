@@ -1,0 +1,262 @@
+"""Inception-v1 (GoogLeNet).
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/models/inception/Inception_v1.scala`` —
+unverified, mount empty): ``Inception_Layer_v1(inputSize, T(T(c1), T(r3, c3), T(r5, c5),
+T(pp)), prefix)`` builds a ``Concat`` of four branches (1x1 | 1x1→3x3 | 1x1→5x5 |
+maxpool→1x1); ``Inception_v1_NoAuxClassifier`` is the plain Sequential stack;
+``Inception_v1`` adds the two auxiliary classifier heads after inception 4a and 4d and
+outputs a 3-element Table trained with ``ParallelCriterion`` (main loss weight 1.0, aux
+0.3). Baseline config #3 (BASELINE.md).
+
+TPU-native notes: the heavy ``Concat`` branch blocks are pure functional fan-out/concat —
+XLA schedules the four branches as independent fusions; LRN is a windowed reduce
+(``SpatialCrossMapLRN``). The aux-head split uses the Graph container's multi-output
+support rather than the reference's nested-ConcatTable trick.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import Table
+
+
+def _cfg(v):
+    return list(v.values()) if isinstance(v, Table) else list(v)
+
+
+def Inception_Layer_v1(input_size: int, config, name_prefix: str = "") -> nn.Concat:
+    """The 4-branch inception block."""
+    cfg = _cfg(config)
+    c1 = _cfg(cfg[0])[0]
+    r3, c3 = _cfg(cfg[1])
+    r5, c5 = _cfg(cfg[2])
+    pp = _cfg(cfg[3])[0]
+    concat = nn.Concat(2)
+    concat.add(nn.Sequential()
+               .add(nn.SpatialConvolution(input_size, c1, 1, 1)
+                    .set_name(name_prefix + "1x1"))
+               .add(nn.ReLU()))
+    concat.add(nn.Sequential()
+               .add(nn.SpatialConvolution(input_size, r3, 1, 1)
+                    .set_name(name_prefix + "3x3_reduce"))
+               .add(nn.ReLU())
+               .add(nn.SpatialConvolution(r3, c3, 3, 3, 1, 1, 1, 1)
+                    .set_name(name_prefix + "3x3"))
+               .add(nn.ReLU()))
+    concat.add(nn.Sequential()
+               .add(nn.SpatialConvolution(input_size, r5, 1, 1)
+                    .set_name(name_prefix + "5x5_reduce"))
+               .add(nn.ReLU())
+               .add(nn.SpatialConvolution(r5, c5, 5, 5, 1, 1, 2, 2)
+                    .set_name(name_prefix + "5x5"))
+               .add(nn.ReLU()))
+    concat.add(nn.Sequential()
+               .add(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil())
+               .add(nn.SpatialConvolution(input_size, pp, 1, 1)
+                    .set_name(name_prefix + "pool_proj"))
+               .add(nn.ReLU()))
+    return concat
+
+
+def _stem() -> nn.Sequential:
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3)
+                 .set_name("conv1/7x7_s2"))
+            .add(nn.ReLU())
+            .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+            .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+            .add(nn.SpatialConvolution(64, 64, 1, 1).set_name("conv2/3x3_reduce"))
+            .add(nn.ReLU())
+            .add(nn.SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1).set_name("conv2/3x3"))
+            .add(nn.ReLU())
+            .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+            .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+            .add(Inception_Layer_v1(192, [[64], [96, 128], [16, 32], [32]],
+                                    "inception_3a/"))
+            .add(Inception_Layer_v1(256, [[128], [128, 192], [32, 96], [64]],
+                                    "inception_3b/"))
+            .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+            .add(Inception_Layer_v1(480, [[192], [96, 208], [16, 48], [64]],
+                                    "inception_4a/")))
+
+
+def _mid() -> nn.Sequential:
+    return (nn.Sequential()
+            .add(Inception_Layer_v1(512, [[160], [112, 224], [24, 64], [64]],
+                                    "inception_4b/"))
+            .add(Inception_Layer_v1(512, [[128], [128, 256], [24, 64], [64]],
+                                    "inception_4c/"))
+            .add(Inception_Layer_v1(512, [[112], [144, 288], [32, 64], [64]],
+                                    "inception_4d/")))
+
+
+def _tail(class_num: int, has_dropout: bool) -> nn.Sequential:
+    seq = (nn.Sequential()
+           .add(Inception_Layer_v1(528, [[256], [160, 320], [32, 128], [128]],
+                                   "inception_4e/"))
+           .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+           .add(Inception_Layer_v1(832, [[256], [160, 320], [32, 128], [128]],
+                                   "inception_5a/"))
+           .add(Inception_Layer_v1(832, [[384], [192, 384], [48, 128], [128]],
+                                   "inception_5b/"))
+           .add(nn.SpatialAveragePooling(7, 7, 1, 1)))
+    if has_dropout:
+        seq.add(nn.Dropout(0.4))
+    return (seq
+            .add(nn.View([1024]))
+            .add(nn.Linear(1024, class_num).set_name("loss3/classifier"))
+            .add(nn.LogSoftMax()))
+
+
+def _aux_head(n_in: int, class_num: int, prefix: str,
+              use_bn: bool = False) -> nn.Sequential:
+    """Aux classifier head; ``use_bn`` swaps the conv+ReLU for conv+BN+ReLU
+    (the v2 variant)."""
+    seq = nn.Sequential().add(nn.SpatialAveragePooling(5, 5, 3, 3).ceil())
+    if use_bn:
+        seq.add(_conv_bn(n_in, 128, 1, 1, name=prefix + "conv"))
+    else:
+        seq.add(nn.SpatialConvolution(n_in, 128, 1, 1).set_name(prefix + "conv"))
+        seq.add(nn.ReLU())
+    return (seq
+            .add(nn.View([128 * 4 * 4]))
+            .add(nn.Linear(128 * 4 * 4, 1024).set_name(prefix + "fc"))
+            .add(nn.ReLU())
+            .add(nn.Linear(1024, class_num).set_name(prefix + "classifier"))
+            .add(nn.LogSoftMax()))
+
+
+def _flatten(*blocks: nn.Sequential) -> nn.Sequential:
+    model = nn.Sequential()
+    for block in blocks:
+        for m in block.modules:
+            model.add(m)
+    return model
+
+
+def Inception_v1_NoAuxClassifier(class_num: int = 1000,
+                                 has_dropout: bool = True) -> nn.Sequential:
+    return _flatten(_stem(), _mid(), _tail(class_num, has_dropout))
+
+
+def Inception_v1(class_num: int = 1000, has_dropout: bool = True) -> nn.Graph:
+    """Full GoogLeNet with the two aux heads; outputs T(main, aux1, aux2)."""
+    inp = nn.Input()
+    feat4a = _stem().inputs(inp)
+    aux1 = _aux_head(512, class_num, "loss1/").inputs(feat4a)
+    feat4d = _mid().inputs(feat4a)
+    aux2 = _aux_head(528, class_num, "loss2/").inputs(feat4d)
+    main = _tail(class_num, has_dropout).inputs(feat4d)
+    return nn.Graph(inp, [main, aux1, aux2])
+
+
+# --------------------------------------------------------------------- v2
+def _conv_bn(in_p: int, out_p: int, kw: int, kh: int, sw: int = 1, sh: int = 1,
+             pw: int = 0, ph: int = 0, name: str = "") -> nn.Sequential:
+    """conv (no bias) + BN + ReLU — the BN-Inception building block."""
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(in_p, out_p, kw, kh, sw, sh, pw, ph,
+                                       with_bias=False).set_name(name))
+            .add(nn.SpatialBatchNormalization(out_p).set_name(name + "/bn"))
+            .add(nn.ReLU()))
+
+
+def Inception_Layer_v2(input_size: int, config, name_prefix: str = "") -> nn.Concat:
+    """The BN-Inception block (reference ``Inception_Layer_v2`` — SURVEY.md
+    §2.5 Inception_v2, unverified): branches 1x1 | 1x1→3x3 | 1x1→3x3→3x3 |
+    pool(+proj), every conv followed by BatchNorm. ``config`` =
+    [[c1], [r3, c3], [rd, cd], [pool_kind, pp]]; c1 == 0 marks a stride-2
+    reduction block (no 1x1 branch, pass-through pool, stride on the 3x3s)."""
+    cfg = _cfg(config)
+    c1 = _cfg(cfg[0])[0]
+    r3, c3 = _cfg(cfg[1])
+    rd, cd = _cfg(cfg[2])
+    pool_kind, pp = _cfg(cfg[3])
+    reduction = c1 == 0
+    stride = 2 if reduction else 1
+
+    concat = nn.Concat(2)
+    if not reduction:
+        concat.add(_conv_bn(input_size, c1, 1, 1, name=name_prefix + "1x1"))
+    concat.add(nn.Sequential()
+               .add(_conv_bn(input_size, r3, 1, 1,
+                             name=name_prefix + "3x3_reduce"))
+               .add(_conv_bn(r3, c3, 3, 3, stride, stride, 1, 1,
+                             name=name_prefix + "3x3")))
+    concat.add(nn.Sequential()
+               .add(_conv_bn(input_size, rd, 1, 1,
+                             name=name_prefix + "double3x3_reduce"))
+               .add(_conv_bn(rd, cd, 3, 3, 1, 1, 1, 1,
+                             name=name_prefix + "double3x3a"))
+               .add(_conv_bn(cd, cd, 3, 3, stride, stride, 1, 1,
+                             name=name_prefix + "double3x3b")))
+    pool_seq = nn.Sequential()
+    if pool_kind == "max" or reduction:
+        pool_seq.add(nn.SpatialMaxPooling(3, 3, stride, stride,
+                                          0 if reduction else 1,
+                                          0 if reduction else 1).ceil())
+    else:
+        pool_seq.add(nn.SpatialAveragePooling(3, 3, stride, stride, 1, 1)
+                     .ceil())
+    if pp > 0:
+        pool_seq.add(_conv_bn(input_size, pp, 1, 1,
+                              name=name_prefix + "pool_proj"))
+    concat.add(pool_seq)
+    return concat
+
+
+def _v2_stem() -> nn.Sequential:
+    return (nn.Sequential()
+            .add(_conv_bn(3, 64, 7, 7, 2, 2, 3, 3, "conv1/7x7_s2"))
+            .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+            .add(_conv_bn(64, 64, 1, 1, name="conv2/3x3_reduce"))
+            .add(_conv_bn(64, 192, 3, 3, 1, 1, 1, 1, "conv2/3x3"))
+            .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+            .add(Inception_Layer_v2(192, [[64], [64, 64], [64, 96],
+                                          ["avg", 32]], "inception_3a/"))
+            .add(Inception_Layer_v2(256, [[64], [64, 96], [64, 96],
+                                          ["avg", 64]], "inception_3b/"))
+            .add(Inception_Layer_v2(320, [[0], [128, 160], [64, 96],
+                                          ["max", 0]], "inception_3c/"))
+            .add(Inception_Layer_v2(576, [[224], [64, 96], [96, 128],
+                                          ["avg", 128]], "inception_4a/")))
+
+
+def _v2_mid() -> nn.Sequential:
+    return (nn.Sequential()
+            .add(Inception_Layer_v2(576, [[192], [96, 128], [96, 128],
+                                          ["avg", 128]], "inception_4b/"))
+            .add(Inception_Layer_v2(576, [[160], [128, 160], [128, 160],
+                                          ["avg", 96]], "inception_4c/"))
+            .add(Inception_Layer_v2(576, [[96], [128, 192], [160, 192],
+                                          ["avg", 96]], "inception_4d/")))
+
+
+def _v2_tail(class_num: int) -> nn.Sequential:
+    return (nn.Sequential()
+            .add(Inception_Layer_v2(576, [[0], [128, 192], [192, 256],
+                                          ["max", 0]], "inception_4e/"))
+            .add(Inception_Layer_v2(1024, [[352], [192, 320], [160, 224],
+                                           ["avg", 128]], "inception_5a/"))
+            .add(Inception_Layer_v2(1024, [[352], [192, 320], [192, 224],
+                                           ["max", 128]], "inception_5b/"))
+            .add(nn.SpatialAveragePooling(7, 7, 1, 1))
+            .add(nn.View([1024]))
+            .add(nn.Linear(1024, class_num).set_name("loss3/classifier"))
+            .add(nn.LogSoftMax()))
+
+
+def Inception_v2_NoAuxClassifier(class_num: int = 1000) -> nn.Sequential:
+    return _flatten(_v2_stem(), _v2_mid(), _v2_tail(class_num))
+
+
+def Inception_v2(class_num: int = 1000) -> nn.Graph:
+    """BN-Inception with two aux heads (after 4a and 4d, mirroring the v1
+    head placement); outputs T(main, aux1, aux2) for ParallelCriterion."""
+    inp = nn.Input()
+    feat4a = _v2_stem().inputs(inp)
+    aux1 = _aux_head(576, class_num, "loss1/", use_bn=True).inputs(feat4a)
+    feat4d = _v2_mid().inputs(feat4a)
+    aux2 = _aux_head(576, class_num, "loss2/", use_bn=True).inputs(feat4d)
+    main = _v2_tail(class_num).inputs(feat4d)
+    return nn.Graph(inp, [main, aux1, aux2])

@@ -1,0 +1,90 @@
+"""Inception-v1/v2 ImageNet training main (reference parity: ``<dl>/models/inception/
+TrainInceptionV1.scala`` — unverified, SURVEY.md §2.5; baseline config #3). With aux heads
+the loss is ``ParallelCriterion`` (main ×1.0, aux ×0.3) with the target repeated, matching
+the reference. No ImageNet on disk here → synthetic fallback keeps the main runnable.
+``python -m bigdl_tpu.models.inception.train``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Inception-v1/v2 training")
+    p.add_argument("-f", "--folder", default=None)
+    p.add_argument("-b", "--batch-size", type=int, default=32)
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--no-aux", action="store_true", help="NoAuxClassifier variant")
+    p.add_argument("--v2", action="store_true", help="BN-Inception (Inception_v2)")
+    p.add_argument("--max-iteration", type=int, default=4)
+    p.add_argument("--learning-rate", type=float, default=0.01)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--distributed", action="store_true")
+    p.add_argument("--synthetic-size", type=int, default=64)
+    p.add_argument("--image-size", type=int, default=224)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models.inception import (
+        Inception_v1, Inception_v1_NoAuxClassifier, Inception_v2,
+        Inception_v2_NoAuxClassifier,
+    )
+    from bigdl_tpu.optim import DistriOptimizer, LocalOptimizer, SGD, Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    if not Engine.is_initialized():
+        Engine.init()
+
+    if args.folder is not None:
+        # on-disk ImageNet-layout folder through the streaming pipeline
+        from bigdl_tpu.models.imagenet_data import imagenet_sets
+        train_set, _ = imagenet_sets(
+            args.folder, args.batch_size, crop=args.image_size,
+            distributed=args.distributed)
+    else:
+        # fast in-memory synthetic set (clustered blobs so loss visibly drops)
+        rng = np.random.default_rng(0)
+        n_cls = min(args.classes, 10)
+        protos = np.random.default_rng(7).normal(
+            0, 1, size=(n_cls, 3, args.image_size, args.image_size)).astype(np.float32)
+        labels = rng.integers(0, n_cls, size=args.synthetic_size)
+        imgs = (protos[labels]
+                + rng.normal(0, 0.5, size=(args.synthetic_size, 3, args.image_size,
+                                           args.image_size)).astype(np.float32))
+        samples = [Sample(x, y) for x, y in zip(imgs, labels.astype(np.int32))]
+        train_set = (DataSet.array(samples, distributed=args.distributed)
+                     >> SampleToMiniBatch(args.batch_size))
+
+    if args.no_aux:
+        model = (Inception_v2_NoAuxClassifier(args.classes) if args.v2
+                 else Inception_v1_NoAuxClassifier(args.classes))
+        criterion = nn.ClassNLLCriterion()
+    else:
+        model = (Inception_v2(args.classes) if args.v2
+                 else Inception_v1(args.classes))
+        criterion = (nn.ParallelCriterion(repeat_target=True)
+                     .add(nn.ClassNLLCriterion(), 1.0)
+                     .add(nn.ClassNLLCriterion(), 0.3)
+                     .add(nn.ClassNLLCriterion(), 0.3))
+    cls = DistriOptimizer if args.distributed else LocalOptimizer
+    optimizer = (cls(model, train_set, criterion)
+                 .set_optim_method(SGD(learningrate=args.learning_rate,
+                                       momentum=args.momentum,
+                                       weightdecay=args.weight_decay, dampening=0.0))
+                 .set_end_when(Trigger.max_iteration(args.max_iteration)))
+    trained = optimizer.optimize()
+    print(f"final loss: {optimizer.state['loss']:.4f}")
+    return trained
+
+
+if __name__ == "__main__":
+    main()

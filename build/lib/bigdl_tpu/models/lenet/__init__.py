@@ -1,0 +1,1 @@
+from bigdl_tpu.models.lenet.lenet5 import LeNet5

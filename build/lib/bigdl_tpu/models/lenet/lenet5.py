@@ -1,0 +1,21 @@
+"""LeNet-5 (reference parity: ``<dl>/models/lenet/LeNet5.scala`` — unverified, SURVEY.md
+§2.5): conv(1→6,5x5) → tanh → maxpool → conv(6→12,5x5) → tanh → maxpool → fc(100) → tanh
+→ fc(classNum) → logsoftmax. Baseline config #1 (BASELINE.md)."""
+
+from bigdl_tpu import nn
+
+
+def LeNet5(class_num: int = 10) -> nn.Sequential:
+    return (nn.Sequential()
+            .add(nn.Reshape([1, 28, 28]))
+            .add(nn.SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5"))
+            .add(nn.Tanh())
+            .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+            .add(nn.SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5"))
+            .add(nn.Tanh())
+            .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+            .add(nn.Reshape([12 * 4 * 4]))
+            .add(nn.Linear(12 * 4 * 4, 100).set_name("fc_1"))
+            .add(nn.Tanh())
+            .add(nn.Linear(100, class_num).set_name("fc_2"))
+            .add(nn.LogSoftMax()))

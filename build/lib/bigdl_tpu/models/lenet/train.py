@@ -1,0 +1,81 @@
+"""LeNet-5 MNIST training main (reference parity: ``<dl>/models/lenet/Train.scala`` with
+its scopt options — unverified, SURVEY.md §2.5). ``python -m bigdl_tpu.models.lenet.train``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="LeNet-5 on MNIST")
+    p.add_argument("-f", "--folder", default=None, help="MNIST data dir (idx files)")
+    p.add_argument("-b", "--batch-size", type=int, default=128)
+    p.add_argument("--learning-rate", type=float, default=0.05)
+    p.add_argument("--learning-rate-decay", type=float, default=0.0)
+    p.add_argument("--max-epoch", type=int, default=1)
+    p.add_argument("--checkpoint", default=None, help="checkpoint dir")
+    p.add_argument("--overwrite-checkpoint", action="store_true")
+    p.add_argument("--model-snapshot", default=None, help="resume model snapshot")
+    p.add_argument("--state-snapshot", default=None, help="resume optim state snapshot")
+    p.add_argument("--summary-dir", default=None, help="TensorBoard summary dir")
+    p.add_argument("--distributed", action="store_true",
+                   help="train with DistriOptimizer over the device mesh")
+    p.add_argument("--synthetic-size", type=int, default=2048,
+                   help="synthetic fallback dataset size when no data folder")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.mnist import load_mnist, to_samples
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.optim import (
+        DistriOptimizer, LocalOptimizer, SGD, Top1Accuracy, Trigger,
+    )
+    from bigdl_tpu.utils.engine import Engine
+
+    if not Engine.is_initialized():
+        Engine.init()
+
+    train = to_samples(*load_mnist(args.folder, "train",
+                                   synthetic_size=args.synthetic_size))
+    test = to_samples(*load_mnist(args.folder, "test",
+                                  synthetic_size=max(args.synthetic_size // 4, 256)))
+    train_set = (DataSet.array(train, distributed=args.distributed)
+                 >> SampleToMiniBatch(args.batch_size))
+    test_set = (DataSet.array(test, distributed=args.distributed)
+                >> SampleToMiniBatch(args.batch_size))
+
+    if args.model_snapshot:
+        model = nn.AbstractModule.load(args.model_snapshot)
+    else:
+        model = LeNet5(10)
+    if args.state_snapshot:
+        from bigdl_tpu.utils import file as _file
+        method = _file.load(args.state_snapshot)
+    else:
+        method = SGD(learningrate=args.learning_rate,
+                     learningrate_decay=args.learning_rate_decay)
+    cls = DistriOptimizer if args.distributed else LocalOptimizer
+    optimizer = (cls(model, train_set, nn.ClassNLLCriterion())
+                 .set_optim_method(method)
+                 .set_end_when(Trigger.max_epoch(args.max_epoch))
+                 .set_validation(Trigger.every_epoch(), test_set, [Top1Accuracy()]))
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+        optimizer.over_write_checkpoint(args.overwrite_checkpoint)
+    if args.summary_dir:
+        from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+        optimizer.set_train_summary(TrainSummary(args.summary_dir, "lenet"))
+        optimizer.set_val_summary(ValidationSummary(args.summary_dir, "lenet"))
+    trained = optimizer.optimize()
+    print(f"final loss: {optimizer.state['loss']:.4f}")
+    return trained
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Model-import example; see main.py."""

@@ -1,0 +1,73 @@
+"""Model-import example main (reference parity: upstream ``example/loadmodel``
+— unverified, SURVEY.md §2.5): load a TF frozen graph (``--tf model.pb``), a
+Caffe pair (``--caffe deploy.prototxt weights.caffemodel``), or a native
+portable file (``--bigdl model.bigdl``), then run inference on synthetic (or
+``.npy``) input and print the top predictions.
+
+``python -m bigdl_tpu.models.loadmodel.main --tf model.pb --input-shape 1,3,224,224``
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Load an external model and predict")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--tf", help="TF frozen GraphDef (.pb)")
+    src.add_argument("--caffe", nargs=2,
+                     metavar=("PROTOTXT", "CAFFEMODEL"),
+                     help="Caffe structure + weights")
+    src.add_argument("--bigdl", help="portable native model (.bigdl)")
+    p.add_argument("--tf-output", default="output",
+                   help="TF output node name")
+    p.add_argument("--tf-input", default=None, help="TF input node name")
+    p.add_argument("--input-shape", required=True,
+                   help="comma-separated input shape incl. batch "
+                        "(NHWC for TF models, NCHW for Caffe/native)")
+    p.add_argument("--input-npy", default=None,
+                   help=".npy file to feed instead of synthetic data")
+    p.add_argument("--top", type=int, default=5)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init()
+    if args.tf:
+        from bigdl_tpu.utils.tf import load_frozen_graph
+        model = load_frozen_graph(
+            args.tf, outputs=[args.tf_output],
+            inputs=[args.tf_input] if args.tf_input else None)
+    elif args.caffe:
+        from bigdl_tpu.utils.caffe import load_caffe
+        model = load_caffe(args.caffe[0], args.caffe[1])
+    else:
+        model = nn.AbstractModule.load(args.bigdl)
+
+    shape = tuple(int(s) for s in args.input_shape.split(","))
+    if args.input_npy:
+        x = np.load(args.input_npy).astype(np.float32).reshape(shape)
+    else:
+        x = np.random.default_rng(0).normal(size=shape).astype(np.float32)
+
+    out = np.asarray(model.evaluate().forward(jnp.asarray(x)))
+    scores = out.reshape(out.shape[0], -1)
+    top = np.argsort(-scores, axis=1)[:, : args.top]
+    for i, row in enumerate(top):
+        pretty = ", ".join(f"{c}:{scores[i, c]:.4f}" for c in row)
+        print(f"sample {i}: top{args.top} -> {pretty}")
+    return scores
+
+
+if __name__ == "__main__":
+    main()

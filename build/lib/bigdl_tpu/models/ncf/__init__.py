@@ -1,0 +1,3 @@
+from bigdl_tpu.models.ncf.ncf import NeuralCF
+
+__all__ = ["NeuralCF"]

@@ -1,0 +1,57 @@
+"""Neural Collaborative Filtering (NeuMF) — the recommendation-example model.
+
+Reference parity (SURVEY.md §2.5 Examples, expected upstream
+``<dl>/example/recommendation/NeuralCFexample.scala`` + ``NeuralCF`` model —
+unverified, mount empty): GMF branch (elementwise product of user/item
+embeddings) + MLP branch (concatenated embeddings through a ReLU tower), fused
+by a final affine layer into class scores.
+
+TPU-native: the whole model is one ``nn.Graph`` — embeddings are gathers, both
+branches and the fusion compile into a single XLA program; batched (user, item)
+id pairs arrive as one (N, 2) int32 tensor, so the input pipeline ships one
+array per batch instead of a table of columns.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def NeuralCF(user_count: int, item_count: int, class_num: int = 2,
+             user_embed: int = 16, item_embed: int = 16,
+             hidden_layers: tuple[int, ...] = (32, 16, 8),
+             mf_embed: int = 8, hash_buckets: int = 0) -> nn.Graph:
+    """Build NeuMF. ``hash_buckets > 0`` switches both id spaces to the hashing
+    trick (``HashBucketEmbedding``) so unbounded ids need no dictionary.
+
+    Input: (N, 2) int32 of 1-based (user, item) ids — or raw ids when hashing.
+    Output: (N, class_num) log-probabilities.
+    """
+    def make_embed(count: int, dim: int):
+        if hash_buckets > 0:
+            return nn.HashBucketEmbedding(hash_buckets, dim)
+        return nn.LookupTable(count, dim)
+
+    inp = nn.Input()
+    user = nn.Select(2, 1).inputs(inp)   # (N,) user ids
+    item = nn.Select(2, 2).inputs(inp)   # (N,) item ids
+
+    # GMF branch: elementwise product in the latent space
+    mf_user = make_embed(user_count, mf_embed).inputs(user)
+    mf_item = make_embed(item_count, mf_embed).inputs(item)
+    gmf = nn.CMulTable().inputs(mf_user, mf_item)
+
+    # MLP branch: concat embeddings → ReLU tower
+    mlp_user = make_embed(user_count, user_embed).inputs(user)
+    mlp_item = make_embed(item_count, item_embed).inputs(item)
+    x = nn.JoinTable(2).inputs(mlp_user, mlp_item)
+    in_dim = user_embed + item_embed
+    for width in hidden_layers:
+        x = nn.Linear(in_dim, width).inputs(x)
+        x = nn.ReLU().inputs(x)
+        in_dim = width
+
+    merged = nn.JoinTable(2).inputs(gmf, x)
+    out = nn.Linear(mf_embed + in_dim, class_num).inputs(merged)
+    out = nn.LogSoftMax().inputs(out)
+    return nn.Graph(inp, out)

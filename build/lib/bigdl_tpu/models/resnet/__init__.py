@@ -1,0 +1,3 @@
+from bigdl_tpu.models.resnet.resnet import (
+    ResNet, ResNet50, basic_block, bottleneck, conv_bn,
+)

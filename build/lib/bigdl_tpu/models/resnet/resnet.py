@@ -1,0 +1,163 @@
+"""ResNet model family — CIFAR-10 and ImageNet variants.
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/models/resnet/ResNet.scala`` —
+unverified, mount empty): the reference builder takes ``(classNum, T(opts))`` with
+``depth`` (20/32/44/56/110 CIFAR = 6n+2 basic blocks; 18/34/50/101/152 ImageNet),
+``shortcutType`` ("A" zero-padded identity, "B" projection on shape change, "C" projection
+always), ``dataSet`` (CIFAR-10 | ImageNet), and ``optnet`` (memory-optimised variant —
+irrelevant on TPU: XLA owns buffer reuse). Blocks are basicBlock (2×3x3) or bottleneck
+(1x1→3x3→1x1, expansion 4); weights use MSRA (He) init; final-block BN gammas may be
+zero-initialised for large-batch convergence.
+
+TPU-native design notes: shortcut join is ``ConcatTable`` → ``CAddTable`` (a pure add XLA
+fuses into the preceding conv epilogue); shortcut type A's zero-pad + stride is a
+``lax``-friendly pad/slice with no custom kernel; global average pool is a mean reduce.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.nn.initialization import MsraFiller, Zeros
+from bigdl_tpu.utils.table import Table
+
+
+class _ShortcutA(TensorModule):
+    """Type-A shortcut: stride-subsample spatially, zero-pad extra channels (no params)."""
+
+    def __init__(self, n_in: int, n_out: int, stride: int):
+        super().__init__()
+        self.n_in, self.n_out, self.stride = n_in, n_out, stride
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        if self.stride != 1:
+            x = x[:, :, ::self.stride, ::self.stride]
+        if self.n_out > self.n_in:
+            pad = self.n_out - self.n_in
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        return x, state
+
+
+def conv_bn(n_in: int, n_out: int, k: int, stride: int = 1, pad: int = 0,
+            relu: bool = True, zero_bn_gamma: bool = False) -> nn.Sequential:
+    """conv (MSRA init, no bias — BN supplies the shift) → BN → optional ReLU."""
+    seq = (nn.Sequential()
+           .add(nn.SpatialConvolution(n_in, n_out, k, k, stride, stride, pad, pad,
+                                      with_bias=False, w_init=MsraFiller()))
+           .add(nn.SpatialBatchNormalization(
+               n_out, init_weight=Zeros() if zero_bn_gamma else None)))
+    if relu:
+        seq.add(nn.ReLU())
+    return seq
+
+
+def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str) -> nn.AbstractModule:
+    use_conv = (shortcut_type == "C"
+                or (shortcut_type == "B" and (n_in != n_out or stride != 1)))
+    if use_conv:
+        return (nn.Sequential()
+                .add(nn.SpatialConvolution(n_in, n_out, 1, 1, stride, stride,
+                                           with_bias=False, w_init=MsraFiller()))
+                .add(nn.SpatialBatchNormalization(n_out)))
+    if n_in != n_out or stride != 1:
+        return _ShortcutA(n_in, n_out, stride)
+    return nn.Identity()
+
+
+def basic_block(n_in: int, n_out: int, stride: int, shortcut_type: str,
+                zero_init_residual: bool = False) -> nn.Sequential:
+    """Two 3x3 convs + shortcut (ResNet-18/34 and all CIFAR depths)."""
+    branch = (nn.Sequential()
+              .add(conv_bn(n_in, n_out, 3, stride, 1))
+              .add(conv_bn(n_out, n_out, 3, 1, 1, relu=False,
+                           zero_bn_gamma=zero_init_residual)))
+    return (nn.Sequential()
+            .add(nn.ConcatTable().add(branch).add(_shortcut(n_in, n_out, stride,
+                                                            shortcut_type)))
+            .add(nn.CAddTable())
+            .add(nn.ReLU()))
+
+
+def bottleneck(n_in: int, n_mid: int, stride: int, shortcut_type: str,
+               zero_init_residual: bool = False) -> nn.Sequential:
+    """1x1 → 3x3 → 1x1 with expansion 4 (ResNet-50/101/152)."""
+    n_out = n_mid * 4
+    branch = (nn.Sequential()
+              .add(conv_bn(n_in, n_mid, 1))
+              .add(conv_bn(n_mid, n_mid, 3, stride, 1))
+              .add(conv_bn(n_mid, n_out, 1, relu=False,
+                           zero_bn_gamma=zero_init_residual)))
+    return (nn.Sequential()
+            .add(nn.ConcatTable().add(branch).add(_shortcut(n_in, n_out, stride,
+                                                            shortcut_type)))
+            .add(nn.CAddTable())
+            .add(nn.ReLU()))
+
+
+class _GlobalAvgPool(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.mean(input, axis=(2, 3)), state
+
+
+# (depth -> (block kind, per-stage counts)) for ImageNet variants
+_IMAGENET_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def ResNet(class_num: int, opt: Table | dict | None = None) -> nn.Sequential:
+    """Builder mirroring the reference's ``ResNet(classNum, T(opts))``."""
+    opt = dict(opt.items()) if isinstance(opt, Table) else dict(opt or {})
+    depth = int(opt.get("depth", 18))
+    dataset = opt.get("dataSet", opt.get("dataset", "CIFAR-10"))
+    shortcut = opt.get("shortcutType", "B" if dataset == "ImageNet" else "A")
+    zero_init_residual = bool(opt.get("zeroInitResidual", False))
+
+    model = nn.Sequential()
+    if dataset == "ImageNet":
+        kind, counts = _IMAGENET_CFG[depth]
+        model.add(conv_bn(3, 64, 7, 2, 3))
+        model.add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+        n_in = 64
+        for stage, n_blocks in enumerate(counts):
+            n_mid = 64 * (2 ** stage)
+            for b in range(n_blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                if kind == "bottleneck":
+                    model.add(bottleneck(n_in, n_mid, stride, shortcut,
+                                         zero_init_residual))
+                    n_in = n_mid * 4
+                else:
+                    model.add(basic_block(n_in, n_mid, stride, shortcut,
+                                          zero_init_residual))
+                    n_in = n_mid
+        model.add(_GlobalAvgPool())
+        model.add(nn.Linear(n_in, class_num, w_init=MsraFiller()))
+    else:  # CIFAR-10: depth = 6n+2
+        assert (depth - 2) % 6 == 0, "CIFAR depth must be 6n+2"
+        n = (depth - 2) // 6
+        model.add(conv_bn(3, 16, 3, 1, 1))
+        n_in = 16
+        for stage, n_out in enumerate([16, 32, 64]):
+            for b in range(n):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                model.add(basic_block(n_in, n_out, stride, shortcut,
+                                      zero_init_residual))
+                n_in = n_out
+        model.add(_GlobalAvgPool())
+        model.add(nn.Linear(64, class_num, w_init=MsraFiller()))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def ResNet50(class_num: int = 1000, shortcut_type: str = "B") -> nn.Sequential:
+    """The flagship/benchmark model (BASELINE.md config #2)."""
+    return ResNet(class_num, {"depth": 50, "dataSet": "ImageNet",
+                              "shortcutType": shortcut_type})

@@ -1,0 +1,81 @@
+"""ResNet training main — CIFAR-10 (depth 20/32/...) or ImageNet (50/...) variants.
+
+Reference parity: ``<dl>/models/resnet/Train*.scala`` scopt options (depth, shortcutType,
+batchSize, nEpochs, learningRate, momentum, weightDecay, dataset, optnet — unverified,
+SURVEY.md §2.5). ``python -m bigdl_tpu.models.resnet.train``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="ResNet training")
+    p.add_argument("-f", "--folder", default=None, help="dataset dir")
+    p.add_argument("--dataset", default="CIFAR-10", choices=["CIFAR-10", "ImageNet"])
+    p.add_argument("--depth", type=int, default=20)
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--shortcut-type", default=None, choices=[None, "A", "B", "C"])
+    p.add_argument("-b", "--batch-size", type=int, default=128)
+    p.add_argument("--max-epoch", type=int, default=1)
+    p.add_argument("--learning-rate", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--nesterov", action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--summary-dir", default=None)
+    p.add_argument("--distributed", action="store_true")
+    p.add_argument("--synthetic-size", type=int, default=1024)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import cifar
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.optim import (
+        DistriOptimizer, LocalOptimizer, SGD, Top1Accuracy, Trigger,
+    )
+    from bigdl_tpu.utils.engine import Engine
+
+    if not Engine.is_initialized():
+        Engine.init()
+
+    if args.dataset == "ImageNet":
+        from bigdl_tpu.models.imagenet_data import imagenet_sets
+        train_set, test_set = imagenet_sets(
+            args.folder, args.batch_size, distributed=args.distributed,
+            synthetic_per_class=max(args.synthetic_size // 4, 8))
+    else:
+        train_set, test_set = cifar.train_val_sets(
+            args.folder, args.batch_size, distributed=args.distributed,
+            synthetic_size=args.synthetic_size)
+
+    opt = {"depth": args.depth, "dataSet": args.dataset}
+    if args.shortcut_type:
+        opt["shortcutType"] = args.shortcut_type
+    model = ResNet(args.classes, opt)
+    cls = DistriOptimizer if args.distributed else LocalOptimizer
+    optimizer = (cls(model, train_set, nn.ClassNLLCriterion())
+                 .set_optim_method(SGD(learningrate=args.learning_rate,
+                                       momentum=args.momentum,
+                                       weightdecay=args.weight_decay,
+                                       nesterov=args.nesterov, dampening=0.0))
+                 .set_end_when(Trigger.max_epoch(args.max_epoch))
+                 .set_validation(Trigger.every_epoch(), test_set, [Top1Accuracy()]))
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    if args.summary_dir:
+        from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+        optimizer.set_train_summary(TrainSummary(args.summary_dir, "resnet"))
+        optimizer.set_val_summary(ValidationSummary(args.summary_dir, "resnet"))
+    trained = optimizer.optimize()
+    print(f"final loss: {optimizer.state['loss']:.4f}")
+    return trained
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""RNN language models (PTB word-level LM + SimpleRNN).
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/models/rnn/`` and
+``<dl>/example/languagemodel/PTBModel.scala`` — unverified, mount empty): ``PTBModel`` is
+LookupTable(vocab→hidden) → numLayers stacked LSTMs → TimeDistributed(Linear(hidden→vocab))
+→ TimeDistributed(LogSoftMax), trained with ``TimeDistributedCriterion(ClassNLLCriterion)``
+on bptt-length windows; ``SimpleRNN`` is the small tanh-RnnCell variant used by the text
+generation example. Baseline config #4 (BASELINE.md).
+
+TPU-native notes: each LSTM layer is a ``Recurrent`` container whose time loop is ONE
+``lax.scan`` (SURVEY.md §5.7 — the reference re-ran a Scala loop per step); stacking layers
+keeps everything inside a single jit so XLA pipelines the per-step 4H-gate matmuls on the
+MXU.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def PTBModel(input_size: int, hidden_size: int = 650, output_size: int | None = None,
+             num_layers: int = 2, dropout: float = 0.0,
+             key_proj: bool = False) -> nn.Sequential:
+    """Word-level PTB LSTM LM. ``input_size``/``output_size`` = vocabulary size."""
+    output_size = output_size if output_size is not None else input_size
+    model = (nn.Sequential()
+             .add(nn.LookupTable(input_size, hidden_size, zero_based=True)
+                  .set_name("embedding")))
+    for i in range(num_layers):
+        if dropout > 0:
+            model.add(nn.Dropout(dropout))
+        model.add(nn.Recurrent(nn.LSTM(hidden_size, hidden_size))
+                  .set_name(f"lstm{i + 1}"))
+    if dropout > 0:
+        model.add(nn.Dropout(dropout))
+    model.add(nn.TimeDistributed(nn.Linear(hidden_size, output_size))
+              .set_name("decoder"))
+    model.add(nn.TimeDistributed(nn.LogSoftMax()))
+    return model
+
+
+def SimpleRNN(input_size: int, hidden_size: int, output_size: int) -> nn.Sequential:
+    """Tanh-cell RNN LM (reference ``models/rnn/SimpleRNN``)."""
+    return (nn.Sequential()
+            .add(nn.LookupTable(input_size, hidden_size))
+            .add(nn.Recurrent(nn.RnnCell(hidden_size, hidden_size)))
+            .add(nn.TimeDistributed(nn.Linear(hidden_size, output_size)))
+            .add(nn.TimeDistributed(nn.LogSoftMax())))

@@ -1,0 +1,80 @@
+"""PTB LSTM language-model training main (reference parity:
+``<dl>/example/languagemodel/PTBWordLM.scala`` — unverified, SURVEY.md §2.5; baseline
+config #4). ``python -m bigdl_tpu.models.rnn.train``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="PTB LSTM LM training")
+    p.add_argument("-f", "--folder", default=None, help="dir with ptb.train.txt etc.")
+    p.add_argument("-b", "--batch-size", type=int, default=32)
+    p.add_argument("--vocab-size", type=int, default=10000)
+    p.add_argument("--hidden-size", type=int, default=256)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--bptt", type=int, default=20)
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--max-epoch", type=int, default=1)
+    p.add_argument("--learning-rate", type=float, default=1.0)
+    p.add_argument("--clip-norm", type=float, default=5.0)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--summary-dir", default=None)
+    p.add_argument("--distributed", action="store_true")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.dataset.text import load_ptb, ptb_windows
+    from bigdl_tpu.models.rnn import PTBModel
+    from bigdl_tpu.optim import DistriOptimizer, LocalOptimizer, Loss, SGD, Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    if not Engine.is_initialized():
+        Engine.init()
+
+    ids, dictionary = load_ptb(args.folder, "train", vocab_size=args.vocab_size)
+    vids, _ = load_ptb(args.folder, "valid", dictionary=dictionary)
+    vocab = dictionary.vocab_size()
+    xs, ys = ptb_windows(ids, args.bptt)
+    vxs, vys = ptb_windows(vids, args.bptt)
+    train_set = (DataSet.array([Sample(x, y) for x, y in zip(xs, ys)],
+                               distributed=args.distributed)
+                 >> SampleToMiniBatch(args.batch_size))
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                            size_average=True)
+    val_set = (DataSet.array([Sample(x, y) for x, y in zip(vxs, vys)],
+                             distributed=args.distributed)
+               >> SampleToMiniBatch(args.batch_size))
+
+    model = PTBModel(vocab, args.hidden_size, num_layers=args.num_layers,
+                     dropout=args.dropout)
+    cls = DistriOptimizer if args.distributed else LocalOptimizer
+    optimizer = (cls(model, train_set, criterion)
+                 .set_optim_method(SGD(learningrate=args.learning_rate))
+                 .set_end_when(Trigger.max_epoch(args.max_epoch))
+                 .set_validation(Trigger.every_epoch(), val_set, [Loss(criterion)]))
+    if args.clip_norm:
+        optimizer.set_gradient_clipping_by_l2_norm(args.clip_norm)
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    if args.summary_dir:
+        from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+        optimizer.set_train_summary(TrainSummary(args.summary_dir, "ptb"))
+        optimizer.set_val_summary(ValidationSummary(args.summary_dir, "ptb"))
+    trained = optimizer.optimize()
+    loss = optimizer.state["loss"]
+    print(f"final loss: {loss:.4f}  perplexity: {np.exp(min(loss, 20.0)):.2f}")
+    return trained
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from bigdl_tpu.models.textclassifier.textclassifier import TextClassifier
+
+__all__ = ["TextClassifier"]

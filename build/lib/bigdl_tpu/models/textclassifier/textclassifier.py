@@ -1,0 +1,33 @@
+"""Text-classification CNN — the textclassification example's model.
+
+Reference parity (SURVEY.md §2.5 Examples, expected upstream
+``<dl>/example/textclassification/TextClassifier.scala`` — unverified, mount
+empty): embedding (GloVe upstream; learned here) → temporal CNN blocks →
+global max over time → dense classifier.
+
+TPU-native: embedding gather + NWC temporal convs + reduce_window max compile
+into one XLA program; sequences are padded/truncated to a fixed length so jit
+sees one shape.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def TextClassifier(vocab_size: int, class_num: int, embed_dim: int = 64,
+                   seq_len: int = 64, conv_channels: int = 128,
+                   kernel_w: int = 5) -> nn.Sequential:
+    """Input: (N, seq_len) int32 token ids (0 = unk/pad, Dictionary convention,
+    hence zero-based lookup). Output: (N, class_num) log-probabilities."""
+    return (nn.Sequential()
+            .add(nn.LookupTable(vocab_size, embed_dim, zero_based=True))
+            .add(nn.TemporalConvolution(embed_dim, conv_channels, kernel_w))
+            .add(nn.ReLU())
+            .add(nn.TemporalMaxPooling(2))
+            .add(nn.TemporalConvolution(conv_channels, conv_channels, kernel_w))
+            .add(nn.ReLU())
+            .add(nn.TemporalMaxPooling(-1))   # global max over remaining time
+            .add(nn.Squeeze(2))
+            .add(nn.Linear(conv_channels, class_num))
+            .add(nn.LogSoftMax()))

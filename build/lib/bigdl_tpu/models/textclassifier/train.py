@@ -1,0 +1,101 @@
+"""Text-classification example main (reference parity: upstream
+``example/textclassification`` — unverified, SURVEY.md §2.5).
+
+``python -m bigdl_tpu.models.textclassifier.train`` — with no corpus on disk
+(no network), generates a synthetic topic-classification task: each class has
+its own keyword vocabulary mixed with shared filler words; sentences are
+tokenized through the text pipeline (SentenceTokenizer + Dictionary), padded to
+a fixed length, and classified by the temporal-CNN model.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Temporal-CNN text classification")
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--learning-rate", type=float, default=1e-3)
+    p.add_argument("--max-epoch", type=int, default=4)
+    p.add_argument("--sentences", type=int, default=2048)
+    p.add_argument("--classes", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--vocab-size", type=int, default=2000)
+    p.add_argument("--distributed", action="store_true")
+    return p
+
+
+def synthetic_corpus(n: int, classes: int, seed=0):
+    """Sentences of filler words + class-specific keywords (learnable topic)."""
+    rng = np.random.default_rng(seed)
+    filler = [f"word{i}" for i in range(200)]
+    keywords = [[f"topic{c}kw{i}" for i in range(20)] for c in range(classes)]
+    texts, labels = [], []
+    for _ in range(n):
+        c = int(rng.integers(0, classes))
+        length = int(rng.integers(8, 24))
+        words = [filler[rng.integers(0, len(filler))] for _ in range(length)]
+        for _ in range(max(2, length // 5)):
+            pos = int(rng.integers(0, len(words)))
+            words[pos] = keywords[c][rng.integers(0, 20)]
+        texts.append(" ".join(words))
+        labels.append(c)
+    return texts, np.asarray(labels, np.int32)
+
+
+def texts_to_samples(texts, labels, dictionary, seq_len):
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.dataset.text import SentenceTokenizer
+
+    tok = SentenceTokenizer()
+    samples = []
+    for text, y in zip(texts, labels):
+        ids = [dictionary.get_index(t) for t in next(tok(iter([text])))]
+        ids = ids[:seq_len] + [0] * max(0, seq_len - len(ids))
+        samples.append(Sample(np.asarray(ids, np.int32), np.int32(y)))
+    return samples
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.text import Dictionary, SentenceTokenizer
+    from bigdl_tpu.models.textclassifier import TextClassifier
+    from bigdl_tpu.optim import (
+        Adam, DistriOptimizer, LocalOptimizer, Top1Accuracy, Trigger,
+    )
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init()
+    texts, labels = synthetic_corpus(args.sentences, args.classes)
+    tok = SentenceTokenizer()
+    dictionary = Dictionary(
+        (t for text in texts for t in next(tok(iter([text])))),
+        vocab_size=args.vocab_size)
+    samples = texts_to_samples(texts, labels, dictionary, args.seq_len)
+    split = int(0.9 * len(samples))
+    train = DataSet.array(samples[:split], distributed=args.distributed) \
+        >> SampleToMiniBatch(args.batch_size)
+    test = DataSet.array(samples[split:]) >> SampleToMiniBatch(args.batch_size)
+
+    model = TextClassifier(dictionary.vocab_size(), args.classes,
+                           seq_len=args.seq_len)
+    cls = DistriOptimizer if args.distributed else LocalOptimizer
+    opt = (cls(model, train, nn.ClassNLLCriterion())
+           .set_optim_method(Adam(learningrate=args.learning_rate))
+           .set_end_when(Trigger.max_epoch(args.max_epoch))
+           .set_validation(Trigger.every_epoch(), test, [Top1Accuracy()]))
+    opt.log_every = 10
+    opt.optimize()
+    acc = opt.state["scores"]["Top1Accuracy"]
+    print(f"TextClassifier held-out Top1Accuracy: {acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

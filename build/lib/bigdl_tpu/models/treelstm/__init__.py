@@ -1,0 +1,1 @@
+"""TreeLSTM sentiment example package; see train.py for the main and model."""

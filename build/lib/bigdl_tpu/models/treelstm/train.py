@@ -1,0 +1,131 @@
+"""TreeLSTM sentiment example main (reference parity: upstream
+``example/treeLSTM`` sentiment training — unverified, SURVEY.md §2.5).
+
+``python -m bigdl_tpu.models.treelstm.train`` — synthetic sentiment task over
+random binary parse trees: leaf tokens carry positive/negative/neutral valence
+and the root label is the majority valence, so the tree recurrence has a real
+compositional signal. Evaluated with TreeNNAccuracy (root-node accuracy).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="BinaryTreeLSTM sentiment")
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--learning-rate", type=float, default=2e-3)
+    p.add_argument("--max-epoch", type=int, default=6)
+    p.add_argument("--trees", type=int, default=2048)
+    p.add_argument("--leaves", type=int, default=8, help="leaves per tree")
+    p.add_argument("--vocab-size", type=int, default=60)
+    p.add_argument("--embed-dim", type=int, default=32)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--distributed", action="store_true")
+    return p
+
+
+def random_tree(n_leaves: int, rng):
+    """Random binary tree; returns (children list root-first, leaf slots).
+    Node 0 is the root; children indices are strictly larger (the encoding
+    BinaryTreeLSTM scans)."""
+    # build bottom-up: start with leaf fragments, merge random pairs
+    nodes = []          # (left, right) per internal node, indices into `nodes`/leaves
+    frags = [("leaf", i) for i in range(n_leaves)]
+    while len(frags) > 1:
+        i = rng.integers(0, len(frags) - 1)
+        a, b = frags[i], frags[i + 1]
+        nodes.append((a, b))
+        frags[i: i + 2] = [("node", len(nodes) - 1)]
+    total = 2 * n_leaves - 1
+    children = np.full((total, 2), -1, np.int32)
+    leaf_slot = np.full(n_leaves, -1, np.int32)
+    counter = [0]
+    order: dict = {}
+
+    def assign(ref):  # root-first DFS numbering
+        kind, idx = ref
+        my = counter[0]
+        counter[0] += 1
+        if kind == "leaf":
+            leaf_slot[idx] = my
+        else:
+            l, r = nodes[idx]
+            children[my] = (assign(l), assign(r))
+        return my
+
+    assign(frags[0])
+    return children, leaf_slot
+
+
+def synthetic_trees(n, n_leaves, vocab_size, seed=0):
+    """Tokens 1..v/3 positive, v/3..2v/3 negative, rest neutral; root label =
+    sign of (positives - negatives)."""
+    from bigdl_tpu.dataset.sample import Sample
+    rng = np.random.default_rng(seed)
+    third = vocab_size // 3
+    samples = []
+    total = 2 * n_leaves - 1
+    for _ in range(n):
+        children, leaf_slot = random_tree(n_leaves, rng)
+        tokens = rng.integers(0, vocab_size, size=n_leaves)
+        ids = np.zeros(total, np.int32)  # internal nodes embed token 0 (pad)
+        ids[leaf_slot] = tokens + 1      # reserve 0 for internal/pad
+        score = int((tokens < third).sum()) - int(((tokens >= third)
+                                                   & (tokens < 2 * third)).sum())
+        label = np.int32(1 if score > 0 else 0)
+        samples.append(Sample((ids, children), label))
+    return samples
+
+
+def build_model(vocab_size: int, embed_dim: int, hidden: int,
+                class_num: int = 2):
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.tree import BinaryTreeLSTM
+
+    inp = nn.Input()
+    ids = nn.SelectTable(1).inputs(inp)
+    children = nn.SelectTable(2).inputs(inp)
+    emb = nn.LookupTable(vocab_size + 1, embed_dim, zero_based=True).inputs(ids)
+    h = BinaryTreeLSTM(embed_dim, hidden).inputs(emb, children)
+    root = nn.Select(2, 1).inputs(h)        # node 0 = root
+    out = nn.Linear(hidden, class_num).inputs(root)
+    out = nn.LogSoftMax().inputs(out)
+    return nn.Graph(inp, out)
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.optim import (
+        Adam, DistriOptimizer, LocalOptimizer, TreeNNAccuracy, Trigger,
+    )
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init()
+    samples = synthetic_trees(args.trees, args.leaves, args.vocab_size)
+    split = int(0.9 * len(samples))
+    train = DataSet.array(samples[:split], distributed=args.distributed) \
+        >> SampleToMiniBatch(args.batch_size)
+    test = DataSet.array(samples[split:]) >> SampleToMiniBatch(args.batch_size)
+
+    model = build_model(args.vocab_size, args.embed_dim, args.hidden)
+    cls = DistriOptimizer if args.distributed else LocalOptimizer
+    opt = (cls(model, train, nn.ClassNLLCriterion())
+           .set_optim_method(Adam(learningrate=args.learning_rate))
+           .set_end_when(Trigger.max_epoch(args.max_epoch))
+           .set_validation(Trigger.every_epoch(), test, [TreeNNAccuracy()]))
+    opt.log_every = 10
+    opt.optimize()
+    acc = opt.state["scores"]["TreeNNAccuracy"]
+    print(f"TreeLSTM held-out TreeNNAccuracy (root): {acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from bigdl_tpu.models.vgg.vgg import Vgg_16, Vgg_19, VggForCifar10

@@ -1,0 +1,63 @@
+"""VGG-16/CIFAR-10 training main (reference parity: ``<dl>/models/vgg/Train.scala`` —
+unverified, SURVEY.md §2.5; baseline config #5). ``python -m bigdl_tpu.models.vgg.train``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="VggForCifar10 training")
+    p.add_argument("-f", "--folder", default=None)
+    p.add_argument("-b", "--batch-size", type=int, default=128)
+    p.add_argument("--max-epoch", type=int, default=1)
+    p.add_argument("--learning-rate", type=float, default=0.01)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=5e-4)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--summary-dir", default=None)
+    p.add_argument("--distributed", action="store_true")
+    p.add_argument("--synthetic-size", type=int, default=512)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import cifar
+    from bigdl_tpu.models.vgg import VggForCifar10
+    from bigdl_tpu.optim import (
+        DistriOptimizer, LocalOptimizer, SGD, Top1Accuracy, Trigger,
+    )
+    from bigdl_tpu.utils.engine import Engine
+
+    if not Engine.is_initialized():
+        Engine.init()
+
+    train_set, test_set = cifar.train_val_sets(
+        args.folder, args.batch_size, distributed=args.distributed,
+        synthetic_size=args.synthetic_size)
+
+    model = VggForCifar10(10)
+    cls = DistriOptimizer if args.distributed else LocalOptimizer
+    optimizer = (cls(model, train_set, nn.ClassNLLCriterion())
+                 .set_optim_method(SGD(learningrate=args.learning_rate,
+                                       momentum=args.momentum,
+                                       weightdecay=args.weight_decay, dampening=0.0))
+                 .set_end_when(Trigger.max_epoch(args.max_epoch))
+                 .set_validation(Trigger.every_epoch(), test_set, [Top1Accuracy()]))
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    if args.summary_dir:
+        from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+        optimizer.set_train_summary(TrainSummary(args.summary_dir, "vgg"))
+        optimizer.set_val_summary(ValidationSummary(args.summary_dir, "vgg"))
+    trained = optimizer.optimize()
+    print(f"final loss: {optimizer.state['loss']:.4f}")
+    return trained
+
+
+if __name__ == "__main__":
+    main()

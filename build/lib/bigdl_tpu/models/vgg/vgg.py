@@ -1,0 +1,82 @@
+"""VGG model family.
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/models/vgg/`` with ``VggForCifar10``,
+``Vgg_16``, ``Vgg_19`` — unverified, mount empty): ``VggForCifar10`` is the BN-augmented
+CIFAR VGG (conv3x3+BN+ReLU stacks, 5 maxpools, 512-wide classifier head with dropout);
+``Vgg_16``/``Vgg_19`` are the classic ImageNet configs D/E (no BN, 4096-wide FC head).
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def _conv_bn_relu(n_in: int, n_out: int) -> list:
+    return [nn.SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1),
+            nn.SpatialBatchNormalization(n_out, eps=1e-3),
+            nn.ReLU()]
+
+
+def VggForCifar10(class_num: int = 10, has_dropout: bool = True) -> nn.Sequential:
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M"]
+    model = nn.Sequential()
+    n_in = 3
+    for v in cfg:
+        if v == "M":
+            model.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+        else:
+            for layer in _conv_bn_relu(n_in, v):
+                model.add(layer)
+            n_in = v
+    model.add(nn.View([512]))
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(512, 512))
+    model.add(nn.BatchNormalization(512))
+    model.add(nn.ReLU())
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(512, class_num))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+_VGG_CFG = {
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _vgg_imagenet(depth: int, class_num: int, has_dropout: bool) -> nn.Sequential:
+    model = nn.Sequential()
+    n_in = 3
+    for v in _VGG_CFG[depth]:
+        if v == "M":
+            model.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+        else:
+            model.add(nn.SpatialConvolution(n_in, v, 3, 3, 1, 1, 1, 1))
+            model.add(nn.ReLU())
+            n_in = v
+    model.add(nn.View([512 * 7 * 7]))
+    model.add(nn.Linear(512 * 7 * 7, 4096))
+    model.add(nn.ReLU())
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, 4096))
+    model.add(nn.ReLU())
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, class_num))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def Vgg_16(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    return _vgg_imagenet(16, class_num, has_dropout)
+
+
+def Vgg_19(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    return _vgg_imagenet(19, class_num, has_dropout)

@@ -1,0 +1,3 @@
+from bigdl_tpu.models.widedeep.widedeep import WideAndDeep
+
+__all__ = ["WideAndDeep"]

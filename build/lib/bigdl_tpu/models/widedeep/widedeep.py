@@ -1,0 +1,46 @@
+"""Wide & Deep — the second recommendation-example model.
+
+Reference parity (SURVEY.md §2.5 Examples, expected upstream
+``<dl>/example/recommendation/WideAndDeep*`` — unverified, mount empty): a wide
+linear model over sparse one-hot/cross features joined with a deep MLP over
+embeddings + dense columns, summed into the output logits.
+
+TPU-native: the wide branch is :class:`SparseLinear` over padded id lists (the
+SparseTensor redesign — nn/sparse.py), the deep branch is bag-of-ids embeddings
+concatenated with dense features through a ReLU tower, and the whole model is
+one ``nn.Graph`` compiled into a single XLA program.
+
+Input: Table/tuple ``(wide_ids (N, Kw) int32 pad=-1, deep_ids (N, Kd) int32
+pad=-1, dense (N, D) float32)`` → (N, class_num) log-probabilities.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.sparse import SparseEmbeddingSum, SparseLinear
+
+
+def WideAndDeep(wide_features: int, deep_vocab: int, dense_dim: int,
+                class_num: int = 2, embed_dim: int = 16,
+                hidden_layers: tuple[int, ...] = (64, 32)) -> nn.Graph:
+    inp = nn.Input()
+    wide_ids = nn.SelectTable(1).inputs(inp)
+    deep_ids = nn.SelectTable(2).inputs(inp)
+    dense = nn.SelectTable(3).inputs(inp)
+
+    # wide: sparse linear straight to the logits
+    wide_out = SparseLinear(wide_features, class_num).inputs(wide_ids)
+
+    # deep: embedding bag + dense → MLP → logits
+    emb = SparseEmbeddingSum(deep_vocab, embed_dim, combiner="mean").inputs(deep_ids)
+    x = nn.JoinTable(2).inputs(emb, dense)
+    in_dim = embed_dim + dense_dim
+    for width in hidden_layers:
+        x = nn.Linear(in_dim, width).inputs(x)
+        x = nn.ReLU().inputs(x)
+        in_dim = width
+    deep_out = nn.Linear(in_dim, class_num).inputs(x)
+
+    out = nn.CAddTable().inputs(wide_out, deep_out)
+    out = nn.LogSoftMax().inputs(out)
+    return nn.Graph(inp, out)

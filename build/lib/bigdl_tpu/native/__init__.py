@@ -1,0 +1,124 @@
+"""Native runtime components (C++, ctypes-bound).
+
+Reference parity (SURVEY.md §2.4): the reference ships native code for its
+data-path hot spots (OpenCV JNI, MKL). The compute path here is XLA's problem;
+what remains host-side and hot is batch assembly in the prefetch producer —
+implemented in ``batchpack.cpp`` and called through ctypes so the GIL is
+released during the copy.
+
+The library is compiled on first use with the baked-in g++ (no pip/apt) and
+cached next to the source; every entry point degrades to numpy when the
+toolchain or compiled artifact is unavailable, gated by ``BIGDL_NATIVE``
+(default on).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+logger = logging.getLogger("bigdl_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "batchpack.cpp")
+_SO = os.path.join(_DIR, "_batchpack.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _enabled() -> bool:
+    return os.environ.get("BIGDL_NATIVE", "1") == "1"
+
+
+def _load():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or (os.path.getmtime(_SO)
+                                           < os.path.getmtime(_SRC)):
+                # pid-unique temp: concurrent first-use builds (multi-process
+                # tests) must not install each other's half-written output
+                tmp = f"{_SO}.{os.getpid()}.tmp"
+                cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                       "-pthread", _SRC, "-o", tmp]
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _SO)
+                logger.info("built native batchpack: %s", _SO)
+            lib = ctypes.CDLL(_SO)
+            lib.pack_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p]
+            lib.gather_rows.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
+            _lib = lib
+        except Exception as e:
+            logger.warning("native batchpack unavailable (%s); using numpy", e)
+            _lib_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _enabled() and _load() is not None
+
+
+def pack_batch(arrays) -> np.ndarray:
+    """Stack same-shaped arrays into a new contiguous batch (np.stack analog).
+    The copy runs in C++ with the GIL released."""
+    first = np.asarray(arrays[0])
+    n = len(arrays)
+    lib = _load() if _enabled() else None
+    if lib is None or n < 2:
+        return np.stack([np.asarray(a) for a in arrays])
+    # NB: np.ascontiguousarray promotes 0-d to 1-d — only call it when needed
+    if first.dtype.hasobject:
+        # raw memcpy of PyObject* slots would skip refcounting → corruption
+        # (hasobject also catches structured dtypes with embedded object fields)
+        return np.stack([np.asarray(a) for a in arrays])
+    mats = [m if m.flags.c_contiguous else np.ascontiguousarray(m)
+            for m in (np.asarray(a) for a in arrays)]
+    for m in mats:
+        if m.shape != first.shape or m.dtype != first.dtype:
+            return np.stack(mats)  # ragged → numpy's error/handling path
+    out = np.empty((n,) + first.shape, first.dtype)
+    ptrs = (ctypes.c_void_p * n)(*[m.ctypes.data for m in mats])
+    lib.pack_batch(ptrs, n, first.nbytes, out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """dst[i] = src[idx[i]] over leading-axis rows (fancy-index analog)."""
+    src = np.asarray(src)
+    if src.dtype.hasobject:
+        idx = np.asarray(idx)
+        if len(idx) and (idx.min() < 0 or idx.max() >= len(src)):
+            raise IndexError(f"gather_rows: index out of range [0, {len(src)})")
+        return src[idx]
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, np.int64)
+    # bounds policy is identical on both paths: negatives rejected (numpy's
+    # wrap-around would make behavior depend on lib availability)
+    if len(idx) and (idx.min() < 0 or idx.max() >= len(src)):
+        raise IndexError(f"gather_rows: index out of range [0, {len(src)})")
+    lib = _load() if _enabled() else None
+    if lib is None:
+        return src[idx]
+    row_bytes = src[0].nbytes if len(src) else 0
+    out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    if len(idx) == 0 or row_bytes == 0:
+        return out
+    lib.gather_rows(src.ctypes.data_as(ctypes.c_void_p),
+                    idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    len(idx), row_bytes, out.ctypes.data_as(ctypes.c_void_p))
+    return out

@@ -1,0 +1,77 @@
+"""Torch-style layer library (flat namespace, mirroring the reference's ``<dl>/nn/``)."""
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, Container, TensorModule
+from bigdl_tpu.nn.attention import MultiHeadAttention
+from bigdl_tpu.nn.activation import (
+    Abs, AddConstant, BinaryThreshold, Clamp, ELU, Exp, GELU, HardSigmoid, HardTanh,
+    LeakyReLU, Log, LogSigmoid, LogSoftMax, MulConstant, Power, PReLU, ReLU, ReLU6,
+    Sigmoid, SoftMax, SoftMin, SoftPlus, SoftSign, Sqrt, Square, Swish, Tanh,
+    TanhShrink,
+)
+from bigdl_tpu.nn.containers import (
+    BifurcateSplitTable, Bottle, CAddTable, CAveTable, CDivTable, CMaxTable, CMinTable,
+    CMulTable, CSubTable, Concat, ConcatTable, Echo, FlattenTable, Identity, JoinTable,
+    MapTable, MaskedSelect, MixtureTable, NarrowTable, Pack, ParallelTable,
+    SelectTable, Sequential,
+)
+from bigdl_tpu.nn.misc import (
+    Bilinear, DotProduct, Euclidean, GaussianSampler, GradientReversal, HardShrink,
+    Highway, L1Penalty, Max, Maxout, Mean, Min, MM, MV, Negative, PairwiseDistance,
+    RReLU, ResizeBilinear, Scale, SoftShrink, SpatialUpSamplingBilinear,
+    SpatialUpSamplingNearest, Sum, Threshold, UpSampling1D, UpSampling2D,
+    UpSampling3D, Cropping2D, Cropping3D,
+)
+from bigdl_tpu.nn.cosine import Cosine, CosineDistance
+from bigdl_tpu.nn.convolution import (
+    LocallyConnected1D, LocallyConnected2D, SpatialConvolution,
+    SpatialDilatedConvolution, SpatialFullConvolution, SpatialShareConvolution,
+    TemporalConvolution,
+)
+from bigdl_tpu.nn.embedding import HashBucketEmbedding, LookupTable
+from bigdl_tpu.nn.graph import Graph, Input, ModuleNode, StaticGraph
+from bigdl_tpu.nn.normalization import (
+    Add, BatchNormalization, CAdd, CMul, Dropout, GaussianDropout, GaussianNoise,
+    LayerNorm, Mul, Normalize, SpatialBatchNormalization,
+    SpatialContrastiveNormalization, SpatialCrossMapLRN,
+    SpatialDivisiveNormalization, SpatialDropout1D, SpatialDropout2D,
+    SpatialDropout3D, SpatialSubtractiveNormalization, SpatialWithinChannelLRN,
+)
+from bigdl_tpu.nn.recurrent import (
+    BiRecurrent, Cell, ConvLSTMPeephole, GRU, LSTM, LSTMPeephole, Masking,
+    Recurrent, RecurrentDecoder, RnnCell, TimeDistributed,
+)
+from bigdl_tpu.nn.criterion import (
+    AbsCriterion, AbstractCriterion, BCECriterion, BCECriterionWithLogits,
+    ClassNLLCriterion, ClassSimplexCriterion, CosineDistanceCriterion,
+    CosineEmbeddingCriterion, CosineProximityCriterion, CrossEntropyCriterion,
+    DistKLDivCriterion, HingeEmbeddingCriterion, KullbackLeiblerDivergenceCriterion,
+    L1Cost, L1HingeEmbeddingCriterion, MarginCriterion, MarginRankingCriterion,
+    MeanAbsolutePercentageCriterion, MeanSquaredLogarithmicCriterion, MSECriterion,
+    MultiCriterion, MultiLabelMarginCriterion, MultiLabelSoftMarginCriterion,
+    MultiMarginCriterion, ParallelCriterion, PoissonCriterion, SmoothL1Criterion,
+    SoftMarginCriterion, TimeDistributedCriterion,
+    CategoricalCrossEntropy, DiceCoefficientCriterion, GaussianCriterion,
+    KLDCriterion, SmoothL1CriterionWithWeights, SoftmaxWithCriterion,
+    TimeDistributedMaskCriterion, TransformerCriterion,
+)
+from bigdl_tpu.nn.initialization import (
+    BilinearFiller, ConstInitMethod, InitializationMethod, MsraFiller, Ones,
+    RandomNormal, RandomUniform, Xavier, Zeros,
+)
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.quantized import QuantizedLinear, QuantizedSpatialConvolution
+from bigdl_tpu.nn.sparse import SparseEmbeddingSum, SparseLinear
+from bigdl_tpu.nn.roi import RoiPooling
+from bigdl_tpu.nn.tree import BinaryTreeLSTM
+from bigdl_tpu.nn.volumetric import (
+    VolumetricAveragePooling, VolumetricConvolution, VolumetricFullConvolution,
+    VolumetricMaxPooling,
+)
+from bigdl_tpu.nn.pooling import (
+    SpatialAveragePooling, SpatialMaxPooling, TemporalMaxPooling,
+)
+from bigdl_tpu.nn.shape_ops import (
+    Contiguous, Flatten, Index, InferReshape, Narrow, Padding, Replicate, Reshape,
+    Reverse, Select, SpatialZeroPadding, SplitTable, Squeeze, Tile, Transpose,
+    Unsqueeze, View,
+)

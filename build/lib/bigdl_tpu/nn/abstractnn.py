@@ -1,0 +1,443 @@
+"""Module system core — Torch-style API over a pure functional JAX core.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/abstractnn/AbstractModule.scala`` —
+unverified, mount empty): the reference ``AbstractModule[A, B, T]`` is a *mutable* module:
+``forward`` caches ``output``, ``backward`` = ``updateGradInput`` + ``accGradParameters``
+accumulating into per-module gradient buffers; ``parameters()`` exposes (weights, gradWeights);
+``training()/evaluate()`` flip mode; ``getTimes()`` exposes per-module timing.
+
+TPU-native design (SURVEY.md §7.1/§7.4): that mutable protocol cannot be the compute path on
+TPU — XLA wants one traced, pure program per training step. So every module is split in two:
+
+- **functional core** — ``apply(params, state, input, training=..., rng=...)`` is pure:
+  ``params`` is a pytree of trainable arrays, ``state`` a pytree of non-trainable buffers
+  (e.g. BatchNorm running stats); it returns ``(output, new_state)``. Composition (containers)
+  nests these pytrees by child index. The trainer (``LocalOptimizer``/``DistriOptimizer``)
+  compiles forward+loss+grad+update into ONE ``jit`` from this core; ``jax.value_and_grad``
+  replaces hand-written ``updateGradInput``/``accGradParameters`` everywhere.
+- **stateful facade** — the Torch-style methods users expect. ``forward`` runs the jitted core
+  with the module's currently-held params and caches ``output``; ``backward(input, grad_out)``
+  uses ``jax.vjp`` (recomputing forward — rematerialisation is the TPU-idiomatic trade) and
+  *accumulates* parameter gradients into module-held buffers for API parity.
+
+Params live on the module (created eagerly at construction, Torch semantics, via the global
+``RandomGenerator``); the trainer checks them out as a pytree, trains functionally, and writes
+them back.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.utils.table import Table
+
+Activity = Any  # jnp.ndarray | Table | tuple/list — anything pytree-shaped
+
+
+def _is_array(x) -> bool:
+    return isinstance(x, (jnp.ndarray, np.ndarray))
+
+
+class RecordsInit(type):
+    """Metaclass recording the constructor arguments of every instance as
+    ``_init_args = (args, kwargs)``. The portable serializer (utils/serializer.py)
+    rebuilds modules from these — a reflection-driven analog of the reference's
+    per-layer protobuf converters (SURVEY.md §2.5 Protobuf serializer)."""
+
+    def __call__(cls, *args, **kwargs):
+        obj = super().__call__(*args, **kwargs)
+        if "_init_args" not in obj.__dict__:
+            obj.__dict__["_init_args"] = (args, kwargs)
+        return obj
+
+
+class AbstractModule(metaclass=RecordsInit):
+    """Base class of all layers and containers."""
+
+    _instance_counter = 0
+
+    def __init__(self) -> None:
+        AbstractModule._instance_counter += 1
+        self.name: str = f"{type(self).__name__}{AbstractModule._instance_counter}"
+        self.output: Activity = None
+        self.grad_input: Activity = None
+        self._training: bool = True
+        self._params: dict[str, jnp.ndarray] = {}      # trainable leaves (leaf modules)
+        self._grads: dict[str, jnp.ndarray] = {}       # accumulated gradients, same keys
+        self._state: dict[str, jnp.ndarray] = {}       # non-trainable buffers
+        self._forward_time: float = 0.0
+        self._backward_time: float = 0.0
+        self._apply_cache: dict = {}
+        # scalar multipliers mirroring the reference's setScaleW/setScaleB
+        self.scale_w: float = 1.0
+        self.scale_b: float = 1.0
+
+    # ------------------------------------------------------------ functional
+    def apply(self, params: dict, state: dict, input: Activity, *,
+              training: bool = False, rng: Optional[jax.Array] = None):
+        """Pure forward. Override in subclasses. Returns ``(output, new_state)``."""
+        raise NotImplementedError
+
+    def needs_rng(self) -> bool:
+        """True if apply consumes randomness in training mode (e.g. Dropout)."""
+        return False
+
+    def has_state(self) -> bool:
+        return bool(self.get_state())
+
+    # params / state checkout-checkin -------------------------------------
+    def get_params(self) -> dict:
+        return dict(self._params)
+
+    def set_params(self, params: dict) -> None:
+        self._params = dict(params)
+
+    def get_state(self) -> dict:
+        return dict(self._state)
+
+    def set_state(self, state: dict) -> None:
+        self._state = dict(state)
+
+    def get_grads(self) -> dict:
+        return {k: self._grads.get(k, jnp.zeros_like(v)) for k, v in self._params.items()}
+
+    def set_grads(self, grads: dict) -> None:
+        self._grads = dict(grads)
+
+    # ------------------------------------------------------------- facade
+    def __call__(self, input: Activity) -> Activity:
+        return self.forward(input)
+
+    def forward(self, input: Activity) -> Activity:
+        t0 = time.perf_counter()
+        params, state = self.get_params(), self.get_state()
+        rng = None
+        if self._training and self.needs_rng():
+            from bigdl_tpu.utils.random_generator import RandomGenerator
+            rng = RandomGenerator.next_key()
+        out, new_state = self._jitted_apply()(params, state, input, self._training, rng)
+        if self._training:
+            self.set_state(new_state)
+        self.output = out
+        self._forward_time += time.perf_counter() - t0
+        return self.output
+
+    def _jitted_apply(self) -> Callable:
+        key = ("apply",)
+        if key not in self._apply_cache:
+            def run(params, state, input, training, rng):
+                return self.apply(params, state, input, training=training, rng=rng)
+            self._apply_cache[key] = jax.jit(run, static_argnums=(3,))
+        return self._apply_cache[key]
+
+    def backward(self, input: Activity, grad_output: Activity) -> Activity:
+        """updateGradInput + accGradParameters in one call (reference semantics)."""
+        t0 = time.perf_counter()
+        grad_input, grad_params = self._vjp(input, grad_output)
+        self._accumulate_grads(grad_params)
+        self.grad_input = grad_input
+        self._backward_time += time.perf_counter() - t0
+        return self.grad_input
+
+    def update_grad_input(self, input: Activity, grad_output: Activity) -> Activity:
+        grad_input, _ = self._vjp(input, grad_output)
+        self.grad_input = grad_input
+        return grad_input
+
+    def acc_grad_parameters(self, input: Activity, grad_output: Activity) -> None:
+        _, grad_params = self._vjp(input, grad_output)
+        self._accumulate_grads(grad_params)
+
+    def _vjp(self, input, grad_output):
+        key = ("vjp",)
+        if key not in self._apply_cache:
+            def run(params, state, input, grad_output, training, rng):
+                def f(p, x):
+                    out, _ = self.apply(p, state, x, training=training, rng=rng)
+                    return out
+                _, vjp_fn = jax.vjp(f, params, input)
+                gp, gi = vjp_fn(grad_output)
+                return gi, gp
+            self._apply_cache[key] = jax.jit(run, static_argnums=(4,))
+        rng = None
+        if self._training and self.needs_rng():
+            from bigdl_tpu.utils.random_generator import RandomGenerator
+            rng = RandomGenerator.next_key()
+        return self._apply_cache[key](
+            self.get_params(), self.get_state(), input, grad_output, self._training, rng)
+
+    def _accumulate_grads(self, grad_params: dict) -> None:
+        self._recursive_acc(self, grad_params)
+
+    @staticmethod
+    def _recursive_acc(module: "AbstractModule", grad_params: dict) -> None:
+        if isinstance(module, Container):
+            for name, child in module.named_children():
+                if name in grad_params:
+                    AbstractModule._recursive_acc(child, grad_params[name])
+        else:
+            for k, g in grad_params.items():
+                if k in module._grads:
+                    module._grads[k] = module._grads[k] + g
+                else:
+                    module._grads[k] = g
+
+    # --------------------------------------------------------------- mode
+    def training(self) -> "AbstractModule":
+        self._training = True
+        return self
+
+    def evaluate(self, dataset=None, methods=None, batch_size=None):
+        """No arguments: switch to eval mode (Torch parity). With a dataset and
+        ValidationMethods: run distributed evaluation and return
+        ``[(ValidationResult, method)]`` (reference ``model.evaluate(rdd, methods,
+        batchSize)`` overload)."""
+        self._training = False
+        if dataset is None:
+            return self
+        from bigdl_tpu.optim.evaluator import Evaluator
+        return Evaluator(self).test(dataset, methods, batch_size)
+
+    def predict(self, data, batch_size=None):
+        """Forward the model over samples/arrays/a DataSet; returns stacked outputs
+        (reference ``model.predict``)."""
+        from bigdl_tpu.optim.evaluator import Predictor
+        self._training = False
+        return Predictor(self).predict(data, batch_size)
+
+    def predict_class(self, data, batch_size=None):
+        """Argmax class index per sample (reference ``model.predictClass``; 0-based
+        here — this framework uses 0-based labels throughout, unlike the 1-based
+        Torch convention)."""
+        from bigdl_tpu.optim.evaluator import Predictor
+        self._training = False
+        return Predictor(self).predict_class(data, batch_size)
+
+    def is_training(self) -> bool:
+        return self._training
+
+    # ---------------------------------------------------------- parameters
+    def parameters(self):
+        """Return (weights, gradWeights) as two flat lists (reference ``parameters()``)."""
+        ws, gs = [], []
+        ptree, gtree = self.get_params(), self.get_grads_tree()
+        wleaves = jax.tree_util.tree_leaves(ptree)
+        gleaves = jax.tree_util.tree_leaves(gtree)
+        ws.extend(wleaves)
+        gs.extend(gleaves)
+        return ws, gs
+
+    def get_grads_tree(self) -> dict:
+        return self.get_grads()
+
+    def zero_grad_parameters(self) -> None:
+        self._grads = {k: jnp.zeros_like(v) for k, v in self._params.items()}
+
+    def n_parameters(self) -> int:
+        return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self.get_params()))
+
+    # ------------------------------------------------------------- timing
+    def get_times(self):
+        """[(module, forward_s, backward_s)] — reference ``getTimes`` parity.
+
+        Note: under async dispatch these are submission times; wrap with
+        ``jax.block_until_ready`` externally for wall-clock accuracy (SURVEY.md §5.1).
+        """
+        return [(self, self._forward_time, self._backward_time)]
+
+    def reset_times(self) -> None:
+        self._forward_time = 0.0
+        self._backward_time = 0.0
+
+    # -------------------------------------------------------------- quantize
+    def quantize(self) -> "AbstractModule":
+        """Return an int8-quantized copy for inference (reference
+        ``module.quantize()`` — SURVEY.md §2.1 Quantized layers): Linear /
+        SpatialConvolution become int8-weight modules running int8×int8→int32
+        contractions on the MXU with an fp32 dequant epilogue."""
+        from bigdl_tpu.nn.quantized import quantize_module
+        return quantize_module(self)
+
+    # -------------------------------------------------------------- graph
+    def inputs(self, *nodes):
+        """Torch-style node wiring: ``layer.inputs(nodeA, nodeB)`` returns a graph
+        ``ModuleNode`` wrapping this layer with the given predecessor nodes (reference
+        ``AbstractModule.inputs`` / ``Node`` wiring — SURVEY.md §2.1 Static graph)."""
+        from bigdl_tpu.nn.graph import make_node
+        return make_node(self, nodes)
+
+    # -------------------------------------------------------------- misc
+    def set_name(self, name: str) -> "AbstractModule":
+        self.name = name
+        return self
+
+    def get_name(self) -> str:
+        return self.name
+
+    def reset(self) -> None:
+        """Re-randomise parameters (reference ``reset()``). Overridden by leaf layers."""
+
+    def clear_state(self) -> "AbstractModule":
+        self.output = None
+        self.grad_input = None
+        return self
+
+    def clone(self) -> "AbstractModule":
+        import copy
+        cache, self._apply_cache = self._apply_cache, {}
+        try:
+            return copy.deepcopy(self)
+        finally:
+            self._apply_cache = cache
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}"
+
+    # serialization --------------------------------------------------------
+    # Two formats, mirroring the reference's split (SURVEY.md §2.5): ``save`` =
+    # in-version pickle (fast, Python-bound, like Java serialization);
+    # ``save_module`` = portable versioned archive (refactor- and
+    # version-tolerant, like the protobuf ``saveModule``). ``load`` sniffs.
+    def save(self, path: str, overwrite: bool = True) -> "AbstractModule":
+        """Persist this module via pickle — reference ``Module.save``."""
+        from bigdl_tpu.utils import file as _file
+        _file.save(self, path, overwrite=overwrite)
+        return self
+
+    def save_module(self, path: str, overwrite: bool = True) -> "AbstractModule":
+        """Persist in the portable versioned format — reference ``saveModule``."""
+        from bigdl_tpu.utils import serializer
+        serializer.save_module(self, path, overwrite=overwrite)
+        return self
+
+    @staticmethod
+    def load(path: str) -> "AbstractModule":
+        from bigdl_tpu.utils import file as _file
+        from bigdl_tpu.utils import serializer
+        if serializer.is_portable_file(path):
+            obj = serializer.load_module(path)
+        else:
+            obj = _file.load(path)
+        if not isinstance(obj, AbstractModule):
+            raise TypeError(f"{path} does not contain a module (got {type(obj)})")
+        return obj
+
+    load_module = load  # reference ``Module.loadModule`` alias
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_cached_fwd_jit", None)  # jitted closures don't pickle
+        d["_apply_cache"] = {}
+        d["_params"] = {k: np.asarray(v) for k, v in self._params.items()}
+        d["_grads"] = {k: np.asarray(v) for k, v in self._grads.items()}
+        d["_state"] = {k: np.asarray(v) for k, v in self._state.items()}
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+
+
+class TensorModule(AbstractModule):
+    """Module whose input and output are single tensors."""
+
+
+class Container(AbstractModule):
+    """Base for composite modules; nests child params/state pytrees by child index."""
+
+    def __init__(self, *modules: AbstractModule) -> None:
+        super().__init__()
+        self.modules: list[AbstractModule] = list(modules)
+
+    def add(self, module: AbstractModule) -> "Container":
+        self.modules.append(module)
+        self.__dict__.pop("_cached_fwd_jit", None)  # structure changed
+        return self
+
+    def __len__(self) -> int:
+        return len(self.modules)
+
+    def __getitem__(self, i: int) -> AbstractModule:
+        return self.modules[i]
+
+    def named_children(self):
+        return [(str(i), m) for i, m in enumerate(self.modules)]
+
+    # nested pytree checkout/checkin --------------------------------------
+    def get_params(self) -> dict:
+        return {name: m.get_params() for name, m in self.named_children()}
+
+    def set_params(self, params: dict) -> None:
+        for name, m in self.named_children():
+            if name in params:
+                m.set_params(params[name])
+
+    def get_state(self) -> dict:
+        return {name: m.get_state() for name, m in self.named_children()}
+
+    def set_state(self, state: dict) -> None:
+        for name, m in self.named_children():
+            if name in state:
+                m.set_state(state[name])
+
+    def get_grads(self) -> dict:
+        return {name: m.get_grads() for name, m in self.named_children()}
+
+    def get_grads_tree(self) -> dict:
+        return self.get_grads()
+
+    def zero_grad_parameters(self) -> None:
+        for m in self.modules:
+            m.zero_grad_parameters()
+
+    def needs_rng(self) -> bool:
+        return any(m.needs_rng() for m in self.modules)
+
+    def training(self) -> "Container":
+        super().training()
+        for m in self.modules:
+            m.training()
+        return self
+
+    def evaluate(self, dataset=None, methods=None, batch_size=None):
+        for m in self.modules:
+            m.evaluate()
+        return super().evaluate(dataset, methods, batch_size)
+
+    def reset(self) -> None:
+        for m in self.modules:
+            m.reset()
+
+    def get_times(self):
+        out = [(self, self._forward_time, self._backward_time)]
+        for m in self.modules:
+            out.extend(m.get_times())
+        return out
+
+    def reset_times(self) -> None:
+        super().reset_times()
+        for m in self.modules:
+            m.reset_times()
+
+    def find_module(self, name: str) -> Optional[AbstractModule]:
+        if self.name == name:
+            return self
+        for m in self.modules:
+            if m.name == name:
+                return m
+            if isinstance(m, Container):
+                found = m.find_module(name)
+                if found is not None:
+                    return found
+        return None
+
+
+def split_rng(rng: Optional[jax.Array], n: int):
+    """Split an optional rng into n optional keys."""
+    if rng is None:
+        return [None] * n
+    return list(jax.random.split(rng, n))

@@ -1,0 +1,227 @@
+"""Element-wise activation layers.
+
+Reference parity (SURVEY.md §2.1, expected one file per layer under ``<dl>/nn/`` —
+unverified): ReLU & friends, Tanh, Sigmoid, LogSoftMax/SoftMax, HardTanh, ELU, SoftPlus…
+TPU-native: plain jnp ops; XLA fuses them into the surrounding matmul/conv epilogues
+(the fusion the reference's mkldnn engine did by hand).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+
+
+class ReLU(TensorModule):
+    def __init__(self, ip: bool = False):  # ip = in-place, meaningless under XLA
+        super().__init__()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.relu(input), state
+
+
+class ReLU6(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.clip(input, 0.0, 6.0), state
+
+
+class Tanh(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.tanh(input), state
+
+
+class Sigmoid(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.sigmoid(input), state
+
+
+class HardTanh(TensorModule):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0):
+        super().__init__()
+        self.min_value, self.max_value = min_value, max_value
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.clip(input, self.min_value, self.max_value), state
+
+
+class HardSigmoid(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.clip(0.2 * input + 0.5, 0.0, 1.0), state
+
+
+class ELU(TensorModule):
+    def __init__(self, alpha: float = 1.0, inplace: bool = False):
+        super().__init__()
+        self.alpha = alpha
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.elu(input, self.alpha), state
+
+
+class SoftPlus(TensorModule):
+    def __init__(self, beta: float = 1.0):
+        super().__init__()
+        self.beta = beta
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.softplus(self.beta * input) / self.beta, state
+
+
+class SoftSign(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input / (1.0 + jnp.abs(input)), state
+
+
+class LeakyReLU(TensorModule):
+    def __init__(self, negval: float = 0.01, inplace: bool = False):
+        super().__init__()
+        self.negval = negval
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.leaky_relu(input, self.negval), state
+
+
+class PReLU(TensorModule):
+    """Learnable leaky slope; n_output_plane=0 → single shared parameter."""
+
+    def __init__(self, n_output_plane: int = 0):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+        self.reset()
+
+    def reset(self):
+        n = max(self.n_output_plane, 1)
+        self._params = {"weight": jnp.full((n,), 0.25, jnp.float32)}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        w = params["weight"]
+        if self.n_output_plane > 0 and input.ndim >= 3:
+            shape = [1] * input.ndim
+            shape[1] = self.n_output_plane  # channel axis of NCHW
+            w = w.reshape(shape)
+        return jnp.where(input > 0, input, w * input), state
+
+
+class GELU(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.gelu(input), state
+
+
+class Swish(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.silu(input), state
+
+
+class Exp(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.exp(input), state
+
+
+class Log(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.log(input), state
+
+
+class Sqrt(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.sqrt(input), state
+
+
+class Square(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.square(input), state
+
+
+class Abs(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.abs(input), state
+
+
+class Clamp(TensorModule):
+    def __init__(self, min_value: float, max_value: float):
+        super().__init__()
+        self.min_value, self.max_value = float(min_value), float(max_value)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.clip(input, self.min_value, self.max_value), state
+
+
+class Power(TensorModule):
+    """(shift + scale * x) ** power — reference ``Power``."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.power(self.shift + self.scale * input, self.power), state
+
+
+class MulConstant(TensorModule):
+    def __init__(self, constant: float, inplace: bool = False):
+        super().__init__()
+        self.constant = constant
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input * self.constant, state
+
+
+class AddConstant(TensorModule):
+    def __init__(self, constant: float, inplace: bool = False):
+        super().__init__()
+        self.constant = constant
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input + self.constant, state
+
+
+class LogSoftMax(TensorModule):
+    """Log-softmax over the last axis for (N, C) or 1-D input (reference semantics).
+
+    fp32 island (nn/precision.py): the exp/sum/log normalisation runs — and the
+    output STAYS — in fp32 even under a bf16 compute dtype, so criterions always
+    see full-precision log-probabilities. The upcast is free next to the loss.
+    """
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.log_softmax(input.astype(jnp.float32), axis=-1), state
+
+
+class SoftMax(TensorModule):
+    """fp32 island under mixed precision — see :class:`LogSoftMax`."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.softmax(input.astype(jnp.float32), axis=-1), state
+
+
+class SoftMin(TensorModule):
+    """fp32 island under mixed precision — see :class:`LogSoftMax`."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.softmax(-input.astype(jnp.float32), axis=-1), state
+
+
+class BinaryThreshold(TensorModule):
+    """1 where input > th else 0 (reference ``BinaryThreshold``)."""
+
+    def __init__(self, th: float = 1e-6, ip: bool = False):
+        super().__init__()
+        self.th = th
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return (input > self.th).astype(input.dtype), state
+
+
+class LogSigmoid(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.log_sigmoid(input), state
+
+
+class TanhShrink(TensorModule):
+    """x - tanh(x) (reference ``TanhShrink``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input - jnp.tanh(input), state

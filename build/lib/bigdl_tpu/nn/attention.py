@@ -1,0 +1,89 @@
+"""Attention layers — long-context first-class via ring attention.
+
+No reference counterpart (SURVEY.md §5.7: the reference predates attention layers);
+required capability of the TPU build. ``MultiHeadAttention`` projects with fused QKV,
+runs :func:`~bigdl_tpu.parallel.ring_attention` when the Engine mesh has a ``seq``
+axis (sequence sharded, K/V rotating over ICI) and plain fused attention otherwise —
+the same module scales from one chip to a sequence-parallel mesh unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.nn.initialization import InitializationMethod, Xavier
+
+
+class MultiHeadAttention(TensorModule):
+    """Self-attention over (batch, seq, embed) inputs.
+
+    ``attention_impl``: "auto" (ring iff the mesh has a >1 ``seq`` axis),
+    "ring", or "full".
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int, causal: bool = False,
+                 with_bias: bool = True, attention_impl: str = "auto",
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        if embed_dim % num_heads != 0:
+            raise ValueError(f"embed_dim {embed_dim} % num_heads {num_heads} != 0")
+        if attention_impl not in ("auto", "ring", "full"):
+            raise ValueError(f"attention_impl must be auto|ring|full, "
+                             f"got {attention_impl!r}")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.causal = causal
+        self.with_bias = with_bias
+        self.attention_impl = attention_impl
+        self.w_init = w_init or Xavier()
+        self.reset()
+
+    def reset(self) -> None:
+        e = self.embed_dim
+        self._params = {
+            "qkv_weight": jnp.asarray(
+                self.w_init.init((3 * e, e), fan_in=e, fan_out=3 * e)),
+            "out_weight": jnp.asarray(
+                self.w_init.init((e, e), fan_in=e, fan_out=e)),
+        }
+        if self.with_bias:
+            self._params["qkv_bias"] = jnp.zeros((3 * e,), jnp.float32)
+            self._params["out_bias"] = jnp.zeros((e,), jnp.float32)
+        self.zero_grad_parameters()
+
+    def _attend(self, q, k, v):
+        from bigdl_tpu.parallel.ring_attention import full_attention, ring_attention
+        if self.attention_impl == "full":
+            return full_attention(q, k, v, causal=self.causal)
+        from bigdl_tpu.utils.engine import Engine
+        mesh = Engine.mesh() if Engine.is_initialized() else None
+        if mesh is None or Engine.SEQ_AXIS not in mesh.axis_names:
+            if self.attention_impl == "ring":
+                raise RuntimeError(
+                    "attention_impl='ring' needs an Engine mesh with a "
+                    f"'{Engine.SEQ_AXIS}' axis")
+            return full_attention(q, k, v, causal=self.causal)
+        return ring_attention(q, k, v, mesh=mesh, seq_axis=Engine.SEQ_AXIS,
+                              causal=self.causal)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        b, t, e = input.shape
+        qkv = input @ params["qkv_weight"].T
+        if self.with_bias:
+            qkv = qkv + params["qkv_bias"]
+        qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))  # b,h,t,d
+        o = self._attend(q, k, v)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, e)
+        out = o @ params["out_weight"].T
+        if self.with_bias:
+            out = out + params["out_bias"]
+        return out, state
+
+    def __repr__(self):
+        return (f"MultiHeadAttention(embed={self.embed_dim}, heads={self.num_heads}, "
+                f"causal={self.causal}, impl={self.attention_impl})")

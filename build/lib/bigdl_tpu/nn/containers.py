@@ -1,0 +1,375 @@
+"""Composition containers.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/Sequential.scala``, ``Concat.scala``,
+``ConcatTable.scala``, ``ParallelTable.scala``, ``CAddTable.scala``, ``JoinTable.scala`` —
+unverified). TPU-native: containers compose the children's pure ``apply`` functions; the
+whole composite stays one traced program under ``jit`` (XLA fuses across layer boundaries —
+the reference needed explicit mkldnn fusion passes for that, SURVEY.md §2.1 "Fusion").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, Container, split_rng
+from bigdl_tpu.utils.table import Table, T
+
+
+class Sequential(Container):
+    """Chain children; output of child i feeds child i+1."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        new_state = {}
+        rngs = split_rng(rng, len(self.modules))
+        for (name, m), r in zip(self.named_children(), rngs):
+            x, s = m.apply(params[name], state[name], x, training=training, rng=r)
+            new_state[name] = s
+        return x, new_state
+
+    def __repr__(self):
+        inner = "\n".join(f"  ({i}): {m!r}" for i, m in enumerate(self.modules))
+        return f"Sequential(\n{inner}\n)"
+
+
+class Concat(Container):
+    """Apply each child to the same input; concatenate outputs along ``dimension``.
+
+    The workhorse of Inception's branch blocks. ``dimension`` is 1-based counting the batch
+    dim first (reference convention): default 2 = channel axis of NCHW.
+    """
+
+    def __init__(self, dimension: int = 2):
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs, new_state = [], {}
+        rngs = split_rng(rng, len(self.modules))
+        for (name, m), r in zip(self.named_children(), rngs):
+            o, s = m.apply(params[name], state[name], input, training=training, rng=r)
+            outs.append(o)
+            new_state[name] = s
+        return jnp.concatenate(outs, axis=self.dimension - 1), new_state
+
+    def __repr__(self):
+        inner = " | ".join(repr(m) for m in self.modules)
+        return f"Concat(dim={self.dimension})[{inner}]"
+
+
+class ConcatTable(Container):
+    """Apply each child to the same input; output a Table of the results."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs, new_state = [], {}
+        rngs = split_rng(rng, len(self.modules))
+        for (name, m), r in zip(self.named_children(), rngs):
+            o, s = m.apply(params[name], state[name], input, training=training, rng=r)
+            outs.append(o)
+            new_state[name] = s
+        return T(*outs), new_state
+
+
+class ParallelTable(Container):
+    """Child i consumes input Table element i; outputs a Table."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        outs, new_state = [], {}
+        rngs = split_rng(rng, len(self.modules))
+        for (name, m), x, r in zip(self.named_children(), xs, rngs):
+            o, s = m.apply(params[name], state[name], x, training=training, rng=r)
+            outs.append(o)
+            new_state[name] = s
+        return T(*outs), new_state
+
+
+class CAddTable(AbstractModule):
+    """Element-wise sum of a Table of tensors (ResNet shortcut join)."""
+
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out, state
+
+
+class CMulTable(AbstractModule):
+    """Element-wise product of a Table of tensors."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        out = xs[0]
+        for x in xs[1:]:
+            out = out * x
+        return out, state
+
+
+class CSubTable(AbstractModule):
+    """Element-wise difference x1 - x2 of a Table pair."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        return xs[0] - xs[1], state
+
+
+class CDivTable(AbstractModule):
+    """Element-wise quotient x1 / x2 of a Table pair."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        return xs[0] / xs[1], state
+
+
+class CMaxTable(AbstractModule):
+    """Element-wise maximum over a Table of tensors."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.maximum(out, x)
+        return out, state
+
+
+class CMinTable(AbstractModule):
+    """Element-wise minimum over a Table of tensors."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.minimum(out, x)
+        return out, state
+
+
+class JoinTable(AbstractModule):
+    """Concatenate a Table of tensors along ``dimension`` (1-based; n_input_dims lets
+    batched input shift the axis, reference semantics)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        axis = self.dimension - 1
+        if self.n_input_dims > 0 and xs[0].ndim == self.n_input_dims + 1:
+            axis += 1  # leading batch dim present
+        return jnp.concatenate(xs, axis=axis), state
+
+
+class SelectTable(AbstractModule):
+    """Pick element ``index`` (1-based) from the input Table."""
+
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        i = self.index - 1 if self.index > 0 else self.index
+        return xs[i], state
+
+
+class FlattenTable(AbstractModule):
+    """Flatten nested Tables into one flat Table."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        flat = []
+
+        def rec(x):
+            if isinstance(x, Table):
+                for v in x.values():
+                    rec(v)
+            elif isinstance(x, (list, tuple)):
+                for v in x:
+                    rec(v)
+            else:
+                flat.append(x)
+
+        rec(input)
+        return T(*flat), state
+
+
+class Identity(AbstractModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input, state
+
+
+class Echo(AbstractModule):
+    """Debug layer: prints shape at trace time, passes input through."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        shape = jax.tree_util.tree_map(lambda x: x.shape, input)
+        print(f"[Echo {self.name}] {shape}")
+        return input, state
+
+
+class Bottle(Container):
+    """Run the wrapped module on a view with leading dims collapsed: input
+    (d1, ..., dk, rest...) is reshaped so the child sees ``n_input_dims`` dims,
+    and the child's output gets the leading dims restored (reference
+    ``<dl>/nn/Bottle.scala`` — unverified). One reshape in, one out — both free
+    under XLA (layout-only)."""
+
+    def __init__(self, module: AbstractModule, n_input_dims: int = 2):
+        super().__init__(module)
+        self.n_input_dims = n_input_dims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        n_lead = x.ndim - (self.n_input_dims - 1)
+        lead = x.shape[:n_lead]
+        if n_lead > 1:
+            x = x.reshape((-1,) + x.shape[n_lead:])
+        out, new_s = self.modules[0].apply(params["0"], state["0"], x,
+                                           training=training, rng=rng)
+        if n_lead > 1:
+            out = out.reshape(lead + out.shape[1:])
+        return out, {"0": new_s}
+
+
+class MapTable(Container):
+    """Apply ONE shared child to every element of the input Table (shared params)."""
+
+    def __init__(self, module: Optional[AbstractModule] = None):
+        super().__init__(*( [module] if module is not None else [] ))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        m = self.modules[0]
+        outs = []
+        s = state["0"]
+        rngs = split_rng(rng, len(xs))
+        for x, r in zip(xs, rngs):
+            o, s = m.apply(params["0"], s, x, training=training, rng=r)
+            outs.append(o)
+        return T(*outs), {"0": s}
+
+
+class NarrowTable(AbstractModule):
+    """Select ``length`` consecutive entries of the input Table starting at
+    ``offset`` (1-based; reference ``NarrowTable``). length=1 returns the bare
+    element, matching the reference's unwrap behavior for singleton narrows."""
+
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__()
+        self.offset, self.length = offset, length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        start = self.offset - 1
+        length = self.length
+        if length < 0:  # same convention as Narrow: count back from the end
+            length = len(xs) - start + length + 1
+        picked = xs[start:start + length]
+        if len(picked) == 1:
+            return picked[0], state
+        return T(*picked), state
+
+
+class Pack(AbstractModule):
+    """Stack the entries of a Table along a NEW dim (1-based; reference
+    ``Pack``)."""
+
+    def __init__(self, dim: int = 1):
+        super().__init__()
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        return jnp.stack(xs, axis=self.dim - 1), state
+
+
+class CAveTable(AbstractModule):
+    """Elementwise average of the Table entries (reference ``CAveTable``)."""
+
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out / float(len(xs)), state
+
+
+class BifurcateSplitTable(AbstractModule):
+    """Split a tensor into a Table of two halves along dim (1-based; reference
+    ``BifurcateSplitTable`` — the dim's size must be even)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dimension - 1 if self.dimension > 0 else input.ndim + self.dimension
+        n = input.shape[axis]
+        if n % 2 != 0:
+            raise ValueError(
+                f"BifurcateSplitTable: dim {self.dimension} has odd size {n}")
+        a, b = jnp.split(input, 2, axis=axis)
+        return T(a, b), state
+
+
+class MixtureTable(AbstractModule):
+    """Mixture-of-experts blend: input Table = (gater (N,E), experts); output =
+    sum_e gater[:, e] * expert_e (reference ``MixtureTable``). Experts may be a
+    Table of E tensors (stacked on a new expert axis) or a single pre-stacked
+    tensor whose expert axis is ``dim`` (1-based counting batch first,
+    default 2). The stack-and-contract is one einsum on the MXU."""
+
+    def __init__(self, dim: int = 2):
+        super().__init__()
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        gater, experts = xs[0], xs[1]
+        if isinstance(experts, Table):
+            stacked = jnp.stack(experts.values(), axis=self.dim - 1)
+        elif isinstance(experts, (list, tuple)):
+            stacked = jnp.stack(list(experts), axis=self.dim - 1)
+        else:
+            stacked = experts                      # already (N, ..E.., ...)
+        axis = self.dim - 1
+        shape = [1] * stacked.ndim
+        shape[0], shape[axis] = gater.shape[0], gater.shape[1]
+        g = gater.reshape(shape)
+        return jnp.sum(g * stacked, axis=axis), state
+
+
+class MaskedSelect(AbstractModule):
+    """Select input[0] values where the input[1] mask is nonzero.
+
+    TPU-native redesign of the reference ``MaskedSelect``: the reference returns
+    a dynamically-sized 1-D tensor, which XLA cannot express inside a traced
+    program (no dynamic shapes on TPU). Eagerly (outside jit) this returns the
+    exact torch-style dynamic result; inside a trace it raises with guidance to
+    use a static-shape masking pattern (``jnp.where`` / sort-by-mask) instead.
+    """
+
+    def forward(self, input):
+        # eager host path — bypasses the jitted-apply facade on purpose
+        xs = input.values() if isinstance(input, Table) else list(input)
+        import numpy as np
+        xv = np.asarray(xs[0])
+        mv = np.asarray(xs[1]).astype(bool)
+        self.output = jnp.asarray(xv[mv])
+        return self.output
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        raise TypeError(
+            "MaskedSelect produces a data-dependent shape and cannot run "
+            "inside jit on TPU; call .forward() eagerly (host) or restructure "
+            "with jnp.where for a static-shape pipeline")

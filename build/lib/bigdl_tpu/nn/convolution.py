@@ -1,0 +1,372 @@
+"""Spatial convolution layers.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/SpatialConvolution.scala`` —
+unverified): NCHW activations, OIHW weights (with groups: (nGroup, out/g, in/g, kH, kW)
+upstream; here flat OIHW + ``feature_group_count``), stride (dW, dH), padding (padW, padH)
+with ``-1`` meaning TensorFlow-style SAME. Default init Xavier-like U(-1/sqrt(fanIn), +).
+
+TPU-native: ``lax.conv_general_dilated`` — XLA tiles it onto the MXU directly; the
+reference's im2col+gemm with per-thread workspaces (BLAS path) and its mkldnn layout
+reorders are both deleted as concepts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform
+
+
+def _conv_padding(pad_w: int, pad_h: int):
+    """Map reference pad ints to lax padding. -1 → SAME (reference convention)."""
+    if pad_w == -1 or pad_h == -1:
+        return "SAME"
+    return [(pad_h, pad_h), (pad_w, pad_w)]
+
+
+class SpatialConvolution(TensorModule):
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 n_group: int = 1, propagate_back: bool = True,
+                 with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_group = n_group
+        self.propagate_back = propagate_back
+        self.with_bias = with_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.reset()
+
+    def reset(self) -> None:
+        fan_in = (self.n_input_plane // self.n_group) * self.kernel_h * self.kernel_w
+        fan_out = (self.n_output_plane // self.n_group) * self.kernel_h * self.kernel_w
+        w = self.w_init.init(
+            (self.n_output_plane, self.n_input_plane // self.n_group,
+             self.kernel_h, self.kernel_w),
+            fan_in=fan_in, fan_out=fan_out)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            b = self.b_init.init((self.n_output_plane,), fan_in=fan_in, fan_out=fan_out)
+            self._params["bias"] = jnp.asarray(b)
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        out = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.stride_h, self.stride_w),
+            padding=_conv_padding(self.pad_w, self.pad_h),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group,
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None]
+        if squeeze:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return (f"SpatialConvolution({self.n_input_plane} -> {self.n_output_plane}, "
+                f"{self.kernel_w}x{self.kernel_h}, {self.stride_w},{self.stride_h}, "
+                f"{self.pad_w},{self.pad_h})")
+
+
+class SpatialConvolutionMap(SpatialConvolution):
+    """Simplified stand-in: full-connection table conv (reference has sparse maps)."""
+
+
+class SpatialDilatedConvolution(TensorModule):
+    """Atrous convolution (reference ``<dl>/nn/SpatialDilatedConvolution.scala``)."""
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh, dw=1, dh=1,
+                 pad_w=0, pad_h=0, dilation_w=1, dilation_h=1,
+                 w_init=None, b_init=None, with_bias: bool = True):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.dilation_w, self.dilation_h = dilation_w, dilation_h
+        self.with_bias = with_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self):
+        fan_in = self.n_input_plane * self.kh * self.kw
+        fan_out = self.n_output_plane * self.kh * self.kw
+        w = self.w_init.init((self.n_output_plane, self.n_input_plane, self.kh, self.kw),
+                             fan_in=fan_in, fan_out=fan_out)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(
+                self.b_init.init((self.n_output_plane,), fan_in=fan_in, fan_out=fan_out))
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        out = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.dh, self.dw),
+            padding=[(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+            rhs_dilation=(self.dilation_h, self.dilation_w),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None]
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class SpatialFullConvolution(TensorModule):
+    """Transposed convolution (deconvolution), reference ``SpatialFullConvolution``."""
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh, dw=1, dh=1,
+                 pad_w=0, pad_h=0, adj_w=0, adj_h=0, n_group=1,
+                 no_bias: bool = False, w_init=None, b_init=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h, self.adj_w, self.adj_h = pad_w, pad_h, adj_w, adj_h
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self):
+        fan_in = self.n_input_plane * self.kh * self.kw
+        fan_out = self.n_output_plane * self.kh * self.kw
+        # Torch layout for full conv: (nIn, nOut/g, kH, kW); keep IOHW and tell lax.
+        w = self.w_init.init(
+            (self.n_input_plane, self.n_output_plane // self.n_group, self.kh, self.kw),
+            fan_in=fan_in, fan_out=fan_out)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(
+                self.b_init.init((self.n_output_plane,), fan_in=fan_in, fan_out=fan_out))
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        kh, kw = self.kh, self.kw
+        pad = [(kh - 1 - self.pad_h, kh - 1 - self.pad_h + self.adj_h),
+               (kw - 1 - self.pad_w, kw - 1 - self.pad_w + self.adj_w)]
+        # lax convs are correlations; the transpose of a correlation applies the
+        # SPATIALLY FLIPPED kernel (torch/Caffe deconv semantics)
+        w = jnp.flip(params["weight"], (-2, -1))
+        if self.n_group > 1:
+            # grouped deconv: torch keeps (I, O/g) with groups sliced along I;
+            # lax wants rhs (I/g, O) with group j in O-slice j — rearrange
+            g = self.n_group
+            i, og = w.shape[0], w.shape[1]
+            w = w.reshape(g, i // g, og, kh, kw).transpose(1, 0, 2, 3, 4) \
+                 .reshape(i // g, g * og, kh, kw)
+        out = lax.conv_general_dilated(
+            x, w,
+            window_strides=(1, 1),
+            padding=pad,
+            lhs_dilation=(self.dh, self.dw),
+            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+            feature_group_count=self.n_group,
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None]
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class TemporalConvolution(TensorModule):
+    """1-D convolution over time (reference ``<dl>/nn/TemporalConvolution.scala``
+    — unverified): input (N, T, input_frame_size) → (N, (T-kw)//dw+1,
+    output_frame_size). One NWC conv lowered onto the MXU."""
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1, with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.with_bias = with_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        fan_in = self.input_frame_size * self.kernel_w
+        w = self.w_init.init((self.kernel_w, self.input_frame_size,
+                              self.output_frame_size),
+                             fan_in=fan_in, fan_out=self.output_frame_size)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            b = self.b_init.init((self.output_frame_size,), fan_in=fan_in,
+                                 fan_out=self.output_frame_size)
+            self._params["bias"] = jnp.asarray(b)
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]
+        out = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.stride_w,),
+            padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        if self.with_bias:
+            out = out + params["bias"]
+        if squeeze:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return (f"TemporalConvolution({self.input_frame_size} -> "
+                f"{self.output_frame_size}, {self.kernel_w}, {self.stride_w})")
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Reference ``SpatialShareConvolution``: a SpatialConvolution variant whose
+    only upstream difference is sharing the im2col workspace across replica
+    threads. XLA owns all workspace memory on TPU, so the compute is identical;
+    the type is kept distinct for API and serialization parity."""
+
+
+class LocallyConnected2D(TensorModule):
+    """Unshared convolution (reference ``LocallyConnected2D``): each output
+    location has its own filter bank. TPU-native: extract patches with
+    ``conv_general_dilated_patches`` (one fused gather) and contract location-
+    wise with a single batched einsum on the MXU — no per-location loop."""
+
+    def __init__(self, n_input_plane: int, input_width: int, input_height: int,
+                 n_output_plane: int, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.input_width, self.input_height = input_width, input_height
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.with_bias = with_bias
+        self.out_h = (input_height + 2 * pad_h - kernel_h) // stride_h + 1
+        self.out_w = (input_width + 2 * pad_w - kernel_w) // stride_w + 1
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        k = self.n_input_plane * self.kernel_h * self.kernel_w
+        n_loc = self.out_h * self.out_w
+        w = self.w_init.init((n_loc, self.n_output_plane, k),
+                             fan_in=k, fan_out=self.n_output_plane)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(self.b_init.init(
+                (n_loc, self.n_output_plane), fan_in=k,
+                fan_out=self.n_output_plane))
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        # patches: (N, C*kh*kw, OH, OW), feature dim ordered (c, kh, kw) —
+        # matches the (n_loc, o, c*kh*kw) weight layout's contraction dim
+        patches = lax.conv_general_dilated_patches(
+            x, (self.kernel_h, self.kernel_w),
+            (self.stride_h, self.stride_w),
+            [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        n = patches.shape[0]
+        p = patches.reshape(n, patches.shape[1], -1)        # (N, K, P)
+        out = jnp.einsum("nkp,pok->npo", p, params["weight"])
+        if self.with_bias:
+            out = out + params["bias"][None]
+        out = jnp.transpose(out, (0, 2, 1)).reshape(
+            n, self.n_output_plane, self.out_h, self.out_w)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class LocallyConnected1D(TensorModule):
+    """Unshared temporal convolution (reference ``LocallyConnected1D``):
+    input (N, T, C) like TemporalConvolution, per-output-frame filters."""
+
+    def __init__(self, n_input_frame: int, input_frame_size: int,
+                 output_frame_size: int, kernel_w: int, stride_w: int = 1,
+                 with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.n_input_frame = n_input_frame
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w, self.stride_w = kernel_w, stride_w
+        self.with_bias = with_bias
+        self.n_output_frame = (n_input_frame - kernel_w) // stride_w + 1
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        k = self.kernel_w * self.input_frame_size
+        w = self.w_init.init((self.n_output_frame, self.output_frame_size, k),
+                             fan_in=k, fan_out=self.output_frame_size)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(self.b_init.init(
+                (self.n_output_frame, self.output_frame_size),
+                fan_in=k, fan_out=self.output_frame_size))
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]
+        idx = (jnp.arange(self.n_output_frame)[:, None] * self.stride_w
+               + jnp.arange(self.kernel_w)[None, :])          # (OT, kw)
+        patches = x[:, idx, :]                                # (N, OT, kw, C)
+        p = patches.reshape(x.shape[0], self.n_output_frame, -1)
+        out = jnp.einsum("npk,pok->npo", p, params["weight"])
+        if self.with_bias:
+            out = out + params["bias"][None]
+        if squeeze:
+            out = out[0]
+        return out, state

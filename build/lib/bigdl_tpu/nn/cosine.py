@@ -1,0 +1,72 @@
+"""Cosine-similarity layers.
+
+Reference parity (SURVEY.md §2.1 layer zoo, expected ``<dl>/nn/Cosine.scala`` /
+``CosineDistance.scala`` — unverified, mount empty): ``Cosine`` scores the input
+against learnable class prototypes by cosine similarity; ``CosineDistance``
+computes the rowwise cosine similarity of a pair of tensors.
+
+TPU-native: one normalised matmul on the MXU (Cosine) / one fused reduction on
+the VPU (CosineDistance).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, TensorModule
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform
+from bigdl_tpu.utils.table import Table
+
+
+def cosine_similarity(x, y, axis: int = -1, eps: float = 1e-12):
+    """Shared clipped cosine similarity (layers + criterions use this one
+    definition so epsilon/broadcasting fixes land everywhere at once)."""
+    return jnp.sum(x * y, axis) / jnp.clip(
+        jnp.linalg.norm(x, axis=axis) * jnp.linalg.norm(y, axis=axis), eps)
+
+
+class Cosine(TensorModule):
+    """``out[b, o] = cos(x[b], w[o])`` with learnable prototypes
+    ``w: (output_size, input_size)``."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.w_init = w_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        self._params = {"weight": jnp.asarray(
+            self.w_init.init((self.output_size, self.input_size),
+                             fan_in=self.input_size, fan_out=self.output_size))}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if input.ndim > 2:
+            raise ValueError(
+                f"Cosine expects (N, {self.input_size}) or ({self.input_size},), "
+                f"got {input.shape}; wrap with Bottle for higher-rank inputs")
+        x = input if input.ndim == 2 else input[None]
+        w = params["weight"]
+        xn = x / jnp.clip(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+        wn = w / jnp.clip(jnp.linalg.norm(w, axis=-1, keepdims=True), 1e-12)
+        out = xn @ wn.T
+        if input.ndim == 1:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return f"Cosine({self.input_size} -> {self.output_size})"
+
+
+class CosineDistance(AbstractModule):
+    """Rowwise cosine similarity of a Table/tuple pair (x1, x2) → (N,)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x1, x2 = (input[1], input[2]) if isinstance(input, Table) \
+            else (input[0], input[1])
+        return cosine_similarity(x1, x2), state

@@ -1,0 +1,697 @@
+"""Loss criterions.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/ClassNLLCriterion.scala`` etc. —
+unverified): ~30 Torch-style criterions with ``forward(input, target)`` /
+``backward(input, target)``, ``sizeAverage`` semantics.
+
+TPU-native: each criterion is a pure function ``apply(input, target) -> scalar``; the
+trainer differentiates through it together with the model (one fused XLA program).
+``backward`` on the facade uses ``jax.grad`` for API parity.
+
+Label convention: targets are **0-based** class indices by default (numpy/torch-native);
+pass ``one_based=True`` for the reference's Torch 1-based labels.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.utils.table import Table
+
+
+from bigdl_tpu.nn.abstractnn import RecordsInit
+
+
+class AbstractCriterion(metaclass=RecordsInit):
+    def __init__(self) -> None:
+        self.output = None
+        self.grad_input = None
+        self._cache: dict = {}
+
+    # functional core ------------------------------------------------------
+    def apply(self, input, target):
+        """Pure loss. Returns a scalar."""
+        raise NotImplementedError
+
+    # facade ---------------------------------------------------------------
+    def forward(self, input, target):
+        if "fwd" not in self._cache:
+            self._cache["fwd"] = jax.jit(self.apply)
+        self.output = self._cache["fwd"](input, target)
+        return self.output
+
+    def backward(self, input, target):
+        if "bwd" not in self._cache:
+            self._cache["bwd"] = jax.jit(jax.grad(lambda i, t: self.apply(i, t)))
+        self.grad_input = self._cache["bwd"](input, target)
+        return self.grad_input
+
+    def __call__(self, input, target):
+        return self.forward(input, target)
+
+    def __repr__(self):
+        return type(self).__name__
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_cache"] = {}
+        return d
+
+
+def _reduce(loss, size_average: bool):
+    return jnp.mean(loss) if size_average else jnp.sum(loss)
+
+
+def _class_index(target, one_based: bool):
+    t = target.astype(jnp.int32)
+    return t - 1 if one_based else t
+
+
+class ClassNLLCriterion(AbstractCriterion):
+    """Negative log-likelihood over log-probabilities (pairs with LogSoftMax)."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 logprob_as_input: bool = True, one_based: bool = False):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+        self.logprob_as_input = logprob_as_input
+        self.one_based = one_based
+
+    def apply(self, input, target):
+        logp = input if self.logprob_as_input else jnp.log(jnp.clip(input, 1e-8))
+        if logp.ndim == 1:
+            logp = logp[None]
+            target = jnp.reshape(target, (1,))
+        idx = _class_index(jnp.reshape(target, (-1,)), self.one_based)
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, idx)
+            loss = -(picked * w)
+            return jnp.sum(loss) / jnp.sum(w) if self.size_average else jnp.sum(loss)
+        return _reduce(-picked, self.size_average)
+
+
+class CrossEntropyCriterion(AbstractCriterion):
+    """LogSoftMax + ClassNLL fused (input = raw logits)."""
+
+    def __init__(self, weights=None, size_average: bool = True, one_based: bool = False):
+        super().__init__()
+        self.inner = ClassNLLCriterion(weights, size_average, one_based=one_based)
+
+    def apply(self, input, target):
+        return self.inner.apply(jax.nn.log_softmax(input, axis=-1), target)
+
+
+class MSECriterion(AbstractCriterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        return _reduce(jnp.square(input - target), self.size_average)
+
+
+class AbsCriterion(AbstractCriterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        return _reduce(jnp.abs(input - target), self.size_average)
+
+
+class BCECriterion(AbstractCriterion):
+    """Binary cross-entropy over probabilities (pairs with Sigmoid)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        eps = 1e-12
+        p = jnp.clip(input, eps, 1.0 - eps)
+        loss = -(target * jnp.log(p) + (1.0 - target) * jnp.log1p(-p))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(loss, self.size_average)
+
+
+class BCECriterionWithLogits(AbstractCriterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        loss = jnp.maximum(input, 0) - input * target + jnp.log1p(jnp.exp(-jnp.abs(input)))
+        return _reduce(loss, self.size_average)
+
+
+class SmoothL1Criterion(AbstractCriterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        d = jnp.abs(input - target)
+        loss = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(loss, self.size_average)
+
+
+class MarginCriterion(AbstractCriterion):
+    """Hinge loss; target ∈ {-1, 1}."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        super().__init__()
+        self.margin, self.size_average, self.squared = margin, size_average, squared
+
+    def apply(self, input, target):
+        loss = jnp.maximum(0.0, self.margin - input * target)
+        if self.squared:
+            loss = jnp.square(loss)
+        return _reduce(loss, self.size_average)
+
+
+class HingeEmbeddingCriterion(AbstractCriterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin, self.size_average = margin, size_average
+
+    def apply(self, input, target):
+        loss = jnp.where(target > 0, input, jnp.maximum(0.0, self.margin - input))
+        return _reduce(loss, self.size_average)
+
+
+class DistKLDivCriterion(AbstractCriterion):
+    """KL(target ‖ input) where input is log-prob, target is prob."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        loss = jnp.where(target > 0, target * (jnp.log(jnp.clip(target, 1e-12)) - input), 0.0)
+        return _reduce(loss, self.size_average)
+
+
+class MultiLabelSoftMarginCriterion(AbstractCriterion):
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        loss = jnp.maximum(input, 0) - input * target + jnp.log1p(jnp.exp(-jnp.abs(input)))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(loss, self.size_average)
+
+
+class CosineEmbeddingCriterion(AbstractCriterion):
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__()
+        self.margin, self.size_average = margin, size_average
+
+    def apply(self, input, target):
+        x1, x2 = (input[1], input[2]) if isinstance(input, Table) else (input[0], input[1])
+        cos = jnp.sum(x1 * x2, -1) / jnp.clip(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+        t = jnp.reshape(target, cos.shape)
+        loss = jnp.where(t > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return _reduce(loss, self.size_average)
+
+
+class MarginRankingCriterion(AbstractCriterion):
+    """Ranking hinge over a pair of score tensors: ``max(0, -y*(x1-x2)+margin)``
+    (reference ``<dl>/nn/MarginRankingCriterion.scala`` — unverified). Input is a
+    Table/tuple (x1, x2); target ∈ {-1, 1}."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__()
+        self.margin, self.size_average = margin, size_average
+
+    def apply(self, input, target):
+        x1, x2 = (input[1], input[2]) if isinstance(input, Table) else (input[0], input[1])
+        t = jnp.reshape(target, x1.shape)
+        loss = jnp.maximum(0.0, -t * (x1 - x2) + self.margin)
+        return _reduce(loss, self.size_average)
+
+
+class MultiMarginCriterion(AbstractCriterion):
+    """Multi-class hinge (reference ``MultiMarginCriterion`` — unverified):
+    ``mean_j(max(0, margin - x[y] + x[j])^p)`` over j != y. 0-based targets by
+    default (framework convention); ``one_based=True`` for Torch parity."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True, one_based: bool = False):
+        super().__init__()
+        if p not in (1, 2):
+            raise ValueError("p must be 1 or 2")
+        self.p, self.margin, self.size_average = p, margin, size_average
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.one_based = one_based
+
+    def apply(self, input, target):
+        x = input if input.ndim == 2 else input[None]
+        t = jnp.reshape(target, (-1,)).astype(jnp.int32)
+        if self.one_based:
+            t = t - 1
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, t[:, None], axis=1)
+        loss = jnp.maximum(0.0, self.margin - correct + x)
+        if self.p == 2:
+            loss = jnp.square(loss)
+        if self.weights is not None:
+            loss = loss * self.weights[t][:, None]
+        # zero out the j == y term
+        mask = jnp.arange(c)[None, :] != t[:, None]
+        per_sample = jnp.sum(loss * mask, axis=1) / c
+        return jnp.mean(per_sample) if self.size_average else jnp.sum(per_sample)
+
+
+class MultiLabelMarginCriterion(AbstractCriterion):
+    """Multi-label multi-class hinge (reference ``MultiLabelMarginCriterion`` —
+    unverified; torch ``multilabel_margin_loss`` semantics). ``target`` rows
+    list label indices, padded with the sentinel 0 (1-based labels) or -1
+    (``one_based=False``); labels after the first sentinel are ignored.
+
+    Memory note: the vectorized hinge materializes an (n, L, c) tensor where L
+    is the target width (= c under torch-shape targets), i.e. O(n*c^2) — fine
+    for the typical multi-label class counts this loss targets (<= a few
+    thousand classes), not for extreme-classification c."""
+
+    def __init__(self, size_average: bool = True, one_based: bool = False):
+        super().__init__()
+        self.size_average = size_average
+        self.one_based = one_based
+
+    def apply(self, input, target):
+        x = input if input.ndim == 2 else input[None]
+        t = target if target.ndim == 2 else target[None]
+        t = t.astype(jnp.int32)
+        n, c = x.shape
+        sentinel = 0 if self.one_based else -1
+        # valid prefix: labels before the first sentinel
+        is_pad = (t == sentinel)
+        valid = jnp.cumsum(is_pad, axis=1) == 0
+        idx = jnp.clip(t - (1 if self.one_based else 0), 0, c - 1)
+        # is_target[b, j] = j appears in the valid label prefix of row b
+        onehot = jax.nn.one_hot(idx, c, dtype=x.dtype) * valid[..., None]
+        is_target = jnp.clip(jnp.sum(onehot, axis=1), 0.0, 1.0)
+        x_target = jnp.take_along_axis(x, idx, axis=1)  # (n, L)
+        # hinge of every valid target score against every non-target class
+        margins = jnp.maximum(
+            0.0, 1.0 - x_target[:, :, None] + x[:, None, :])  # (n, L, c)
+        mask = valid[:, :, None] * (1.0 - is_target)[:, None, :]
+        per_sample = jnp.sum(margins * mask, axis=(1, 2)) / c
+        return jnp.mean(per_sample) if self.size_average else jnp.sum(per_sample)
+
+
+class SoftMarginCriterion(AbstractCriterion):
+    """``mean(log(1 + exp(-y * x)))``, target ∈ {-1, 1} (reference
+    ``SoftMarginCriterion`` — unverified)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        # logaddexp is the overflow-safe log(1 + exp(z)) (cf. BCECriterionWithLogits)
+        return _reduce(jnp.logaddexp(0.0, -input * target), self.size_average)
+
+
+class CosineDistanceCriterion(AbstractCriterion):
+    """``1 - cos(x, y)`` between prediction and target tensors (reference
+    ``CosineDistanceCriterion`` — unverified)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        from bigdl_tpu.nn.cosine import cosine_similarity
+        return _reduce(1.0 - cosine_similarity(input, target), self.size_average)
+
+
+class L1HingeEmbeddingCriterion(AbstractCriterion):
+    """L1 distance hinge over a pair: ``d = |x1 - x2|_1``; loss ``d`` if y=1 else
+    ``max(0, margin - d)`` (reference ``L1HingeEmbeddingCriterion`` — unverified)."""
+
+    def __init__(self, margin: float = 1.0):
+        super().__init__()
+        self.margin = margin
+
+    def apply(self, input, target):
+        x1, x2 = (input[1], input[2]) if isinstance(input, Table) else (input[0], input[1])
+        d = jnp.sum(jnp.abs(x1 - x2), axis=-1)
+        t = jnp.reshape(target, d.shape)
+        loss = jnp.where(t > 0, d, jnp.maximum(0.0, self.margin - d))
+        return jnp.mean(loss)
+
+
+class PoissonCriterion(AbstractCriterion):
+    """Poisson NLL over positive rates: ``mean(pred - target * log(pred))``
+    (keras-style; reference keras loss set — unverified)."""
+
+    def apply(self, input, target):
+        return jnp.mean(input - target * jnp.log(jnp.clip(input, 1e-12)))
+
+
+class CosineProximityCriterion(AbstractCriterion):
+    """Negative mean cosine proximity of l2-normalised tensors (keras
+    ``cosine_proximity``; reference keras loss set — unverified)."""
+
+    def apply(self, input, target):
+        from bigdl_tpu.nn.cosine import cosine_similarity
+        return -jnp.mean(cosine_similarity(input, target))
+
+
+class MeanAbsolutePercentageCriterion(AbstractCriterion):
+    """MAPE: ``100 * mean(|t - x| / clip(|t|))`` (keras-style)."""
+
+    def apply(self, input, target):
+        return 100.0 * jnp.mean(
+            jnp.abs(target - input) / jnp.clip(jnp.abs(target), 1e-7))
+
+
+class MeanSquaredLogarithmicCriterion(AbstractCriterion):
+    """MSLE: ``mean((log(1+t) - log(1+x))^2)`` (keras-style)."""
+
+    def apply(self, input, target):
+        return jnp.mean(jnp.square(
+            jnp.log1p(jnp.clip(target, 0.0)) - jnp.log1p(jnp.clip(input, 0.0))))
+
+
+class KullbackLeiblerDivergenceCriterion(AbstractCriterion):
+    """KL(target ‖ input) over probability distributions (keras ``kld``; the
+    log-prob-input variant is :class:`DistKLDivCriterion`)."""
+
+    def apply(self, input, target):
+        t = jnp.clip(target, 1e-7, 1.0)
+        p = jnp.clip(input, 1e-7, 1.0)
+        return jnp.mean(jnp.sum(t * jnp.log(t / p), axis=-1))
+
+
+class ClassSimplexCriterion(AbstractCriterion):
+    """MSE against regular-simplex target embeddings (reference
+    ``ClassSimplexCriterion`` — unverified): class ``y`` maps to the ``y``-th
+    vertex of a regular (nClasses-1)-simplex in R^nClasses."""
+
+    def __init__(self, n_classes: int, size_average: bool = True,
+                 one_based: bool = False):
+        super().__init__()
+        if n_classes < 2:
+            raise ValueError("n_classes must be >= 2")
+        self.n_classes = n_classes
+        self.size_average = size_average
+        self.one_based = one_based
+        self._simplex = jnp.asarray(self._build_simplex(n_classes))
+
+    @staticmethod
+    def _build_simplex(k: int):
+        import numpy as _np
+        # Gram-Schmidt construction of k unit vectors with equal pairwise distance
+        a = _np.zeros((k, k), _np.float32)
+        for i in range(k):
+            for j in range(i):
+                a[i, j] = -(1.0 / k + _np.dot(a[i], a[j])) / a[j, j] if a[j, j] != 0 else 0.0
+            rest = 1.0 - _np.sum(a[i] ** 2)
+            a[i, i] = _np.sqrt(max(rest, 0.0))
+        return a
+
+    def apply(self, input, target):
+        t = jnp.reshape(target, (-1,)).astype(jnp.int32)
+        if self.one_based:
+            t = t - 1
+        goal = self._simplex[t]
+        return _reduce(jnp.square(input - goal), self.size_average)
+
+
+class ParallelCriterion(AbstractCriterion):
+    """Weighted sum of criterions over (Table input, Table target) pairs."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__()
+        self.criterions: list[tuple[AbstractCriterion, float]] = []
+        self.repeat_target = repeat_target
+
+    def add(self, criterion: AbstractCriterion, weight: float = 1.0) -> "ParallelCriterion":
+        self.criterions.append((criterion, weight))
+        return self
+
+    def apply(self, input, target):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        if self.repeat_target:
+            ts = [target] * len(xs)
+        else:
+            ts = target.values() if isinstance(target, Table) else list(target)
+        total = 0.0
+        for (crit, w), x, t in zip(self.criterions, xs, ts):
+            total = total + w * crit.apply(x, t)
+        return total
+
+
+class TimeDistributedCriterion(AbstractCriterion):
+    """Apply an inner criterion at every timestep of (N, T, ...) input."""
+
+    def __init__(self, criterion: AbstractCriterion, size_average: bool = False,
+                 dimension: int = 2):
+        super().__init__()
+        self.criterion = criterion
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        t_steps = input.shape[1]
+        flat_in = input.reshape((-1,) + input.shape[2:])
+        flat_t = target.reshape((-1,) + target.shape[2:])
+        loss = self.criterion.apply(flat_in, flat_t)
+        if not self.size_average:
+            return loss
+        return loss / t_steps
+
+
+class MultiCriterion(AbstractCriterion):
+    """Weighted sum of criterions applied to the SAME (input, target)."""
+
+    def __init__(self):
+        super().__init__()
+        self.criterions: list[tuple[AbstractCriterion, float]] = []
+
+    def add(self, criterion: AbstractCriterion, weight: float = 1.0) -> "MultiCriterion":
+        self.criterions.append((criterion, weight))
+        return self
+
+    def apply(self, input, target):
+        total = 0.0
+        for crit, w in self.criterions:
+            total = total + w * crit.apply(input, target)
+        return total
+
+
+class L1Cost(AbstractCriterion):
+    def apply(self, input, target):
+        return jnp.sum(jnp.abs(input))
+
+
+class KLDCriterion(AbstractCriterion):
+    """Gaussian KL divergence to the unit prior given a Table (mean, log_var)
+    (reference ``KLDCriterion`` — the VAE regulariser; target is ignored):
+    ``0.5 * sum(mu^2 + exp(log_var) - 1 - log_var)``."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        mu, log_var = xs[0], xs[1]
+        kl = 0.5 * jnp.sum(jnp.square(mu) + jnp.exp(log_var) - 1.0 - log_var,
+                           axis=-1)
+        return jnp.mean(kl) if self.size_average else jnp.sum(kl)
+
+
+class GaussianCriterion(AbstractCriterion):
+    """Negative log-likelihood of ``target`` under N(mean, exp(log_var)) given a
+    Table (mean, log_var) (reference ``GaussianCriterion``)."""
+
+    def __init__(self, size_average: bool = False):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        mu, log_var = xs[0], xs[1]
+        nll = 0.5 * (jnp.log(2.0 * jnp.pi) + log_var
+                     + jnp.square(target - mu) / jnp.exp(log_var))
+        return _reduce(nll, self.size_average)
+
+
+class DiceCoefficientCriterion(AbstractCriterion):
+    """1 - Sørensen–Dice overlap (reference ``DiceCoefficientCriterion`` —
+    segmentation loss): per-sample ``1 - 2·Σxy / (Σx + Σy + ε)``, averaged."""
+
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        super().__init__()
+        self.size_average = size_average
+        self.epsilon = epsilon
+
+    def apply(self, input, target):
+        x = input.reshape(input.shape[0], -1)
+        y = target.reshape(target.shape[0], -1).astype(x.dtype)
+        inter = jnp.sum(x * y, axis=1)
+        denom = jnp.sum(x, axis=1) + jnp.sum(y, axis=1) + self.epsilon
+        loss = 1.0 - 2.0 * inter / denom
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class SoftmaxWithCriterion(AbstractCriterion):
+    """Fused softmax + multinomial logistic loss over logits, Caffe
+    ``SoftmaxWithLoss`` semantics (reference ``SoftmaxWithCriterion``):
+    optional ``ignore_label`` and normalize modes ``valid`` (default: divide by
+    non-ignored count), ``full`` (all), ``batch_size``, ``none``."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "valid", one_based: bool = False):
+        super().__init__()
+        if normalize_mode not in ("valid", "full", "batch_size", "none"):
+            raise ValueError(f"unknown normalize_mode {normalize_mode!r}")
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+        self.one_based = one_based
+
+    def apply(self, input, target):
+        logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=1) \
+            if input.ndim > 1 else jax.nn.log_softmax(input)
+        # channel dim = axis 1 (NC or NCHW); move classes last, flatten the rest
+        logp = jnp.moveaxis(logp, 1, -1).reshape(-1, input.shape[1])
+        idx = _class_index(jnp.reshape(target, (-1,)), self.one_based)
+        picked = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        if self.ignore_label is not None:
+            ignore = _class_index(jnp.asarray(self.ignore_label), self.one_based)
+            mask = (idx != ignore).astype(logp.dtype)
+            # ignore labels may be out of class range (Caffe's 255): clamp the
+            # gather index to 0 for masked rows so no NaN leaks through 0*NaN
+            idx = jnp.where(idx != ignore, idx, 0)
+            picked = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+            picked = picked * mask
+            valid = jnp.sum(mask)
+        else:
+            valid = jnp.asarray(picked.shape[0], picked.dtype)
+        total = jnp.sum(picked)
+        if self.normalize_mode == "valid":
+            return total / jnp.maximum(valid, 1.0)
+        if self.normalize_mode == "full":
+            return total / picked.shape[0]
+        if self.normalize_mode == "batch_size":
+            return total / input.shape[0]
+        return total
+
+
+class CategoricalCrossEntropy(AbstractCriterion):
+    """Keras-style categorical cross-entropy: probabilities vs one-hot targets
+    (reference ``CategoricalCrossEntropy``)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        p = jnp.clip(input, 1e-8, 1.0)
+        loss = -jnp.sum(target * jnp.log(p), axis=-1)
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class TimeDistributedMaskCriterion(AbstractCriterion):
+    """TimeDistributedCriterion that skips padded timesteps (reference
+    ``TimeDistributedMaskCriterion(criterion, paddingValue)``): timesteps whose
+    target equals ``padding_value`` contribute nothing, and the mean runs over
+    the non-padded count only. The inner criterion must be class-index based
+    (ClassNLL / CrossEntropy — the padded-label use case)."""
+
+    def __init__(self, criterion: AbstractCriterion, padding_value: int = 0):
+        super().__init__()
+        if isinstance(criterion, CrossEntropyCriterion):
+            self._logits = True
+        elif isinstance(criterion, ClassNLLCriterion):
+            self._logits = not criterion.logprob_as_input
+        else:
+            raise TypeError(
+                "TimeDistributedMaskCriterion supports class-index criterions "
+                f"(ClassNLL/CrossEntropy), got {type(criterion).__name__}")
+        inner = criterion.inner if isinstance(criterion, CrossEntropyCriterion) \
+            else criterion
+        self.one_based = inner.one_based
+        self.padding_value = padding_value
+
+    def apply(self, input, target):
+        logp = input.reshape(-1, input.shape[-1])
+        if self._logits:
+            logp = jax.nn.log_softmax(logp.astype(jnp.float32), axis=-1)
+        raw = jnp.reshape(target, (-1,))
+        mask = (raw != self.padding_value).astype(logp.dtype)
+        idx = _class_index(raw, self.one_based)
+        idx = jnp.where(mask > 0, idx, 0)  # padded rows pick class 0, masked out
+        picked = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        return jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class SmoothL1CriterionWithWeights(AbstractCriterion):
+    """Fast-RCNN bbox regression loss (reference
+    ``SmoothL1CriterionWithWeights(sigma, num)``): target is a Table
+    (t, inside_w, outside_w); ``sum(outside_w * smoothL1(inside_w*(x-t)))/num``
+    with the sigma-scaled Huber transition at ``1/sigma^2``."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        super().__init__()
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def apply(self, input, target):
+        if isinstance(target, Table):
+            t, iw, ow = target.values()
+        elif isinstance(target, (tuple, list)) and len(target) == 3:
+            t, iw, ow = target
+        else:
+            t, iw, ow = target, None, None
+        d = input - t
+        if iw is not None:
+            d = d * iw
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / self.sigma2,
+                         0.5 * self.sigma2 * jnp.square(d),
+                         ad - 0.5 / self.sigma2)
+        if ow is not None:
+            loss = loss * ow
+        total = jnp.sum(loss)
+        return total / self.num if self.num > 0 else total
+
+
+class TransformerCriterion(AbstractCriterion):
+    """Apply (frozen) transform modules to input and/or target before an inner
+    criterion (reference ``TransformerCriterion`` — perceptual-loss pattern).
+    The transforms' parameters are captured as constants: they do not train
+    through the loss, matching the upstream frozen-feature-extractor usage."""
+
+    def __init__(self, criterion: AbstractCriterion,
+                 input_transformer=None, target_transformer=None):
+        super().__init__()
+        self.criterion = criterion
+        self.input_transformer = input_transformer
+        self.target_transformer = target_transformer
+
+    def _run(self, module, x):
+        if module is None:
+            return x
+        out, _ = module.apply(module.get_params(), module.get_state(), x,
+                              training=False, rng=None)
+        return out
+
+    def apply(self, input, target):
+        return self.criterion.apply(self._run(self.input_transformer, input),
+                                    self._run(self.target_transformer, target))

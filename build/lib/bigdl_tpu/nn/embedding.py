@@ -1,0 +1,98 @@
+"""Embedding layers.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/LookupTable.scala`` — unverified):
+``LookupTable(nIndex, nOutput)`` maps 1-based integer indices to rows of a learnable
+(nIndex, nOutput) weight; options paddingValue / maxNorm / normType.
+
+TPU-native: the lookup is one gather (``weight[idx]``); its VJP is a scatter-add that XLA
+emits natively — no sparse-gradient special-casing like Torch's. max-norm renorm is applied
+functionally in the forward pass (matching Torch semantics of renorm-before-lookup).
+
+Out-of-range behaviour differs from the reference: the reference raises on bad indices, but
+a jitted gather cannot — JAX *clamps* out-of-bounds indices and wraps negative ones, so an
+off-by-one in user data silently reads a wrong row. Callers can assert ranges host-side;
+``zero_based=True`` is the safest choice for new code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomNormal
+
+
+class LookupTable(TensorModule):
+    def __init__(self, n_index: int, n_output: int, padding_value: float = 0.0,
+                 max_norm: float = float("inf"), norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False,
+                 w_init: Optional[InitializationMethod] = None,
+                 zero_based: bool = False):
+        super().__init__()
+        self.n_index = n_index
+        self.n_output = n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.w_init = w_init or RandomNormal(0.0, 1.0)
+        self.zero_based = zero_based
+        self.reset()
+
+    def reset(self) -> None:
+        self._params = {"weight": jnp.asarray(
+            self.w_init.init((self.n_index, self.n_output),
+                             fan_in=self.n_index, fan_out=self.n_output))}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        idx = input.astype(jnp.int32)
+        if not self.zero_based:
+            idx = idx - 1  # reference/Torch indices are 1-based
+        w = params["weight"]
+        if self.max_norm != float("inf"):
+            norms = jnp.power(
+                jnp.sum(jnp.power(jnp.abs(w), self.norm_type), axis=1, keepdims=True),
+                1.0 / self.norm_type)
+            scale = jnp.minimum(1.0, self.max_norm / (norms + 1e-7))
+            w = w * scale
+        out = w[idx]
+        if self.padding_value != 0.0:
+            pad_idx = int(self.padding_value) - (0 if self.zero_based else 1)
+            out = jnp.where((idx == pad_idx)[..., None], 0.0, out)
+        return out, state
+
+    def __repr__(self):
+        return f"LookupTable({self.n_index} -> {self.n_output})"
+
+
+class HashBucketEmbedding(LookupTable):
+    """Embedding over hashed ids: arbitrary (possibly unbounded) non-negative
+    integer ids are mixed with a Fibonacci multiplicative hash and mapped into
+    ``n_buckets`` rows. The analog of the reference recommendation examples'
+    hashing trick for out-of-vocabulary users/items (SURVEY.md §2.5 Examples:
+    NCF / Wide&Deep), without the host-side feature dictionary.
+
+    Always zero-based (ids are raw hashes, not Torch 1-based vocab indices).
+    """
+
+    def __init__(self, n_buckets: int, n_output: int,
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__(n_buckets, n_output, w_init=w_init, zero_based=True)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        h = input.astype(jnp.uint32)
+        # murmur3-style 32-bit finalizer: full avalanche, so every bucket in
+        # [0, n_buckets) is reachable for any n_buckets up to 2^32 — a handful
+        # of fused integer ops on the VPU
+        h = h ^ (h >> jnp.uint32(16))
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> jnp.uint32(13))
+        h = h * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> jnp.uint32(16))
+        bucket = (h % jnp.uint32(self.n_index)).astype(jnp.int32)
+        return super().apply(params, state, bucket, training=training, rng=rng)
+
+    def __repr__(self):
+        return f"HashBucketEmbedding({self.n_index} buckets -> {self.n_output})"

@@ -1,0 +1,103 @@
+"""Weight initialisation strategies.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/InitializationMethod.scala`` —
+unverified): ``Xavier``, ``MsraFiller``, ``RandomUniform``, ``RandomNormal``, ``Zeros``,
+``Ones``, ``ConstInitMethod``, ``BilinearFiller``. Init is eager, host-side, driven by the
+global deterministic ``RandomGenerator`` (Torch semantics); arrays are then pushed to device.
+
+Fan-in/fan-out convention matches Torch/BigDL: for a Linear weight of shape (out, in),
+fan_in = in, fan_out = out; for conv weight (nOut, nIn, kH, kW), fan_in = nIn*kH*kW.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+from bigdl_tpu.nn.abstractnn import RecordsInit
+
+
+class InitializationMethod(metaclass=RecordsInit):
+    def init(self, shape, fan_in: int, fan_out: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform: U(-sqrt(6/(fan_in+fan_out)), +)."""
+
+    def init(self, shape, fan_in, fan_out):
+        limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+        return RandomGenerator.uniform(-limit, limit, shape)
+
+
+class MsraFiller(InitializationMethod):
+    """He/MSRA normal: N(0, sqrt(2/fan)) — the reference uses it for ResNet convs."""
+
+    def __init__(self, variance_norm_average: bool = False):
+        self.variance_norm_average = variance_norm_average
+
+    def init(self, shape, fan_in, fan_out):
+        n = (fan_in + fan_out) / 2.0 if self.variance_norm_average else fan_out
+        std = float(np.sqrt(2.0 / n))
+        return RandomGenerator.normal(0.0, std, shape)
+
+
+class RandomUniform(InitializationMethod):
+    def __init__(self, lower: float | None = None, upper: float | None = None):
+        self.lower, self.upper = lower, upper
+
+    def init(self, shape, fan_in, fan_out):
+        if self.lower is None:
+            # Torch default: U(-1/sqrt(fan_in), 1/sqrt(fan_in))
+            stdv = 1.0 / float(np.sqrt(fan_in)) if fan_in > 0 else 1.0
+            return RandomGenerator.uniform(-stdv, stdv, shape)
+        return RandomGenerator.uniform(self.lower, self.upper, shape)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def init(self, shape, fan_in, fan_out):
+        return RandomGenerator.normal(self.mean, self.stdv, shape)
+
+
+class Zeros(InitializationMethod):
+    def init(self, shape, fan_in, fan_out):
+        return np.zeros(shape, np.float32)
+
+
+class Ones(InitializationMethod):
+    def init(self, shape, fan_in, fan_out):
+        return np.ones(shape, np.float32)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value: float):
+        self.value = value
+
+    def init(self, shape, fan_in, fan_out):
+        return np.full(shape, self.value, np.float32)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear upsampling kernel init (for deconvolution layers)."""
+
+    def init(self, shape, fan_in, fan_out):
+        # shape: (nOut, nIn, kH, kW)
+        if len(shape) != 4:
+            raise ValueError("BilinearFiller expects a 4-D conv weight shape")
+        kh, kw = shape[-2], shape[-1]
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        yy, xx = np.meshgrid(np.arange(kh), np.arange(kw), indexing="ij")
+        filt = (1 - np.abs(yy / f_h - c_h)) * (1 - np.abs(xx / f_w - c_w))
+        out = np.zeros(shape, np.float32)
+        out[...] = filt
+        return out

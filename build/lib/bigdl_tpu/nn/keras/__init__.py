@@ -1,0 +1,22 @@
+"""Keras-1.2-style user API (reference ``<dl>/nn/keras/`` + python
+``bigdl.nn.keras`` — SURVEY.md §2.1, unverified)."""
+
+from bigdl_tpu.nn.keras.layers import (
+    Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
+    Dropout, Embedding, Flatten, GRU, GlobalAveragePooling2D, KerasLayer, LSTM,
+    MaxPooling2D, Reshape, SimpleRNN, ZeroPadding2D,
+)
+from bigdl_tpu.nn.keras.topology import (
+    Input, KerasModel, KerasNode, Model, Sequential, merge,
+)
+
+# Keras-2 style aliases
+Conv2D = Convolution2D
+
+__all__ = [
+    "Activation", "AveragePooling2D", "BatchNormalization", "Conv2D",
+    "Convolution2D", "Dense", "Dropout", "Embedding", "Flatten", "GRU",
+    "GlobalAveragePooling2D", "Input", "KerasLayer", "KerasModel", "KerasNode",
+    "LSTM", "MaxPooling2D", "Model", "Reshape", "Sequential", "SimpleRNN",
+    "ZeroPadding2D", "merge",
+]

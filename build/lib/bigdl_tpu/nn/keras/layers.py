@@ -1,0 +1,445 @@
+"""Keras-1.2-style shape-inferring layers over the nn module zoo.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/keras/`` — unverified): the
+reference wraps its Torch-style layers in Keras layers that infer weight shapes from
+the incoming activation shape; models are wired with ``Sequential.add`` or the
+functional ``layer(node)`` API and trained via ``compile/fit``.
+
+Design: a ``KerasLayer`` is a *builder* — ``build(input_shape)`` (batch dim excluded)
+returns the concrete nn module, ``compute_output_shape`` propagates shapes. Data layout
+is channels-first (NCHW), the framework-wide convention (TPU/XLA handles layout
+assignment internally, so no 'tf' dim-ordering variant is needed).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+from bigdl_tpu import nn as N
+
+
+def _act(name: Optional[str]):
+    if name is None or name == "linear":
+        return None
+    table = {
+        "relu": N.ReLU, "tanh": N.Tanh, "sigmoid": N.Sigmoid,
+        "hard_sigmoid": N.HardSigmoid, "softmax": N.SoftMax,
+        "softplus": N.SoftPlus, "softsign": N.SoftSign, "elu": N.ELU,
+        "gelu": N.GELU, "swish": N.Swish, "log_softmax": N.LogSoftMax,
+    }
+    if name not in table:
+        raise ValueError(f"unknown activation {name!r}")
+    return table[name]()
+
+
+def _pair(v) -> tuple:
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+class KerasLayer:
+    """Shape-inferring builder for one nn module."""
+
+    def __init__(self, input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        self.input_shape = tuple(input_shape) if input_shape is not None else None
+        self.name = name or f"{type(self).__name__.lower()}_{id(self) % 100000}"
+
+    def build(self, input_shape: tuple) -> "N.AbstractModule":
+        raise NotImplementedError
+
+    def compute_output_shape(self, input_shape: tuple) -> tuple:
+        raise NotImplementedError
+
+    def _with_activation(self, module, activation: Optional[str]):
+        act = _act(activation)
+        if act is None:
+            return module
+        return N.Sequential().add(module).add(act)
+
+    # functional API: layer(node) → new node with propagated shape
+    def __call__(self, node):
+        from bigdl_tpu.nn.keras.topology import KerasNode, merge_nodes
+        if isinstance(node, (list, tuple)):
+            node = merge_nodes(node)
+        if not isinstance(node, KerasNode):
+            raise TypeError("functional call expects Input()/layer output node(s)")
+        module = self.build(node.shape)
+        from bigdl_tpu.nn.graph import make_node
+        return KerasNode(make_node(module, [node.node]),
+                         self.compute_output_shape(node.shape))
+
+
+class Dense(KerasLayer):
+    def __init__(self, output_dim: int, activation: Optional[str] = None,
+                 bias: bool = True, init=None, **kw):
+        super().__init__(**kw)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.bias = bias
+        self.init = init
+
+    def build(self, input_shape):
+        if len(input_shape) != 1:
+            raise ValueError(
+                f"Dense expects 1-D (features,) input shape, got {input_shape}; "
+                "add Flatten() first")
+        lin = N.Linear(input_shape[0], self.output_dim, with_bias=self.bias,
+                       w_init=self.init)
+        return self._with_activation(lin, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        return (self.output_dim,)
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation: str, **kw):
+        super().__init__(**kw)
+        self.activation = activation
+
+    def build(self, input_shape):
+        act = _act(self.activation)
+        return act if act is not None else N.Identity()
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p: float, **kw):
+        super().__init__(**kw)
+        self.p = p
+
+    def build(self, input_shape):
+        return N.Dropout(self.p)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class Flatten(KerasLayer):
+    def build(self, input_shape):
+        return N.Reshape([int(math.prod(input_shape))])
+
+    def compute_output_shape(self, input_shape):
+        return (int(math.prod(input_shape)),)
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape: Sequence[int], **kw):
+        super().__init__(**kw)
+        self.target_shape = tuple(target_shape)
+
+    def build(self, input_shape):
+        return N.Reshape(list(self.target_shape))
+
+    def compute_output_shape(self, input_shape):
+        if math.prod(self.target_shape) != math.prod(input_shape):
+            raise ValueError(
+                f"cannot reshape {input_shape} into {self.target_shape}")
+        return self.target_shape
+
+
+class Convolution2D(KerasLayer):
+    """2-D conv on (channels, h, w). ``border_mode``: 'valid' or 'same'."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None, border_mode: str = "valid",
+                 subsample=(1, 1), bias: bool = True, init=None, **kw):
+        super().__init__(**kw)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode must be valid|same, got {border_mode!r}")
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.bias = bias
+        self.init = init
+
+    def build(self, input_shape):
+        c = input_shape[0]
+        kh, kw = self.nb_row, self.nb_col
+        pre_pad = None
+        pw = ph = 0
+        if self.border_mode == "same":
+            if kh % 2 == 1 and kw % 2 == 1:
+                pw, ph = (kw - 1) // 2, (kh - 1) // 2  # symmetric pad suffices
+            else:
+                # even kernel: SAME needs asymmetric (k-1)//2 / k//2 padding,
+                # which the conv's symmetric pad can't express — pad explicitly.
+                # Total pad k-1 yields out = ceil(in/stride) for every stride.
+                pre_pad = N.SpatialZeroPadding((kw - 1) // 2, kw // 2,
+                                               (kh - 1) // 2, kh // 2)
+        conv = N.SpatialConvolution(
+            c, self.nb_filter, kw, kh,
+            self.subsample[1], self.subsample[0], pw, ph,
+            with_bias=self.bias, w_init=self.init)
+        if pre_pad is not None:
+            conv = N.Sequential().add(pre_pad).add(conv)
+        return self._with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        sh, sw = self.subsample
+        if self.border_mode == "same":
+            oh = (h + sh - 1) // sh
+            ow = (w + sw - 1) // sw
+        else:
+            oh = (h - self.nb_row) // sh + 1
+            ow = (w - self.nb_col) // sw + 1
+        return (self.nb_filter, oh, ow)
+
+
+class _Pooling2D(KerasLayer):
+    _op = None
+
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode: str = "valid",
+                 **kw):
+        super().__init__(**kw)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode must be valid|same, got {border_mode!r}")
+        self.border_mode = border_mode
+
+    def build(self, input_shape):
+        if self.border_mode == "same":
+            # SAME = ceil(h/s) per dimension; the pooling primitive computes the exact
+            # asymmetric lo/hi padding itself (pad_mode="same"), which is correct for
+            # odd, even, and mixed pool sizes alike — no ceil-mode double counting.
+            return self._op(self.pool_size[1], self.pool_size[0],
+                            self.strides[1], self.strides[0], pad_mode="same")
+        return self._op(self.pool_size[1], self.pool_size[0],
+                        self.strides[1], self.strides[0], 0, 0)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        sh, sw = self.strides
+        if self.border_mode == "same":
+            return (c, (h + sh - 1) // sh, (w + sw - 1) // sw)
+        return (c, (h - self.pool_size[0]) // sh + 1,
+                (w - self.pool_size[1]) // sw + 1)
+
+
+class MaxPooling2D(_Pooling2D):
+    @property
+    def _op(self):
+        return N.SpatialMaxPooling
+
+
+class AveragePooling2D(_Pooling2D):
+    @property
+    def _op(self):
+        return N.SpatialAveragePooling
+
+
+class GlobalAveragePooling2D(KerasLayer):
+    def build(self, input_shape):
+        c, h, w = input_shape
+        return N.Sequential().add(N.SpatialAveragePooling(w, h)) \
+                             .add(N.Reshape([c]))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding=(1, 1), **kw):
+        super().__init__(**kw)
+        self.padding = _pair(padding)
+
+    def build(self, input_shape):
+        ph, pw = self.padding
+        return N.SpatialZeroPadding(pw, pw, ph, ph)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        return (c, h + 2 * self.padding[0], w + 2 * self.padding[1])
+
+
+class BatchNormalization(KerasLayer):
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99, **kw):
+        super().__init__(**kw)
+        self.epsilon = epsilon
+        self.momentum = momentum
+
+    def build(self, input_shape):
+        # our BatchNormalization momentum is the update fraction (Torch style),
+        # Keras momentum is the retain fraction
+        mom = 1.0 - self.momentum
+        if len(input_shape) == 3:
+            return N.SpatialBatchNormalization(input_shape[0], eps=self.epsilon,
+                                               momentum=mom)
+        return N.BatchNormalization(input_shape[0], eps=self.epsilon, momentum=mom)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class Embedding(KerasLayer):
+    """(batch, seq) int indices → (batch, seq, output_dim). 0-based indices."""
+
+    def __init__(self, input_dim: int, output_dim: int, init=None, **kw):
+        super().__init__(**kw)
+        self.input_dim, self.output_dim = input_dim, output_dim
+        self.init = init
+
+    def build(self, input_shape):
+        return N.LookupTable(self.input_dim, self.output_dim, w_init=self.init,
+                             zero_based=True)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+
+class _RecurrentLayer(KerasLayer):
+    _cell = None
+
+    def __init__(self, output_dim: int, return_sequences: bool = False,
+                 go_backwards: bool = False, **kw):
+        super().__init__(**kw)
+        self.output_dim = output_dim
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def _make_cell(self, input_size):
+        return self._cell(input_size, self.output_dim)
+
+    def build(self, input_shape):
+        if len(input_shape) != 2:
+            raise ValueError(
+                f"recurrent layers expect (time, features) input, got {input_shape}")
+        seq = N.Sequential()
+        if self.go_backwards:
+            seq.add(_ReverseTime())
+        seq.add(N.Recurrent(self._make_cell(input_shape[1])))
+        if not self.return_sequences:
+            seq.add(N.Select(2, -1))  # last timestep (1-based dims)
+        return seq
+
+    def compute_output_shape(self, input_shape):
+        if self.return_sequences:
+            return (input_shape[0], self.output_dim)
+        return (self.output_dim,)
+
+
+class _ReverseTime(N.TensorModule):
+    """Flip the time axis of (batch, time, feature)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input[:, ::-1], state
+
+
+class LSTM(_RecurrentLayer):
+    @property
+    def _cell(self):
+        return N.LSTM
+
+
+class GRU(_RecurrentLayer):
+    @property
+    def _cell(self):
+        return N.GRU
+
+
+class SimpleRNN(_RecurrentLayer):
+    @property
+    def _cell(self):
+        return N.RnnCell
+
+
+class Convolution1D(KerasLayer):
+    """1-D conv on (steps, features) — keras-1.2 ``Convolution1D``. Maps onto
+    the native NWC TemporalConvolution (one MXU contraction)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation: Optional[str] = None, border_mode: str = "valid",
+                 subsample_length: int = 1, bias: bool = True, init=None, **kw):
+        super().__init__(**kw)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode must be valid|same, got {border_mode!r}")
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample_length = subsample_length
+        self.bias = bias
+        self.init = init
+
+    def build(self, input_shape):
+        steps, features = input_shape
+        conv = N.TemporalConvolution(features, self.nb_filter,
+                                     self.filter_length,
+                                     self.subsample_length,
+                                     with_bias=self.bias, w_init=self.init)
+        if self.border_mode == "same":
+            # exact TF/keras SAME split (shared helper — pooling.py)
+            from bigdl_tpu.nn.pooling import _same_pad
+            k, s = self.filter_length, self.subsample_length
+            left, right = _same_pad(steps, k, s)
+            needed = left + right
+            seq = N.Sequential()
+            if left:
+                seq.add(N.Padding(1, -left, num_input_dims=2))
+            if needed - left:
+                seq.add(N.Padding(1, needed - left, num_input_dims=2))
+            conv = seq.add(conv)
+        return self._with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        steps, _ = input_shape
+        k, s = self.filter_length, self.subsample_length
+        if self.border_mode == "same":
+            return ((steps + s - 1) // s, self.nb_filter)
+        return ((steps - k) // s + 1, self.nb_filter)
+
+
+class _Pooling1D(KerasLayer):
+    def __init__(self, pool_length: int = 2, stride: Optional[int] = None,
+                 **kw):
+        super().__init__(**kw)
+        self.pool_length = pool_length
+        self.stride = stride if stride is not None else pool_length
+
+    def compute_output_shape(self, input_shape):
+        steps, f = input_shape
+        return ((steps - self.pool_length) // self.stride + 1, f)
+
+
+class MaxPooling1D(_Pooling1D):
+    def build(self, input_shape):
+        return N.TemporalMaxPooling(self.pool_length, self.stride)
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    def build(self, input_shape):
+        return N.Sequential().add(N.TemporalMaxPooling(-1)).add(
+            N.Reshape([input_shape[1]]))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[1],)
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    def build(self, input_shape):
+        c, h, w = input_shape
+        return N.Sequential().add(N.SpatialMaxPooling(w, h)) \
+                             .add(N.Reshape([c]))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class LayerNormalization(KerasLayer):
+    """LayerNorm over the trailing feature axis (served by the Pallas kernel
+    on TPU)."""
+
+    def __init__(self, epsilon: float = 1e-5, **kw):
+        super().__init__(**kw)
+        self.epsilon = epsilon
+
+    def build(self, input_shape):
+        return N.LayerNorm(input_shape[-1], eps=self.epsilon)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape

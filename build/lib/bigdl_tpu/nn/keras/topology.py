@@ -1,0 +1,280 @@
+"""Keras-style model topologies: Sequential and functional Model with
+``compile / fit / evaluate / predict``.
+
+Reference parity (SURVEY.md §2.1/§3.4, expected ``<dl>/nn/keras/Topology.scala``,
+``Model.scala``, ``Sequential.scala`` — unverified): ``compile(optimizer, loss,
+metrics)`` then ``fit(x, y, batch_size, nb_epoch, validation_data)`` builds an
+Optimizer under the hood; ``predict``/``evaluate`` route through Predictor/Evaluator.
+
+TPU-native: no Py4J seam — numpy in, numpy out; ``fit`` assembles the same
+LocalOptimizer/DistriOptimizer used by the low-level API, so the jitted train step,
+mesh sharding, checkpoints and summaries all apply unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu import nn as N
+from bigdl_tpu.nn.graph import Input as GraphInput, ModuleNode
+from bigdl_tpu.nn.keras.layers import KerasLayer
+from bigdl_tpu.utils.engine import Engine
+
+
+class KerasNode:
+    """Functional-API handle: a graph node plus its (batch-less) activation shape."""
+
+    def __init__(self, node: ModuleNode, shape: tuple):
+        self.node = node
+        self.shape = tuple(shape)
+
+    def __repr__(self):
+        return f"KerasNode(shape={self.shape})"
+
+
+def Input(shape: Sequence[int], name: Optional[str] = None) -> KerasNode:
+    """Functional-API entry point: a placeholder carrying the declared shape."""
+    return KerasNode(GraphInput(), tuple(shape))
+
+
+def merge_nodes(nodes, mode: str = "concat", concat_axis: int = 1) -> KerasNode:
+    """Merge several functional nodes (reference keras ``Merge``/``merge``)."""
+    from bigdl_tpu.nn.graph import make_node
+    nodes = list(nodes)
+    if mode == "concat":
+        shapes = [n.shape for n in nodes]
+        for s in shapes[1:]:
+            if len(s) != len(shapes[0]):
+                raise ValueError(f"rank mismatch in concat merge: {shapes}")
+        rank = len(shapes[0])
+        # concat_axis counts the batch dim (Keras convention); normalize negatives
+        axis0 = (rank + concat_axis) if concat_axis < 0 else concat_axis - 1
+        if not 0 <= axis0 < rank:
+            raise ValueError(f"concat_axis {concat_axis} out of range for rank "
+                             f"{rank}+batch shapes {shapes}")
+        out = list(shapes[0])
+        out[axis0] = sum(s[axis0] for s in shapes)
+        module = N.JoinTable(axis0 + 2)  # 1-based dim including batch
+        shape = tuple(out)
+    elif mode in ("sum", "add"):
+        shape = nodes[0].shape
+        module = N.CAddTable()
+    else:
+        raise ValueError(f"unknown merge mode {mode!r}")
+    return KerasNode(make_node(module, [n.node for n in nodes]), shape)
+
+
+merge = merge_nodes
+
+
+# ---------------------------------------------------------------- loss/optim maps
+def _prob_crossentropy():
+    """Keras categorical_crossentropy: model outputs *probabilities* (softmax last
+    layer); ClassNLLCriterion takes the log itself with logprob_as_input=False."""
+    return N.ClassNLLCriterion(logprob_as_input=False)
+
+
+def _resolve_loss(loss):
+    if not isinstance(loss, str):
+        return loss
+    table = {
+        "categorical_crossentropy": _prob_crossentropy,
+        "sparse_categorical_crossentropy": _prob_crossentropy,
+        "mse": N.MSECriterion, "mean_squared_error": N.MSECriterion,
+        "mae": N.AbsCriterion, "mean_absolute_error": N.AbsCriterion,
+        "binary_crossentropy": N.BCECriterion,
+        "hinge": N.MarginCriterion,
+    }
+    if loss not in table:
+        raise ValueError(f"unknown loss {loss!r}")
+    return table[loss]()
+
+
+def _resolve_optimizer(opt):
+    if not isinstance(opt, str):
+        return opt
+    from bigdl_tpu import optim as O
+    table = {
+        "sgd": lambda: O.SGD(learningrate=0.01),
+        "adam": lambda: O.Adam(),
+        "adamax": lambda: O.Adamax(),
+        "adagrad": lambda: O.Adagrad(),
+        "adadelta": lambda: O.Adadelta(),
+        "rmsprop": lambda: O.RMSprop(),
+    }
+    if opt not in table:
+        raise ValueError(f"unknown optimizer {opt!r}")
+    return table[opt]()
+
+
+def _resolve_metric(m):
+    if not isinstance(m, str):
+        return m
+    from bigdl_tpu import optim as O
+    table = {"accuracy": O.Top1Accuracy, "acc": O.Top1Accuracy,
+             "top5": O.Top5Accuracy, "loss": O.Loss, "mae": O.MAE}
+    if m not in table:
+        raise ValueError(f"unknown metric {m!r}")
+    return table[m]()
+
+
+class KerasModel:
+    """Shared compile/fit/evaluate/predict over an underlying nn module."""
+
+    def __init__(self):
+        self._optim_method = None
+        self._criterion = None
+        self._metrics = None
+
+    # subclasses provide the built nn module
+    def _module(self) -> N.AbstractModule:
+        raise NotImplementedError
+
+    def _input_shape(self) -> Optional[tuple]:
+        """Declared per-sample input shape, when known (Sequential only)."""
+        return None
+
+    def _check_input(self, x) -> None:
+        want = self._input_shape()
+        if want is None or not isinstance(x, np.ndarray):
+            return
+        if tuple(x.shape[1:]) != tuple(want):
+            raise ValueError(
+                f"model expects per-sample input shape {tuple(want)}, got "
+                f"{tuple(x.shape[1:])} (full array shape {x.shape}); reshape your "
+                "data — e.g. images need an explicit channel axis")
+
+    def compile(self, optimizer, loss, metrics=None) -> "KerasModel":
+        self._optim_method = _resolve_optimizer(optimizer)
+        self._criterion = _resolve_loss(loss)
+        self._metrics = [_resolve_metric(m) for m in (metrics or [])]
+        return self
+
+    def _classification(self) -> bool:
+        return isinstance(self._criterion,
+                          (N.ClassNLLCriterion, N.CrossEntropyCriterion))
+
+    def _to_samples(self, x, y):
+        from bigdl_tpu.dataset.sample import Sample
+        x = np.asarray(x)
+        if not np.issubdtype(x.dtype, np.floating):
+            x = x.astype(np.float32)
+        if y is None:
+            return [Sample(xi) for xi in x]
+        y = np.asarray(y)
+        # one-hot → int labels, but ONLY for classification losses — 2-D float
+        # regression / multi-label targets must pass through untouched
+        if self._classification() and y.ndim == 2 and y.shape[1] > 1:
+            y = y.argmax(axis=1)
+        y = y.astype(np.int32) if np.issubdtype(y.dtype, np.integer) \
+            else y.astype(np.float32)
+        return [Sample(xi, yi) for xi, yi in zip(x, y)]
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None, distributed: bool = False) -> "KerasModel":
+        if self._criterion is None:
+            raise RuntimeError("call compile(optimizer, loss) before fit")
+        from bigdl_tpu.dataset import DataSet
+        from bigdl_tpu.dataset.sample import SampleToMiniBatch
+        from bigdl_tpu.optim import DistriOptimizer, LocalOptimizer, Trigger
+        if not Engine.is_initialized():
+            Engine.init()
+        self._check_input(x if isinstance(x, np.ndarray) else None)
+        if isinstance(x, np.ndarray) or isinstance(x, (list, tuple)):
+            dataset = DataSet.array(self._to_samples(x, y),
+                                    distributed=distributed) \
+                >> SampleToMiniBatch(batch_size)
+        else:
+            dataset = x  # already a DataSet of MiniBatches
+        cls = DistriOptimizer if distributed else LocalOptimizer
+        opt = (cls(self._module(), dataset, self._criterion)
+               .set_optim_method(self._optim_method)
+               .set_end_when(Trigger.max_epoch(nb_epoch)))
+        if validation_data is not None:
+            vx, vy = validation_data
+            val_ds = DataSet.array(self._to_samples(vx, vy),
+                                   distributed=distributed) \
+                >> SampleToMiniBatch(batch_size)
+            opt.set_validation(Trigger.every_epoch(), val_ds,
+                               self._metrics or [_resolve_metric("accuracy")])
+        self._last_optimizer = opt
+        opt.optimize()
+        return self
+
+    def evaluate(self, x, y=None, batch_size: int = 32):
+        from bigdl_tpu.optim.evaluator import Evaluator
+        methods = self._metrics or [_resolve_metric("accuracy")]
+        samples = self._to_samples(x, y) if isinstance(x, np.ndarray) else x
+        results = Evaluator(self._module()).test(samples, methods, batch_size)
+        return [r.result()[0] for r, _ in results]
+
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        self._check_input(x if isinstance(x, np.ndarray) else None)
+        return self._module().predict(x, batch_size)
+
+    def predict_classes(self, x, batch_size: int = 32) -> np.ndarray:
+        return self._module().predict_class(x, batch_size)
+
+    # persistence passthrough
+    def save(self, path: str, overwrite: bool = True) -> None:
+        self._module().save(path, overwrite=overwrite)
+
+    def get_weights(self):
+        return self._module().get_params()
+
+    def set_weights(self, params) -> None:
+        self._module().set_params(params)
+
+    def summary(self) -> str:
+        lines = [f"{type(self).__name__}:"]
+        lines.append(repr(self._module()))
+        return "\n".join(lines)
+
+
+class Sequential(KerasModel):
+    """Linear stack with incremental shape inference (first layer needs
+    ``input_shape``)."""
+
+    def __init__(self):
+        super().__init__()
+        self._seq = N.Sequential()
+        self._cur_shape: Optional[tuple] = None
+        self.layers: list[KerasLayer] = []
+
+    def add(self, layer: KerasLayer) -> "Sequential":
+        if self._cur_shape is None:
+            if layer.input_shape is None:
+                raise ValueError("first layer must declare input_shape")
+            self._cur_shape = layer.input_shape
+        self._seq.add(layer.build(self._cur_shape))
+        self._cur_shape = layer.compute_output_shape(self._cur_shape)
+        self.layers.append(layer)
+        return self
+
+    @property
+    def output_shape(self) -> tuple:
+        return self._cur_shape
+
+    def _module(self):
+        return self._seq
+
+    def _input_shape(self):
+        return self.layers[0].input_shape if self.layers else None
+
+
+class Model(KerasModel):
+    """Functional model over Input()/layer(node) wiring."""
+
+    def __init__(self, input, output):
+        super().__init__()
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+        outputs = output if isinstance(output, (list, tuple)) else [output]
+        self._graph = N.Graph([n.node for n in inputs],
+                              [n.node for n in outputs])
+        self.output_shape = tuple(outputs[0].shape) if len(outputs) == 1 else \
+            [tuple(o.shape) for o in outputs]
+
+    def _module(self):
+        return self._graph

@@ -1,0 +1,60 @@
+"""Fully-connected layers.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/Linear.scala`` — unverified): weight
+shape (outputSize, inputSize), optional bias, Torch default init U(-1/sqrt(fanIn), +).
+TPU-native: one ``jnp.dot`` lowered onto the MXU; weight regularisation hooks carried as
+metadata consumed by the optimizer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform, Zeros
+
+
+class Linear(TensorModule):
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.reset()
+
+    def reset(self) -> None:
+        w = self.w_init.init((self.output_size, self.input_size),
+                             fan_in=self.input_size, fan_out=self.output_size)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            b = self.b_init.init((self.output_size,),
+                                 fan_in=self.input_size, fan_out=self.output_size)
+            self._params["bias"] = jnp.asarray(b)
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        flattened = False
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+            flattened = True
+        elif x.ndim == 1:
+            x = x[None, :]
+        out = x @ params["weight"].T
+        if self.with_bias:
+            out = out + params["bias"]
+        if input.ndim == 1 and not flattened:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return f"Linear({self.input_size} -> {self.output_size})"

@@ -1,0 +1,203 @@
+"""Spatial pooling layers.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/SpatialMaxPooling.scala``,
+``SpatialAveragePooling.scala`` — unverified): NCHW, kernel (kW,kH), stride (dW,dH),
+pad (padW,padH), floor mode by default with a ``.ceil()`` toggle.
+
+TPU-native: ``lax.reduce_window`` — XLA maps it onto the VPU; the extra high-side padding
+needed for ceil mode is computed statically so shapes stay static under ``jit``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+
+
+def _out_size(in_size: int, k: int, s: int, p: int, ceil_mode: bool) -> int:
+    if ceil_mode:
+        out = int(math.ceil((in_size + 2 * p - k) / s)) + 1
+    else:
+        out = int(math.floor((in_size + 2 * p - k) / s)) + 1
+    if p > 0 and (out - 1) * s >= in_size + p:
+        out -= 1  # last window must start inside the (low-padded) input — Torch rule
+    return out
+
+
+def _pad_amounts(in_size: int, k: int, s: int, p: int, ceil_mode: bool):
+    out = _out_size(in_size, k, s, p, ceil_mode)
+    needed = (out - 1) * s + k - in_size - p
+    return p, max(needed, 0), out
+
+
+def _same_pad(in_size: int, k: int, s: int):
+    """TF/Keras SAME padding: out = ceil(in/s), asymmetric lo/hi split per dimension.
+
+    ``lax.reduce_window`` takes arbitrary (lo, hi) pads, so SAME needs no ceil-mode
+    trickery — it is exact for every kernel parity and stride.
+    """
+    out = -(-in_size // s)
+    total = max((out - 1) * s + k - in_size, 0)
+    lo = total // 2
+    return lo, total - lo
+
+
+class SpatialMaxPooling(TensorModule):
+    def __init__(self, kw: int, kh: int, dw: int | None = None, dh: int | None = None,
+                 pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,
+                 pad_mode: str = "torch"):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = ceil_mode
+        if pad_mode not in ("torch", "same"):
+            raise ValueError(f"pad_mode must be torch|same, got {pad_mode!r}")
+        self.pad_mode = pad_mode
+
+    def ceil(self) -> "SpatialMaxPooling":
+        self.ceil_mode = True
+        return self
+
+    def floor(self) -> "SpatialMaxPooling":
+        self.ceil_mode = False
+        return self
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        h, w = x.shape[2], x.shape[3]
+        if self.pad_mode == "same":
+            ph_lo, ph_hi = _same_pad(h, self.kh, self.dh)
+            pw_lo, pw_hi = _same_pad(w, self.kw, self.dw)
+        else:
+            ph_lo, ph_hi, _ = _pad_amounts(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
+            pw_lo, pw_hi, _ = _pad_amounts(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
+        out = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, 1, self.kh, self.kw),
+            window_strides=(1, 1, self.dh, self.dw),
+            padding=((0, 0), (0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi)),
+        )
+        if squeeze:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return (f"SpatialMaxPooling({self.kw}x{self.kh}, {self.dw},{self.dh}, "
+                f"{self.pad_w},{self.pad_h}{', ceil' if self.ceil_mode else ''})")
+
+
+class SpatialAveragePooling(TensorModule):
+    def __init__(self, kw: int, kh: int, dw: int | None = None, dh: int | None = None,
+                 pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,
+                 count_include_pad: bool = True, divide: bool = True,
+                 global_pooling: bool = False, pad_mode: str = "torch"):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+        self.global_pooling = global_pooling
+        if pad_mode not in ("torch", "same"):
+            raise ValueError(f"pad_mode must be torch|same, got {pad_mode!r}")
+        if pad_mode == "same" and global_pooling:
+            raise ValueError("pad_mode='same' is meaningless with global_pooling "
+                             "(the window already covers the whole input)")
+        self.pad_mode = pad_mode
+
+    def ceil(self) -> "SpatialAveragePooling":
+        self.ceil_mode = True
+        return self
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        h, w = x.shape[2], x.shape[3]
+        kh, kw = (h, w) if self.global_pooling else (self.kh, self.kw)
+        dh, dw = (1, 1) if self.global_pooling else (self.dh, self.dw)
+        if self.pad_mode == "same":
+            # TF/Keras SAME semantics: padded positions never count toward the average.
+            ph_lo, ph_hi = _same_pad(h, kh, dh)
+            pw_lo, pw_hi = _same_pad(w, kw, dw)
+            include_pad_in_count = False
+        else:
+            ph_lo, ph_hi, _ = _pad_amounts(h, kh, dh, self.pad_h, self.ceil_mode)
+            pw_lo, pw_hi, _ = _pad_amounts(w, kw, dw, self.pad_w, self.ceil_mode)
+            include_pad_in_count = self.count_include_pad and (
+                self.pad_h > 0 or self.pad_w > 0)
+        pad = ((0, 0), (0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi))
+        # fp32 island (nn/precision.py): window sums are reductions — under bf16
+        # a global pool over H*W values would lose ~1% relative accuracy, so
+        # accumulate fp32 and cast back at the end (same rule as BN statistics).
+        x32 = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
+        sums = lax.reduce_window(
+            x32, 0.0, lax.add,
+            window_dimensions=(1, 1, kh, kw),
+            window_strides=(1, 1, dh, dw),
+            padding=pad,
+        )
+        no_pad = ph_lo == ph_hi == pw_lo == pw_hi == 0
+        if not self.divide:
+            out = sums
+        elif include_pad_in_count or no_pad:
+            out = sums / float(kh * kw)
+        else:
+            ones = jnp.ones((1, 1, h, w), jnp.float32)
+            counts = lax.reduce_window(
+                ones, 0.0, lax.add,
+                window_dimensions=(1, 1, kh, kw),
+                window_strides=(1, 1, dh, dw),
+                padding=pad,
+            )
+            out = sums / jnp.maximum(counts, 1.0)
+        out = out.astype(x.dtype)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return f"SpatialAveragePooling({self.kw}x{self.kh}, {self.dw},{self.dh})"
+
+
+class TemporalMaxPooling(TensorModule):
+    """1-D max pooling over time (reference ``<dl>/nn/TemporalMaxPooling.scala``
+    — unverified): (N, T, F) → (N, (T-kw)//dw+1, F). ``kernel_w=-1`` pools over
+    the WHOLE sequence (global max over time)."""
+
+    def __init__(self, kernel_w: int, stride_w: int | None = None):
+        super().__init__()
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w if stride_w is not None else kernel_w
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]
+        kw = x.shape[1] if self.kernel_w == -1 else self.kernel_w
+        dw = x.shape[1] if self.kernel_w == -1 else self.stride_w
+        out = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, kw, 1),
+            window_strides=(1, dw, 1),
+            padding="VALID").astype(x.dtype)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return f"TemporalMaxPooling({self.kernel_w}, {self.stride_w})"

@@ -1,0 +1,32 @@
+"""Mixed-precision policy — bf16 compute with fp32 master state.
+
+Reference parity (SURVEY.md §7.1, §7.3(5)): the reference's FP16 gradient *compression*
+(ParameterProcessor halving wire traffic) has no TPU analog worth keeping — ICI is fast and
+XLA owns the collectives. What matters on TPU is *compute* precision: the MXU runs bfloat16
+matmuls/convs at ~2x the fp32 rate and always accumulates in fp32 internally, so the
+numerically-sound policy is:
+
+- **master params fp32** — the optimizer state and update run in fp32; params are cast to
+  the compute dtype *inside* the jitted step (the cast's transpose makes gradients fp32);
+- **activations bf16** — inputs cast once at the step boundary;
+- **fp32 islands** — softmax/log-softmax (criterions see fp32 logits), batch-norm batch
+  statistics, and attention's streaming-softmax accumulators stay fp32;
+- **no loss scaling** — bfloat16 keeps fp32's exponent range, so the fp16-style scaled-loss
+  dance is unnecessary (and is deliberately not implemented).
+
+Enable via ``Engine.init(compute_dtype=jnp.bfloat16)`` or ``BIGDL_COMPUTE_DTYPE=bf16``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_floating(tree, dtype):
+    """Cast every floating leaf of a pytree to ``dtype``; integer/bool leaves pass
+    through untouched (targets, masks, valid counts)."""
+    def _cast(x):
+        x = jnp.asarray(x)
+        return x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+    return jax.tree_util.tree_map(_cast, tree)

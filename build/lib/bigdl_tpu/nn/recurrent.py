@@ -1,0 +1,450 @@
+"""Recurrent stack: cells, Recurrent container, TimeDistributed, BiRecurrent.
+
+Reference parity (SURVEY.md §2.1/§5.7, expected ``<dl>/nn/Recurrent.scala``, ``LSTM.scala``,
+``GRU.scala``, ``RnnCell.scala``, ``TimeDistributed.scala``, ``BiRecurrent.scala`` —
+unverified): the reference ``Recurrent`` container unrolls a cell over the time axis with a
+per-timestep Scala loop, cloning hidden state each step; input layout is (batch, time,
+feature).
+
+TPU-native redesign: the time loop is ``jax.lax.scan`` — ONE compiled loop body, O(1)
+compile cost in sequence length, and XLA rematerialises activations for the backward scan
+(the reference kept all T clones alive; SURVEY.md §5.7 notes scan "also fixes the unroll
+cost"). Gates are computed as a single fused (4H) matmul per step so the MXU sees one large
+GEMM instead of four small ones. Per-step dropout rng is derived inside the scan via
+``fold_in`` on the step index, keeping the step function pure.
+
+Gate memory layout is i|f|g|o (input, forget, cell-candidate, output) to match
+torch.nn.LSTM, which the test suite uses as the numerical oracle (SURVEY.md §4: oracle
+comparison against an independent implementation is the test backbone).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, Container, TensorModule
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform
+from bigdl_tpu.utils.table import T, Table
+
+
+class Cell(TensorModule):
+    """Base recurrent cell: one timestep ``(x_t, hidden) -> (out_t, new_hidden)``.
+
+    ``hidden`` is a pytree (tuple of arrays). ``apply`` runs a single step on a
+    ``Table(x_t, *hidden)`` for reference-API parity; ``Recurrent`` uses ``cell_apply``
+    directly inside its scan.
+    """
+
+    input_size: int
+    hidden_size: int
+
+    def init_hidden(self, batch_size: int):
+        raise NotImplementedError
+
+    def init_hidden_from(self, x0):
+        """Zero hidden state shaped for step-0 input ``x0`` (cells whose state
+        shape depends on the input, e.g. ConvLSTM feature maps, override this;
+        the default delegates to ``init_hidden(batch)``)."""
+        return self.init_hidden(x0.shape[0])
+
+    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+        raise NotImplementedError
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        x, hidden = xs[0], tuple(xs[1:])
+        out, new_hidden = self.cell_apply(params, x, hidden, training=training, rng=rng)
+        return T(out, *new_hidden), state
+
+
+def _uniform_init(init, shape, fan_in):
+    return jnp.asarray(init.init(shape, fan_in=fan_in, fan_out=shape[0]))
+
+
+class RnnCell(Cell):
+    """Vanilla RNN cell: ``h' = act(W_x x + b_x + W_h h + b_h)``."""
+
+    def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh,
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self.w_init = w_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        i, h = self.input_size, self.hidden_size
+        init = self.w_init
+        self._params = {
+            "w_ih": _uniform_init(init, (h, i), h),
+            "w_hh": _uniform_init(init, (h, h), h),
+            "b_ih": _uniform_init(init, (h,), h),
+            "b_hh": _uniform_init(init, (h,), h),
+        }
+        self.zero_grad_parameters()
+
+    def init_hidden(self, batch_size: int):
+        return (jnp.zeros((batch_size, self.hidden_size), jnp.float32),)
+
+    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+        (h,) = hidden
+        new_h = self.activation(
+            x @ params["w_ih"].T + params["b_ih"] + h @ params["w_hh"].T + params["b_hh"])
+        return new_h, (new_h,)
+
+    def __repr__(self):
+        return f"RnnCell({self.input_size}, {self.hidden_size})"
+
+
+class LSTM(Cell):
+    """LSTM cell (reference ``nn.LSTM``); gates fused into one (4H) GEMM, i|f|g|o order."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.w_init = w_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        i, h = self.input_size, self.hidden_size
+        init = self.w_init
+        self._params = {
+            "w_ih": _uniform_init(init, (4 * h, i), h),
+            "w_hh": _uniform_init(init, (4 * h, h), h),
+            "b_ih": _uniform_init(init, (4 * h,), h),
+            "b_hh": _uniform_init(init, (4 * h,), h),
+        }
+        self.zero_grad_parameters()
+
+    def init_hidden(self, batch_size: int):
+        z = jnp.zeros((batch_size, self.hidden_size), jnp.float32)
+        return (z, z)
+
+    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+        h, c = hidden
+        gates = (x @ params["w_ih"].T + params["b_ih"]
+                 + h @ params["w_hh"].T + params["b_hh"])
+        i_g, f_g, g_g, o_g = jnp.split(gates, 4, axis=-1)
+        i_g = jax.nn.sigmoid(i_g)
+        f_g = jax.nn.sigmoid(f_g)
+        g_g = jnp.tanh(g_g)
+        o_g = jax.nn.sigmoid(o_g)
+        new_c = f_g * c + i_g * g_g
+        new_h = o_g * jnp.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+    def __repr__(self):
+        return f"LSTM({self.input_size}, {self.hidden_size})"
+
+
+class LSTMPeephole(LSTM):
+    """LSTM with peephole connections from the cell state into i/f/o gates."""
+
+    def reset(self) -> None:
+        super().reset()
+        h = self.hidden_size
+        init = self.w_init
+        self._params["w_ci"] = _uniform_init(init, (h,), h)
+        self._params["w_cf"] = _uniform_init(init, (h,), h)
+        self._params["w_co"] = _uniform_init(init, (h,), h)
+        self.zero_grad_parameters()
+
+    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+        h, c = hidden
+        gates = (x @ params["w_ih"].T + params["b_ih"]
+                 + h @ params["w_hh"].T + params["b_hh"])
+        i_g, f_g, g_g, o_g = jnp.split(gates, 4, axis=-1)
+        i_g = jax.nn.sigmoid(i_g + c * params["w_ci"])
+        f_g = jax.nn.sigmoid(f_g + c * params["w_cf"])
+        g_g = jnp.tanh(g_g)
+        new_c = f_g * c + i_g * g_g
+        o_g = jax.nn.sigmoid(o_g + new_c * params["w_co"])
+        new_h = o_g * jnp.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+
+class GRU(Cell):
+    """GRU cell (reference ``nn.GRU``); gate order r|z|n matching torch.nn.GRU."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.w_init = w_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        i, h = self.input_size, self.hidden_size
+        init = self.w_init
+        self._params = {
+            "w_ih": _uniform_init(init, (3 * h, i), h),
+            "w_hh": _uniform_init(init, (3 * h, h), h),
+            "b_ih": _uniform_init(init, (3 * h,), h),
+            "b_hh": _uniform_init(init, (3 * h,), h),
+        }
+        self.zero_grad_parameters()
+
+    def init_hidden(self, batch_size: int):
+        return (jnp.zeros((batch_size, self.hidden_size), jnp.float32),)
+
+    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+        (h,) = hidden
+        gi = x @ params["w_ih"].T + params["b_ih"]
+        gh = h @ params["w_hh"].T + params["b_hh"]
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        new_h = (1.0 - z) * n + z * h
+        return new_h, (new_h,)
+
+    def __repr__(self):
+        return f"GRU({self.input_size}, {self.hidden_size})"
+
+
+def _scan_cell(cell: "Cell", cparams, x, *, training: bool, rng):
+    """Run ``cell`` over the time axis of (N, T, F) ``x`` with ``lax.scan``.
+
+    Returns the (N, T, H) output sequence. Per-step rng is derived by ``fold_in`` on the
+    step index so the scan body stays pure.
+    """
+    xs = jnp.swapaxes(x, 0, 1)  # (T, N, F)
+    steps = jnp.arange(xs.shape[0])
+
+    def step(h, xt_i):
+        x_t, i = xt_i
+        r = jax.random.fold_in(rng, i) if rng is not None else None
+        out, new_h = cell.cell_apply(cparams, x_t, h, training=training, rng=r)
+        return new_h, out
+
+    _, outs = jax.lax.scan(step, cell.init_hidden_from(x[:, 0]), (xs, steps))
+    return jnp.swapaxes(outs, 0, 1)
+
+
+class Recurrent(Container):
+    """Unroll one cell over the time axis of (batch, time, feature) input.
+
+    TPU-native: ``jax.lax.scan`` over the time-major transpose; returns the full
+    (batch, time, hidden) output sequence like the reference container.
+    """
+
+    def __init__(self, cell: Optional[Cell] = None):
+        super().__init__(*([cell] if cell is not None else []))
+
+    def add(self, module: AbstractModule) -> "Recurrent":
+        if self.modules:
+            raise ValueError("Recurrent holds exactly one cell")
+        if not isinstance(module, Cell):
+            raise TypeError("Recurrent requires a Cell (RnnCell/LSTM/GRU/...)")
+        return super().add(module)
+
+    @property
+    def cell(self) -> Cell:
+        return self.modules[0]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return _scan_cell(self.cell, params["0"], input,
+                          training=training, rng=rng), state
+
+    def needs_rng(self) -> bool:
+        return self.cell.needs_rng() if self.modules else False
+
+    def __repr__(self):
+        return f"Recurrent({self.cell!r})" if self.modules else "Recurrent()"
+
+
+class BiRecurrent(Container):
+    """Bidirectional recurrence: forward cell + backward cell over reversed time.
+
+    ``merge`` is "concat" (feature concat, reference ``JoinTable`` default) or "add".
+    The backward cell is an independent clone of the given cell (own parameters), as in
+    the reference.
+    """
+
+    def __init__(self, cell: Optional[Cell] = None, merge: str = "concat"):
+        if merge not in ("concat", "add"):
+            raise ValueError("merge must be 'concat' or 'add'")
+        mods = []
+        if cell is not None:
+            bwd = cell.clone()
+            bwd.reset()  # independent parameters
+            mods = [cell, bwd]
+        super().__init__(*mods)
+        self.merge = merge
+
+    def add(self, module: AbstractModule) -> "BiRecurrent":
+        if self.modules:
+            raise ValueError("BiRecurrent holds exactly one user-supplied cell")
+        if not isinstance(module, Cell):
+            raise TypeError("BiRecurrent requires a Cell (RnnCell/LSTM/GRU/...)")
+        bwd = module.clone()
+        bwd.reset()  # independent parameters
+        super().add(module)
+        return super().add(bwd)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        fwd, bwd = self.modules
+        rng_f = rng_b = None
+        if rng is not None:
+            rng_f, rng_b = jax.random.split(rng)
+        out_f = _scan_cell(fwd, params["0"], input, training=training, rng=rng_f)
+        out_b = _scan_cell(bwd, params["1"], input[:, ::-1],
+                           training=training, rng=rng_b)[:, ::-1]
+        if self.merge == "concat":
+            return jnp.concatenate([out_f, out_b], axis=-1), state
+        return out_f + out_b, state
+
+
+class TimeDistributed(Container):
+    """Apply the wrapped module independently at every timestep of (N, T, ...) input.
+
+    TPU-native: fold time into batch — one big GEMM on (N*T, ...) instead of T small
+    ones; XLA sees a single static-shape program.
+    """
+
+    def __init__(self, module: Optional[AbstractModule] = None):
+        super().__init__(*([module] if module is not None else []))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        m = self.modules[0]
+        n, t = input.shape[0], input.shape[1]
+        x = input.reshape((n * t,) + input.shape[2:])
+        out, new_s = m.apply(params["0"], state["0"], x, training=training, rng=rng)
+        out = out.reshape((n, t) + out.shape[1:])
+        return out, {"0": new_s}
+
+    def __repr__(self):
+        return f"TimeDistributed({self.modules[0]!r})" if self.modules \
+            else "TimeDistributed()"
+
+
+class Masking(TensorModule):
+    """Zero out timesteps equal to ``mask_value`` (reference ``nn.Masking``)."""
+
+    def __init__(self, mask_value: float = 0.0):
+        super().__init__()
+        self.mask_value = mask_value
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        keep = jnp.any(input != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, input, 0.0), state
+
+
+class RecurrentDecoder(Recurrent):
+    """Decoder recurrence (reference ``RecurrentDecoder(outputLength)``): the
+    cell's output at step t is fed back as its input at step t+1; the single
+    (N, F) input seeds step 0. Output: (N, outputLength, F). The feedback loop
+    is one ``lax.scan`` whose carry holds (hidden, last_output) — same O(1)
+    compile cost as Recurrent. The cell's input and hidden sizes must match."""
+
+    def __init__(self, output_length: int, cell: Optional[Cell] = None):
+        super().__init__(cell)
+        if output_length < 1:
+            raise ValueError("output_length must be >= 1")
+        self.output_length = output_length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        cell, cparams = self.cell, params["0"]
+        x0 = input[:, 0] if input.ndim == 3 else input  # accept (N,1,F) too
+        steps = jnp.arange(self.output_length)
+
+        def step(carry, i):
+            hidden, x = carry
+            r = jax.random.fold_in(rng, i) if rng is not None else None
+            out, new_hidden = cell.cell_apply(cparams, x, hidden,
+                                              training=training, rng=r)
+            return (new_hidden, out), out
+
+        hidden0 = cell.init_hidden_from(x0)
+        _, outs = jax.lax.scan(step, (hidden0, x0), steps)
+        return jnp.swapaxes(outs, 0, 1), state
+
+    def __repr__(self):
+        inner = repr(self.cell) if self.modules else ""
+        return f"RecurrentDecoder({self.output_length}, {inner})"
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM cell with peephole connections (reference
+    ``ConvLSTMPeephole(inputSize, outputSize, kernelI, kernelC, stride)``):
+    hidden state and cell state are NCHW feature maps; the four gates come from
+    two SAME-padded convolutions (input→4C and hidden→4C) — two conv GEMMs per
+    step on the MXU, peepholes as per-channel elementwise products."""
+
+    def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
+                 kernel_c: int = 3, stride: int = 1,
+                 w_init: Optional[InitializationMethod] = None,
+                 with_peephole: bool = True):
+        super().__init__()
+        if stride != 1:
+            raise ValueError(
+                "ConvLSTMPeephole feedback requires stride 1 (hidden and input "
+                "maps must stay the same spatial size)")
+        self.input_size, self.hidden_size = input_size, output_size
+        self.output_size = output_size
+        self.kernel_i, self.kernel_c = kernel_i, kernel_c
+        self.with_peephole = with_peephole
+        self.w_init = w_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        ci, co = self.input_size, self.output_size
+        ki, kc = self.kernel_i, self.kernel_c
+        init = self.w_init
+        fan_i, fan_c = ci * ki * ki, co * kc * kc
+        self._params = {
+            "w_ih": jnp.asarray(init.init((4 * co, ci, ki, ki),
+                                          fan_in=fan_i, fan_out=4 * co)),
+            "w_hh": jnp.asarray(init.init((4 * co, co, kc, kc),
+                                          fan_in=fan_c, fan_out=4 * co)),
+            "bias": jnp.zeros((4 * co,), jnp.float32),
+        }
+        if self.with_peephole:
+            for k in ("w_ci", "w_cf", "w_co"):
+                self._params[k] = jnp.asarray(
+                    init.init((co,), fan_in=co, fan_out=co))
+        self.zero_grad_parameters()
+
+    def init_hidden(self, batch_size: int):
+        raise TypeError("ConvLSTMPeephole hidden shape depends on the input "
+                        "feature map; Recurrent derives it via init_hidden_from")
+
+    def init_hidden_from(self, x0):
+        n, _, h, w = x0.shape
+        z = jnp.zeros((n, self.output_size, h, w), x0.dtype)
+        return (z, z)
+
+    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+        h, c = hidden
+        gates = (
+            jax.lax.conv_general_dilated(
+                x, params["w_ih"], (1, 1), "SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            + jax.lax.conv_general_dilated(
+                h, params["w_hh"], (1, 1), "SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            + params["bias"][None, :, None, None])
+        i_g, f_g, g_g, o_g = jnp.split(gates, 4, axis=1)
+        if self.with_peephole:
+            peep = lambda k: params[k][None, :, None, None]
+            i_g = jax.nn.sigmoid(i_g + c * peep("w_ci"))
+            f_g = jax.nn.sigmoid(f_g + c * peep("w_cf"))
+        else:
+            i_g, f_g = jax.nn.sigmoid(i_g), jax.nn.sigmoid(f_g)
+        g_g = jnp.tanh(g_g)
+        new_c = f_g * c + i_g * g_g
+        if self.with_peephole:
+            o_g = jax.nn.sigmoid(o_g + new_c * params["w_co"][None, :, None, None])
+        else:
+            o_g = jax.nn.sigmoid(o_g)
+        new_h = o_g * jnp.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+    def __repr__(self):
+        return (f"ConvLSTMPeephole({self.input_size}, {self.output_size}, "
+                f"{self.kernel_i}, {self.kernel_c})")

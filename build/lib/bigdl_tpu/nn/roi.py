@@ -1,0 +1,82 @@
+"""Region-of-interest pooling.
+
+Reference parity (SURVEY.md §2.1 layer zoo, expected ``<dl>/nn/RoiPooling.scala``
+— unverified, mount empty): the reference implements Fast-R-CNN max RoiPooling
+with data-dependent bin extents — control flow a TPU program cannot trace.
+
+TPU-native redesign: RoiAlign semantics (Mask R-CNN) with a FIXED number of
+bilinear sample points per bin — every ROI becomes the same static gather
+pattern, so one ``vmap`` over ROIs compiles to batched gathers with no dynamic
+shapes. ``mode='avg'`` is standard RoiAlign; ``mode='max'`` maxes the sample
+points, approximating the reference's max pooling on a static budget.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import AbstractModule
+from bigdl_tpu.utils.table import Table
+
+
+class RoiPooling(AbstractModule):
+    """Input: Table ``(features (N, C, H, W), rois (R, 5))`` with rows
+    ``[batch_idx, x1, y1, x2, y2]`` in feature-map coordinates (apply
+    ``spatial_scale`` to image-space boxes). Output ``(R, C, pooled_h,
+    pooled_w)``."""
+
+    def __init__(self, pooled_h: int, pooled_w: int,
+                 spatial_scale: float = 1.0, sampling_ratio: int = 2,
+                 mode: str = "avg"):
+        super().__init__()
+        if mode not in ("avg", "max"):
+            raise ValueError("mode must be 'avg' or 'max'")
+        self.pooled_h, self.pooled_w = pooled_h, pooled_w
+        self.spatial_scale = spatial_scale
+        self.sampling_ratio = sampling_ratio
+        self.mode = mode
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        feats, rois = xs[0], xs[1]
+        n, c, h, w = feats.shape
+        ph, pw, ns = self.pooled_h, self.pooled_w, self.sampling_ratio
+
+        def one_roi(roi):
+            b = roi[0].astype(jnp.int32)
+            x1, y1, x2, y2 = roi[1:] * self.spatial_scale
+            bw = jnp.maximum(x2 - x1, 1e-6) / pw
+            bh = jnp.maximum(y2 - y1, 1e-6) / ph
+            # sample grid: (ph*ns) x (pw*ns) bilinear points
+            iy = jnp.arange(ph * ns)
+            ix = jnp.arange(pw * ns)
+            ys = y1 + (iy // ns) * bh + ((iy % ns) + 0.5) / ns * bh
+            xs_ = x1 + (ix // ns) * bw + ((ix % ns) + 0.5) / ns * bw
+            ys = jnp.clip(ys, 0.0, h - 1.0)
+            xs_ = jnp.clip(xs_, 0.0, w - 1.0)
+            y0 = jnp.floor(ys).astype(jnp.int32)
+            x0 = jnp.floor(xs_).astype(jnp.int32)
+            y1i = jnp.minimum(y0 + 1, h - 1)
+            x1i = jnp.minimum(x0 + 1, w - 1)
+            wy = (ys - y0)[:, None]
+            wx = (xs_ - x0)[None, :]
+            fmap = feats[b]  # (C, H, W)
+
+            def g(yy, xx):
+                return fmap[:, yy, :][:, :, xx]  # (C, ph*ns, pw*ns)
+
+            samp = ((1 - wy) * (1 - wx) * g(y0, x0)
+                    + (1 - wy) * wx * g(y0, x1i)
+                    + wy * (1 - wx) * g(y1i, x0)
+                    + wy * wx * g(y1i, x1i))
+            samp = samp.reshape(c, ph, ns, pw, ns)
+            if self.mode == "avg":
+                return samp.mean(axis=(2, 4))
+            return samp.max(axis=(2, 4))
+
+        return jax.vmap(one_roi)(rois.astype(jnp.float32)), state
+
+    def __repr__(self):
+        return (f"RoiPooling({self.pooled_h}x{self.pooled_w}, "
+                f"scale={self.spatial_scale}, {self.mode})")

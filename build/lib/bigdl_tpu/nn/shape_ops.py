@@ -1,0 +1,240 @@
+"""Shape-manipulation layers.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/nn/Reshape.scala``, ``View.scala``,
+``Squeeze.scala``, ``Unsqueeze.scala``, ``Transpose.scala``, ``Padding.scala``,
+``Narrow.scala``, ``Select.scala``, ``SplitTable.scala``, ``Contiguous.scala`` — unverified).
+All are metadata-only ops under XLA (free at runtime when fused).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, TensorModule
+from bigdl_tpu.utils.table import T, Table
+
+
+class Reshape(TensorModule):
+    """Reshape non-batch dims to ``size``; ``batch_mode=None`` auto-detects a batch dim:
+    input is treated as batched when its non-batch dims hold exactly ``prod(size)``
+    elements (``ndim >= 2 and prod(shape[1:]) == prod(size)``)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool | None = None):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        batched = self.batch_mode
+        if batched is None:
+            import numpy as np
+            # batch dim preserved whenever the non-batch dims hold exactly the target
+            # element count (robust for batch size 1, unlike ndim heuristics)
+            batched = (input.ndim >= 2 and
+                       int(np.prod(input.shape[1:])) == int(np.prod(self.size)))
+        if batched:
+            return input.reshape((input.shape[0],) + self.size), state
+        return input.reshape(self.size), state
+
+    def __repr__(self):
+        return f"Reshape({'x'.join(map(str, self.size))})"
+
+
+class View(Reshape):
+    """Alias of Reshape with batch handling (reference ``View`` with num_input_dims)."""
+
+
+class Flatten(TensorModule):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input.reshape(input.shape[0], -1), state
+
+
+class Squeeze(TensorModule):
+    def __init__(self, dim: int | None = None, num_input_dims: int | None = None):
+        super().__init__()
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if self.dim is None:
+            return jnp.squeeze(input), state
+        return jnp.squeeze(input, axis=self.dim - 1), state
+
+
+class Unsqueeze(TensorModule):
+    def __init__(self, pos: int, num_input_dims: int | None = None):
+        super().__init__()
+        self.pos = pos
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.expand_dims(input, axis=self.pos - 1), state
+
+
+class Transpose(TensorModule):
+    """Swap listed (1-based) dim pairs in order (reference semantics)."""
+
+    def __init__(self, permutations: Sequence[tuple[int, int]]):
+        super().__init__()
+        self.permutations = [(a - 1, b - 1) for a, b in permutations]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        perm = list(range(input.ndim))
+        for a, b in self.permutations:
+            perm[a], perm[b] = perm[b], perm[a]
+        return jnp.transpose(input, perm), state
+
+
+class Select(TensorModule):
+    """Select index ``index`` (1-based; negative from end) along dim (1-based)."""
+
+    def __init__(self, dim: int, index: int):
+        super().__init__()
+        self.dim, self.index = dim, index
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
+        idx = self.index - 1 if self.index > 0 else input.shape[axis] + self.index
+        return jnp.take(input, idx, axis=axis), state
+
+
+class Narrow(TensorModule):
+    """Slice ``length`` elements starting at ``offset`` (1-based) along dim."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
+        start = self.offset - 1
+        length = self.length
+        if length < 0:
+            length = input.shape[axis] - start + length + 1
+        return jnp.take(input, jnp.arange(start, start + length), axis=axis), state
+
+
+class SplitTable(AbstractModule):
+    """Split a tensor along dim (1-based) into a Table of slices."""
+
+    def __init__(self, dim: int, num_input_dims: int = -1):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
+        if self.num_input_dims > 0 and input.ndim == self.num_input_dims + 1:
+            axis += 1
+        parts = [jnp.squeeze(p, axis=axis)
+                 for p in jnp.split(input, input.shape[axis], axis=axis)]
+        return T(*parts), state
+
+
+class Padding(TensorModule):
+    """Pad ``pad`` entries (negative → before, positive → after) along dim with value."""
+
+    def __init__(self, dim: int, pad: int, num_input_dims: int = 0,
+                 value: float = 0.0, n_index: int = 1):
+        super().__init__()
+        self.dim, self.pad, self.value = dim, pad, value
+        self.num_input_dims = num_input_dims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1
+        if self.num_input_dims > 0 and input.ndim == self.num_input_dims + 1:
+            axis += 1
+        widths = [(0, 0)] * input.ndim
+        widths[axis] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(input, widths, constant_values=self.value), state
+
+
+class SpatialZeroPadding(TensorModule):
+    def __init__(self, pad_left: int, pad_right: int, pad_top: int, pad_bottom: int):
+        super().__init__()
+        self.pads = (pad_left, pad_right, pad_top, pad_bottom)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        l, r, t, b = self.pads
+        widths = [(0, 0)] * (input.ndim - 2) + [(t, b), (l, r)]
+        return jnp.pad(input, widths), state
+
+
+class Contiguous(TensorModule):
+    """No-op under XLA (arrays are always logically contiguous)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input, state
+
+
+class Replicate(TensorModule):
+    """Replicate input ``n_features`` times along a new dim (1-based)."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_input_dims: int = -1):
+        super().__init__()
+        self.n_features, self.dim, self.n_input_dims = n_features, dim, n_input_dims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1
+        if self.n_input_dims > 0 and input.ndim == self.n_input_dims + 1:
+            axis += 1
+        return jnp.repeat(jnp.expand_dims(input, axis), self.n_features, axis=axis), state
+
+
+class Tile(TensorModule):
+    """Repeat input ``copies`` times along dim (1-based; reference ``Tile``)."""
+
+    def __init__(self, dim: int = 1, copies: int = 2):
+        super().__init__()
+        self.dim, self.copies = dim, copies
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
+        reps = [1] * input.ndim
+        reps[axis] = self.copies
+        return jnp.tile(input, reps), state
+
+
+class Reverse(TensorModule):
+    """Flip along dim (1-based; reference ``Reverse``)."""
+
+    def __init__(self, dimension: int = 1, is_inplace: bool = False):
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axis = self.dimension - 1 if self.dimension > 0 else input.ndim + self.dimension
+        return jnp.flip(input, axis=axis), state
+
+
+class Index(AbstractModule):
+    """Index select: input Table = (source, indices); gathers along dim
+    (1-based; reference ``Index``). Indices are 0-based here, consistent with
+    this framework's labels."""
+
+    def __init__(self, dimension: int = 1):
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        src, idx = xs[0], xs[1]
+        axis = self.dimension - 1 if self.dimension > 0 else src.ndim + self.dimension
+        return jnp.take(src, idx.astype(jnp.int32), axis=axis), state
+
+
+class InferReshape(TensorModule):
+    """Reshape where one target dim may be -1 (inferred) and 0 copies the
+    corresponding input dim (reference ``InferReshape``)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        in_shape = input.shape[1:] if self.batch_mode else input.shape
+        target = [in_shape[i] if s == 0 else s for i, s in enumerate(self.size)]
+        if self.batch_mode:
+            target = [input.shape[0]] + target
+        return input.reshape(tuple(target)), state

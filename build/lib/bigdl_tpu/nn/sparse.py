@@ -1,0 +1,124 @@
+"""Sparse-feature layers — the SparseTensor/SparseLinear redesign.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/tensor/SparseTensor.scala`` +
+``<dl>/nn/SparseLinear.scala``/``SparseJoinTable`` — unverified, mount empty):
+the reference carries a COO ``SparseTensor`` through the data pipeline so
+Wide&Deep's very wide one-hot/cross features avoid dense materialization.
+
+TPU-native redesign: XLA wants static shapes, so the sparse representation is a
+**padded id/value list** per row — ``ids (N, K) int32`` (pad = -1) and optional
+``values (N, K) float`` — instead of a dynamic-length COO tensor. The contraction
+``out[b] = Σ_k values[b,k] * W[ids[b,k]]`` is one gather + masked reduction:
+exactly what a CSR matvec does, but in the form the MXU/VPU pipeline and SPMD
+partitioner handle natively (dense gathers over a sharded table). K is the max
+active features per row — Wide&Deep-style workloads have small fixed K, so the
+padding cost is bounded and shapes never change between steps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import AbstractModule
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform, Zeros
+from bigdl_tpu.utils.table import Table
+
+PAD_ID = -1
+
+
+def _split_ids_values(input):
+    if isinstance(input, Table):
+        xs = input.values()
+    elif isinstance(input, (tuple, list)):
+        xs = list(input)
+    else:
+        xs = [input]
+    ids = xs[0]
+    values = xs[1] if len(xs) > 1 else None
+    return ids, values
+
+
+class SparseLinear(AbstractModule):
+    """Linear layer over padded sparse ids: input ``ids (N, K)`` [+ optional
+    ``values (N, K)``] → ``(N, output_size)``. Pad entries (id == -1) contribute
+    nothing. The reference's SparseLinear consumed a COO SparseTensor; the
+    padded-gather form is the shape-static equivalent."""
+
+    def __init__(self, n_features: int, output_size: int, with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.n_features = n_features
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or Zeros()
+        self.reset()
+
+    def reset(self) -> None:
+        self._params = {"weight": jnp.asarray(
+            self.w_init.init((self.n_features, self.output_size),
+                             fan_in=self.n_features, fan_out=self.output_size))}
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(
+                self.b_init.init((self.output_size,), fan_in=self.n_features,
+                                 fan_out=self.output_size))
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        ids, values = _split_ids_values(input)
+        mask = (ids != PAD_ID)
+        safe = jnp.where(mask, ids, 0).astype(jnp.int32)
+        rows = params["weight"][safe]                      # (N, K, out)
+        w = mask.astype(rows.dtype)
+        if values is not None:
+            w = w * values
+        out = jnp.sum(rows * w[..., None], axis=1)
+        if self.with_bias:
+            out = out + params["bias"]
+        return out, state
+
+    def __repr__(self):
+        return f"SparseLinear({self.n_features} -> {self.output_size})"
+
+
+class SparseEmbeddingSum(AbstractModule):
+    """Bag-of-ids embedding: mean/sum of embedding rows over the padded id list
+    (the reference reached this via LookupTable + sparse input; here it is the
+    direct masked-gather reduction)."""
+
+    def __init__(self, n_index: int, n_output: int, combiner: str = "mean",
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        if combiner not in ("mean", "sum"):
+            raise ValueError("combiner must be 'mean' or 'sum'")
+        self.n_index = n_index
+        self.n_output = n_output
+        self.combiner = combiner
+        self.w_init = w_init or RandomUniform(-0.05, 0.05)
+        self.reset()
+
+    def reset(self) -> None:
+        self._params = {"weight": jnp.asarray(
+            self.w_init.init((self.n_index, self.n_output),
+                             fan_in=self.n_index, fan_out=self.n_output))}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        ids, values = _split_ids_values(input)
+        mask = (ids != PAD_ID)
+        safe = jnp.where(mask, ids, 0).astype(jnp.int32)
+        rows = params["weight"][safe]                      # (N, K, dim)
+        w = mask.astype(rows.dtype)
+        if values is not None:
+            w = w * values
+        out = jnp.sum(rows * w[..., None], axis=1)
+        if self.combiner == "mean":
+            out = out / jnp.clip(jnp.sum(w, axis=1, keepdims=True), 1e-12)
+        return out, state
+
+    def __repr__(self):
+        return (f"SparseEmbeddingSum({self.n_index} -> {self.n_output}, "
+                f"{self.combiner})")

@@ -1,0 +1,99 @@
+"""Tree-structured recurrence — BinaryTreeLSTM.
+
+Reference parity (SURVEY.md §2.5 Examples, expected upstream
+``<dl>/example/treeLSTM`` + ``<dl>/nn/BinaryTreeLSTM.scala`` — unverified,
+mount empty): the constituency TreeLSTM of Tai et al. used by the sentiment
+example, with per-child forget gates.
+
+TPU-native design: the reference walks each tree with recursive Scala calls —
+data-dependent control flow that cannot compile. Here every tree is encoded as
+a STATIC array program: nodes are indexed with the ROOT AT 0 and children at
+strictly larger indices; ``lax.scan`` sweeps indices from high to low, each step
+gathering its two children's (h, c) from the carried state arrays and writing
+its own — one compiled program for the whole batch of trees, padding nodes
+(children = -1) costing only masked lanes. Trees of any shape batch together as
+long as they share the padded node count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.abstractnn import AbstractModule
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform, Zeros
+from bigdl_tpu.utils.table import Table
+
+
+class BinaryTreeLSTM(AbstractModule):
+    """Input: Table ``(x (N, nodes, D), children (N, nodes, 2) int32)`` where
+    ``children[b, i] = (left, right)`` node indices (> i) or -1 for a leaf slot.
+    Output: per-node hidden states ``(N, nodes, H)`` — the root's state is
+    ``out[:, 0]``. Gate layout: [i, o, u, f_l, f_r]."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or Zeros()
+        self.reset()
+
+    def reset(self) -> None:
+        d, h = self.input_size, self.hidden_size
+
+        def mk(shape, fan_in):
+            return jnp.asarray(self.w_init.init(shape, fan_in=fan_in,
+                                                fan_out=shape[-1]))
+
+        self._params = {
+            "w_x": mk((d, 5 * h), d),
+            "u_l": mk((h, 5 * h), h),
+            "u_r": mk((h, 5 * h), h),
+            "bias": jnp.asarray(self.b_init.init((5 * h,), fan_in=d,
+                                                 fan_out=5 * h)),
+        }
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        x, children = xs[0], xs[1].astype(jnp.int32)
+        n, nodes, _ = x.shape
+        h_dim = self.hidden_size
+
+        def gather_child(arr, idx):
+            # arr (N, nodes, H); idx (N,) node index per sample, -1 → zeros
+            safe = jnp.clip(idx, 0, nodes - 1)
+            picked = jnp.take_along_axis(arr, safe[:, None, None].repeat(
+                h_dim, axis=2), axis=1)[:, 0]
+            return jnp.where((idx >= 0)[:, None], picked, 0.0)
+
+        def step(carry, i):
+            h_all, c_all = carry
+            idx = nodes - 1 - i  # sweep high → low so children are ready
+            xi = lax.dynamic_index_in_dim(x, idx, axis=1, keepdims=False)
+            ch = lax.dynamic_index_in_dim(children, idx, axis=1, keepdims=False)
+            h_l, h_r = gather_child(h_all, ch[:, 0]), gather_child(h_all, ch[:, 1])
+            c_l, c_r = gather_child(c_all, ch[:, 0]), gather_child(c_all, ch[:, 1])
+            gates = (xi @ params["w_x"] + h_l @ params["u_l"]
+                     + h_r @ params["u_r"] + params["bias"])
+            i_g, o_g, u_g, fl_g, fr_g = jnp.split(gates, 5, axis=-1)
+            c_new = (jax.nn.sigmoid(i_g) * jnp.tanh(u_g)
+                     + jax.nn.sigmoid(fl_g) * c_l + jax.nn.sigmoid(fr_g) * c_r)
+            h_new = jax.nn.sigmoid(o_g) * jnp.tanh(c_new)
+            h_all = lax.dynamic_update_index_in_dim(h_all, h_new, idx, axis=1)
+            c_all = lax.dynamic_update_index_in_dim(c_all, c_new, idx, axis=1)
+            return (h_all, c_all), None
+
+        init = (jnp.zeros((n, nodes, h_dim), x.dtype),
+                jnp.zeros((n, nodes, h_dim), x.dtype))
+        (h_all, _), _ = lax.scan(step, init, jnp.arange(nodes))
+        return h_all, state
+
+    def __repr__(self):
+        return f"BinaryTreeLSTM({self.input_size} -> {self.hidden_size})"

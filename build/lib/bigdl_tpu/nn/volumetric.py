@@ -1,0 +1,193 @@
+"""Volumetric (3-D) layers — video/voxel workloads.
+
+Reference parity (SURVEY.md §2.1 layer zoo, expected ``<dl>/nn/
+VolumetricConvolution.scala`` / ``VolumetricMaxPooling.scala`` /
+``VolumetricAveragePooling.scala`` — unverified, mount empty): Torch-style
+NCDHW 3-D conv and pooling. One ``conv_general_dilated`` / ``reduce_window``
+each — XLA tiles the contraction onto the MXU like any other conv.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform
+
+
+class VolumetricConvolution(TensorModule):
+    """Input (N, C, T, H, W) → (N, O, T', H', W'). Weight OIDHW."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int,
+                 d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t, self.d_w, self.d_h = d_t, d_w, d_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.with_bias = with_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        fan_in = self.n_input_plane * self.k_t * self.k_h * self.k_w
+        w = self.w_init.init(
+            (self.n_output_plane, self.n_input_plane, self.k_t, self.k_h,
+             self.k_w), fan_in=fan_in, fan_out=self.n_output_plane)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(
+                self.b_init.init((self.n_output_plane,), fan_in=fan_in,
+                                 fan_out=self.n_output_plane))
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 4
+        if squeeze:
+            x = x[None]
+        out = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.d_t, self.d_h, self.d_w),
+            padding=[(self.pad_t, self.pad_t), (self.pad_h, self.pad_h),
+                     (self.pad_w, self.pad_w)],
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None, None]
+        if squeeze:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return (f"VolumetricConvolution({self.n_input_plane} -> "
+                f"{self.n_output_plane}, {self.k_t}x{self.k_h}x{self.k_w})")
+
+
+class _VolumetricPool(TensorModule):
+    def __init__(self, k_t: int, k_w: int, k_h: int,
+                 d_t: int | None = None, d_w: int | None = None,
+                 d_h: int | None = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t = d_t if d_t is not None else k_t
+        self.d_w = d_w if d_w is not None else k_w
+        self.d_h = d_h if d_h is not None else k_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+
+    def _window(self):
+        return ((1, 1, self.k_t, self.k_h, self.k_w),
+                (1, 1, self.d_t, self.d_h, self.d_w),
+                ((0, 0), (0, 0), (self.pad_t, self.pad_t),
+                 (self.pad_h, self.pad_h), (self.pad_w, self.pad_w)))
+
+
+class VolumetricMaxPooling(_VolumetricPool):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 4
+        if squeeze:
+            x = x[None]
+        win, strides, pad = self._window()
+        out = lax.reduce_window(x, -jnp.inf, lax.max, win, strides, pad)
+        out = out.astype(x.dtype)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class VolumetricAveragePooling(_VolumetricPool):
+    """count_include_pad=True average (Torch default for AvgPool3d)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 4
+        if squeeze:
+            x = x[None]
+        win, strides, pad = self._window()
+        sums = lax.reduce_window(x, 0.0, lax.add, win, strides, pad)
+        out = sums / (self.k_t * self.k_h * self.k_w)
+        out = out.astype(x.dtype)
+        if squeeze:
+            out = out[0]
+        return out, state
+
+
+class VolumetricFullConvolution(TensorModule):
+    """3-D transposed convolution (reference ``VolumetricFullConvolution``):
+    the NCDHW mirror of SpatialFullConvolution — one lhs-dilated conv, which
+    XLA lowers to the same MXU contractions as the forward conv."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kt: int, kw: int, kh: int, dt: int = 1, dw: int = 1,
+                 dh: int = 1, pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 adj_t: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 n_group: int = 1, no_bias: bool = False,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kt, self.kw, self.kh = kt, kw, kh
+        self.dt, self.dw, self.dh = dt, dw, dh
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.adj_t, self.adj_w, self.adj_h = adj_t, adj_w, adj_h
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.w_init = w_init or RandomUniform()
+        self.b_init = b_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        fan_in = self.n_input_plane * self.kt * self.kh * self.kw
+        fan_out = self.n_output_plane * self.kt * self.kh * self.kw
+        w = self.w_init.init(
+            (self.n_input_plane, self.n_output_plane // self.n_group,
+             self.kt, self.kh, self.kw),
+            fan_in=fan_in, fan_out=fan_out)
+        self._params = {"weight": jnp.asarray(w)}
+        if self.with_bias:
+            self._params["bias"] = jnp.asarray(self.b_init.init(
+                (self.n_output_plane,), fan_in=fan_in, fan_out=fan_out))
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 4
+        if squeeze:
+            x = x[None]
+        pad = [(self.kt - 1 - self.pad_t, self.kt - 1 - self.pad_t + self.adj_t),
+               (self.kh - 1 - self.pad_h, self.kh - 1 - self.pad_h + self.adj_h),
+               (self.kw - 1 - self.pad_w, self.kw - 1 - self.pad_w + self.adj_w)]
+        # correlation-transpose needs the spatially flipped kernel (torch/Caffe
+        # deconv semantics — same fix as SpatialFullConvolution)
+        w = jnp.flip(params["weight"], (-3, -2, -1))
+        if self.n_group > 1:
+            # grouped deconv rearrange (I, O/g) → (I/g, O); see SpatialFullConvolution
+            g = self.n_group
+            i, og = w.shape[0], w.shape[1]
+            w = w.reshape(g, i // g, og, self.kt, self.kh, self.kw) \
+                 .transpose(1, 0, 2, 3, 4, 5) \
+                 .reshape(i // g, g * og, self.kt, self.kh, self.kw)
+        out = lax.conv_general_dilated(
+            x, w,
+            window_strides=(1, 1, 1),
+            padding=pad,
+            lhs_dilation=(self.dt, self.dh, self.dw),
+            dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+            feature_group_count=self.n_group,
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None, None]
+        if squeeze:
+            out = out[0]
+        return out, state

@@ -1,0 +1,17 @@
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.evaluator import Evaluator, Predictor
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.optim_method import (
+    Adadelta, Adagrad, Adam, Adamax, Ftrl, LBFGS, LarsSGD, OptimMethod, RMSprop, SGD,
+)
+from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer
+from bigdl_tpu.optim.schedules import (
+    Default, Exponential, LearningRateSchedule, MultiStep, NaturalExp, Plateau, Poly,
+    SequentialSchedule, Step, Warmup,
+)
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import (
+    AccuracyResult, HitRatio, Loss, LossResult, MAE, NDCG, Top1Accuracy, Top5Accuracy,
+    TreeNNAccuracy,
+    TopKAccuracy, ValidationMethod, ValidationResult,
+)

@@ -1,0 +1,125 @@
+"""DistriOptimizer — synchronous data-parallel training over the device mesh.
+
+Reference parity (SURVEY.md §2.3/§3.1, expected ``<dl>/optim/DistriOptimizer.scala`` —
+unverified): the reference runs one Spark job per iteration — broadcast model once, cache
+per-executor replicas, pull weight slices from the BlockManager, compute, publish gradient
+slices, slice-owned optimizer update, publish weight slices; plus driver-side validation/
+checkpoint/summary and retry-from-checkpoint.
+
+TPU-native redesign (SURVEY.md §5.8, §7.1): the entire per-iteration protocol is replaced
+by ONE jitted SPMD program over the Engine mesh:
+
+- the mini-batch is sharded over the ``data`` axis (NamedSharding);
+- params/model-state are replicated; XLA's partitioner inserts the gradient all-reduce
+  over ICI (the reference's all-to-all BlockManager slice pulls);
+- with ``parameter_sync="zero1"`` the optimizer slots are sharded over ``data``, so the
+  update computes on slices and new params are all-gathered — the exact ZeRO-1 structure
+  of ``AllReduceParameter``'s slice-owned update;
+- there is no per-iteration driver scheduling at all (the reference's biggest fixed cost).
+
+The training *loop* (triggers, checkpoint/retry, validation, summaries) is inherited
+unchanged from ``Optimizer`` — only batch placement and program shardings differ.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+
+from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.parallel.sharding import batch_sharding, replicated, zero1_state_sharding
+from bigdl_tpu.utils.engine import Engine
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+
+class DistriOptimizer(Optimizer):
+    def __init__(self, model, dataset, criterion, parameter_sync: str = "allreduce"):
+        super().__init__(model, dataset, criterion)
+        if parameter_sync not in ("allreduce", "zero1"):
+            raise ValueError("parameter_sync must be 'allreduce' or 'zero1'")
+        self.parameter_sync = parameter_sync
+        self._mesh = None
+        self._batch_sh = None
+        self.tp_rules = None
+
+    def set_parameter_sync(self, mode: str) -> "DistriOptimizer":
+        if mode not in ("allreduce", "zero1"):
+            raise ValueError("parameter_sync must be 'allreduce' or 'zero1'")
+        self.parameter_sync = mode
+        self._step_cache = None
+        return self
+
+    def set_tensor_parallel(self, rules) -> "DistriOptimizer":
+        """Enable tensor parallelism: ``rules`` is a
+        :class:`~bigdl_tpu.parallel.TPRules` mapping parameter paths to
+        PartitionSpecs over the mesh's ``model`` axis. XLA's SPMD partitioner
+        splits the matmuls and inserts the activation collectives."""
+        self.tp_rules = rules
+        self._step_cache = None
+        return self
+
+    # ------------------------------------------------------------- compile
+    def _compile_step(self):
+        self._mesh = Engine.mesh()
+        if Engine.DATA_AXIS not in self._mesh.axis_names:
+            raise ValueError(
+                f"Engine mesh {self._mesh.axis_names} has no "
+                f"'{Engine.DATA_AXIS}' axis")
+        self._batch_sh = batch_sharding(self._mesh, Engine.DATA_AXIS)
+        repl = replicated(self._mesh)
+
+        params = self.model.get_params()
+        # shapes only — no device allocation for the throwaway state
+        ostate_shapes = jax.eval_shape(self.optim_method.init_state, params)
+        if self.tp_rules is not None:
+            param_sh = self.tp_rules.param_shardings(params, self._mesh)
+        else:
+            param_sh = jax.tree_util.tree_map(lambda _: repl, params)
+        mstate_sh = jax.tree_util.tree_map(lambda _: repl, self.model.get_state())
+        if self.tp_rules is not None:
+            # TP slots always mirror the param sharding; unmatched slots get
+            # ZeRO-1 data sharding or replication per the sync mode
+            dp_axis = Engine.DATA_AXIS if self.parameter_sync == "zero1" else None
+            ostate_sh = self.tp_rules.slot_shardings(ostate_shapes, self._mesh,
+                                                     dp_axis)
+        elif self.parameter_sync == "zero1":
+            ostate_sh = zero1_state_sharding(self._mesh, ostate_shapes,
+                                             Engine.DATA_AXIS)
+        else:
+            ostate_sh = jax.tree_util.tree_map(lambda _: repl, ostate_shapes)
+        self._shardings = (param_sh, mstate_sh, ostate_sh)
+
+        step = self._make_step_fn()
+        out_sh = (param_sh, mstate_sh, ostate_sh, None)
+        if self.check_numerics:
+            step = self._wrap_checkify(step)
+            out_sh = (*out_sh, None)
+        return jax.jit(
+            step,
+            in_shardings=(param_sh, mstate_sh, ostate_sh, None,
+                          self._batch_sh, self._batch_sh, None),
+            out_shardings=out_sh,
+            donate_argnums=(0, 1, 2),
+        )
+
+    def _place_batch(self, batch):
+        n_dev = int(dict(self._mesh.shape)[Engine.DATA_AXIS])
+        bsz = batch.size()
+        if bsz % n_dev != 0:
+            raise ValueError(
+                f"batch size {bsz} not divisible by data-parallel size {n_dev}")
+        inp = jax.device_put(batch.input, self._batch_sh)
+        target = jax.device_put(batch.target, self._batch_sh)
+        return inp, target
+
+    def _put_input(self, batch):
+        return jax.device_put(batch.input, self._batch_sh)
+
+    def _optimize_impl(self):
+        # compile path sets mesh/shardings before the first _put_batch
+        logger.info("DistriOptimizer: mesh=%s sync=%s",
+                    dict(Engine.mesh().shape), self.parameter_sync)
+        return super()._optimize_impl()

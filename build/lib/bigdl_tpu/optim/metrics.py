@@ -1,0 +1,67 @@
+"""Per-phase training metrics.
+
+Reference parity (SURVEY.md §2.3, expected ``<dl>/optim/Metrics.scala`` — unverified): the
+reference aggregates per-iteration phase timings (get weights / computing / aggregate
+gradient / send weights) through Spark accumulators and logs them per epoch.
+
+TPU-native: the phases collapse — weights never move (they live sharded/replicated on
+device) and gradient aggregation is fused into the step — so the meaningful phase left on
+the host side is the data feed (``put_batch``), logged at the end of training. Timings are
+dispatch-side (async-safe); per-op device attribution comes from ``jax.profiler``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class Metrics:
+    """Thread-safe phase-timing accumulator (the producer thread times
+    ``put_batch`` while the step loop times ``feed``/``step_dispatch``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._sums: dict[str, float] = defaultdict(float)
+        self._counts: dict[str, int] = defaultdict(int)
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._sums[name] += seconds
+            self._counts[name] += 1
+
+    def timer(self, name: str):
+        return _Timer(self, name)
+
+    def summary(self) -> dict[str, float]:
+        """Mean seconds per phase occurrence."""
+        with self._lock:
+            return {k: self._sums[k] / max(self._counts[k], 1) for k in self._sums}
+
+    def totals(self) -> dict[str, float]:
+        """Total seconds per phase."""
+        with self._lock:
+            return dict(self._sums)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sums.clear()
+            self._counts.clear()
+
+    def __repr__(self):
+        parts = ", ".join(f"{k} {v * 1e3:.2f}ms" for k, v in sorted(self.summary().items()))
+        return f"Metrics({parts})"
+
+
+class _Timer:
+    def __init__(self, metrics: Metrics, name: str):
+        self.metrics, self.name = metrics, name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.metrics.add(self.name, time.perf_counter() - self.t0)
+        return False

@@ -1,0 +1,491 @@
+"""Optimization methods (pure, jit-compatible).
+
+Reference parity (SURVEY.md §2.3, expected ``<dl>/optim/SGD.scala`` etc. — unverified):
+``OptimMethod`` subclasses hold hyper-parameters and per-weight slots; SGD carries the
+learning-rate schedule family (Default/Step/Poly/…, see ``schedules.py``).
+
+TPU-native: an OptimMethod is a **pure transform**: ``init_state(params)`` builds the slot
+pytree, ``update(params, grads, state, step)`` returns the new params+slots. The trainer
+fuses it into the jitted train step, so on a mesh the sharded (ZeRO-1) update falls out of
+sharding the pytrees — matching the reference's slice-owned ``AllReduceParameter`` update.
+``step`` is a traced scalar so schedules don't retrigger compilation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_map(f, *trees, **kwargs):
+    return jax.tree_util.tree_map(f, *trees, **kwargs)
+
+
+def decayed_lr(learningrate, learningrate_decay, step):
+    """The reference's default decay: ``lr / (1 + step * decay)`` (SGD.Default)."""
+    return learningrate / (1.0 + step * learningrate_decay)
+
+
+class OptimMethod:
+    def init_state(self, params) -> dict:
+        return {}
+
+    def update(self, params, grads, state: dict, step):
+        """Return (new_params, new_state). ``step`` is a 0-based traced int scalar."""
+        raise NotImplementedError
+
+    def get_learning_rate(self, step: int) -> float:
+        return 0.0
+
+    def __repr__(self):
+        return type(self).__name__
+
+    # Reference-parity convenience: stateful single-tensor optimize ---------
+    def optimize(self, feval: Callable, weight):
+        """Torch-style: feval(w) -> (loss, grad); mutates internal state. Parity shim."""
+        if not hasattr(self, "_shim_state"):
+            self._shim_state = self.init_state(weight)
+            self._shim_step = 0
+        loss, grad = feval(weight)
+        new_w, self._shim_state = self.update(weight, grad, self._shim_state,
+                                              jnp.asarray(self._shim_step))
+        self._shim_step += 1
+        return new_w, (loss,)
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/dampening/nesterov/weight-decay + LR schedules.
+
+    Default schedule matches the reference's ``SGD.Default``:
+    ``clr = lr / (1 + step * learningrate_decay)``. Pass any
+    :mod:`~bigdl_tpu.optim.schedules` schedule as ``learningrate_schedule``; the
+    stateful ``Plateau`` schedule carries its current LR as a leaf of the optimizer
+    state (``state["clr"]``) so the trainer can lower it between jitted steps
+    without recompiling. ``layer_lr_mults`` maps a parameter-path substring to a
+    per-layer LR multiplier (reference: per-layer ``learningRateMult``).
+    """
+
+    def __init__(self, learningrate: float = 1e-3, learningrate_decay: float = 0.0,
+                 weightdecay: float = 0.0, momentum: float = 0.0,
+                 dampening: Optional[float] = None, nesterov: bool = False,
+                 learningrate_schedule=None, layer_lr_mults: Optional[dict] = None):
+        self.learningrate = learningrate
+        self.learningrate_decay = learningrate_decay
+        self.weightdecay = weightdecay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        self.learningrate_schedule = learningrate_schedule
+        self.layer_lr_mults = dict(layer_lr_mults or {})
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            raise ValueError("nesterov requires momentum > 0 and dampening = 0")
+        if self._stateful_schedule():
+            self.learningrate_schedule.reset(self.learningrate)
+
+    def _stateful_schedule(self) -> bool:
+        return bool(getattr(self.learningrate_schedule, "stateful", False))
+
+    def _lr(self, step, state=None):
+        if self._stateful_schedule() and state is not None and "clr" in state:
+            return state["clr"]
+        if self.learningrate_schedule is not None:
+            return self.learningrate_schedule(self.learningrate, step)
+        return decayed_lr(self.learningrate, self.learningrate_decay, step)
+
+    def get_learning_rate(self, step):
+        if self._stateful_schedule():
+            return float(self.learningrate_schedule.current_lr)
+        return float(jax.device_get(self._lr(jnp.asarray(step, jnp.float32))))
+
+    def init_state(self, params) -> dict:
+        state = {}
+        if self.momentum > 0:
+            state["v"] = tree_map(jnp.zeros_like, params)
+        if self._stateful_schedule():
+            state["clr"] = jnp.asarray(self.learningrate, jnp.float32)
+        return state
+
+    def _mult_tree(self, params):
+        from jax.tree_util import keystr, tree_map_with_path
+
+        def mult_for(path, _):
+            key = keystr(path)
+            for pat, m in self.layer_lr_mults.items():
+                if pat in key:
+                    return m
+            return 1.0
+
+        return tree_map_with_path(mult_for, params)
+
+    def update(self, params, grads, state, step):
+        lr = self._lr(step.astype(jnp.float32), state)
+        wd, mu, damp = self.weightdecay, self.momentum, self.dampening
+
+        if wd > 0:
+            grads = tree_map(lambda g, p: g + wd * p, grads, params)
+        new_state = {}
+        if self._stateful_schedule():
+            new_state["clr"] = state["clr"]
+        if mu > 0:
+            v = tree_map(lambda v, g: mu * v + (1.0 - damp) * g, state["v"], grads)
+            new_state["v"] = v
+            if self.nesterov:
+                grads = tree_map(lambda g, v: g + mu * v, grads, v)
+            else:
+                grads = v
+        if self.layer_lr_mults:
+            mults = self._mult_tree(params)
+            new_params = tree_map(lambda p, g, m: p - lr * m * g, params, grads, mults)
+        else:
+            new_params = tree_map(lambda p, g: p - lr * g, params, grads)
+        return new_params, new_state
+
+
+class Adam(OptimMethod):
+    """Adam (reference ``<dl>/optim/Adam.scala`` — unverified)."""
+
+    def __init__(self, learningrate: float = 1e-3, learningrate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8):
+        self.learningrate = learningrate
+        self.learningrate_decay = learningrate_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        return {"m": tree_map(jnp.zeros_like, params),
+                "v": tree_map(jnp.zeros_like, params)}
+
+    def get_learning_rate(self, step):
+        return float(decayed_lr(self.learningrate, self.learningrate_decay, step))
+
+    def update(self, params, grads, state, step):
+        t = step.astype(jnp.float32) + 1.0
+        lr = decayed_lr(self.learningrate, self.learningrate_decay, step.astype(jnp.float32))
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        m = tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        bc1 = 1.0 - jnp.power(b1, t)
+        bc2 = 1.0 - jnp.power(b2, t)
+        new_params = tree_map(
+            lambda p, m, v: p - lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps), params, m, v)
+        return new_params, {"m": m, "v": v}
+
+
+class Adagrad(OptimMethod):
+    """Adagrad (reference ``<dl>/optim/Adagrad.scala`` — unverified).
+
+    ``accum += g²; p -= clr · g / (√accum + 1e-10)`` with
+    ``clr = lr / (1 + step·decay)`` — matches torch.optim.Adagrad.
+    """
+
+    def __init__(self, learningrate: float = 1e-3, learningrate_decay: float = 0.0,
+                 weightdecay: float = 0.0):
+        self.learningrate = learningrate
+        self.learningrate_decay = learningrate_decay
+        self.weightdecay = weightdecay
+
+    def get_learning_rate(self, step):
+        return float(decayed_lr(self.learningrate, self.learningrate_decay, step))
+
+    def init_state(self, params):
+        return {"accum": tree_map(jnp.zeros_like, params)}
+
+    def update(self, params, grads, state, step):
+        clr = decayed_lr(self.learningrate, self.learningrate_decay, step.astype(jnp.float32))
+        if self.weightdecay > 0:
+            grads = tree_map(lambda g, p: g + self.weightdecay * p, grads, params)
+        accum = tree_map(lambda a, g: a + g * g, state["accum"], grads)
+        new_params = tree_map(
+            lambda p, g, a: p - clr * g / (jnp.sqrt(a) + 1e-10), params, grads, accum)
+        return new_params, {"accum": accum}
+
+
+class Adadelta(OptimMethod):
+    """Adadelta (reference ``<dl>/optim/Adadelta.scala`` — unverified).
+
+    Matches torch.optim.Adadelta with ``lr`` scaling (reference uses lr = 1).
+    """
+
+    def __init__(self, decayrate: float = 0.9, epsilon: float = 1e-10,
+                 learningrate: float = 1.0):
+        self.decayrate = decayrate
+        self.epsilon = epsilon
+        self.learningrate = learningrate
+
+    def get_learning_rate(self, step):
+        return float(self.learningrate)
+
+    def init_state(self, params):
+        return {"sq_avg": tree_map(jnp.zeros_like, params),
+                "acc_delta": tree_map(jnp.zeros_like, params)}
+
+    def update(self, params, grads, state, step):
+        rho, eps, lr = self.decayrate, self.epsilon, self.learningrate
+        sq_avg = tree_map(lambda s, g: rho * s + (1 - rho) * g * g,
+                          state["sq_avg"], grads)
+        delta = tree_map(
+            lambda g, s, a: g * jnp.sqrt(a + eps) / jnp.sqrt(s + eps),
+            grads, sq_avg, state["acc_delta"])
+        acc_delta = tree_map(lambda a, d: rho * a + (1 - rho) * d * d,
+                             state["acc_delta"], delta)
+        new_params = tree_map(lambda p, d: p - lr * d, params, delta)
+        return new_params, {"sq_avg": sq_avg, "acc_delta": acc_delta}
+
+
+class Adamax(OptimMethod):
+    """Adamax (reference ``<dl>/optim/Adamax.scala`` — unverified).
+
+    ``u = max(β₂·u, |g|); p -= (lr / (1-β₁ᵗ)) · m / (u + ε)``.
+    """
+
+    def __init__(self, learningrate: float = 0.002, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-38):
+        self.learningrate = learningrate
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def get_learning_rate(self, step):
+        return float(self.learningrate)
+
+    def init_state(self, params):
+        return {"m": tree_map(jnp.zeros_like, params),
+                "u": tree_map(jnp.zeros_like, params)}
+
+    def update(self, params, grads, state, step):
+        t = step.astype(jnp.float32) + 1.0
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        m = tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        u = tree_map(lambda u, g: jnp.maximum(b2 * u, jnp.abs(g)), state["u"], grads)
+        clr = self.learningrate / (1.0 - jnp.power(b1, t))
+        new_params = tree_map(lambda p, m, u: p - clr * m / (u + eps), params, m, u)
+        return new_params, {"m": m, "u": u}
+
+
+class RMSprop(OptimMethod):
+    """RMSprop (reference ``<dl>/optim/RMSprop.scala`` — unverified).
+
+    ``sa = ρ·sa + (1-ρ)·g²; p -= clr · g / (√sa + ε)`` — matches torch with
+    ``eps`` outside the sqrt... (torch adds eps after sqrt; so do we).
+    """
+
+    def __init__(self, learningrate: float = 1e-2, learningrate_decay: float = 0.0,
+                 decayrate: float = 0.99, epsilon: float = 1e-8):
+        self.learningrate = learningrate
+        self.learningrate_decay = learningrate_decay
+        self.decayrate = decayrate
+        self.epsilon = epsilon
+
+    def get_learning_rate(self, step):
+        return float(decayed_lr(self.learningrate, self.learningrate_decay, step))
+
+    def init_state(self, params):
+        return {"sq_avg": tree_map(jnp.zeros_like, params)}
+
+    def update(self, params, grads, state, step):
+        clr = decayed_lr(self.learningrate, self.learningrate_decay, step.astype(jnp.float32))
+        rho, eps = self.decayrate, self.epsilon
+        sq_avg = tree_map(lambda s, g: rho * s + (1 - rho) * g * g,
+                          state["sq_avg"], grads)
+        new_params = tree_map(
+            lambda p, g, s: p - clr * g / (jnp.sqrt(s) + eps), params, grads, sq_avg)
+        return new_params, {"sq_avg": sq_avg}
+
+
+class Ftrl(OptimMethod):
+    """FTRL-proximal (reference ``<dl>/optim/Ftrl.scala`` — unverified).
+
+    TensorFlow-style FTRL with L1/L2 regularization and optional L2 shrinkage.
+    """
+
+    def __init__(self, learningrate: float = 1e-3, learningrate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_regularization_strength: float = 0.0,
+                 l2_regularization_strength: float = 0.0,
+                 l2_shrinkage_regularization_strength: float = 0.0):
+        if initial_accumulator_value < 0:
+            raise ValueError("initial_accumulator_value must be >= 0")
+        if learningrate_power > 0:
+            raise ValueError("learningrate_power must be <= 0")
+        self.learningrate = learningrate
+        self.learningrate_power = learningrate_power
+        self.initial_accumulator_value = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+        self.l2_shrinkage = l2_shrinkage_regularization_strength
+
+    def get_learning_rate(self, step):
+        return float(self.learningrate)
+
+    def init_state(self, params):
+        return {"accum": tree_map(
+                    lambda p: jnp.full_like(p, self.initial_accumulator_value), params),
+                "linear": tree_map(jnp.zeros_like, params)}
+
+    def update(self, params, grads, state, step):
+        lr, lp = self.learningrate, self.learningrate_power
+
+        def upd(p, g, n, z):
+            g_shrunk = g + 2.0 * self.l2_shrinkage * p
+            new_n = n + g * g
+            sigma = (jnp.power(new_n, -lp) - jnp.power(n, -lp)) / lr
+            new_z = z + g_shrunk - sigma * p
+            quad = jnp.power(new_n, -lp) / lr + 2.0 * self.l2
+            pre = jnp.clip(new_z, -self.l1, self.l1) - new_z
+            new_p = jnp.where(jnp.abs(new_z) > self.l1, pre / quad, jnp.zeros_like(p))
+            return new_p, new_n, new_z
+
+        flat = tree_map(upd, params, grads, state["accum"], state["linear"])
+        new_params = tree_map(lambda t: t[0], flat,
+                              is_leaf=lambda x: isinstance(x, tuple))
+        accum = tree_map(lambda t: t[1], flat, is_leaf=lambda x: isinstance(x, tuple))
+        linear = tree_map(lambda t: t[2], flat, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, {"accum": accum, "linear": linear}
+
+
+class LarsSGD(OptimMethod):
+    """Layer-wise Adaptive Rate Scaling SGD (reference ``<dl>/optim/LarsSGD.scala``
+    — unverified, [M] confidence in SURVEY §2.3).
+
+    Per parameter leaf ("layer"): ``local_lr = trust · ‖w‖ / (‖g‖ + wd·‖w‖ + ε)``;
+    momentum buffer ``v = μ·v + clr·local_lr·(g + wd·w); p -= v``.
+    """
+
+    def __init__(self, learningrate: float = 1e-2, learningrate_decay: float = 0.0,
+                 momentum: float = 0.9, weightdecay: float = 0.0,
+                 trust: float = 1.0, epsilon: float = 1e-9,
+                 learningrate_schedule=None):
+        self.learningrate = learningrate
+        self.learningrate_decay = learningrate_decay
+        self.momentum = momentum
+        self.weightdecay = weightdecay
+        self.trust = trust
+        self.epsilon = epsilon
+        if getattr(learningrate_schedule, "stateful", False):
+            raise ValueError(
+                "stateful schedules (Plateau) are only supported by SGD — LarsSGD "
+                "carries no live-LR state leaf, so the schedule would be inert")
+        self.learningrate_schedule = learningrate_schedule
+
+    def get_learning_rate(self, step):
+        if self.learningrate_schedule is not None:
+            return float(jax.device_get(self.learningrate_schedule(
+                self.learningrate, jnp.asarray(step, jnp.float32))))
+        return float(decayed_lr(self.learningrate, self.learningrate_decay, step))
+
+    def init_state(self, params):
+        return {"v": tree_map(jnp.zeros_like, params)}
+
+    def update(self, params, grads, state, step):
+        s = step.astype(jnp.float32)
+        if self.learningrate_schedule is not None:
+            clr = self.learningrate_schedule(self.learningrate, s)
+        else:
+            clr = decayed_lr(self.learningrate, self.learningrate_decay, s)
+        wd, mu, trust, eps = self.weightdecay, self.momentum, self.trust, self.epsilon
+
+        def upd(p, g, v):
+            w_norm = jnp.linalg.norm(p.ravel())
+            g_norm = jnp.linalg.norm(g.ravel())
+            local = jnp.where(
+                (w_norm > 0) & (g_norm > 0),
+                trust * w_norm / (g_norm + wd * w_norm + eps),
+                jnp.asarray(1.0, p.dtype))
+            new_v = mu * v + clr * local * (g + wd * p)
+            return p - new_v, new_v
+
+        flat = tree_map(upd, params, grads, state["v"])
+        new_params = tree_map(lambda t: t[0], flat,
+                              is_leaf=lambda x: isinstance(x, tuple))
+        v = tree_map(lambda t: t[1], flat, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, {"v": v}
+
+class LBFGS(OptimMethod):
+    """L-BFGS with fixed-size history, one quasi-Newton iteration per ``update``
+    (reference ``<dl>/optim/LBFGS.scala`` — unverified).
+
+    TPU-native: the two-loop recursion runs under ``lax.fori_loop`` over circular
+    (s, y) history buffers of static shape ``(history, n)``, so the whole update
+    stays inside one jitted step with no host sync and a fixed state structure
+    (donation-safe). No line search (the reference's default); step size is
+    ``learningrate``, with the first step scaled by ``min(1, 1/‖g‖₁)`` as in
+    torch.optim.LBFGS.
+    """
+
+    def __init__(self, history: int = 8, learningrate: float = 1.0,
+                 epsilon: float = 1e-10):
+        self.history = history
+        self.learningrate = learningrate
+        self.epsilon = epsilon
+
+    def get_learning_rate(self, step):
+        return float(self.learningrate)
+
+    def init_state(self, params):
+        from jax.flatten_util import ravel_pytree
+        flat, _ = ravel_pytree(params)
+        n, m = flat.shape[0], self.history
+        return {"s": jnp.zeros((m, n), flat.dtype), "y": jnp.zeros((m, n), flat.dtype),
+                "rho": jnp.zeros((m,), flat.dtype),
+                "pos": jnp.asarray(0, jnp.int32),       # next write slot
+                "hist_len": jnp.asarray(0, jnp.int32),  # valid pairs (<= m)
+                "count": jnp.asarray(0, jnp.int32),     # update calls so far
+                "prev_flat": jnp.zeros((n,), flat.dtype),
+                "prev_grad": jnp.zeros((n,), flat.dtype)}
+
+    def update(self, params, grads, state, step):
+        from jax.flatten_util import ravel_pytree
+
+        flat, unravel = ravel_pytree(params)
+        g, _ = ravel_pytree(grads)
+        m, eps = self.history, self.epsilon
+        count, pos, hist_len = state["count"], state["pos"], state["hist_len"]
+
+        # Push last iteration's (s, y) pair if it passes the curvature condition.
+        s_vec = flat - state["prev_flat"]
+        y_vec = g - state["prev_grad"]
+        ys = jnp.dot(s_vec, y_vec)
+        accept = (count > 0) & (ys > eps)
+        S = jnp.where(accept, state["s"].at[pos].set(s_vec), state["s"])
+        Y = jnp.where(accept, state["y"].at[pos].set(y_vec), state["y"])
+        rho = jnp.where(accept,
+                        state["rho"].at[pos].set(1.0 / jnp.maximum(ys, eps)),
+                        state["rho"])
+        pos = jnp.where(accept, (pos + 1) % m, pos)
+        hist_len = jnp.where(accept, jnp.minimum(hist_len + 1, m), hist_len)
+        newest = (pos - 1) % m  # valid only when hist_len > 0
+
+        # Two-loop recursion: newest→oldest, then oldest→newest.
+        def alpha_body(i, carry):
+            q, alphas = carry
+            j = (newest - i) % m
+            valid = i < hist_len
+            a = jnp.where(valid, rho[j] * jnp.dot(S[j], q), 0.0)
+            q = q - jnp.where(valid, a, 0.0) * Y[j]
+            return q, alphas.at[i].set(a)
+
+        q, alphas = jax.lax.fori_loop(0, m, alpha_body, (g, jnp.zeros((m,), g.dtype)))
+
+        # Initial Hessian scaling γ = sᵀy / yᵀy of the newest pair.
+        y_new = Y[newest]
+        gamma = jnp.where(hist_len > 0,
+                          1.0 / jnp.maximum(rho[newest] * jnp.dot(y_new, y_new), eps),
+                          1.0)
+        r = gamma * q
+
+        def beta_body(i, r):
+            k = m - 1 - i  # oldest valid first
+            j = (newest - k) % m
+            valid = k < hist_len
+            b = jnp.where(valid, rho[j] * jnp.dot(Y[j], r), 0.0)
+            return r + jnp.where(valid, alphas[k] - b, 0.0) * S[j]
+
+        r = jax.lax.fori_loop(0, m, beta_body, r)
+
+        lr = jnp.where(count == 0,
+                       jnp.minimum(1.0, 1.0 / jnp.maximum(jnp.abs(g).sum(), eps))
+                       * self.learningrate,
+                       self.learningrate)
+        new_flat = flat - lr * r
+        new_state = {"s": S, "y": Y, "rho": rho, "pos": pos, "hist_len": hist_len,
+                     "count": count + 1, "prev_flat": flat, "prev_grad": g}
+        return unravel(new_flat), new_state

@@ -1,0 +1,66 @@
+"""Triggers — composable stop/fire conditions.
+
+Reference parity (SURVEY.md §2.3, expected ``<dl>/optim/Trigger.scala`` — unverified):
+``everyEpoch``, ``severalIteration(n)``, ``maxEpoch(n)``, ``maxIteration(n)``, ``minLoss``,
+``maxScore``, ``and``/``or``. A trigger is evaluated against the trainer's state table
+(keys: "epoch" 1-based, "neval" 1-based iteration counter, "loss", "score",
+"epoch_finished" bool set at epoch boundaries).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class Trigger:
+    """``scope`` controls when side-effect triggers are evaluated by the trainer:
+    'iteration' (inside the batch loop), 'epoch' (at epoch boundaries), or 'any'."""
+
+    def __init__(self, fn: Callable[[dict], bool], name: str = "trigger",
+                 scope: str = "any"):
+        self._fn = fn
+        self._name = name
+        self.scope = scope
+
+    def __call__(self, state: dict) -> bool:
+        return bool(self._fn(state))
+
+    def __repr__(self):
+        return f"Trigger({self._name})"
+
+    # factories ------------------------------------------------------------
+    @staticmethod
+    def every_epoch() -> "Trigger":
+        return Trigger(lambda s: s.get("epoch_finished", False), "everyEpoch",
+                       scope="epoch")
+
+    @staticmethod
+    def several_iteration(interval: int) -> "Trigger":
+        return Trigger(lambda s: s.get("neval", 0) % interval == 0,
+                       f"severalIteration({interval})", scope="iteration")
+
+    @staticmethod
+    def max_epoch(n: int) -> "Trigger":
+        return Trigger(lambda s: s.get("epoch", 1) > n, f"maxEpoch({n})")
+
+    @staticmethod
+    def max_iteration(n: int) -> "Trigger":
+        # checked at loop top with neval starting at 1 → runs exactly n iterations
+        return Trigger(lambda s: s.get("neval", 0) > n, f"maxIteration({n})")
+
+    @staticmethod
+    def min_loss(value: float) -> "Trigger":
+        return Trigger(lambda s: s.get("loss", float("inf")) < value, f"minLoss({value})")
+
+    @staticmethod
+    def max_score(value: float) -> "Trigger":
+        return Trigger(lambda s: s.get("score", float("-inf")) > value,
+                       f"maxScore({value})")
+
+    @staticmethod
+    def and_(*triggers: "Trigger") -> "Trigger":
+        return Trigger(lambda s: all(t(s) for t in triggers), "and")
+
+    @staticmethod
+    def or_(*triggers: "Trigger") -> "Trigger":
+        return Trigger(lambda s: any(t(s) for t in triggers), "or")

@@ -1,0 +1,215 @@
+"""Validation methods and results.
+
+Reference parity (SURVEY.md §2.3, expected ``<dl>/optim/ValidationMethod.scala`` —
+unverified): ``Top1Accuracy``, ``Top5Accuracy``, ``Loss``, ``MAE``, …; partial results
+aggregate with ``+`` and ``.result()`` yields (value, count).
+
+Padded batches: methods take ``valid`` (real sample count) so the repeated padding rows
+never contaminate metrics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ValidationResult:
+    def result(self) -> tuple[float, int]:
+        raise NotImplementedError
+
+    def __add__(self, other: "ValidationResult") -> "ValidationResult":
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct: float, count: int):
+        self.correct, self.count = float(correct), int(count)
+
+    def result(self):
+        return (self.correct / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct, self.count + other.count)
+
+    def __repr__(self):
+        v, c = self.result()
+        return f"Accuracy({v:.4f}, count={c})"
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss_sum: float, count: int):
+        self.loss_sum, self.count = float(loss_sum), int(count)
+
+    def result(self):
+        return (self.loss_sum / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return LossResult(self.loss_sum + other.loss_sum, self.count + other.count)
+
+    def __repr__(self):
+        v, c = self.result()
+        return f"Loss({v:.4f}, count={c})"
+
+
+class ValidationMethod:
+    name = "ValidationMethod"
+
+    def apply(self, output, target, valid: int | None = None) -> ValidationResult:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.name
+
+
+def _mask_valid(n: int, valid: int | None):
+    if valid is None or valid >= n:
+        return None
+    return np.arange(n) < valid
+
+
+class TopKAccuracy(ValidationMethod):
+    def __init__(self, k: int, one_based: bool = False):
+        self.k = k
+        self.one_based = one_based
+        self.name = f"Top{k}Accuracy"
+
+    def apply(self, output, target, valid=None):
+        out = np.asarray(output)
+        t = np.asarray(target).astype(np.int64).reshape(-1)
+        if self.one_based:
+            t = t - 1
+        if out.ndim == 1:
+            out = out[None]
+        topk = np.argsort(-out, axis=1)[:, : self.k]
+        correct = (topk == t[:, None]).any(axis=1).astype(np.float64)
+        mask = _mask_valid(len(t), valid)
+        if mask is not None:
+            correct = correct[mask]
+        return AccuracyResult(correct.sum(), len(correct))
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Top-1 accuracy on the tree ROOT node's prediction (reference
+    ``<dl>/optim/ValidationMethod.scala`` TreeNNAccuracy, used by the treeLSTM
+    sentiment example — unverified). ``output`` is (N, nodes, classes); the
+    root is the FIRST node; (N, classes) outputs degrade to plain Top-1.
+    ``target`` may be per-node (N, nodes) — the root column is used — or (N,)."""
+
+    def __init__(self, one_based: bool = False):
+        self.one_based = one_based
+        self.name = "TreeNNAccuracy"
+
+    def apply(self, output, target, valid=None):
+        out = np.asarray(output)
+        t = np.asarray(target)
+        if out.ndim == 3:
+            out = out[:, 0, :]
+        if t.ndim == 2:
+            t = t[:, 0]
+        return Top1Accuracy(self.one_based).apply(out, t, valid)
+
+
+class Top1Accuracy(TopKAccuracy):
+    def __init__(self, one_based: bool = False):
+        super().__init__(1, one_based)
+
+
+class Top5Accuracy(TopKAccuracy):
+    def __init__(self, one_based: bool = False):
+        super().__init__(5, one_based)
+
+
+class Loss(ValidationMethod):
+    def __init__(self, criterion=None):
+        from bigdl_tpu.nn.criterion import ClassNLLCriterion
+        self.criterion = criterion or ClassNLLCriterion()
+        self.name = "Loss"
+
+    def apply(self, output, target, valid=None):
+        n = np.asarray(output).shape[0]
+        if valid is not None and valid < n:
+            output = np.asarray(output)[:valid]
+            target = np.asarray(target)[:valid]
+            n = valid
+        loss = float(self.criterion.forward(jnp.asarray(np.asarray(output)),
+                                            jnp.asarray(np.asarray(target))))
+        return LossResult(loss * n, n)
+
+
+class MAE(ValidationMethod):
+    name = "MAE"
+
+    def apply(self, output, target, valid=None):
+        out = np.asarray(output)
+        t = np.asarray(target)
+        n = out.shape[0]
+        if valid is not None and valid < n:
+            out, t = out[:valid], t[:valid]
+            n = valid
+        return LossResult(float(np.abs(out - t).mean()) * n, n)
+
+
+class HitRatio(ValidationMethod):
+    """HR@k over (1 positive + neg_num negatives) score groups (reference
+    ``<dl>/optim/ValidationMethod.scala`` HitRatio, used by the NCF
+    recommendation example — unverified).
+
+    ``output`` holds one score per candidate item; ``target`` is 1 for the
+    positive item and 0 for sampled negatives. Rows of ``neg_num + 1``
+    candidates are formed in order; the hit rate is the fraction of rows whose
+    positive lands in the top ``k`` scores.
+    """
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+        self.neg_num = neg_num
+        self.name = f"HitRatio@{k}"
+
+    def _ranks(self, output, target, valid):
+        output = np.asarray(output).reshape(-1)
+        target = np.asarray(target).reshape(-1)
+        if valid is not None:
+            output, target = output[:valid], target[:valid]
+        group = self.neg_num + 1
+        if len(output) % group != 0 or len(output) == 0:
+            # silent regrouping across misaligned batches would produce a
+            # plausible-looking but wrong metric — refuse instead
+            raise ValueError(
+                f"{self.name}: got {len(output)} scores, not a positive multiple of "
+                f"neg_num+1={group}; evaluate with batch_size a multiple of {group} "
+                "so every (positive + negatives) group stays within one batch")
+        n_rows = len(output) // group
+        scores = output.reshape(n_rows, group)
+        labels = target.reshape(n_rows, group)
+        if not (labels.max(axis=1) > 0).all():
+            # argmax on an all-zero row would silently crown candidate 0 the
+            # "positive" and inflate the metric — refuse, like the alignment
+            # check above
+            raise ValueError(
+                f"{self.name}: found a candidate group with no positive label "
+                "(every label 0); each neg_num+1 group must contain exactly one "
+                "positive item")
+        pos_idx = labels.argmax(axis=1)
+        pos_score = scores[np.arange(n_rows), pos_idx]
+        # rank = 1 + number of candidates scoring strictly higher
+        return 1 + (scores > pos_score[:, None]).sum(axis=1), n_rows
+
+    def apply(self, output, target, valid: int | None = None):
+        ranks, n = self._ranks(output, target, valid)
+        hits = float((ranks <= self.k).sum())
+        return AccuracyResult(hits, n)
+
+
+class NDCG(HitRatio):
+    """NDCG@k over the same grouped layout as :class:`HitRatio`: one relevant
+    item per group, so DCG reduces to ``log(2)/log(1 + rank)`` within top-k."""
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        super().__init__(k, neg_num)
+        self.name = f"NDCG@{k}"
+
+    def apply(self, output, target, valid: int | None = None):
+        ranks, n = self._ranks(output, target, valid)
+        gains = np.where(ranks <= self.k, np.log(2.0) / np.log(1.0 + ranks), 0.0)
+        return AccuracyResult(float(gains.sum()), n)

@@ -1,0 +1,9 @@
+from bigdl_tpu.parallel.ring_attention import full_attention, ring_attention
+from bigdl_tpu.parallel.sharding import (
+    batch_sharding, replicated, shard_leading_axis, zero1_state_sharding,
+)
+from bigdl_tpu.parallel.moe import MoE, expert_parallel_rules
+from bigdl_tpu.parallel.pipeline import GPipe
+from bigdl_tpu.parallel.tensor_parallel import (
+    TPRules, column_parallel, megatron_mlp_rules, row_parallel,
+)

@@ -1,0 +1,138 @@
+"""Mixture-of-Experts with expert parallelism.
+
+No reference counterpart (SURVEY.md §2.3 checklist: EP/MoE absent upstream —
+design headroom for the TPU build, like ring attention). Switch-style top-1
+routing in the GShard dense-dispatch formulation: every tensor keeps a static
+shape (tokens × experts × capacity one-hot dispatch), so the whole layer is
+three einsums + a softmax — exactly what the SPMD partitioner can shard.
+
+Expert parallelism is NOT a separate communication path: the expert-indexed
+parameters (E, D, H) are sharded over a mesh axis via the same TPRules
+machinery as tensor parallelism (``expert_parallel_rules``), and XLA inserts
+the token all-to-all implied by the dispatch einsums over ICI. One mechanism,
+dp x ep meshes for free.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomNormal
+from bigdl_tpu.parallel.tensor_parallel import TPRules
+from jax.sharding import PartitionSpec as P
+
+
+class MoE(TensorModule):
+    """Switch-style top-1 MoE MLP block.
+
+    Input (N, D) or (N, T, D) → same shape. ``capacity_factor`` bounds tokens
+    per expert; overflow tokens get dispatch weight zero, so their OUTPUT IS
+    ZERO (the standard GShard drop) — wire the layer with an external residual
+    connection (e.g. ``CAddTable`` around it) if dropped tokens should pass
+    through. The load-balancing auxiliary loss (Switch eq. 4) is exposed in
+    the state as ``aux_loss`` for observability.
+    """
+
+    def __init__(self, input_size: int, hidden_size: int, n_experts: int,
+                 capacity_factor: float = 1.25,
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.n_experts = n_experts
+        self.capacity_factor = capacity_factor
+        self.w_init = w_init or RandomNormal(0.0, 0.02)
+        self.reset()
+
+    def reset(self) -> None:
+        d, h, e = self.input_size, self.hidden_size, self.n_experts
+
+        def mk(shape, fan_in, fan_out):
+            return jnp.asarray(self.w_init.init(shape, fan_in=fan_in,
+                                                fan_out=fan_out))
+
+        self._params = {
+            "w_gate": mk((d, e), d, e),
+            "w1": mk((e, d, h), d, h),
+            "b1": jnp.zeros((e, h), jnp.float32),
+            "w2": mk((e, h, d), h, d),
+            "b2": jnp.zeros((e, d), jnp.float32),
+        }
+        self._state = {"aux_loss": jnp.zeros((), jnp.float32)}
+        self.zero_grad_parameters()
+
+    def _capacity(self, n_tokens: int) -> int:
+        import math
+        # ceil (GShard/Switch convention): flooring could drop tokens even
+        # under perfectly balanced routing with capacity_factor > 1
+        cap = math.ceil(n_tokens * self.capacity_factor / self.n_experts)
+        return max(cap, 1)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        flat = x.ndim == 3
+        if flat:
+            n, t, d = x.shape
+            x = x.reshape(n * t, d)
+        tokens = x.shape[0]
+        e = self.n_experts
+        cap = self._capacity(tokens)
+
+        logits = x @ params["w_gate"]                      # (T, E)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                # (T,)
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)      # (T, E)
+        # position of each token within its expert's queue
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # (T, E)
+        keep = (pos < cap) & (onehot > 0)
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                                dtype=jnp.float32) * keep[..., None]
+        dispatch = pos_oh                                           # (T, E, C)
+
+        # route tokens to expert buffers, run the per-expert MLP, combine
+        xin = jnp.einsum("tec,td->ecd", dispatch, x)                # (E, C, D)
+        hmid = jax.nn.relu(
+            jnp.einsum("ecd,edh->ech", xin, params["w1"])
+            + params["b1"][:, None, :])
+        out_e = jnp.einsum("ech,ehd->ecd", hmid, params["w2"]) \
+            + params["b2"][:, None, :]
+        combine = dispatch * gate[:, None, None]
+        y = jnp.einsum("tec,ecd->td", combine, out_e).astype(x.dtype)
+
+        # Switch aux loss: e * Σ_e (fraction of tokens) * (mean router prob)
+        frac = jnp.mean(onehot, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        aux = e * jnp.sum(frac * mean_prob)
+        new_state = dict(state)
+        new_state["aux_loss"] = aux
+
+        if flat:
+            y = y.reshape(n, t, d)
+        return y, new_state
+
+    def __repr__(self):
+        return (f"MoE({self.input_size}, hidden={self.hidden_size}, "
+                f"experts={self.n_experts})")
+
+
+def expert_parallel_rules(moe_path_prefix: str = "", axis: str = "model",
+                          rules: Optional[TPRules] = None) -> TPRules:
+    """TPRules sharding an MoE block's expert-indexed params over ``axis`` —
+    expert parallelism through the same mechanism as tensor parallelism. The
+    gate stays replicated; w1/b1/w2/b2 shard on the expert dim."""
+    import re as _re
+    r = rules if rules is not None else TPRules()
+    # anchored + escaped (TPRules convention, cf. megatron_mlp_rules): prefix
+    # "1" must not also match paths under "11"
+    pre = f"(^|/){_re.escape(moe_path_prefix)}/" if moe_path_prefix else "(^|/)"
+    r.add(f"{pre}w1$", P(axis, None, None))
+    r.add(f"{pre}b1$", P(axis, None))
+    r.add(f"{pre}w2$", P(axis, None, None))
+    r.add(f"{pre}b2$", P(axis, None))
+    return r

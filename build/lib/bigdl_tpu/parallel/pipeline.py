@@ -1,0 +1,137 @@
+"""Pipeline parallelism — GPipe-style stage sharding over the ``pipe`` axis.
+
+No reference counterpart (SURVEY.md §2.3 checklist: PP absent upstream —
+design headroom for the TPU build, like ring attention and MoE). Homogeneous
+stages (identical pytree structure, input shape = output shape) are stacked on
+a leading stage dim sharded over the mesh's ``pipe`` axis; under ``shard_map``
+each device holds one stage and the classic GPipe schedule runs: at tick ``t``
+a device applies its stage to the activation it received, then ``ppermute``\\ s
+the result to its right neighbor. After ``M + S - 1`` ticks every microbatch
+has crossed all ``S`` stages. The backward schedule needs no hand-written code:
+jax reverse-mode differentiates through the ``lax.scan`` + ``ppermute`` chain,
+producing the reversed-communication backward pipeline automatically — the
+whole train step stays ONE jitted program.
+
+Off-mesh (no ``pipe`` axis) the same microbatch loop runs without
+communication, so tests and single-chip runs get identical math.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, Container
+
+
+class GPipe(Container):
+    """Pipeline container: ``n_stages`` clones of ``stage`` composed
+    sequentially, executed as a pipeline over the ``pipe`` mesh axis when
+    present. Stages must be stateless (no BatchNorm running stats) and
+    shape-preserving (output shape == input shape)."""
+
+    def __init__(self, stage: Optional[AbstractModule] = None,
+                 n_stages: int = 1, n_microbatches: int = 2,
+                 axis_name: str = "pipe"):
+        mods = []
+        if stage is not None:
+            if jax.tree_util.tree_leaves(stage.get_state()):
+                raise ValueError("GPipe stages must be stateless")
+            mods = [stage]
+            for _ in range(n_stages - 1):
+                c = stage.clone()
+                c.reset()  # independent parameters per stage
+                mods.append(c)
+        super().__init__(*mods)
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+        self.axis_name = axis_name
+
+    # ------------------------------------------------------------------ run
+    def _stage_apply(self, params, x, training):
+        # stages are stateless, but containers still want the structured
+        # (empty) state tree
+        out, _ = self.modules[0].apply(params, self.modules[0].get_state(), x,
+                                       training=training, rng=None)
+        return out
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.utils.engine import Engine
+
+        s, m = self.n_stages, self.n_microbatches
+        b = input.shape[0]
+        if b % m != 0:
+            raise ValueError(f"batch {b} not divisible by n_microbatches {m}")
+
+        mesh = Engine.mesh() if Engine.is_initialized() else None
+        axes = dict(mesh.shape) if mesh is not None else {}
+        if axes.get(self.axis_name, 1) == s and s > 1:
+            # under dp x pp the batch stays sharded over `data` inside the
+            # shard_map (replicating it would all-gather and nullify dp)
+            data_axis = Engine.DATA_AXIS if Engine.DATA_AXIS in axes else None
+            d = axes.get(data_axis, 1) if data_axis else 1
+            if d > 1 and (b % d != 0 or (b // d) % m != 0):
+                raise ValueError(
+                    f"batch {b} must divide by data size {d} and the local "
+                    f"batch by n_microbatches {m}")
+            return self._apply_sharded(params, input, training, mesh,
+                                       data_axis if d > 1 else None), state
+
+        # sequential fallback: same stage composition, no communication
+        y = input
+        for i in range(s):
+            y = self._stage_apply(params[str(i)], y, training)
+        return y, state
+
+    def _apply_sharded(self, params, x, training, mesh, data_axis=None):
+        s, m = self.n_stages, self.n_microbatches
+        axis = self.axis_name
+        x_spec = P(data_axis) if data_axis else P()
+        # stack per-stage params on a leading stage dim (sharded over `pipe`)
+        stacked = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *[params[str(i)] for i in range(s)])
+
+        def body(p_stk, xs):
+            rank = lax.axis_index(axis)
+            p = jax.tree_util.tree_map(lambda l: l[0], p_stk)  # my stage
+            micro = xs.reshape((m, xs.shape[0] // m) + xs.shape[1:])
+            # carries become device-varying after the first ppermute; mark the
+            # (invariant) zeros accordingly or scan rejects the carry typing
+            zero = lax.pcast(jnp.zeros_like(micro[0]), (axis,), to="varying")
+            out_acc = lax.pcast(jnp.zeros_like(micro), (axis,), to="varying")
+            perm = [(i, i + 1) for i in range(s - 1)]
+
+            def tick(carry, t):
+                recv, out_acc = carry
+                feed = micro[jnp.minimum(t, m - 1)]
+                inp = jnp.where(jnp.logical_and(rank == 0, t < m), feed, recv)
+                out = self._stage_apply(p, inp, training)
+                # last stage banks microbatch t-(s-1) when it emerges
+                slot = jnp.clip(t - (s - 1), 0, m - 1)
+                bank = jnp.logical_and(rank == s - 1, t >= s - 1)
+                prev = lax.dynamic_index_in_dim(out_acc, slot, 0,
+                                                keepdims=False)
+                out_acc = lax.dynamic_update_index_in_dim(
+                    out_acc, jnp.where(bank, out, prev), slot, axis=0)
+                recv = lax.ppermute(out, axis, perm)
+                return (recv, out_acc), None
+
+            (recv, out_acc), _ = lax.scan(tick, (zero, out_acc),
+                                          jnp.arange(m + s - 1))
+            # results live on the last stage only → broadcast over the axis
+            out_acc = jnp.where(lax.axis_index(axis) == s - 1, out_acc, 0.0)
+            out_acc = lax.psum(out_acc, axis)
+            return out_acc.reshape(xs.shape)
+
+        spec_p = jax.tree_util.tree_map(lambda _: P(axis), stacked)
+        fn = jax.shard_map(body, mesh=mesh,
+                           in_specs=(spec_p, x_spec), out_specs=x_spec)
+        return fn(stacked, x)
+
+    def __repr__(self):
+        return (f"GPipe(stages={self.n_stages}, "
+                f"microbatches={self.n_microbatches})")

@@ -1,0 +1,132 @@
+"""Ring attention — sequence/context parallelism for long sequences.
+
+No reference counterpart (SURVEY.md §5.7: sequence parallelism is absent upstream —
+the longest-sequence workload is a PTB LSTM). This is a required capability of the
+TPU build: long-context attention whose memory scales with the *local* sequence
+shard, communication riding the ICI ring.
+
+Design (blockwise ring attention, Liu et al. 2023 style, re-derived for shard_map):
+the sequence axis of Q/K/V is sharded over the mesh's ``seq`` axis. Each device
+keeps its Q shard resident and processes one K/V block per ring step, carrying the
+numerically-stable streaming-softmax accumulators (running max ``m``, normalizer
+``l``, un-normalized output ``o``); after each step K/V blocks rotate to the next
+device with ``lax.ppermute``. After ``n`` steps every Q row has attended to the
+full global sequence; communication is n-1 K/V block transfers per device —
+point-to-point neighbor traffic, exactly what the torus ICI is built for. Causal
+masking compares *global* row/column indices, so blocks that are entirely in the
+future are suppressed by the mask (their contribution underflows to zero in the
+streaming softmax).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_kernel(q, k, v, *, axis_name: str, causal: bool, scale: float):
+    """Per-shard body under shard_map. q/k/v: (batch, heads, t_local, d)."""
+    n = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    b, h, t_local, d = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    q_pos = rank * t_local + jnp.arange(t_local)  # global row indices
+
+    def step(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        # the block currently held originated on device (rank - i) mod n
+        src = (rank - i) % n
+        # fp32 islands: scores and the streaming-softmax accumulators (m, l, o)
+        # stay fp32 across all n ring steps; the two matmuls run in the input
+        # dtype with fp32 accumulation (MXU-native under bf16).
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(axis=-1)
+        o_new = o * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
+        # rotate K/V to the neighbor for the next step (skipped result unused on last)
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return o_new, l_new, m_new, k_next, v_next
+
+    # derive accumulators from q so they carry shard_map's varying-axis tag
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+    l0 = (q[..., 0] * 0.0).astype(jnp.float32)
+    m0 = l0 + _NEG_INF
+    o, l, m, _, _ = lax.fori_loop(0, n, step, (o0, l0, m0, k, v))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Optional[Mesh] = None, seq_axis: str = "seq",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Global attention over sequence-sharded Q/K/V.
+
+    Args: ``q/k/v`` of shape (batch, heads, seq, head_dim) — global arrays (or
+    already sharded on ``seq``); ``mesh`` defaults to the Engine mesh. Returns the
+    attention output with the same shape/sharding. Falls back to single-device
+    attention when the mesh has no ``seq_axis`` or it has size 1.
+    """
+    if mesh is None:
+        from bigdl_tpu.utils.engine import Engine
+        mesh = Engine.mesh()
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    axes = dict(mesh.shape)
+    if seq_axis not in axes or axes[seq_axis] == 1:
+        return full_attention(q, k, v, causal=causal, scale=scale)
+    if q.shape[2] % axes[seq_axis] != 0:
+        raise ValueError(
+            f"sequence length {q.shape[2]} not divisible by seq-parallel size "
+            f"{axes[seq_axis]}")
+    # on a combined dp × sp mesh the batch dim stays data-sharded — otherwise
+    # every data group would all-gather the batch and compute attention redundantly
+    batch_axis = data_axis if (data_axis := _present_axis(axes, q.shape[0])) else None
+    spec = P(batch_axis, None, seq_axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_kernel, axis_name=seq_axis,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def _present_axis(axes: dict, batch: int, name: str = "data"):
+    """The data axis name iff it exists, is >1, and divides the batch."""
+    size = axes.get(name, 1)
+    return name if size > 1 and batch % size == 0 else None
+
+
+def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Single-device reference attention (also the oracle in tests).
+
+    Mixed-precision contract: the two matmuls run in the input dtype (bf16 →
+    MXU double rate) with fp32 accumulation (``preferred_element_type`` — the
+    MXU accumulates fp32 natively, this just keeps XLA from truncating), and the
+    softmax itself is an fp32 island. Output returns in the input dtype.
+    """
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)

@@ -1,0 +1,51 @@
+"""Sharding helpers — the TPU-native replacement for the reference's parameter-partition
+machinery.
+
+Reference parity (SURVEY.md §2.3/§5.8, expected ``<dl>/parameters/AllReduceParameter.scala``
+— unverified): the reference flattens all parameters into one vector, splits it into
+``partitionNum`` slices, and moves gradient/weight slices through the Spark BlockManager —
+structurally reduce-scatter → per-slice optimizer update → all-gather (ZeRO-1).
+
+TPU-native: no flattening, no explicit messaging. Pytrees get ``NamedSharding`` annotations
+over the Engine mesh and XLA's SPMD partitioner emits the ICI collectives:
+
+- replicated params + batch sharded on ``data`` → XLA inserts the gradient all-reduce;
+- ``zero1_state_sharding`` shards optimizer slots over ``data`` → the (elementwise) update
+  computes sharded and XLA all-gathers the new params — the exact slice-owned update the
+  reference ran over BlockManager, minus the seam.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dim over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_leading_axis(mesh: Mesh, x_shape, axis: str = "data") -> NamedSharding:
+    """Shard dim 0 over ``axis`` when divisible, else replicate (per-leaf decision)."""
+    n = int(dict(mesh.shape)[axis])
+    if len(x_shape) > 0 and x_shape[0] % n == 0 and x_shape[0] >= n:
+        return NamedSharding(mesh, P(axis))
+    return NamedSharding(mesh, P())
+
+
+def zero1_state_sharding(mesh: Mesh, state_tree, axis: str = "data"):
+    """A sharding pytree for optimizer slots: leading-axis sharded where divisible.
+
+    Matches the reference's slice-owned optimizer state (each partition updates 1/N of the
+    parameter vector); here the slicing is per-leaf along dim 0 and XLA handles the
+    reduce-scatter/all-gather placement.
+    """
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: shard_leading_axis(mesh, np.shape(x), axis), state_tree)

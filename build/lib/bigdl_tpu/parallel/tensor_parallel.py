@@ -1,0 +1,132 @@
+"""Tensor parallelism — parameter-sharding rules over the mesh's ``model`` axis.
+
+No reference counterpart (SURVEY.md §2.3 parallelism checklist: TP absent upstream);
+required capability of the TPU build. TPU-native design: TP is *declarative* — params
+get ``NamedSharding`` annotations and XLA's SPMD partitioner splits the matmuls and
+inserts the activation collectives (all-gather/reduce-scatter over ICI). No manual
+collective calls, no module rewrites: the same model runs 1-chip or TP=8 by changing
+only the rules.
+
+Rules are ``(path_substring_or_regex, PartitionSpec)`` pairs matched against the
+pytree path of each parameter leaf (e.g. ``("classifier/weight", P("model", None))``
+for a column-parallel Linear). Helpers provide the two Megatron-style Linear
+shardings; pair a column-parallel layer with a following row-parallel layer so the
+intermediate activation stays sharded and only one all-reduce happens per pair.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.tree_util import keystr, tree_map_with_path
+
+
+def _normalize_path(path) -> str:
+    # keystr gives e.g. "['1']['weight']" — normalize to "1/weight"
+    return keystr(path).replace("']['", "/").strip("[]'\"")
+
+
+def column_parallel(model_axis: str = "model") -> P:
+    """Linear weight (out, in) split on the output dim; bias splits with it."""
+    return P(model_axis, None)
+
+
+def row_parallel(model_axis: str = "model") -> P:
+    """Linear weight (out, in) split on the input dim; bias replicated."""
+    return P(None, model_axis)
+
+
+class TPRules:
+    """Ordered parameter-path → PartitionSpec rules (first match wins)."""
+
+    def __init__(self, rules: Sequence[Tuple[str, P]] = (),
+                 default: P = P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.default = default
+
+    def add(self, pattern: str, spec: P) -> "TPRules":
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def match(self, path: str, shape) -> Optional[P]:
+        """The first matching rule's spec, or None (no rule matched)."""
+        for pat, spec in self.rules:
+            if pat.search(path):
+                self._check(path, spec, shape)
+                return spec
+        return None
+
+    def spec_for(self, path: str, shape) -> P:
+        spec = self.match(path, shape)
+        return self.default if spec is None else spec
+
+    @staticmethod
+    def _check(path: str, spec: P, shape) -> None:
+        if len(spec) > len(shape):
+            raise ValueError(
+                f"TP rule for {path!r}: spec {spec} has more axes than the "
+                f"parameter shape {tuple(shape)}")
+
+    def param_shardings(self, params, mesh: Mesh):
+        """NamedSharding pytree for a parameter tree. Divisibility is validated
+        eagerly so a bad rule fails at compile time with the path named."""
+        axes = dict(mesh.shape)
+
+        def one(path, leaf):
+            p = _normalize_path(path)
+            shape = np.shape(leaf)
+            spec = self.spec_for(p, shape)
+            for dim, ax in enumerate(spec):
+                if ax is None:
+                    continue
+                size = axes.get(ax)
+                if size is None:
+                    raise ValueError(
+                        f"TP rule for {p!r} uses mesh axis {ax!r}, not in mesh "
+                        f"{tuple(axes)}")
+                if shape[dim] % size != 0:
+                    raise ValueError(
+                        f"TP rule for {p!r}: dim {dim} of shape {shape} not "
+                        f"divisible by {ax!r} axis size {size}")
+            return NamedSharding(mesh, spec)
+
+        return tree_map_with_path(one, params)
+
+    def slot_shardings(self, state_shapes, mesh: Mesh,
+                       dp_axis: Optional[str] = None):
+        """Shardings for optimizer slot trees. Slot trees mirror the param tree
+        one level down (e.g. ``state["v"][...]``), so rule paths match them too:
+        slots of a TP-sharded param follow the param's sharding; the rest are
+        replicated, or — when ``dp_axis`` is given (ZeRO-1) — sharded on their
+        leading dim over the data axis."""
+        from bigdl_tpu.parallel.sharding import shard_leading_axis
+
+        def one(path, leaf):
+            p = _normalize_path(path)
+            shape = np.shape(leaf)
+            spec = self.match(p, shape)
+            if spec is not None:
+                return NamedSharding(mesh, spec)
+            if dp_axis is not None:
+                return shard_leading_axis(mesh, shape, dp_axis)
+            return NamedSharding(mesh, P())
+
+        return tree_map_with_path(one, state_shapes)
+
+
+def megatron_mlp_rules(up_pattern: str, down_pattern: str,
+                       model_axis: str = "model") -> TPRules:
+    """The canonical pair: up-projection column-parallel, down-projection
+    row-parallel → one all-reduce per MLP block instead of two.
+
+    Patterns are boundary-anchored so layer index "1" cannot match "11"."""
+    return TPRules([
+        (rf"(^|/){up_pattern}/weight$", column_parallel(model_axis)),
+        (rf"(^|/){up_pattern}/bias$", P(model_axis)),
+        (rf"(^|/){down_pattern}/weight$", row_parallel(model_axis)),
+        (rf"(^|/){down_pattern}/bias$", P()),
+    ])

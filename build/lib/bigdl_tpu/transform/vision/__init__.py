@@ -1,0 +1,7 @@
+from bigdl_tpu.transform.vision.image import (
+    AspectScale, Brightness, CenterCrop, ChannelNormalize, ChannelOrder,
+    ColorJitter, Contrast, Expand, FeatureTransformer, HFlip, ImageFeature,
+    ImageFrame, ImageFrameToSample, Lighting, MatToTensor, Pipeline,
+    PixelBytesToMat, RandomCrop, RandomHFlip, RandomTransformer, Resize,
+    Saturation,
+)

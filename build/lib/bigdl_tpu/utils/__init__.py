@@ -1,0 +1,5 @@
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+__all__ = ["Engine", "Table", "T", "RandomGenerator"]

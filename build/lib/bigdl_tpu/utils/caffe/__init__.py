@@ -1,0 +1,4 @@
+from bigdl_tpu.utils.caffe.loader import CaffeImportError, load_caffe
+from bigdl_tpu.utils.caffe.saver import CaffeExportError, save_caffe
+
+__all__ = ["CaffeExportError", "CaffeImportError", "load_caffe", "save_caffe"]

@@ -1,0 +1,55 @@
+"""Caffe-op adapter modules (cf. utils/tf/ops.py): the few Caffe layers with no
+1:1 native equivalent. Module-level classes so imported nets serialize through
+the portable format (registered under the ``caffe.`` namespace)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+
+
+class CaffeScale(TensorModule):
+    """Per-channel affine ``y = x * gamma[c] (+ beta[c])`` — the Scale layer
+    that conventionally follows BatchNorm in Caffe nets."""
+
+    def __init__(self, gamma: np.ndarray, beta: np.ndarray | None = None):
+        super().__init__()
+        self._params = {"gamma": jnp.asarray(gamma)}
+        if beta is not None:
+            self._params["beta"] = jnp.asarray(beta)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        shape = (1, -1) + (1,) * (input.ndim - 2)
+        out = input * params["gamma"].reshape(shape)
+        if "beta" in params:
+            out = out + params["beta"].reshape(shape)
+        return out, state
+
+
+class CaffeSoftmax(TensorModule):
+    """Softmax over an explicit axis (Caffe default: 1, the channel dim of an
+    NCHW map — unlike jax.nn.softmax's last-dim default)."""
+
+    def __init__(self, axis: int = 1):
+        super().__init__()
+        self.axis = int(axis)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+        return jax.nn.softmax(input, axis=self.axis), state
+
+
+class CaffeGlobalPool(TensorModule):
+    """Caffe global pooling: whole-plane reduction → (N, C, 1, 1)."""
+
+    def __init__(self, kind: str):
+        super().__init__()
+        if kind not in ("max", "avg"):
+            raise ValueError(kind)
+        self.kind = kind
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        fn = jnp.max if self.kind == "max" else jnp.mean
+        return fn(input, axis=(-2, -1), keepdims=True), state

@@ -1,0 +1,248 @@
+"""Caffe model exporter — the CaffePersister analog.
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/utils/caffe/CaffePersister.scala``
+— unverified, mount empty): serialize a native model as a deploy ``.prototxt``
+plus binary ``.caffemodel`` so Caffe-ecosystem consumers can run it.
+
+Scope mirrors the importer's layer set (the NCHW zoo): Linear → InnerProduct,
+SpatialConvolution → Convolution, Max/Avg pooling (incl. ceil/floor round
+mode), ReLU/Dropout/Softmax, JoinTable → Concat, CAdd/CMul/CMaxTable →
+Eltwise, SpatialCrossMapLRN → LRN, SpatialBatchNormalization → BatchNorm (+
+Scale when affine), Sequential and Graph containers, plus the importer's
+adapter modules (CaffeSoftmax/CaffeScale/CaffeGlobalPool → their source
+layers; CSubTable → Eltwise SUM with coeff [1,-1]) so ``load_caffe`` →
+``save_caffe`` stays closed. Unsupported layers fail loudly. Export →
+``load_caffe`` round-trips exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class CaffeExportError(Exception):
+    pass
+
+
+def _pb2():
+    from bigdl_tpu.utils.caffe import caffe_minimal_pb2
+    return caffe_minimal_pb2
+
+
+def _fill_blob(blob, arr):
+    arr = np.asarray(arr, np.float32)
+    blob.shape.dim.extend(arr.shape)
+    blob.data.extend(arr.ravel().tolist())
+
+
+class _Exporter:
+    def __init__(self):
+        self.pb2 = _pb2()
+        self.net = self.pb2.NetParameter()
+        self.wnet = self.pb2.NetParameter()
+        self.counter = 0
+
+    def _name(self, kind):
+        self.counter += 1
+        return f"{kind}{self.counter}"
+
+    def _layer(self, kind, type_, bottoms, blobs=()):
+        name = self._name(kind)
+        l = self.net.layer.add()
+        l.name, l.type = name, type_
+        l.bottom.extend(bottoms)
+        l.top.append(name)
+        if blobs:
+            wl = self.wnet.layer.add()
+            wl.name = name
+            for arr in blobs:
+                _fill_blob(wl.blobs.add(), arr)
+        return l, name
+
+    # ------------------------------------------------------------------ emit
+    def emit(self, module, bottom: str) -> str:
+        from bigdl_tpu import nn
+
+        t = type(module).__name__
+        if isinstance(module, nn.Sequential):
+            for child in module.modules:
+                bottom = self.emit(child, bottom)
+            return bottom
+        if isinstance(module, nn.Graph):
+            return self._emit_graph(module, bottom)
+
+        params = {k: np.asarray(v) for k, v in module.get_params().items()}
+        state = {k: np.asarray(v) for k, v in module.get_state().items()}
+
+        if t == "Linear":
+            blobs = [params["weight"]]
+            if "bias" in params:
+                blobs.append(params["bias"])
+            l, name = self._layer("ip", "InnerProduct", [bottom], blobs)
+            l.inner_product_param.num_output = module.output_size
+            l.inner_product_param.bias_term = "bias" in params
+            return name
+        if t == "SpatialConvolution":
+            if module.pad_w == -1 or module.pad_h == -1:
+                raise CaffeExportError("SAME-pad conv has no Caffe form "
+                                       "(pad explicitly)")
+            blobs = [params["weight"]]
+            if "bias" in params:
+                blobs.append(params["bias"])
+            l, name = self._layer("conv", "Convolution", [bottom], blobs)
+            p = l.convolution_param
+            p.num_output = module.n_output_plane
+            p.kernel_h, p.kernel_w = module.kernel_h, module.kernel_w
+            p.stride_h, p.stride_w = module.stride_h, module.stride_w
+            p.pad_h, p.pad_w = module.pad_h, module.pad_w
+            p.group = module.n_group
+            p.bias_term = "bias" in params
+            return name
+        if t in ("SpatialMaxPooling", "SpatialAveragePooling"):
+            if getattr(module, "pad_mode", "torch") != "torch":
+                raise CaffeExportError("pad_mode='same' pooling has no Caffe form")
+            if getattr(module, "global_pooling", False) or \
+                    not getattr(module, "divide", True):
+                raise CaffeExportError("global/sum pooling export not supported")
+            if t == "SpatialAveragePooling" and (module.pad_h or module.pad_w) \
+                    and not getattr(module, "count_include_pad", True):
+                raise CaffeExportError(
+                    "padded avg pooling with count_include_pad=False has no "
+                    "Caffe form (border counts differ)")
+            l, name = self._layer("pool", "Pooling", [bottom])
+            p = l.pooling_param
+            p.pool = p.MAX if t == "SpatialMaxPooling" else p.AVE
+            p.kernel_h, p.kernel_w = module.kh, module.kw
+            p.stride_h, p.stride_w = module.dh, module.dw
+            p.pad_h, p.pad_w = module.pad_h, module.pad_w
+            p.round_mode = p.CEIL if module.ceil_mode else p.FLOOR
+            return name
+        if t == "ReLU":
+            _, name = self._layer("relu", "ReLU", [bottom])
+            return name
+        if t == "LeakyReLU":
+            l, name = self._layer("relu", "ReLU", [bottom])
+            l.relu_param.negative_slope = module.negval
+            return name
+        if t == "Dropout":
+            l, name = self._layer("drop", "Dropout", [bottom])
+            l.dropout_param.dropout_ratio = module.p
+            return name
+        if t == "SoftMax":
+            l, name = self._layer("prob", "Softmax", [bottom])
+            # native SoftMax normalizes the LAST axis; Caffe's default is the
+            # channel axis (1) — only equivalent for 2-D outputs
+            l.softmax_param.axis = -1
+            return name
+        if t == "SpatialCrossMapLRN":
+            l, name = self._layer("lrn", "LRN", [bottom])
+            p = l.lrn_param
+            p.local_size = module.size
+            p.alpha, p.beta, p.k = module.alpha, module.beta, module.k
+            return name
+        if t in ("BatchNormalization", "SpatialBatchNormalization"):
+            mean, var = state["running_mean"], state["running_var"]
+            l, name = self._layer(
+                "bn", "BatchNorm", [bottom],
+                [mean, var, np.asarray([1.0], np.float32)])
+            l.batch_norm_param.eps = module.eps
+            if "weight" in params:
+                l2, name2 = self._layer("scale", "Scale", [name],
+                                        [params["weight"], params["bias"]])
+                l2.scale_param.bias_term = True
+                return name2
+            return name
+        if t in ("Identity", "Contiguous"):
+            return bottom
+        # importer-produced adapter modules (utils/caffe/ops.py) — exact Caffe
+        # layers, so the import → export round trip stays closed
+        if t == "CaffeSoftmax":
+            l, name = self._layer("prob", "Softmax", [bottom])
+            l.softmax_param.axis = module.axis
+            return name
+        if t == "CaffeScale":
+            blobs = [params["gamma"]]
+            if "beta" in params:
+                blobs.append(params["beta"])
+            l, name = self._layer("scale", "Scale", [bottom], blobs)
+            l.scale_param.bias_term = "beta" in params
+            return name
+        if t == "CaffeGlobalPool":
+            l, name = self._layer("pool", "Pooling", [bottom])
+            p = l.pooling_param
+            p.pool = p.MAX if module.kind == "max" else p.AVE
+            p.global_pooling = True
+            return name
+
+        raise CaffeExportError(
+            f"layer {t!r} has no Caffe export rule — add one in "
+            f"bigdl_tpu/utils/caffe/saver.py")
+
+    def _emit_graph(self, g, bottom: str) -> str:
+        from bigdl_tpu import nn
+
+        values = {}
+        if len(g.input_nodes) != 1 or len(g.output_nodes) != 1:
+            raise CaffeExportError("only single-input/single-output Graph export")
+        values[g.input_nodes[0].id] = bottom
+        for node in g.sorted_nodes:
+            if node.module is None:
+                continue
+            if node.prev_nodes:
+                ins = [values[p.id] for p in node.prev_nodes]
+            elif node.id in values:
+                ins = [values[node.id]]
+            else:
+                raise CaffeExportError(f"graph node {node!r} has no inputs")
+            tname = type(node.module).__name__
+            if tname == "JoinTable":
+                if node.module.n_input_dims > 0:
+                    # the batched-axis shift needs runtime rank, which a static
+                    # prototxt cannot express — fail loudly, not wrongly
+                    raise CaffeExportError(
+                        "JoinTable with n_input_dims has no static Caffe "
+                        "axis; use an absolute dimension")
+                l, name = self._layer("concat", "Concat", ins)
+                l.concat_param.axis = node.module.dimension - 1
+                values[node.id] = name
+            elif tname in ("CAddTable", "CMulTable", "CMaxTable"):
+                l, name = self._layer("elt", "Eltwise", ins)
+                e = l.eltwise_param
+                e.operation = {"CAddTable": e.SUM, "CMulTable": e.PROD,
+                               "CMaxTable": e.MAX}[tname]
+                values[node.id] = name
+            elif tname == "CSubTable":
+                l, name = self._layer("elt", "Eltwise", ins)
+                l.eltwise_param.operation = l.eltwise_param.SUM
+                l.eltwise_param.coeff.extend([1.0, -1.0])
+                values[node.id] = name
+            else:
+                if len(ins) != 1:
+                    raise CaffeExportError(
+                        f"multi-input {tname} has no Caffe export rule")
+                values[node.id] = self.emit(node.module, ins[0])
+        return values[g.output_nodes[0].id]
+
+
+def save_caffe(module, prototxt_path: str, caffemodel_path: str,
+               input_shape) -> None:
+    """Export an inference model as deploy prototxt + caffemodel. ``input_shape``
+    is the full NCHW/feature shape including batch."""
+    from google.protobuf import text_format
+
+    was_training = module.is_training()
+    module.evaluate()
+    try:
+        ex = _Exporter()
+        ex.net.name = "bigdl_tpu_export"
+        ex.net.input.append("data")
+        shp = ex.net.input_shape.add()
+        shp.dim.extend(int(s) for s in input_shape)
+        ex.emit(module, "data")
+        with open(prototxt_path, "w") as f:
+            f.write(text_format.MessageToString(ex.net))
+        with open(caffemodel_path, "wb") as f:
+            f.write(ex.wnet.SerializeToString())
+    finally:
+        if was_training:  # exporting mid-training must not flip the mode
+            module.training()

@@ -1,0 +1,40 @@
+"""Module/object persistence.
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/utils/File.scala`` and
+``Module.save/load`` — unverified, mount empty): the reference offers Java-serialization
+``Module.save(path)``/``Module.load`` plus the versioned protobuf ``saveModule`` format.
+
+TPU-native: modules are pickle-safe (jit caches dropped, arrays → numpy on
+``__getstate__``), so ``save``/``load`` are one format; a content header versions the file.
+Writes are atomic (tmp + rename) so a killed process never leaves a torn checkpoint —
+required by the retry-from-checkpoint semantics (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+MAGIC = b"BIGDL_TPU_V1\n"
+
+
+def save(obj, path: str, overwrite: bool = True) -> None:
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(f"{path} exists (pass overwrite=True)")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(MAGIC)
+        pickle.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def load(path: str):
+    with open(path, "rb") as f:
+        head = f.read(len(MAGIC))
+        if head != MAGIC:
+            # plain pickle fallback (e.g. files written by other tools)
+            f.seek(0)
+        return pickle.load(f)

@@ -1,0 +1,51 @@
+"""LoggerFilter — tame noisy third-party logs.
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/utils/LoggerFilter.scala`` —
+unverified, mount empty): the reference redirects chatty Spark/BigDL log4j
+output to a file, keeping the console for training progress. The analog here
+quiets the noisy Python loggers (jax compilation chatter, TF import noise)
+and optionally redirects them to a file.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_NOISY = ("jax", "jax._src", "tensorflow", "absl", "orbax")
+
+
+class LoggerFilter:
+    _handlers: list[tuple[logging.Logger, logging.Handler, bool]] = []
+    _saved_levels: list[tuple[logging.Logger, int]] = []
+
+    @classmethod
+    def redirect(cls, path: str | None = None,
+                 level: int = logging.ERROR,
+                 loggers: tuple[str, ...] = _NOISY) -> None:
+        """Raise ``loggers`` to ``level`` on the console; with ``path``, send
+        their full output to a file instead of dropping it (reference
+        ``LoggerFilter.redirect`` semantics)."""
+        for name in loggers:
+            lg = logging.getLogger(name)
+            cls._saved_levels.append((lg, lg.level))
+            lg.setLevel(level if path is None else logging.DEBUG)
+            if path is not None:
+                h = logging.FileHandler(path)
+                h.setLevel(logging.DEBUG)
+                lg.addHandler(h)
+                cls._handlers.append((lg, h, lg.propagate))
+                lg.propagate = False
+
+    disable = redirect  # reference alias (``LoggerFilter.disable``)
+
+    @classmethod
+    def restore(cls) -> None:
+        for lg, h, was_propagating in cls._handlers:
+            lg.removeHandler(h)
+            h.close()
+            lg.propagate = was_propagating
+        cls._handlers.clear()
+        # reversed: nested redirects must unwind to the ORIGINAL levels
+        for lg, lvl in reversed(cls._saved_levels):
+            lg.setLevel(lvl)
+        cls._saved_levels.clear()

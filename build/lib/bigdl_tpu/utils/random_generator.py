@@ -1,0 +1,86 @@
+"""Deterministic global RNG — analog of the reference's Torch-compatible ``RandomGenerator``.
+
+Reference parity (SURVEY.md §2.1, expected ``<dl>/utils/RandomGenerator.scala`` — unverified):
+the reference seeds a global Mersenne-twister RNG used by weight init and dropout.
+
+TPU-native split (SURVEY.md §7.4 "RNG parity"):
+- **Weight initialisation** happens eagerly on host at module construction (Torch semantics),
+  so it uses a numpy ``Generator`` seeded from the global seed — deterministic and
+  reproducible, independent of device count.
+- **Traced randomness** (dropout masks inside ``jit``) must use the JAX counter-based PRNG;
+  ``next_key()`` hands out fresh ``jax.random`` keys derived from the same seed via a
+  monotonically increasing fold-in counter (never reused, safe across replicas when further
+  folded with the shard index).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class RandomGenerator:
+    _lock = threading.Lock()
+    _seed: int = 1
+    _np: np.random.Generator = np.random.default_rng(1)
+    _key_counter: int = 0
+    _salt_counter: int = 0
+    _base_key = None  # lazily-built jax PRNGKey for the current seed
+
+    @classmethod
+    def set_seed(cls, seed: int) -> None:
+        with cls._lock:
+            cls._seed = int(seed)
+            cls._np = np.random.default_rng(cls._seed)
+            cls._key_counter = 0
+            cls._salt_counter = 0
+            cls._base_key = None
+
+    @classmethod
+    def get_seed(cls) -> int:
+        return cls._seed
+
+    @classmethod
+    def numpy(cls) -> np.random.Generator:
+        """Host RNG for eager weight init."""
+        return cls._np
+
+    # Torch-style sampling helpers used by InitializationMethod ------------
+    @classmethod
+    def uniform(cls, low: float, high: float, shape) -> np.ndarray:
+        with cls._lock:
+            return cls._np.uniform(low, high, size=shape).astype(np.float32)
+
+    @classmethod
+    def normal(cls, mean: float, std: float, shape) -> np.ndarray:
+        with cls._lock:
+            return cls._np.normal(mean, std, size=shape).astype(np.float32)
+
+    @classmethod
+    def bernoulli(cls, p: float, shape) -> np.ndarray:
+        with cls._lock:
+            return (cls._np.random(shape) < p).astype(np.float32)
+
+    @classmethod
+    def next_salt(cls) -> int:
+        """Monotonic per-construction salt (host-side decorrelation, e.g. vision
+        transformers sharing the Engine seed). Resets with ``set_seed`` so an
+        identically-seeded, identically-ordered pipeline reproduces exactly."""
+        with cls._lock:
+            cls._salt_counter += 1
+            return cls._salt_counter
+
+    # JAX keys for traced randomness ---------------------------------------
+    @classmethod
+    def next_key(cls):
+        """A fresh, never-reused jax PRNG key derived from the global seed."""
+        import jax
+
+        with cls._lock:
+            c = cls._key_counter
+            cls._key_counter += 1
+            if cls._base_key is None:
+                cls._base_key = jax.random.PRNGKey(cls._seed)
+            base = cls._base_key
+        return jax.random.fold_in(base, c)

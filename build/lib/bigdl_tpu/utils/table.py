@@ -1,0 +1,110 @@
+"""Torch-style ``Table`` activity — heterogeneous int-keyed container.
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/utils/Table.scala`` — unverified): the
+reference uses ``Table`` (built with ``T(...)``) as the multi-input/multi-output ``Activity``
+flowing between layers (e.g. ``ConcatTable`` outputs, ``JoinTable`` inputs, LSTM (h, c) state).
+
+TPU-native design: a Table must be a JAX **pytree** so whole activities trace through ``jit``
+and ``grad`` — so it registers with ``jax.tree_util``. Keys are 1-based ints (Torch/Lua
+heritage) or strings; iteration order is sorted-int-first for determinism.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+import jax
+
+
+class Table:
+    """1-based int-keyed (plus string-keyed) container registered as a JAX pytree."""
+
+    def __init__(self, *elements: Any, **named: Any) -> None:
+        self._dict: dict[Any, Any] = {}
+        for i, e in enumerate(elements):
+            self._dict[i + 1] = e
+        self._dict.update(named)
+
+    # -------------------------------------------------------------- mapping
+    def __getitem__(self, key: Any) -> Any:
+        return self._dict[key]
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self._dict[key] = value
+
+    def __contains__(self, key: Any) -> bool:
+        return key in self._dict
+
+    def __len__(self) -> int:
+        return len(self._dict)
+
+    def __iter__(self) -> Iterator[Any]:
+        return iter(self.values())
+
+    def keys(self):
+        ints = sorted(k for k in self._dict if isinstance(k, int))
+        others = sorted((k for k in self._dict if not isinstance(k, int)),
+                        key=lambda k: (type(k).__name__, repr(k)))
+        return ints + others
+
+    def values(self):
+        return [self._dict[k] for k in self.keys()]
+
+    def items(self):
+        return [(k, self._dict[k]) for k in self.keys()]
+
+    def insert(self, value: Any) -> "Table":
+        """Append at the next free 1-based int index (Torch ``table.insert``)."""
+        i = 1
+        while i in self._dict:
+            i += 1
+        self._dict[i] = value
+        return self
+
+    def to_list(self) -> list:
+        return self.values()
+
+    def to_tuple(self) -> tuple:
+        return tuple(self.values())
+
+    # --------------------------------------------------------------- dunder
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}: {v!r}" for k, v in self.items())
+        return f"T({{{inner}}})"
+
+    def __eq__(self, other: Any) -> bool:
+        if not isinstance(other, Table):
+            return NotImplemented
+        if self.keys() != other.keys():
+            return False
+        import numpy as np
+        for k in self.keys():
+            a, b = self[k], other[k]
+            if isinstance(a, Table) or isinstance(b, Table):
+                if a != b:
+                    return False
+            elif not np.array_equal(np.asarray(a), np.asarray(b)):
+                return False
+        return True
+
+    __hash__ = None  # mutable
+
+
+def T(*elements: Any, **named: Any) -> Table:
+    """Builder mirroring the reference's ``T()`` helper."""
+    return Table(*elements, **named)
+
+
+def _table_flatten(t: Table):
+    keys = t.keys()
+    return [t._dict[k] for k in keys], tuple(keys)
+
+
+def _table_unflatten(keys, children) -> Table:
+    t = Table()
+    for k, c in zip(keys, children):
+        t._dict[k] = c
+    return t
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)

@@ -1,0 +1,4 @@
+from bigdl_tpu.utils.tf.loader import TFImportError, load_frozen_graph
+from bigdl_tpu.utils.tf.saver import TFExportError, save_tf
+
+__all__ = ["TFExportError", "TFImportError", "load_frozen_graph", "save_tf"]

@@ -1,0 +1,218 @@
+"""TF-op adapter modules for the frozen-graph importer.
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/utils/tf/loaders/*`` — ~100
+per-op loader files upstream, unverified): each supported TF op becomes one
+small AbstractModule so an imported network is a plain ``nn.Graph`` — trainable,
+serializable, quantizable like any native model.
+
+TPU-native: ops execute in TF's own NHWC layout (TPU/XLA is layout-agnostic —
+the compiler picks physical layouts, so there is no reason to rewrite the graph
+into NCHW and pay permanent transposes the way a cuDNN port would). Imported
+weights live in ``_params`` so fine-tuning works.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, TensorModule
+from bigdl_tpu.utils.table import Table
+
+
+class TFConv2D(TensorModule):
+    """NHWC Conv2D; weights HWIO (TF layout, kept as-is)."""
+
+    def __init__(self, weight: np.ndarray, strides: Sequence[int],
+                 padding: str, dilations: Sequence[int] = (1, 1)):
+        super().__init__()
+        self.strides = tuple(strides)
+        self.padding = padding
+        self.dilations = tuple(dilations)
+        self._params = {"weight": jnp.asarray(weight)}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = lax.conv_general_dilated(
+            input, params["weight"],
+            window_strides=self.strides,
+            padding=self.padding,
+            rhs_dilation=self.dilations,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return out, state
+
+
+class TFDepthwiseConv2D(TensorModule):
+    """NHWC DepthwiseConv2dNative; TF weight (H, W, C, M) → grouped conv."""
+
+    def __init__(self, weight: np.ndarray, strides: Sequence[int], padding: str,
+                 dilations: Sequence[int] = (1, 1)):
+        super().__init__()
+        self.strides = tuple(strides)
+        self.padding = padding
+        self.dilations = tuple(dilations)
+        h, w, c, m = weight.shape
+        self.channels = c
+        # grouped-conv weight: (H, W, 1, C*M) with feature_group_count=C
+        self._params = {"weight": jnp.asarray(weight.reshape(h, w, 1, c * m))}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = lax.conv_general_dilated(
+            input, params["weight"],
+            window_strides=self.strides,
+            padding=self.padding,
+            rhs_dilation=self.dilations,
+            feature_group_count=self.channels,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return out, state
+
+
+class TFBiasAdd(TensorModule):
+    def __init__(self, bias: np.ndarray):
+        super().__init__()
+        self._params = {"bias": jnp.asarray(bias)}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input + params["bias"], state
+
+
+class TFBatchNorm(TensorModule):
+    """FusedBatchNorm(V3) in inference form: folded scale/offset/mean/var."""
+
+    def __init__(self, scale, offset, mean, variance, epsilon: float = 1e-3):
+        super().__init__()
+        self.epsilon = epsilon
+        self._params = {"scale": jnp.asarray(scale), "offset": jnp.asarray(offset)}
+        self._state = {"mean": jnp.asarray(mean), "variance": jnp.asarray(variance)}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        inv = params["scale"] * lax.rsqrt(state["variance"] + self.epsilon)
+        return input * inv + (params["offset"] - state["mean"] * inv), state
+
+
+class TFPool(TensorModule):
+    def __init__(self, kind: str, ksize: Sequence[int], strides: Sequence[int],
+                 padding: str):
+        super().__init__()
+        if kind not in ("max", "avg"):
+            raise ValueError(kind)
+        self.kind = kind
+        self.ksize = tuple(ksize)       # (kh, kw)
+        self.strides = tuple(strides)   # (sh, sw)
+        self.padding = padding
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        window = (1, *self.ksize, 1)
+        strides = (1, *self.strides, 1)
+        if self.kind == "max":
+            out = lax.reduce_window(input, -jnp.inf, lax.max, window, strides,
+                                    self.padding)
+        else:
+            summed = lax.reduce_window(input, 0.0, lax.add, window, strides,
+                                       self.padding)
+            counts = lax.reduce_window(jnp.ones_like(input), 0.0, lax.add,
+                                       window, strides, self.padding)
+            out = summed / counts
+        return out, state
+
+
+class TFMatMul(TensorModule):
+    def __init__(self, weight: np.ndarray, transpose_b: bool = False):
+        super().__init__()
+        self._params = {"weight": jnp.asarray(
+            weight.T if transpose_b else weight)}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input @ params["weight"], state
+
+
+class TFReshape(TensorModule):
+    def __init__(self, shape: Sequence[int]):
+        super().__init__()
+        self.shape = tuple(int(s) for s in shape)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.reshape(input, self.shape), state
+
+
+class TFMean(TensorModule):
+    def __init__(self, axes: Sequence[int], keepdims: bool = False):
+        super().__init__()
+        self.axes = tuple(int(a) for a in axes)
+        self.keepdims = keepdims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.mean(input, axis=self.axes, keepdims=self.keepdims), state
+
+
+class TFPad(TensorModule):
+    def __init__(self, paddings: np.ndarray):
+        super().__init__()
+        self.paddings = [(int(a), int(b)) for a, b in np.asarray(paddings)]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.pad(input, self.paddings), state
+
+
+class TFTranspose(TensorModule):
+    def __init__(self, perm: Sequence[int]):
+        super().__init__()
+        self.perm = tuple(int(p) for p in perm)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.transpose(input, self.perm), state
+
+
+class TFExpandDims(TensorModule):
+    def __init__(self, axis: int):
+        super().__init__()
+        self.axis = int(axis)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.expand_dims(input, self.axis), state
+
+
+class TFSqueeze(TensorModule):
+    def __init__(self, axes: Sequence[int] = ()):
+        super().__init__()
+        self.axes = tuple(int(a) for a in axes) or None
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.squeeze(input, axis=self.axes), state
+
+
+class TFConcat(AbstractModule):
+    def __init__(self, axis: int):
+        super().__init__()
+        self.axis = int(axis)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        return jnp.concatenate(xs, axis=self.axis), state
+
+
+class TFBinaryOp(AbstractModule):
+    """Add/Sub/Mul over two graph inputs (Table) — or one input and a captured
+    constant."""
+
+    _FNS = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply}
+
+    def __init__(self, op: str, const=None, const_on_left: bool = False):
+        super().__init__()
+        if op not in self._FNS:
+            raise ValueError(op)
+        self.op = op
+        self.const_on_left = const_on_left
+        if const is not None:
+            self._state = {"const": jnp.asarray(const)}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        fn = self._FNS[self.op]
+        if "const" in state:
+            c = state["const"]
+            out = fn(c, input) if self.const_on_left else fn(input, c)
+            return out, state
+        xs = input.values() if isinstance(input, Table) else list(input)
+        return fn(xs[0], xs[1]), state

@@ -1,0 +1,197 @@
+"""TF frozen-graph exporter — the ``saveTF`` analog.
+
+Reference parity (SURVEY.md §2.5, expected ``<dl>/utils/tf/TensorflowSaver.scala``
+— unverified, mount empty): serialize a native model as a frozen TensorFlow
+GraphDef so TF-serving-style consumers can run it.
+
+Scope: the inference layer set of the vision/classifier zoo — Linear,
+SpatialConvolution (zero/explicit padding), Max/Avg pooling (floor mode),
+ReLU/Tanh/Sigmoid/SoftMax/LogSoftMax, BatchNormalization (folded eval form),
+Reshape/Flatten/View, Dropout (identity at inference), Sequential and Graph
+containers. Spatial ops emit in NHWC with boundary transposes (TF CPU kernels
+are NHWC-only); weights embed as Const nodes. Unsupported layers fail loudly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class TFExportError(Exception):
+    pass
+
+
+def _require_tf():
+    try:
+        import tensorflow as tf
+        return tf
+    except ImportError as e:  # pragma: no cover
+        raise TFExportError("tensorflow is required for save_tf") from e
+
+
+def _emit(module, x, tf):
+    """Return the TF tensor computing ``module`` on NCHW-convention input x."""
+    from bigdl_tpu import nn
+
+    t = type(module).__name__
+
+    if isinstance(module, nn.Sequential):
+        for child in module.modules:
+            x = _emit(child, x, tf)
+        return x
+    if isinstance(module, nn.Graph):
+        return _emit_graph(module, x, tf)
+
+    params = {k: np.asarray(v) for k, v in module.get_params().items()}
+    state = {k: np.asarray(v) for k, v in module.get_state().items()}
+
+    if t == "Linear":
+        if x.shape.rank and x.shape.rank > 2:
+            x = tf.reshape(x, [x.shape[0] or -1,
+                               int(np.prod(x.shape.as_list()[1:]))])
+        y = tf.matmul(x, tf.constant(params["weight"].T))
+        if "bias" in params:
+            y = tf.nn.bias_add(y, tf.constant(params["bias"]))
+        return y
+    if t == "SpatialConvolution":
+        if module.n_group != 1:
+            raise TFExportError("grouped conv export not supported")
+        w = tf.constant(params["weight"].transpose(2, 3, 1, 0))  # OIHW→HWIO
+        y = tf.transpose(x, [0, 2, 3, 1])
+        if module.pad_w == -1 or module.pad_h == -1:
+            pad = "SAME"
+        else:
+            if module.pad_h or module.pad_w:
+                y = tf.pad(y, [[0, 0], [module.pad_h, module.pad_h],
+                               [module.pad_w, module.pad_w], [0, 0]])
+            pad = "VALID"
+        y = tf.nn.conv2d(y, w, strides=[1, module.stride_h, module.stride_w, 1],
+                         padding=pad)
+        if "bias" in params:
+            y = tf.nn.bias_add(y, tf.constant(params["bias"]))
+        return tf.transpose(y, [0, 3, 1, 2])
+    if t in ("SpatialMaxPooling", "SpatialAveragePooling"):
+        # non-default semantics must fail loudly, not export something else
+        if getattr(module, "ceil_mode", False):
+            raise TFExportError("ceil-mode pooling has no TF frozen-graph form")
+        if getattr(module, "pad_mode", "torch") != "torch":
+            raise TFExportError("pad_mode='same' pooling export not supported")
+        if getattr(module, "global_pooling", False):
+            raise TFExportError("global_pooling export not supported")
+        if t == "SpatialAveragePooling" and not getattr(module, "divide", True):
+            raise TFExportError("sum pooling (divide=False) export not supported")
+        y = tf.transpose(x, [0, 2, 3, 1])
+        if module.pad_h or module.pad_w:
+            if t == "SpatialMaxPooling":
+                y = tf.pad(y, [[0, 0], [module.pad_h, module.pad_h],
+                               [module.pad_w, module.pad_w], [0, 0]],
+                           constant_values=-np.inf)
+            else:
+                raise TFExportError(
+                    "padded average pooling export not supported "
+                    "(count semantics differ)")
+        fn = tf.nn.max_pool2d if t == "SpatialMaxPooling" else tf.nn.avg_pool2d
+        y = fn(y, ksize=[1, module.kh, module.kw, 1],
+               strides=[1, module.dh, module.dw, 1], padding="VALID")
+        return tf.transpose(y, [0, 3, 1, 2])
+    if t in ("BatchNormalization", "SpatialBatchNormalization"):
+        mean, var = state["running_mean"], state["running_var"]
+        gamma = params.get("weight", np.ones_like(mean))
+        beta = params.get("bias", np.zeros_like(mean))
+        inv = gamma / np.sqrt(var + module.eps)
+        shape = [1, -1] + [1] * (x.shape.rank - 2)
+        return (x * tf.constant(inv.reshape(shape).astype(np.float32))
+                + tf.constant((beta - mean * inv).reshape(shape)
+                              .astype(np.float32)))
+    if t == "ReLU":
+        return tf.nn.relu(x)
+    if t == "ReLU6":
+        return tf.nn.relu6(x)
+    if t == "Tanh":
+        return tf.tanh(x)
+    if t == "Sigmoid":
+        return tf.sigmoid(x)
+    if t == "SoftMax":
+        return tf.nn.softmax(x)
+    if t == "LogSoftMax":
+        return tf.nn.log_softmax(x)
+    if t in ("Dropout", "Identity", "Contiguous", "GaussianDropout",
+             "GaussianNoise"):
+        return x  # inference no-ops
+    if t == "Flatten":
+        return tf.reshape(x, [x.shape[0] or -1,
+                              int(np.prod(x.shape.as_list()[1:]))])
+    if t in ("Reshape", "View"):
+        size = list(module.size)
+        # mirror the native batch-mode rule (shape_ops.py): keep the batch dim
+        # only when batch_mode is on (or auto-detected via element counts)
+        n_rest = int(np.prod(x.shape.as_list()[1:]))
+        bm = module.batch_mode
+        if bm is None:  # native auto-detect (shape_ops.py): ndim>=2 and
+            # non-batch element count matches the target
+            bm = x.shape.rank >= 2 and n_rest == int(np.prod(size))
+        if bm:
+            return tf.reshape(x, [x.shape[0] or -1] + size)
+        return tf.reshape(x, size)
+
+    raise TFExportError(
+        f"layer {t!r} has no TF export rule — add one in "
+        f"bigdl_tpu/utils/tf/saver.py")
+
+
+def _emit_graph(g, x, tf):
+    values = {}
+    if len(g.input_nodes) != 1:
+        raise TFExportError("multi-input Graph export not supported")
+    values[g.input_nodes[0].id] = x
+    for node in g.sorted_nodes:
+        if node.module is None:
+            continue
+        if node.prev_nodes:
+            ins = [values[p.id] for p in node.prev_nodes]
+        elif node.id in values:
+            # module node used directly as the graph input (graph.py supports
+            # `layer.inputs()` with no predecessors)
+            ins = [values[node.id]]
+        else:
+            raise TFExportError(f"graph node {node!r} has no inputs")
+        inp = ins[0] if len(ins) == 1 else ins
+        tname = type(node.module).__name__
+        if tname == "CAddTable":
+            values[node.id] = tf.add_n(inp)
+        elif tname == "JoinTable":
+            m = node.module
+            axis = m.dimension - 1
+            if m.n_input_dims > 0 and ins[0].shape.rank == m.n_input_dims + 1:
+                axis += 1  # native batched-input shift (containers.py)
+            values[node.id] = tf.concat(inp, axis=axis)
+        else:
+            values[node.id] = _emit(node.module, inp, tf)
+    if len(g.output_nodes) != 1:
+        raise TFExportError("multi-output Graph export not supported")
+    return values[g.output_nodes[0].id]
+
+
+def save_tf(module, path: str, input_shape, input_name: str = "input",
+            output_name: str = "output") -> None:
+    """Export an inference model as a frozen GraphDef protobuf.
+
+    ``input_shape``: full NCHW/feature shape including batch (use None for a
+    dynamic batch dim).
+    """
+    tf = _require_tf()
+    was_training = module.is_training()
+    module.evaluate()
+    try:
+        graph = tf.Graph()
+        with graph.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, input_shape,
+                                         name=input_name)
+            y = _emit(module, x, tf)
+            tf.identity(y, name=output_name)
+        gd = graph.as_graph_def()
+        with open(path, "wb") as f:
+            f.write(gd.SerializeToString())
+    finally:
+        if was_training:  # exporting mid-training must not flip the mode
+            module.training()

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Launcher wrapper — the reference's scripts/bigdl.sh analog (SURVEY.md §2.5):
+# source the env-flag tier, then exec the CLI. Usage:
+#   scripts/bigdl-tpu.sh [--conf path/to/bigdl-tpu.conf] <subcommand> [args...]
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+CONF="${REPO_ROOT}/conf/bigdl-tpu.conf"
+if [[ "${1:-}" == "--conf" ]]; then
+  CONF="$2"; shift 2
+fi
+if [[ -f "$CONF" ]]; then
+  # export uncommented KEY=VALUE lines
+  set -a
+  # shellcheck disable=SC1090
+  source <(grep -E '^[A-Z_]+=' "$CONF" || true)
+  set +a
+fi
+exec python -m bigdl_tpu.cli "$@"

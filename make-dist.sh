@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Build a distributable artifact set — the reference's make-dist.sh analog
+# (SURVEY.md §2.5 Build system): dist/ gets the wheel plus the launcher,
+# conf reference, and docs, zipped as bigdl-tpu-dist.zip.
+set -euo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")"
+
+rm -rf dist build
+mkdir -p dist
+pip wheel . --no-deps --no-build-isolation -w dist >/dev/null
+cp -r conf scripts docs dist/
+( cd dist && zip -qr bigdl-tpu-dist.zip . ) 2>/dev/null \
+  || tar -czf dist/bigdl-tpu-dist.tar.gz -C dist \
+       $(cd dist && ls | grep -v 'bigdl-tpu-dist')
+echo "dist/ contents:"
+ls dist

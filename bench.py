@@ -2,7 +2,9 @@
 installed wheel's ``bigdl-tpu bench`` works without a checkout. This file keeps
 the contract entry point ``python bench.py`` at the repo root."""
 
+import sys
+
 from bigdl_tpu.benchmark import main
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -283,6 +283,11 @@ def run_worker(args) -> None:
 
 
 def _spawn(argv, env, timeout):
+    # the child must import bigdl_tpu even when the package isn't installed and
+    # cwd is elsewhere: prepend the parent's package root to PYTHONPATH
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(env)
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
     try:
         p = subprocess.run([sys.executable, "-m", "bigdl_tpu.benchmark"] + argv,
                            capture_output=True, text=True, timeout=timeout,
@@ -366,7 +371,7 @@ def run_orchestrator(args) -> None:
     }))
 
 
-def main():
+def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50", choices=["resnet50", "lenet"])
     p.add_argument("--batch", type=int, default=128)
@@ -383,7 +388,7 @@ def main():
                    help="inference micro-bench: bf16 vs int8-quantized forward")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
-    args = p.parse_args()
+    args = p.parse_args(argv)
     if args.int8_infer:
         res = _measure_int8_infer(args.model, args.batch, max(args.iters, 10))
         res["metric"] = f"{args.model}_int8_vs_bf16_infer"
@@ -392,8 +397,8 @@ def main():
         run_worker(args)
     else:
         run_orchestrator(args)
-    sys.exit(0)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -64,8 +64,7 @@ def main(argv=None) -> int:
         return _run_module(mod, args.rest)
     if args.command == "bench":
         from bigdl_tpu import benchmark
-        benchmark.main()
-        return 0
+        return benchmark.main([])
     if args.command == "dryrun-multichip":
         import os
         # virtual CPU mesh: override any preset accelerator platform — this

@@ -1,0 +1,3 @@
+"""End-to-end example mains (reference parity: ``<dl>/example/`` — SURVEY.md §2.5
+Examples). Each example is a self-contained ``main(argv)`` runnable offline on
+synthetic data; pass your own data paths for real runs."""

@@ -210,6 +210,16 @@ class AbstractModule(metaclass=RecordsInit):
         self._training = False
         return Predictor(self).predict(data, batch_size)
 
+    def predict_image(self, image_frame, batch_size=None):
+        """Run the vision-transformed ``ImageFrame`` through the model and
+        return stacked outputs (reference ``model.predictImage(imageFrame)``)."""
+        from bigdl_tpu.optim.evaluator import Predictor
+        self._training = False
+        samples = image_frame.to_samples()
+        if batch_size is None:
+            batch_size = min(len(samples), 32) or 1
+        return Predictor(self).predict(samples, batch_size)
+
     def predict_class(self, data, batch_size=None):
         """Argmax class index per sample (reference ``model.predictClass``; 0-based
         here — this framework uses 0-based labels throughout, unlike the 1-based

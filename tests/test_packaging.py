@@ -119,3 +119,42 @@ class TestPackagedContract:
         assert bench.main is bigdl_tpu.benchmark.main
         assert __graft_entry__.dryrun_multichip is bigdl_tpu.dryrun.dryrun_multichip
         assert __graft_entry__.entry is bigdl_tpu.dryrun.entry
+
+
+class TestCliBench:
+    def test_bench_subcommand_parses(self, monkeypatch):
+        """`bigdl-tpu bench` must not re-parse sys.argv (review fix)."""
+        import bigdl_tpu.benchmark as bm
+        from bigdl_tpu.cli import main
+        called = {}
+        monkeypatch.setattr(bm, "run_orchestrator",
+                            lambda args: called.setdefault("model", args.model))
+        monkeypatch.setattr("sys.argv", ["bigdl-tpu", "bench"])
+        assert main(["bench"]) == 0
+        assert called["model"] == "resnet50"
+
+    def test_worker_spawn_sets_pythonpath(self):
+        """Spawned workers must import bigdl_tpu from any cwd (review fix)."""
+        import json
+        import subprocess
+        import sys
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env["JAX_PLATFORMS"] = "cpu"
+        # ORCHESTRATOR mode so the `-m bigdl_tpu.benchmark` worker is actually
+        # spawned: parent finds bigdl_tpu via sys.path[0] (the script dir); the
+        # worker subprocess must get it from _spawn's PYTHONPATH propagation
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py"),
+             "--model", "lenet", "--batch", "16", "--iters", "2",
+             "--warmup", "1", "--dtype", "fp32", "--no-compare-dtypes",
+             "--timeout", "500"],
+            cwd="/tmp", capture_output=True, text=True, timeout=600, env=env)
+        assert r.returncode == 0, r.stderr[-1500:]
+        line = json.loads(r.stdout.strip().splitlines()[-1])
+        assert line["value"] is not None
+
+    def test_no_build_artifacts_tracked(self):
+        r = subprocess.run(["git", "ls-files", "build", "dist",
+                            "bigdl_tpu.egg-info"],
+                           cwd=ROOT, capture_output=True, text=True)
+        assert r.stdout.strip() == "", "generated artifacts tracked in git"

@@ -40,6 +40,11 @@ def _run_module(modname: str, argv) -> int:
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    # bench forwards option-style args; argparse REMAINDER cannot capture a
+    # leading option (py3.12), so hand the tail to the benchmark CLI directly
+    if argv[:1] == ["bench"]:
+        from bigdl_tpu import benchmark
+        return benchmark.main(argv[1:])
     p = argparse.ArgumentParser(
         prog="bigdl-tpu",
         description="TPU-native BigDL: train models, benchmark, validate "
@@ -51,7 +56,8 @@ def main(argv=None) -> int:
     train.add_argument("rest", nargs=argparse.REMAINDER,
                        help="arguments forwarded to the model's own CLI")
 
-    sub.add_parser("bench", help="single-chip ResNet-50 benchmark (bench.py)")
+    sub.add_parser("bench", help="single-chip ResNet-50 benchmark "
+                                  "(all bench.py options forwarded)")
     dry = sub.add_parser("dryrun-multichip",
                          help="compile+run one sharded step on an n-device mesh")
     dry.add_argument("-n", "--n-devices", type=int, default=8)
@@ -62,9 +68,6 @@ def main(argv=None) -> int:
     if args.command == "train":
         mod, _ = _TRAIN_MAINS[args.model]
         return _run_module(mod, args.rest)
-    if args.command == "bench":
-        from bigdl_tpu import benchmark
-        return benchmark.main([])
     if args.command == "dryrun-multichip":
         import os
         # virtual CPU mesh: override any preset accelerator platform — this

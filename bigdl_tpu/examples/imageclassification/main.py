@@ -45,6 +45,10 @@ def _synthetic_frame(n: int, size: int):
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.folder is not None and args.model is None:
+        raise SystemExit(
+            "--folder requires --model: the offline fallback trains on "
+            "synthetic two-class blobs, which says nothing about your data")
 
     from bigdl_tpu import nn
     from bigdl_tpu.transform.vision.image import (

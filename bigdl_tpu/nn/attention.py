@@ -3,7 +3,8 @@
 No reference counterpart (SURVEY.md §5.7: the reference predates attention layers);
 required capability of the TPU build. ``MultiHeadAttention`` projects with fused QKV,
 runs :func:`~bigdl_tpu.parallel.ring_attention` when the Engine mesh has a ``seq``
-axis (sequence sharded, K/V rotating over ICI) and plain fused attention otherwise —
+axis (sequence sharded, K/V rotating over ICI) and the single-chip Pallas flash
+kernel (kernels/flash_attention.py; plain fused attention off-TPU) otherwise —
 the same module scales from one chip to a sequence-parallel mesh unchanged.
 """
 
@@ -20,8 +21,9 @@ from bigdl_tpu.nn.initialization import InitializationMethod, Xavier
 class MultiHeadAttention(TensorModule):
     """Self-attention over (batch, seq, embed) inputs.
 
-    ``attention_impl``: "auto" (ring iff the mesh has a >1 ``seq`` axis),
-    "ring", or "full".
+    ``attention_impl``: "auto" (ring iff the mesh has a ``seq`` axis, else the
+    single-chip flash kernel with off-TPU fallback), "ring", "flash", or
+    "full" (plain fused attention, the numerical oracle).
     """
 
     def __init__(self, embed_dim: int, num_heads: int, causal: bool = False,
@@ -30,8 +32,8 @@ class MultiHeadAttention(TensorModule):
         super().__init__()
         if embed_dim % num_heads != 0:
             raise ValueError(f"embed_dim {embed_dim} % num_heads {num_heads} != 0")
-        if attention_impl not in ("auto", "ring", "full"):
-            raise ValueError(f"attention_impl must be auto|ring|full, "
+        if attention_impl not in ("auto", "ring", "full", "flash"):
+            raise ValueError(f"attention_impl must be auto|ring|full|flash, "
                              f"got {attention_impl!r}")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
@@ -59,6 +61,9 @@ class MultiHeadAttention(TensorModule):
         from bigdl_tpu.parallel.ring_attention import full_attention, ring_attention
         if self.attention_impl == "full":
             return full_attention(q, k, v, causal=self.causal)
+        if self.attention_impl == "flash":
+            from bigdl_tpu.kernels.flash_attention import flash_attention
+            return flash_attention(q, k, v, self.causal)
         from bigdl_tpu.utils.engine import Engine
         mesh = Engine.mesh() if Engine.is_initialized() else None
         if mesh is None or Engine.SEQ_AXIS not in mesh.axis_names:
@@ -66,7 +71,10 @@ class MultiHeadAttention(TensorModule):
                 raise RuntimeError(
                     "attention_impl='ring' needs an Engine mesh with a "
                     f"'{Engine.SEQ_AXIS}' axis")
-            return full_attention(q, k, v, causal=self.causal)
+            # single chip: the flash kernel engages on TPU and degrades to the
+            # plain fused attention elsewhere (kernels/flash_attention.py)
+            from bigdl_tpu.kernels.flash_attention import flash_attention
+            return flash_attention(q, k, v, self.causal)
         return ring_attention(q, k, v, mesh=mesh, seq_axis=Engine.SEQ_AXIS,
                               causal=self.causal)
 

@@ -41,3 +41,12 @@ class TestUdfPredictor:
         from bigdl_tpu.examples.udfpredictor.main import main
         acc = main(["--max-epoch", "4"])
         assert acc > 0.8
+
+
+class TestImageClassificationGuards:
+    def test_folder_without_model_rejected(self):
+        import pytest
+
+        from bigdl_tpu.examples.imageclassification.main import main
+        with pytest.raises(SystemExit, match="--folder requires --model"):
+            main(["--folder", "/tmp/nonexistent"])

@@ -158,3 +158,15 @@ class TestCliBench:
                             "bigdl_tpu.egg-info"],
                            cwd=ROOT, capture_output=True, text=True)
         assert r.stdout.strip() == "", "generated artifacts tracked in git"
+
+
+class TestCliBenchArgs:
+    def test_bench_forwards_args(self, monkeypatch):
+        import bigdl_tpu.benchmark as bm
+        from bigdl_tpu.cli import main
+        seen = {}
+        monkeypatch.setattr(bm, "run_orchestrator",
+                            lambda args: seen.update(model=args.model,
+                                                     iters=args.iters))
+        assert main(["bench", "--model", "lenet", "--iters", "5"]) == 0
+        assert seen == {"model": "lenet", "iters": 5}

@@ -99,3 +99,88 @@ class TestLayerNormModule:
                .set_end_when(Trigger.max_iteration(6)))
         opt.optimize()
         assert np.isfinite(opt.state["loss"])
+
+
+class TestFlashAttention:
+    """Interpret-mode validation of the flash kernel against plain attention."""
+
+    def _qkv(self, b=2, h=2, t=32, d=16, seed=0):
+        rng = np.random.default_rng(seed)
+        mk = lambda s: jnp.asarray(
+            rng.normal(size=(b, h, t, d)).astype(np.float32) * s)
+        return mk(1.0), mk(1.0), mk(1.0)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        from bigdl_tpu.kernels.flash_attention import (
+            _reference_attention, flash_attention,
+        )
+        q, k, v = self._qkv()
+        out = flash_attention(q, k, v, causal, True)   # pallas interpret
+        ref = _reference_attention(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_matches_full_attention_module_path(self):
+        from bigdl_tpu.kernels.flash_attention import flash_attention
+        from bigdl_tpu.parallel.ring_attention import full_attention
+        q, k, v = self._qkv(t=64, d=8, seed=3)
+        out = flash_attention(q, k, v, True, True)
+        ref = full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_large_scores_stable(self):
+        """Streaming max must keep exp() in range for large logits."""
+        from bigdl_tpu.kernels.flash_attention import (
+            _reference_attention, flash_attention,
+        )
+        q, k, v = self._qkv(seed=1)
+        q = q * 30.0
+        out = flash_attention(q, k, v, False, True)
+        ref = _reference_attention(q, k, v, False)
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gradients_match_reference(self):
+        from bigdl_tpu.kernels.flash_attention import (
+            _reference_attention, flash_attention,
+        )
+        q, k, v = self._qkv(t=16, d=8, seed=2)
+
+        g1 = jax.grad(lambda a, b, c: jnp.sum(
+            jnp.square(flash_attention(a, b, c, True, True))),
+            argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda a, b, c: jnp.sum(
+            jnp.square(_reference_attention(a, b, c, True))),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_mha_flash_impl(self):
+        from bigdl_tpu import nn
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        RandomGenerator.set_seed(0)
+        m1 = nn.MultiHeadAttention(16, 2, causal=True, attention_impl="flash")
+        m2 = nn.MultiHeadAttention(16, 2, causal=True, attention_impl="full")
+        m2.set_params(m1.get_params())
+        x = jnp.asarray(np.random.default_rng(0)
+                        .normal(size=(2, 32, 16)).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(m1.evaluate().forward(x)),
+                                   np.asarray(m2.evaluate().forward(x)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_odd_length_falls_back(self):
+        """Non-power-of-two T can't tile; must silently use the reference."""
+        from bigdl_tpu.kernels.flash_attention import (
+            _reference_attention, flash_attention,
+        )
+        rng = np.random.default_rng(5)
+        mk = lambda: jnp.asarray(rng.normal(size=(1, 2, 15, 8)).astype(np.float32))
+        q, k, v = mk(), mk(), mk()
+        out = flash_attention(q, k, v, False, True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(_reference_attention(q, k, v, False)),
+            rtol=1e-4, atol=1e-5)

@@ -27,6 +27,8 @@ _TRAIN_MAINS = {
     "textclassifier": ("bigdl_tpu.models.textclassifier.train",
                        "temporal-CNN text classification"),
     "treelstm": ("bigdl_tpu.models.treelstm.train", "binary TreeLSTM sentiment"),
+    "transformerlm": ("bigdl_tpu.models.transformerlm.train",
+                      "decoder-only Transformer LM (flash/ring attention)"),
 }
 
 

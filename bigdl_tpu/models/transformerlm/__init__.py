@@ -1,0 +1,3 @@
+from bigdl_tpu.models.transformerlm.transformerlm import (
+    PositionEmbedding, TransformerBlock, TransformerLM,
+)

@@ -1,0 +1,79 @@
+"""Transformer LM training main — the long-context flagship. Synthetic token
+stream offline (dataset/text.py synthetic_ptb); real text via --folder with a
+whitespace corpus file. ``--distributed`` trains SPMD over the Engine mesh;
+with a ``seq`` axis in the mesh the attention runs sequence-parallel ring over
+ICI, otherwise the flash kernel per chip.
+``python -m bigdl_tpu.models.transformerlm.train``
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Transformer LM training")
+    p.add_argument("-f", "--folder", default=None,
+                   help="text corpus file; synthetic stream if unset")
+    p.add_argument("-b", "--batch-size", type=int, default=16)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--embed-dim", type=int, default=128)
+    p.add_argument("--num-heads", type=int, default=4)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--vocab-size", type=int, default=256)
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each block (fit deeper/longer in HBM)")
+    p.add_argument("--max-iteration", type=int, default=8)
+    p.add_argument("--learning-rate", type=float, default=3e-4)
+    p.add_argument("--distributed", action="store_true")
+    p.add_argument("--synthetic-tokens", type=int, default=200_000)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.dataset.text import ptb_windows, synthetic_ptb
+    from bigdl_tpu.models.transformerlm import TransformerLM
+    from bigdl_tpu.optim import Adam, DistriOptimizer, LocalOptimizer, Trigger
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.random_generator import RandomGenerator
+
+    if not Engine.is_initialized():
+        Engine.init()
+    RandomGenerator.set_seed(0)
+
+    if args.folder is not None:
+        from bigdl_tpu.dataset.text import Dictionary, SentenceTokenizer
+        text = open(args.folder).read()
+        tokens = next(iter(SentenceTokenizer()(iter([text]))))
+        vocab = Dictionary(tokens, vocab_size=args.vocab_size)
+        ids = np.asarray([vocab.get_index(t) for t in tokens], np.int32)
+    else:
+        ids = synthetic_ptb(args.synthetic_tokens, vocab_size=args.vocab_size)
+    xs, ys = ptb_windows(ids, args.seq_len)
+    samples = [Sample(x, y) for x, y in zip(xs, ys)]
+    data = (DataSet.array(samples, distributed=args.distributed)
+            >> SampleToMiniBatch(args.batch_size))
+
+    model = TransformerLM(args.vocab_size, args.embed_dim, args.num_heads,
+                          args.num_layers, max_len=args.seq_len,
+                          dropout=args.dropout, remat=args.remat)
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                            size_average=True)
+    cls = DistriOptimizer if args.distributed else LocalOptimizer
+    opt = (cls(model, data, criterion)
+           .set_optim_method(Adam(learningrate=args.learning_rate))
+           .set_end_when(Trigger.max_iteration(args.max_iteration)))
+    opt.optimize()
+    print(f"final loss: {opt.state['loss']:.4f}")
+    return opt.state["loss"]
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Decoder-only Transformer language model.
+
+No reference counterpart (the reference predates transformers — SURVEY.md §5.7
+its longest-sequence workload is the PTB LSTM); this family exists because
+long-context is a first-class requirement of the TPU build. It is the showcase
+model for the attention stack: causal ``MultiHeadAttention`` routes to the
+single-chip Pallas flash kernel on TPU and to sequence-parallel ring attention
+when the Engine mesh has a ``seq`` axis — the SAME model scales from one chip
+to a sequence-sharded mesh unchanged. ``remat=True`` wraps each block in
+``nn.Remat`` (jax.checkpoint) so depth x context fits HBM.
+
+Pre-LN blocks (x + MHA(LN(x)); x + MLP(LN(x))) built from the stock layer
+zoo: the residual join is the ConcatTable(Identity, branch) >> CAddTable
+idiom, LayerNorm is the fused Pallas kernel on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.nn.initialization import RandomNormal
+
+
+class PositionEmbedding(TensorModule):
+    """Learned absolute position embedding added to (N, T, E) token embeddings."""
+
+    def __init__(self, max_len: int, embed_dim: int):
+        super().__init__()
+        self.max_len, self.embed_dim = max_len, embed_dim
+        self.reset()
+
+    def reset(self) -> None:
+        # global-RandomGenerator convention: seedable and re-randomized by reset
+        self._params = {"pos": jnp.asarray(RandomNormal(0.0, 0.02).init(
+            (self.max_len, self.embed_dim),
+            fan_in=self.embed_dim, fan_out=self.embed_dim))}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        t = input.shape[1]
+        if t > self.max_len:
+            raise ValueError(f"sequence length {t} > max_len {self.max_len}")
+        return input + params["pos"][None, :t], state
+
+
+def _residual(inner: nn.AbstractModule) -> nn.Sequential:
+    return (nn.Sequential()
+            .add(nn.ConcatTable().add(nn.Identity()).add(inner))
+            .add(nn.CAddTable()))
+
+
+def TransformerBlock(embed_dim: int, num_heads: int, mlp_ratio: int = 4,
+                     dropout: float = 0.0,
+                     attention_impl: str = "auto") -> nn.Sequential:
+    attn = nn.Sequential().add(nn.LayerNorm(embed_dim)).add(
+        nn.MultiHeadAttention(embed_dim, num_heads, causal=True,
+                              attention_impl=attention_impl))
+    mlp = (nn.Sequential()
+           .add(nn.LayerNorm(embed_dim))
+           .add(nn.TimeDistributed(nn.Linear(embed_dim, mlp_ratio * embed_dim)))
+           .add(nn.GELU())
+           .add(nn.TimeDistributed(nn.Linear(mlp_ratio * embed_dim, embed_dim))))
+    if dropout > 0:
+        attn.add(nn.Dropout(dropout))
+        mlp.add(nn.Dropout(dropout))
+    return nn.Sequential().add(_residual(attn)).add(_residual(mlp))
+
+
+def TransformerLM(vocab_size: int, embed_dim: int = 256, num_heads: int = 4,
+                  num_layers: int = 4, max_len: int = 1024,
+                  mlp_ratio: int = 4, dropout: float = 0.0,
+                  remat: bool = False,
+                  attention_impl: str = "auto") -> nn.Sequential:
+    """Token ids (N, T) int32 → per-position log-probs (N, T, vocab)."""
+    model = (nn.Sequential()
+             .add(nn.LookupTable(vocab_size, embed_dim, zero_based=True)
+                  .set_name("embedding"))
+             .add(PositionEmbedding(max_len, embed_dim).set_name("pos")))
+    for i in range(num_layers):
+        block = TransformerBlock(embed_dim, num_heads, mlp_ratio, dropout,
+                                 attention_impl)
+        if remat:
+            block = nn.Remat(block)
+        model.add(block.set_name(f"block{i + 1}"))
+    model.add(nn.LayerNorm(embed_dim).set_name("final_norm"))
+    model.add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size))
+              .set_name("decoder"))
+    model.add(nn.TimeDistributed(nn.LogSoftMax()))
+    return model

@@ -12,7 +12,7 @@ from bigdl_tpu.nn.containers import (
     BifurcateSplitTable, Bottle, CAddTable, CAveTable, CDivTable, CMaxTable, CMinTable,
     CMulTable, CSubTable, Concat, ConcatTable, Echo, FlattenTable, Identity, JoinTable,
     MapTable, MaskedSelect, MixtureTable, NarrowTable, Pack, ParallelTable,
-    SelectTable, Sequential,
+    Remat, SelectTable, Sequential,
 )
 from bigdl_tpu.nn.misc import (
     Bilinear, DotProduct, Euclidean, GaussianSampler, GradientReversal, HardShrink,

@@ -373,3 +373,33 @@ class MaskedSelect(AbstractModule):
             "MaskedSelect produces a data-dependent shape and cannot run "
             "inside jit on TPU; call .forward() eagerly (host) or restructure "
             "with jnp.where for a static-shape pipeline")
+
+
+class Remat(Container):
+    """Rematerialisation container: wraps one child in ``jax.checkpoint`` so its
+    activations are recomputed during the backward pass instead of living in
+    HBM across it (SURVEY.md build directives: trade FLOPs for memory). No
+    reference counterpart — the reference kept every activation alive; on TPU
+    this is the standard way to fit long-context / deep models."""
+
+    def __init__(self, module: AbstractModule = None):
+        super().__init__(*([module] if module is not None else []))
+
+    def add(self, module: AbstractModule) -> "Remat":
+        if self.modules:
+            raise ValueError("Remat wraps exactly one module")
+        return super().add(module)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not self.modules:
+            raise RuntimeError("Remat has no child module — add() one first")
+        m = self.modules[0]
+
+        def f(p, x):
+            return m.apply(p, state["0"], x, training=training, rng=rng)
+
+        out, new_s = jax.checkpoint(f)(params["0"], input)
+        return out, {"0": new_s}
+
+    def __repr__(self):
+        return f"Remat({self.modules[0]!r})" if self.modules else "Remat()"

@@ -235,6 +235,7 @@ EXAMPLES = {
     "Cropping2D": (lambda: nn.Cropping2D((1, 1), (1, 1)), _x(1, 2, 5, 5)),
     "Cropping3D": (lambda: nn.Cropping3D((1, 0), (0, 1), (1, 1)),
                    _x(1, 2, 4, 4, 4)),
+    "Remat": (lambda: nn.Remat(nn.Linear(4, 3)), _x(2, 4)),
     # round-3 recurrent sweep
     "RecurrentDecoder": (lambda: nn.RecurrentDecoder(3, nn.RnnCell(4, 4)),
                          _x(2, 4)),

@@ -111,7 +111,7 @@ class DistriOptimizer(Optimizer):
         if bsz % n_dev != 0:
             raise ValueError(
                 f"batch size {bsz} not divisible by data-parallel size {n_dev}")
-        inp = jax.device_put(batch.input, self._batch_sh)
+        inp = jax.device_put(self._feed_cast(batch.input), self._batch_sh)
         target = jax.device_put(batch.target, self._batch_sh)
         return inp, target
 

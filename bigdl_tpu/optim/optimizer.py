@@ -274,11 +274,16 @@ class Optimizer:
         dataset object changes (a kept cache must never outlive its dataset's
         eligibility)."""
         ds = self.dataset
+        cdt = Engine.compute_dtype()
         if self._device_batch_cache is not None \
-                and getattr(self, "_device_cache_ds", None) is ds:
+                and getattr(self, "_device_cache_ds", None) is ds \
+                and getattr(self, "_device_cache_dtype", None) == cdt:
             return
+        # dtype change invalidates too: cached inputs are placed pre-cast to
+        # the compute dtype and must not leak into a different-precision run
         self._device_batch_cache = None
         self._device_cache_ds = ds
+        self._device_cache_dtype = cdt
         if os.environ.get("BIGDL_DEVICE_CACHE", "1") == "0":
             return
         from bigdl_tpu.dataset.dataset import LocalDataSet, TransformedDataSet
@@ -310,11 +315,24 @@ class Optimizer:
         return placed
 
     def _place_batch(self, batch: MiniBatch):
-        return jax.device_put(batch.input), jax.device_put(batch.target)
+        return (jax.device_put(self._feed_cast(batch.input)),
+                jax.device_put(batch.target))
+
+    @staticmethod
+    def _feed_cast(x):
+        """Cast float32 inputs to the compute dtype BEFORE the h2d transfer
+        (producer thread). The jitted step casts inputs to the compute dtype
+        anyway — identical numerics — but casting host-side halves the
+        transfer bytes and the device-cache footprint under bf16."""
+        cdt = Engine.compute_dtype()
+        if cdt != jnp.float32 and getattr(x, "dtype", None) == np.float32:
+            return np.asarray(x).astype(cdt)  # bf16 is a valid numpy dtype here
+        return x
 
     def _put_input(self, batch: MiniBatch):
-        """Inputs-only placement for the eval path (targets stay on host there)."""
-        return jax.device_put(batch.input)
+        """Inputs-only placement for the eval path (targets stay on host there).
+        Same pre-transfer cast as the train feed — the eval jit casts anyway."""
+        return jax.device_put(self._feed_cast(batch.input))
 
     # ------------------------------------------------------------ optimize
     def _stop_profiler_if_active(self) -> None:

@@ -246,3 +246,43 @@ class TestDeviceBatchCache:
         opt.set_end_when(Trigger.max_iteration(2))
         opt.optimize()
         assert opt._device_batch_cache is None
+
+
+class TestDeviceCacheDtypeInvalidation:
+    def test_dtype_switch_drops_cache(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+        from bigdl_tpu.utils.engine import Engine
+
+        rng = np.random.default_rng(0)
+        batches = [MiniBatch(rng.normal(size=(4, 5)).astype(np.float32),
+                             rng.integers(0, 2, size=(4,)).astype(np.int32))]
+        model = nn.Sequential().add(nn.Linear(5, 2)).add(nn.LogSoftMax())
+        Engine.reset()
+        Engine.init(compute_dtype=jnp.bfloat16)
+        try:
+            opt = LocalOptimizer(model, DataSet.array(batches),
+                                 nn.ClassNLLCriterion())
+            opt.set_optim_method(SGD(learningrate=0.1))
+            opt.set_end_when(Trigger.max_iteration(1))
+            opt.optimize()
+            assert opt._device_batch_cache
+            placed = next(iter(opt._device_batch_cache.values()))[1]
+            assert placed[0].dtype == jnp.bfloat16  # cast pre-transfer
+            # switch precision: the bf16-truncated cache must NOT survive
+            Engine.reset()
+            Engine.init(compute_dtype=jnp.float32)
+            opt._step_cache = None
+            opt.set_end_when(Trigger.max_iteration(2))
+            opt.optimize()
+            placed = next(iter(opt._device_batch_cache.values()))[1]
+            assert placed[0].dtype == jnp.float32
+        finally:
+            Engine.reset()
+            Engine.init()

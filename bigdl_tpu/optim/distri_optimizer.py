@@ -116,7 +116,7 @@ class DistriOptimizer(Optimizer):
         return inp, target
 
     def _put_input(self, batch):
-        return jax.device_put(batch.input, self._batch_sh)
+        return jax.device_put(self._feed_cast(batch.input), self._batch_sh)
 
     def _optimize_impl(self):
         # compile path sets mesh/shardings before the first _put_batch

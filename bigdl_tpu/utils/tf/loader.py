@@ -225,8 +225,11 @@ class _Importer:
             if axis is None:
                 raise TFImportError(f"{node.name}: dynamic concat axis")
             return wire(O.TFConcat(int(axis)), *ins[:-1])
-        if op in ("Add", "AddV2", "Sub", "Mul"):
-            kind = {"Add": "add", "AddV2": "add", "Sub": "sub", "Mul": "mul"}[op]
+        _binary = {"Add": "add", "AddV2": "add", "Sub": "sub", "Mul": "mul",
+                   "RealDiv": "div", "Div": "div", "Maximum": "max",
+                   "Minimum": "min", "SquaredDifference": "sqdiff"}
+        if op in _binary:
+            kind = _binary[op]
             a, b = data_inputs()
             ca, cb = self.const_value(a), self.const_value(b)
             if ca is not None and cb is None:
@@ -236,6 +239,37 @@ class _Importer:
             if ca is None and cb is None:
                 return wire(O.TFBinaryOp(kind), a, b)
             raise TFImportError(f"{node.name}: both inputs const")
+
+        _unary = {"Neg": "neg", "Abs": "abs", "Square": "square",
+                  "Sqrt": "sqrt", "Rsqrt": "rsqrt", "Exp": "exp",
+                  "Log": "log", "Softplus": "softplus", "Elu": "elu"}
+        if op in _unary:
+            return wire(O.TFUnary(_unary[op]), node.input[0])
+        if op == "LeakyRelu":
+            alpha = node.attr["alpha"].f if "alpha" in node.attr else 0.2
+            return wire(O.TFLeakyRelu(alpha), node.input[0])
+        if op in ("Sum", "Max", "Min"):
+            axes = self.const_value(node.input[1])
+            if axes is None:
+                raise TFImportError(f"{node.name}: dynamic reduction axes")
+            keep = node.attr["keep_dims"].b
+            return wire(O.TFReduce(op.lower(), np.atleast_1d(axes), keep),
+                        node.input[0])
+        if op == "Conv2DBackpropInput":
+            _data_format(node)
+            d = _attr_list(node, "dilations") or [1, 1, 1, 1]
+            if any(v != 1 for v in d):
+                raise TFImportError(
+                    f"{node.name}: dilated deconv unsupported (fail loudly "
+                    f"rather than import wrong values)")
+            out_shape = self.const_value(node.input[0])
+            w = self.const_value(node.input[1])
+            if out_shape is None or w is None:
+                raise TFImportError(
+                    f"{node.name}: dynamic output_shape or non-const weights")
+            s = _attr_list(node, "strides")
+            return wire(O.TFConvTranspose(w, s[1:3], _padding(node),
+                                          out_shape), node.input[2])
 
         raise TFImportError(
             f"unsupported op {op!r} at node {node.name!r} — add a converter in "

@@ -197,7 +197,9 @@ class TFBinaryOp(AbstractModule):
     """Add/Sub/Mul over two graph inputs (Table) — or one input and a captured
     constant."""
 
-    _FNS = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply}
+    _FNS = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide, "max": jnp.maximum, "min": jnp.minimum,
+            "sqdiff": lambda a, b: jnp.square(a - b)}
 
     def __init__(self, op: str, const=None, const_on_left: bool = False):
         super().__init__()
@@ -216,3 +218,99 @@ class TFBinaryOp(AbstractModule):
             return out, state
         xs = input.values() if isinstance(input, Table) else list(input)
         return fn(xs[0], xs[1]), state
+
+
+class TFUnary(TensorModule):
+    """Elementwise unary TF math ops (Neg/Abs/Square/Sqrt/Rsqrt/Exp/Log...)."""
+
+    _FNS = {
+        "neg": lambda x: -x,
+        "abs": jnp.abs,
+        "square": jnp.square,
+        "sqrt": jnp.sqrt,
+        "rsqrt": lambda x: 1.0 / jnp.sqrt(x),
+        "exp": jnp.exp,
+        "log": jnp.log,
+        "softplus": lambda x: jnp.logaddexp(x, 0.0),
+        "elu": lambda x: jnp.where(x > 0, x, jnp.expm1(x)),
+    }
+
+    def __init__(self, op: str):
+        super().__init__()
+        if op not in self._FNS:
+            raise ValueError(op)
+        self.op = op
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self._FNS[self.op](input), state
+
+
+class TFLeakyRelu(TensorModule):
+    def __init__(self, alpha: float = 0.2):
+        super().__init__()
+        self.alpha = float(alpha)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.where(input >= 0, input, self.alpha * input), state
+
+
+class TFReduce(TensorModule):
+    """Sum/Max/Min reductions over const axes (Mean has its own class for
+    backward compatibility of serialized graphs)."""
+
+    _FNS = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}
+
+    def __init__(self, op: str, axes, keepdims: bool = False):
+        super().__init__()
+        if op not in self._FNS:
+            raise ValueError(op)
+        self.op = op
+        self.axes = tuple(int(a) for a in np.atleast_1d(axes))
+        self.keepdims = keepdims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self._FNS[self.op](input, axis=self.axes,
+                                  keepdims=self.keepdims), state
+
+
+class TFConvTranspose(TensorModule):
+    """Conv2DBackpropInput (deconvolution), NHWC, HWOI kernel as TF stores it
+    (height, width, out_channels, in_channels); output spatial size captured
+    from the graph's const output_shape."""
+
+    def __init__(self, kernel: np.ndarray, strides, padding: str,
+                 output_shape):
+        super().__init__()
+        self._state = {"kernel": jnp.asarray(kernel)}
+        self.strides = tuple(int(s) for s in strides)
+        self.padding = padding
+        self.output_shape = tuple(int(s) for s in output_shape)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from jax import lax
+        k = state["kernel"]                      # (kh, kw, O, I)
+        kh, kw = int(k.shape[0]), int(k.shape[1])
+        sh, sw = self.strides
+        oh, ow = self.output_shape[1], self.output_shape[2]
+        ih, iw = input.shape[1], input.shape[2]
+        # effective pads reproducing TF's conv_backprop_input geometry:
+        # lhs-dilated conv output = (i-1)*s + 1 + plo + phi - kk + 1 must hit o;
+        # plo mirrors the forward conv's before-padding (0 for VALID)
+        def pads(o, i, kk, s):
+            fwd_before = 0
+            if self.padding == "SAME":
+                fwd_before = max((i - 1) * s + kk - o, 0) // 2
+            lo = kk - 1 - fwd_before
+            hi = o - (i - 1) * s - 1 - lo + kk - 1
+            return (lo, hi)
+        ph = pads(oh, ih, kh, sh)
+        pw = pads(ow, iw, kw, sw)
+        # correlation-transpose applies the spatially flipped kernel
+        out = lax.conv_general_dilated(
+            input, jnp.flip(k, (0, 1)),
+            window_strides=(1, 1),
+            padding=[ph, pw],
+            lhs_dilation=(sh, sw),
+            dimension_numbers=("NHWC", "HWOI", "NHWC"),
+        )
+        return out, state

@@ -134,3 +134,82 @@ class TestFrozenGraphImport:
         np.testing.assert_allclose(np.asarray(loaded.evaluate().forward(x)),
                                    np.asarray(g.evaluate().forward(x)),
                                    rtol=1e-6)
+
+
+class TestWidenedOpSet:
+    """Round-3 second widening: unary math, LeakyRelu, reductions, div/max/min/
+    sqdiff binaries, Conv2DBackpropInput — each against TF's own execution."""
+
+    def _roundtrip(self, fn, spec, x):
+        gd, in_name, out_name, frozen = _freeze(fn, spec)
+        g = load_frozen_graph(gd, [out_name], [in_name])
+        ours = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+        theirs = frozen(tf.constant(x))[0].numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+        return ours
+
+    def test_unary_chain(self):
+        def f(x):
+            y = tf.abs(x) + 0.5
+            y = tf.sqrt(y) * tf.math.rsqrt(y + 1.0)
+            y = tf.exp(-tf.square(y))
+            return tf.math.log(y + 1.2) + tf.math.softplus(y) + tf.nn.elu(y) \
+                - tf.negative(y)
+        x = np.random.default_rng(0).normal(size=(2, 7)).astype(np.float32)
+        self._roundtrip(f, tf.TensorSpec((2, 7), tf.float32), x)
+
+    def test_leaky_relu(self):
+        def f(x):
+            return tf.nn.leaky_relu(x, alpha=0.1)
+        x = np.random.default_rng(1).normal(size=(3, 5)).astype(np.float32)
+        self._roundtrip(f, tf.TensorSpec((3, 5), tf.float32), x)
+
+    def test_reductions_and_binaries(self):
+        def f(x):
+            s = tf.reduce_sum(x, axis=1, keepdims=True)
+            m = tf.reduce_max(x, axis=1, keepdims=True)
+            n = tf.reduce_min(x, axis=1, keepdims=True)
+            d = tf.math.divide(x - n, m - n + 1.0)
+            return tf.math.squared_difference(d, s / 10.0) \
+                + tf.maximum(d, 0.25) - tf.minimum(d, 0.75)
+        x = np.random.default_rng(2).normal(size=(2, 6)).astype(np.float32)
+        self._roundtrip(f, tf.TensorSpec((2, 6), tf.float32), x)
+
+    @pytest.mark.parametrize("padding,stride", [("SAME", 2), ("VALID", 2),
+                                                ("SAME", 1), ("VALID", 1)])
+    def test_conv2d_transpose(self, padding, stride):
+        rng = np.random.default_rng(3)
+        w = tf.constant(rng.normal(scale=0.3, size=(3, 3, 5, 4))
+                        .astype(np.float32))  # (kh, kw, out, in)
+        i = 6
+        o = i * stride if padding == "SAME" else (i - 1) * stride + 3
+
+        def f(x):
+            return tf.nn.conv2d_transpose(
+                x, w, output_shape=(1, o, o, 5), strides=stride,
+                padding=padding)
+        x = rng.normal(size=(1, i, i, 4)).astype(np.float32)
+        self._roundtrip(f, tf.TensorSpec((1, i, i, 4), tf.float32), x)
+
+    def test_dilated_deconv_rejected(self):
+        """Dilated Conv2DBackpropInput must fail loudly, not import wrong."""
+        from tensorflow.core.framework import graph_pb2
+        gd = graph_pb2.GraphDef()
+        n = gd.node.add()
+        n.name, n.op = "x", "Placeholder"
+        c = gd.node.add()
+        c.name, c.op = "oshape", "Const"
+        c.attr["value"].tensor.CopyFrom(tf.make_tensor_proto(
+            np.array([1, 8, 8, 2], np.int32)))
+        w = gd.node.add()
+        w.name, w.op = "w", "Const"
+        w.attr["value"].tensor.CopyFrom(tf.make_tensor_proto(
+            np.zeros((3, 3, 2, 2), np.float32)))
+        d = gd.node.add()
+        d.name, d.op = "deconv", "Conv2DBackpropInput"
+        d.input.extend(["oshape", "w", "x"])
+        d.attr["strides"].list.i.extend([1, 2, 2, 1])
+        d.attr["dilations"].list.i.extend([1, 2, 2, 1])
+        d.attr["padding"].s = b"SAME"
+        with pytest.raises(TFImportError, match="dilated deconv"):
+            load_frozen_graph(gd, ["deconv"], ["x"])

@@ -489,3 +489,87 @@ class LBFGS(OptimMethod):
         new_state = {"s": S, "y": Y, "rho": rho, "pos": pos, "hist_len": hist_len,
                      "count": count + 1, "prev_flat": flat, "prev_grad": g}
         return unravel(new_flat), new_state
+
+
+class CompositeOptimMethod(OptimMethod):
+    """Per-submodule optimizers (reference ``setOptimMethods`` — SURVEY.md §2.3
+    Optimizer front-end): routes disjoint parameter subtrees, identified by
+    module-name path prefixes, to their own OptimMethod; parameters matching no
+    prefix use ``default``. Runs inside the one jitted training step — each
+    group's update is traced into the same XLA program.
+
+    Built by ``Optimizer.set_optim_methods``; rarely constructed directly.
+    ``groups``: list of (name, path_prefix_tuple, method).
+    """
+
+    def __init__(self, groups, default: OptimMethod):
+        self.groups = list(groups)
+        self.default = default
+
+    @property
+    def learningrate_schedule(self):
+        """Stateful-schedule plumbing (Plateau, checkpoint save/restore)
+        observes the DEFAULT method's schedule."""
+        return getattr(self.default, "learningrate_schedule", None)
+
+    # ---------------------------------------------------------- partitioning
+    @staticmethod
+    def _flatten(tree):
+        from jax.tree_util import tree_flatten_with_path
+
+        leaves, treedef = tree_flatten_with_path(tree)
+        flat = {}
+        for path, leaf in leaves:
+            key = tuple(str(getattr(p, "key", p)) for p in path)
+            flat[key] = leaf
+        return flat, treedef
+
+    def _group_of(self, path: tuple) -> int:
+        """Index into groups, or -1 for default. Longest prefix wins."""
+        best, best_len = -1, -1
+        for gi, (_, prefix, _) in enumerate(self.groups):
+            if len(prefix) > best_len and path[:len(prefix)] == prefix:
+                best, best_len = gi, len(prefix)
+        return best
+
+    def _partition(self, tree):
+        flat, treedef = self._flatten(tree)
+        parts = [dict() for _ in range(len(self.groups) + 1)]  # last = default
+        for path, leaf in flat.items():
+            parts[self._group_of(path)][path] = leaf
+        return parts, treedef, list(flat)
+
+    # ------------------------------------------------------------- OptimMethod
+    def init_state(self, params) -> dict:
+        parts, _, _ = self._partition(params)
+        state = {}
+        for gi, (name, _, method) in enumerate(self.groups):
+            state[f"g{gi}:{name}"] = method.init_state(parts[gi])
+        state["default"] = self.default.init_state(parts[-1])
+        return state
+
+    def update(self, params, grads, state, step):
+        from jax.tree_util import tree_unflatten
+
+        parts_p, treedef, order = self._partition(params)
+        parts_g, _, _ = self._partition(grads)
+        merged = {}
+        new_state = {}
+        for gi, (name, _, method) in enumerate(self.groups):
+            key = f"g{gi}:{name}"
+            new_p, new_s = method.update(parts_p[gi], parts_g[gi],
+                                         state[key], step)
+            merged.update(new_p)
+            new_state[key] = new_s
+        new_p, new_s = self.default.update(parts_p[-1], parts_g[-1],
+                                           state["default"], step)
+        merged.update(new_p)
+        new_state["default"] = new_s
+        return tree_unflatten(treedef, [merged[k] for k in order]), new_state
+
+    def get_learning_rate(self, step: int) -> float:
+        return self.default.get_learning_rate(step)
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}: {m!r}" for n, _, m in self.groups)
+        return f"CompositeOptimMethod({inner}, default={self.default!r})"

@@ -112,6 +112,46 @@ class Optimizer:
         self._final_ostate = None
         return self
 
+    def set_optim_methods(self, methods: dict) -> "Optimizer":
+        """Per-submodule optimizers (reference ``setOptimMethods``): ``methods``
+        maps module names (``module.set_name``/``get_name``) to OptimMethods;
+        each named module's parameter subtree updates with its own method, the
+        rest with the current ``set_optim_method`` default. Stateful LR
+        schedules (Plateau) are only observed on the default method."""
+        from bigdl_tpu.nn.abstractnn import Container
+        from bigdl_tpu.optim.optim_method import CompositeOptimMethod
+
+        prefixes: dict[str, list] = {}
+
+        def walk(m, path):
+            if m.name in methods:
+                prefixes.setdefault(m.name, []).append(path)
+            if isinstance(m, Container):
+                for idx, child in m.named_children():
+                    walk(child, path + (idx,))
+
+        walk(self.model, ())
+        missing = set(methods) - set(prefixes)
+        if missing:
+            raise ValueError(
+                f"set_optim_methods: module names not found in the model: "
+                f"{sorted(missing)}")
+        # duplicate names route ALL matches (one group per occurrence)
+        groups = [(name, path, method)
+                  for name, method in methods.items()
+                  for path in prefixes[name]]
+        default = self.optim_method
+        if isinstance(default, CompositeOptimMethod):
+            # repeated call: rebuild from the ORIGINAL default; new names
+            # override previous groups, remaining previous groups carry over
+            old = [(n, p, m) for n, p, m in default.groups if n not in methods]
+            groups = old + groups
+            default = default.default
+        self.optim_method = CompositeOptimMethod(groups, default)
+        self._step_cache = None
+        self._final_ostate = None
+        return self
+
     def set_prefetch(self, depth: int) -> "Optimizer":
         """Feed-pipeline depth: placed batches kept in flight by the background
         producer (dataset/prefetch.py). 0 = synchronous feeding."""
@@ -635,7 +675,12 @@ class Optimizer:
     def _update_stateful_schedule(self, ostate, state) -> None:
         """Feed the monitored metric to a stateful LR schedule (Plateau) and write
         the resulting LR into the live optimizer state — a traced leaf, so the LR
-        drops without recompiling the step."""
+        drops without recompiling the step. With per-submodule optimizers the
+        DEFAULT method's schedule is observed and its 'clr' lives under
+        ostate['default']."""
+        from bigdl_tpu.optim.optim_method import CompositeOptimMethod
+        if isinstance(self.optim_method, CompositeOptimMethod):
+            ostate = ostate.get("default", {})  # the default group's slots
         sched = getattr(self.optim_method, "learningrate_schedule", None)
         if not getattr(sched, "stateful", False) or "clr" not in ostate:
             return

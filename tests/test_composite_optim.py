@@ -1,0 +1,146 @@
+"""Per-submodule optimizers (reference setOptimMethods — SURVEY.md §2.3)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.optim import Adam, SGD
+from bigdl_tpu.optim.optimizer import LocalOptimizer
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _mk_opt(methods=None, freeze_name=None):
+    RandomGenerator.set_seed(0)
+    model = nn.Sequential()
+    model.add(nn.Linear(6, 8).set_name("backbone"))
+    model.add(nn.ReLU())
+    model.add(nn.Linear(8, 3).set_name("head"))
+    model.add(nn.LogSoftMax())
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    y = rng.integers(0, 3, size=(32,)).astype(np.int32)
+    ds = DataSet.array([MiniBatch(x[i:i + 8], y[i:i + 8])
+                        for i in range(0, 32, 8)])
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+    opt.set_optim_method(SGD(learningrate=0.1))
+    if methods:
+        opt.set_optim_methods(methods)
+    return opt, model
+
+
+class TestSetOptimMethods:
+    def test_frozen_group_stays_put(self):
+        opt, model = _mk_opt({"backbone": SGD(learningrate=0.0)})
+        before = np.asarray(model.modules[0].get_params()["weight"]).copy()
+        head_before = np.asarray(model.modules[2].get_params()["weight"]).copy()
+        opt.set_end_when(Trigger.max_iteration(5))
+        opt.optimize()
+        after = np.asarray(model.modules[0].get_params()["weight"])
+        head_after = np.asarray(model.modules[2].get_params()["weight"])
+        np.testing.assert_allclose(after, before)          # lr=0 group frozen
+        assert np.abs(head_after - head_before).max() > 1e-5  # default moved
+
+    def test_mixed_sgd_adam_trains(self):
+        opt, _ = _mk_opt({"head": Adam(learningrate=5e-3)})
+        opt.set_end_when(Trigger.max_iteration(12))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+        # Adam slots exist for the head group only
+        ostate = opt._final_ostate
+        assert any(k.startswith("g0:head") for k in ostate)
+        assert "default" in ostate
+
+    def test_unknown_name_rejected(self):
+        opt, _ = _mk_opt()
+        with pytest.raises(ValueError, match="not found"):
+            opt.set_optim_methods({"nonexistent": SGD()})
+
+    def test_continuation_keeps_slots(self):
+        opt, _ = _mk_opt({"head": Adam(learningrate=5e-3)})
+        opt.set_end_when(Trigger.max_iteration(4))
+        opt.optimize()
+        first = opt._final_ostate
+        opt.set_end_when(Trigger.max_iteration(8))
+        opt.optimize()  # continuation must reuse (not re-init) slots
+        assert np.isfinite(opt.state["loss"])
+        assert set(first) == set(opt._final_ostate)
+
+    def test_distri_zero1_composite(self):
+        """Composite slots must survive ZeRO-1 sharding over the mesh."""
+        from bigdl_tpu.optim import DistriOptimizer
+        from bigdl_tpu.utils.engine import Engine
+
+        RandomGenerator.set_seed(0)
+        model = nn.Sequential()
+        model.add(nn.Linear(6, 8).set_name("backbone"))
+        model.add(nn.ReLU())
+        model.add(nn.Linear(8, 3).set_name("head"))
+        model.add(nn.LogSoftMax())
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 6)).astype(np.float32)
+        y = rng.integers(0, 3, size=(64,)).astype(np.int32)
+        ds = DataSet.array([MiniBatch(x[i:i + 16], y[i:i + 16])
+                            for i in range(0, 64, 16)], distributed=True)
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                              parameter_sync="zero1")
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_optim_methods({"head": Adam(learningrate=5e-3)})
+        opt.set_end_when(Trigger.max_iteration(3))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+
+
+class TestReviewFixesComposite:
+    def test_second_call_preserves_first_routing(self):
+        opt, model = _mk_opt({"backbone": SGD(learningrate=0.0)})
+        opt.set_optim_methods({"head": Adam(learningrate=5e-3)})
+        before = np.asarray(model.modules[0].get_params()["weight"]).copy()
+        opt.set_end_when(Trigger.max_iteration(5))
+        opt.optimize()
+        after = np.asarray(model.modules[0].get_params()["weight"])
+        np.testing.assert_allclose(after, before)  # freeze must survive
+
+    def test_duplicate_names_route_all(self):
+        RandomGenerator.set_seed(0)
+        model = nn.Sequential()
+        model.add(nn.Linear(6, 6).set_name("frozen"))
+        model.add(nn.Linear(6, 6).set_name("frozen"))
+        model.add(nn.Linear(6, 3).set_name("head"))
+        model.add(nn.LogSoftMax())
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 6)).astype(np.float32)
+        y = rng.integers(0, 3, size=(16,)).astype(np.int32)
+        ds = DataSet.array([MiniBatch(x, y)])
+        opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_optim_methods({"frozen": SGD(learningrate=0.0)})
+        b0 = np.asarray(model.modules[0].get_params()["weight"]).copy()
+        b1 = np.asarray(model.modules[1].get_params()["weight"]).copy()
+        opt.set_end_when(Trigger.max_iteration(3))
+        opt.optimize()
+        np.testing.assert_allclose(
+            np.asarray(model.modules[0].get_params()["weight"]), b0)
+        np.testing.assert_allclose(
+            np.asarray(model.modules[1].get_params()["weight"]), b1)
+
+    def test_plateau_on_default_inside_composite(self):
+        from bigdl_tpu.optim.schedules import Plateau
+        opt, _ = _mk_opt()
+        # epsilon so large no loss drop ever counts as improvement — the
+        # reduction must fire at the first boundary after patience
+        opt.set_optim_method(SGD(learningrate=0.1,
+                                 learningrate_schedule=Plateau(
+                                     monitor="loss", factor=0.5, patience=0,
+                                     epsilon=1e9)))
+        opt.set_optim_methods({"head": Adam(learningrate=5e-3)})
+        opt.log_every = 1
+        opt.set_end_when(Trigger.max_epoch(4))
+        opt.optimize()
+        sched = opt.optim_method.default.learningrate_schedule
+        # patience=0 on a noisy loss: at least one reduction must have fired,
+        # proving the composite still feeds the default's Plateau
+        assert sched.current_lr < 0.1

@@ -68,7 +68,8 @@ from bigdl_tpu.nn.volumetric import (
     VolumetricMaxPooling,
 )
 from bigdl_tpu.nn.pooling import (
-    SpatialAveragePooling, SpatialMaxPooling, TemporalMaxPooling,
+    SpatialAveragePooling, SpatialMaxPooling, TemporalAveragePooling,
+    TemporalMaxPooling,
 )
 from bigdl_tpu.nn.shape_ops import (
     Contiguous, Flatten, Index, InferReshape, Narrow, Padding, Replicate, Reshape,

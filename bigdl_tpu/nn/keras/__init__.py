@@ -2,9 +2,18 @@
 ``bigdl.nn.keras`` — SURVEY.md §2.1, unverified)."""
 
 from bigdl_tpu.nn.keras.layers import (
-    Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
-    Dropout, Embedding, Flatten, GRU, GlobalAveragePooling2D, KerasLayer, LSTM,
-    MaxPooling2D, Reshape, SimpleRNN, ZeroPadding2D,
+    Activation, AtrousConvolution2D, AveragePooling1D, AveragePooling2D,
+    AveragePooling3D, BatchNormalization, Bidirectional, Convolution1D,
+    Convolution2D, Convolution3D, Cropping1D, Cropping2D, Cropping3D,
+    Deconvolution2D, Dense, Dropout, ELU, Embedding, Flatten, GRU,
+    GaussianDropout, GaussianNoise, GlobalAveragePooling1D,
+    GlobalAveragePooling2D, GlobalMaxPooling1D, GlobalMaxPooling2D, Highway,
+    KerasLayer, LSTM, LayerNormalization, LeakyReLU, LocallyConnected1D,
+    LocallyConnected2D, Masking, MaxPooling1D, MaxPooling2D, MaxPooling3D,
+    MaxoutDense, PReLU, Permute, RepeatVector, Reshape, SeparableConvolution2D,
+    SimpleRNN, SpatialDropout1D, SpatialDropout2D, SpatialDropout3D,
+    ThresholdedReLU, TimeDistributed, UpSampling1D, UpSampling2D, UpSampling3D,
+    ZeroPadding1D, ZeroPadding2D, ZeroPadding3D,
 )
 from bigdl_tpu.nn.keras.topology import (
     Input, KerasModel, KerasNode, Model, Sequential, merge,
@@ -12,11 +21,23 @@ from bigdl_tpu.nn.keras.topology import (
 
 # Keras-2 style aliases
 Conv2D = Convolution2D
+Conv1D = Convolution1D
+Conv3D = Convolution3D
 
 __all__ = [
-    "Activation", "AveragePooling2D", "BatchNormalization", "Conv2D",
-    "Convolution2D", "Dense", "Dropout", "Embedding", "Flatten", "GRU",
-    "GlobalAveragePooling2D", "Input", "KerasLayer", "KerasModel", "KerasNode",
-    "LSTM", "MaxPooling2D", "Model", "Reshape", "Sequential", "SimpleRNN",
-    "ZeroPadding2D", "merge",
+    "Activation", "AtrousConvolution2D", "AveragePooling1D", "AveragePooling2D",
+    "AveragePooling3D", "BatchNormalization", "Bidirectional", "Conv1D",
+    "Conv2D", "Conv3D", "Convolution1D", "Convolution2D", "Convolution3D",
+    "Cropping1D", "Cropping2D", "Cropping3D", "Deconvolution2D", "Dense",
+    "Dropout", "ELU", "Embedding", "Flatten", "GRU", "GaussianDropout",
+    "GaussianNoise", "GlobalAveragePooling1D", "GlobalAveragePooling2D",
+    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "Highway", "Input",
+    "KerasLayer", "KerasModel", "KerasNode", "LSTM", "LayerNormalization",
+    "LeakyReLU", "LocallyConnected1D", "LocallyConnected2D", "Masking",
+    "MaxPooling1D", "MaxPooling2D", "MaxPooling3D", "MaxoutDense", "Model",
+    "PReLU", "Permute", "RepeatVector", "Reshape", "SeparableConvolution2D",
+    "Sequential", "SimpleRNN", "SpatialDropout1D", "SpatialDropout2D",
+    "SpatialDropout3D", "ThresholdedReLU", "TimeDistributed", "UpSampling1D",
+    "UpSampling2D", "UpSampling3D", "ZeroPadding1D", "ZeroPadding2D",
+    "ZeroPadding3D", "merge",
 ]

@@ -443,3 +443,573 @@ class LayerNormalization(KerasLayer):
 
     def compute_output_shape(self, input_shape):
         return input_shape
+
+
+# --------------------------------------------------------------- round-3 batch
+class Permute(KerasLayer):
+    """Permute the non-batch dims (keras 1-based ``dims``)."""
+
+    def __init__(self, dims, **kw):
+        super().__init__(**kw)
+        self.dims = tuple(int(d) for d in dims)
+
+    def build(self, input_shape):
+        # nn.Transpose swaps pairs; express an arbitrary permutation as a
+        # sequence of (1-based, batch-counted) swaps via cycle decomposition
+        perm = [0] + [d for d in self.dims]              # with batch dim
+        swaps, cur = [], list(range(len(perm)))
+        for i in range(len(perm)):
+            while cur[i] != perm[i]:
+                j = cur.index(perm[i])
+                swaps.append((i + 1, j + 1))
+                cur[i], cur[j] = cur[j], cur[i]
+        return N.Transpose(swaps)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[d - 1] for d in self.dims)
+
+
+class RepeatVector(KerasLayer):
+    """(features,) → (n, features) per sample (keras ``RepeatVector``)."""
+
+    def __init__(self, n: int, **kw):
+        super().__init__(**kw)
+        self.n = n
+
+    def build(self, input_shape):
+        return N.Replicate(self.n, dim=1, n_input_dims=1)
+
+    def compute_output_shape(self, input_shape):
+        return (self.n,) + tuple(input_shape)
+
+
+class Masking(KerasLayer):
+    def __init__(self, mask_value: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.mask_value = mask_value
+
+    def build(self, input_shape):
+        return N.Masking(self.mask_value)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class Highway(KerasLayer):
+    def __init__(self, activation: Optional[str] = None, bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.activation = activation
+        self.bias = bias
+
+    def build(self, input_shape):
+        return N.Highway(input_shape[-1], with_bias=self.bias,
+                         activation=_act(self.activation))
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class MaxoutDense(KerasLayer):
+    def __init__(self, output_dim: int, nb_feature: int = 4, bias: bool = True,
+                 **kw):
+        super().__init__(**kw)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+        self.bias = bias
+
+    def build(self, input_shape):
+        return N.Maxout(input_shape[-1], self.output_dim, self.nb_feature,
+                        with_bias=self.bias)
+
+    def compute_output_shape(self, input_shape):
+        return (self.output_dim,)
+
+
+class _UpSamplingBase(KerasLayer):
+    def __init__(self, size, **kw):
+        super().__init__(**kw)
+        self.size = size
+
+
+class UpSampling1D(_UpSamplingBase):
+    def __init__(self, length: int = 2, **kw):
+        super().__init__(length, **kw)
+
+    def build(self, input_shape):
+        return N.UpSampling1D(self.size)
+
+    def compute_output_shape(self, input_shape):
+        t, f = input_shape
+        return (t * self.size, f)
+
+
+class UpSampling2D(_UpSamplingBase):
+    def __init__(self, size=(2, 2), **kw):
+        super().__init__(_pair(size), **kw)
+
+    def build(self, input_shape):
+        return N.UpSampling2D(self.size)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        return (c, h * self.size[0], w * self.size[1])
+
+
+class UpSampling3D(_UpSamplingBase):
+    def __init__(self, size=(2, 2, 2), **kw):
+        super().__init__(tuple(size), **kw)
+
+    def build(self, input_shape):
+        return N.UpSampling3D(self.size)
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        return (c, d * self.size[0], h * self.size[1], w * self.size[2])
+
+
+class ZeroPadding1D(KerasLayer):
+    def __init__(self, padding: int = 1, **kw):
+        super().__init__(**kw)
+        self.padding = padding
+
+    def build(self, input_shape):
+        seq = N.Sequential()
+        seq.add(N.Padding(1, -self.padding, num_input_dims=2))
+        seq.add(N.Padding(1, self.padding, num_input_dims=2))
+        return seq
+
+    def compute_output_shape(self, input_shape):
+        t, f = input_shape
+        return (t + 2 * self.padding, f)
+
+
+class ZeroPadding3D(KerasLayer):
+    def __init__(self, padding=(1, 1, 1), **kw):
+        super().__init__(**kw)
+        self.padding = tuple(padding)
+
+    def build(self, input_shape):
+        pd, ph, pw = self.padding
+        seq = N.Sequential()
+        for dim, p in ((2, pd), (3, ph), (4, pw)):
+            if p:
+                seq.add(N.Padding(dim, -p, num_input_dims=4))
+                seq.add(N.Padding(dim, p, num_input_dims=4))
+        return seq
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        pd, ph, pw = self.padding
+        return (c, d + 2 * pd, h + 2 * ph, w + 2 * pw)
+
+
+class Cropping1D(KerasLayer):
+    def __init__(self, cropping=(1, 1), **kw):
+        super().__init__(**kw)
+        self.cropping = _pair(cropping)
+
+    def build(self, input_shape):
+        t, _ = input_shape
+        a, b = self.cropping
+        return N.Narrow(2, a + 1, t - a - b)
+
+    def compute_output_shape(self, input_shape):
+        t, f = input_shape
+        return (t - sum(self.cropping), f)
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)), **kw):
+        super().__init__(**kw)
+        self.cropping = (tuple(cropping[0]), tuple(cropping[1]))
+
+    def build(self, input_shape):
+        return N.Cropping2D(self.cropping[0], self.cropping[1])
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        (t, b), (l, r) = self.cropping
+        return (c, h - t - b, w - l - r)
+
+
+class Cropping3D(KerasLayer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), **kw):
+        super().__init__(**kw)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def build(self, input_shape):
+        return N.Cropping3D(*self.cropping)
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        (a0, a1), (b0, b1), (c0, c1) = self.cropping
+        return (c, d - a0 - a1, h - b0 - b1, w - c0 - c1)
+
+
+class AveragePooling1D(_Pooling1D):
+    def build(self, input_shape):
+        return N.TemporalAveragePooling(self.pool_length, self.stride)
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    def build(self, input_shape):
+        return N.Sequential().add(N.TemporalAveragePooling(-1)).add(
+            N.Reshape([input_shape[1]]))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[1],)
+
+
+class _Pooling3D(KerasLayer):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, **kw):
+        super().__init__(**kw)
+        self.pool_size = tuple(pool_size)
+        self.strides = tuple(strides) if strides is not None else self.pool_size
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        kd, kh, kw_ = self.pool_size
+        sd, sh, sw = self.strides
+        return (c, (d - kd) // sd + 1, (h - kh) // sh + 1, (w - kw_) // sw + 1)
+
+
+class MaxPooling3D(_Pooling3D):
+    def build(self, input_shape):
+        kd, kh, kw_ = self.pool_size
+        sd, sh, sw = self.strides
+        return N.VolumetricMaxPooling(kd, kw_, kh, sd, sw, sh)
+
+
+class AveragePooling3D(_Pooling3D):
+    def build(self, input_shape):
+        kd, kh, kw_ = self.pool_size
+        sd, sh, sw = self.strides
+        return N.VolumetricAveragePooling(kd, kw_, kh, sd, sw, sh)
+
+
+class Convolution3D(KerasLayer):
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, activation: Optional[str] = None,
+                 subsample=(1, 1, 1), border_mode: str = "valid",
+                 bias: bool = True, **kw):
+        super().__init__(**kw)
+        if border_mode != "valid":
+            raise ValueError("Convolution3D supports border_mode='valid' only")
+        self.nb_filter = nb_filter
+        self.kernel = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.activation = activation
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def build(self, input_shape):
+        c = input_shape[0]
+        kd, kh, kw_ = self.kernel
+        sd, sh, sw = self.subsample
+        conv = N.VolumetricConvolution(c, self.nb_filter, kd, kw_, kh,
+                                       sd, sw, sh, with_bias=self.bias)
+        return self._with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        _, d, h, w = input_shape
+        kd, kh, kw_ = self.kernel
+        sd, sh, sw = self.subsample
+        return (self.nb_filter, (d - kd) // sd + 1, (h - kh) // sh + 1,
+                (w - kw_) // sw + 1)
+
+
+class Deconvolution2D(KerasLayer):
+    """Transposed conv (keras-1.2 ``Deconvolution2D``) over NCHW."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 subsample=(1, 1), activation: Optional[str] = None,
+                 bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.nb_row, self.nb_col = nb_row, nb_col
+        self.subsample = _pair(subsample)
+        self.activation = activation
+        self.bias = bias
+
+    def build(self, input_shape):
+        c = input_shape[0]
+        deconv = N.SpatialFullConvolution(
+            c, self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], no_bias=not self.bias)
+        return self._with_activation(deconv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        sh, sw = self.subsample
+        return (self.nb_filter, (h - 1) * sh + self.nb_row,
+                (w - 1) * sw + self.nb_col)
+
+
+class AtrousConvolution2D(KerasLayer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 atrous_rate=(1, 1), activation: Optional[str] = None,
+                 bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.nb_row, self.nb_col = nb_row, nb_col
+        self.atrous_rate = _pair(atrous_rate)
+        self.activation = activation
+        self.bias = bias
+
+    def build(self, input_shape):
+        c = input_shape[0]
+        conv = N.SpatialDilatedConvolution(
+            c, self.nb_filter, self.nb_col, self.nb_row, 1, 1, 0, 0,
+            self.atrous_rate[1], self.atrous_rate[0], with_bias=self.bias)
+        return self._with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        eff_h = self.nb_row + (self.nb_row - 1) * (self.atrous_rate[0] - 1)
+        eff_w = self.nb_col + (self.nb_col - 1) * (self.atrous_rate[1] - 1)
+        return (self.nb_filter, h - eff_h + 1, w - eff_w + 1)
+
+
+class SeparableConvolution2D(KerasLayer):
+    """Depthwise (grouped) conv + 1x1 pointwise (keras
+    ``SeparableConvolution2D``) — two MXU contractions."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 depth_multiplier: int = 1, activation: Optional[str] = None,
+                 subsample=(1, 1), border_mode: str = "valid",
+                 bias: bool = True, **kw):
+        super().__init__(**kw)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode must be valid|same, got {border_mode!r}")
+        self.nb_filter = nb_filter
+        self.nb_row, self.nb_col = nb_row, nb_col
+        self.depth_multiplier = depth_multiplier
+        self.activation = activation
+        self.subsample = _pair(subsample)
+        self.border_mode = border_mode
+        self.bias = bias
+
+    def build(self, input_shape):
+        c = input_shape[0]
+        pad = -1 if self.border_mode == "same" else 0
+        depthwise = N.SpatialConvolution(
+            c, c * self.depth_multiplier, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], pad, pad,
+            n_group=c, with_bias=False)
+        pointwise = N.SpatialConvolution(
+            c * self.depth_multiplier, self.nb_filter, 1, 1,
+            with_bias=self.bias)
+        seq = N.Sequential().add(depthwise).add(pointwise)
+        return self._with_activation(seq, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        sh, sw = self.subsample
+        if self.border_mode == "same":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh = (h - self.nb_row) // sh + 1
+            ow = (w - self.nb_col) // sw + 1
+        return (self.nb_filter, oh, ow)
+
+
+class LocallyConnected1D(KerasLayer):
+    def __init__(self, nb_filter: int, filter_length: int,
+                 subsample_length: int = 1, activation: Optional[str] = None,
+                 bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.subsample_length = subsample_length
+        self.activation = activation
+        self.bias = bias
+
+    def build(self, input_shape):
+        t, f = input_shape
+        m = N.LocallyConnected1D(t, f, self.nb_filter, self.filter_length,
+                                 self.subsample_length, with_bias=self.bias)
+        return self._with_activation(m, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        t, _ = input_shape
+        return ((t - self.filter_length) // self.subsample_length + 1,
+                self.nb_filter)
+
+
+class LocallyConnected2D(KerasLayer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 subsample=(1, 1), activation: Optional[str] = None,
+                 bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.nb_row, self.nb_col = nb_row, nb_col
+        self.subsample = _pair(subsample)
+        self.activation = activation
+        self.bias = bias
+
+    def build(self, input_shape):
+        c, h, w = input_shape
+        m = N.LocallyConnected2D(c, w, h, self.nb_filter, self.nb_col,
+                                 self.nb_row, self.subsample[1],
+                                 self.subsample[0], with_bias=self.bias)
+        return self._with_activation(m, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        sh, sw = self.subsample
+        return (self.nb_filter, (h - self.nb_row) // sh + 1,
+                (w - self.nb_col) // sw + 1)
+
+
+class SpatialDropout1D(KerasLayer):
+    def __init__(self, p: float = 0.5, **kw):
+        super().__init__(**kw)
+        self.p = p
+
+    def build(self, input_shape):
+        return N.SpatialDropout1D(self.p)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class SpatialDropout2D(SpatialDropout1D):
+    def build(self, input_shape):
+        return N.SpatialDropout2D(self.p)
+
+
+class SpatialDropout3D(SpatialDropout1D):
+    def build(self, input_shape):
+        return N.SpatialDropout3D(self.p)
+
+
+class GaussianDropout(KerasLayer):
+    def __init__(self, p: float = 0.5, **kw):
+        super().__init__(**kw)
+        self.p = p
+
+    def build(self, input_shape):
+        return N.GaussianDropout(self.p)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class GaussianNoise(KerasLayer):
+    def __init__(self, sigma: float = 0.1, **kw):
+        super().__init__(**kw)
+        self.sigma = sigma
+
+    def build(self, input_shape):
+        return N.GaussianNoise(self.sigma)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class LeakyReLU(KerasLayer):
+    def __init__(self, alpha: float = 0.3, **kw):
+        super().__init__(**kw)
+        self.alpha = alpha
+
+    def build(self, input_shape):
+        return N.LeakyReLU(self.alpha)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class ELU(KerasLayer):
+    def __init__(self, alpha: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.alpha = alpha
+
+    def build(self, input_shape):
+        return N.ELU(self.alpha)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.theta = theta
+
+    def build(self, input_shape):
+        return N.Threshold(self.theta, 0.0)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class PReLU(KerasLayer):
+    """Learnable leaky slope. Slope layout by input rank: (features,) →
+    per-feature; (C, H, W[, ...]) → per-channel (nn.PReLU broadcasts on the
+    channel axis); temporal (steps, features) → ONE shared slope — the native
+    PReLU has no per-last-axis broadcast, and a per-timestep slope would be
+    silently wrong semantics."""
+
+    def build(self, input_shape):
+        if len(input_shape) == 1:
+            return N.PReLU(input_shape[0])   # (N, F): per-feature on axis -1
+        if len(input_shape) >= 3:
+            return N.PReLU(input_shape[0])   # NCHW-style: per-channel
+        return N.PReLU(0)                    # (steps, features): shared scalar
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class TimeDistributed(KerasLayer):
+    """Apply an inner keras layer at every timestep of (time, ...) input."""
+
+    def __init__(self, layer: KerasLayer, **kw):
+        super().__init__(**kw)
+        self.layer = layer
+
+    def build(self, input_shape):
+        return N.TimeDistributed(self.layer.build(tuple(input_shape[1:])))
+
+    def compute_output_shape(self, input_shape):
+        inner = self.layer.compute_output_shape(tuple(input_shape[1:]))
+        return (input_shape[0],) + tuple(inner)
+
+
+class Bidirectional(KerasLayer):
+    """Wrap a recurrent keras layer with a backward clone (merge: concat/sum)."""
+
+    def __init__(self, layer: "_RecurrentLayer", merge_mode: str = "concat",
+                 **kw):
+        super().__init__(**kw)
+        if merge_mode not in ("concat", "sum"):
+            raise ValueError("merge_mode must be 'concat' or 'sum'")
+        if not isinstance(layer, _RecurrentLayer):
+            raise TypeError("Bidirectional wraps a recurrent keras layer")
+        self.layer = layer
+        self.merge_mode = merge_mode
+
+    def build(self, input_shape):
+        cell = self.layer._make_cell(input_shape[1])
+        merge = "concat" if self.merge_mode == "concat" else "add"
+        if self.layer.return_sequences:
+            # BiRecurrent re-reverses the backward outputs so step t aligns
+            return N.Sequential().add(N.BiRecurrent(cell, merge=merge))
+        # return_sequences=False: keras semantics = [fwd FULL-sequence summary,
+        # bwd FULL-sequence summary]. BiRecurrent's re-reversed stream puts the
+        # backward summary at t=0, so Select(-1) would grab a one-step state;
+        # run the two directions explicitly and take each one's LAST output.
+        bwd_cell = cell.clone()
+        bwd_cell.reset()
+        concat = N.ConcatTable()
+        concat.add(N.Sequential().add(N.Recurrent(cell)).add(N.Select(2, -1)))
+        concat.add(N.Sequential().add(_ReverseTime())
+                   .add(N.Recurrent(bwd_cell)).add(N.Select(2, -1)))
+        joiner = N.JoinTable(1, n_input_dims=1) if merge == "concat" \
+            else N.CAddTable()
+        return N.Sequential().add(concat).add(joiner)
+
+    def compute_output_shape(self, input_shape):
+        width = self.layer.output_dim * (2 if self.merge_mode == "concat" else 1)
+        if self.layer.return_sequences:
+            return (input_shape[0], width)
+        return (width,)

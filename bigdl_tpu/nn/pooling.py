@@ -201,3 +201,30 @@ class TemporalMaxPooling(TensorModule):
 
     def __repr__(self):
         return f"TemporalMaxPooling({self.kernel_w}, {self.stride_w})"
+
+
+class TemporalAveragePooling(TensorModule):
+    """1-D average pooling over time (reference ``TemporalAveragePooling``? —
+    the keras AveragePooling1D backend either way): (N, T, F) →
+    (N, (T-kw)//dw+1, F). ``kernel_w=-1`` averages the WHOLE sequence."""
+
+    def __init__(self, kernel_w: int, stride_w: int | None = None):
+        super().__init__()
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w if stride_w is not None else kernel_w
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]
+        kw = x.shape[1] if self.kernel_w == -1 else self.kernel_w
+        dw = x.shape[1] if self.kernel_w == -1 else self.stride_w
+        out = lax.reduce_window(
+            x, 0.0, lax.add,
+            window_dimensions=(1, kw, 1),
+            window_strides=(1, dw, 1),
+            padding="VALID").astype(x.dtype) / kw
+        if squeeze:
+            out = out[0]
+        return out, state

@@ -1,0 +1,177 @@
+"""Round-3 keras-API widening: shape inference + numerics for the new layers
+(SURVEY.md §2.1 Keras layer API)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import bigdl_tpu.nn.keras as K
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _np(*shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def _run(layer, input_shape, x):
+    RandomGenerator.set_seed(0)
+    m = layer.build(tuple(input_shape))
+    out = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+    expect = layer.compute_output_shape(tuple(input_shape))
+    assert out.shape[1:] == tuple(expect), (out.shape, expect)
+    return out, m
+
+
+class TestShapeAndNumerics:
+    def test_permute(self):
+        x = _np(2, 3, 4, 5)
+        out, _ = _run(K.Permute((2, 3, 1)), (3, 4, 5), x)
+        np.testing.assert_allclose(out, x.transpose(0, 2, 3, 1))
+
+    def test_repeat_vector(self):
+        x = _np(2, 6)
+        out, _ = _run(K.RepeatVector(3), (6,), x)
+        np.testing.assert_allclose(out, np.repeat(x[:, None, :], 3, axis=1))
+
+    def test_upsampling(self):
+        _run(K.UpSampling1D(2), (4, 3), _np(2, 4, 3))
+        _run(K.UpSampling2D((2, 2)), (3, 4, 4), _np(2, 3, 4, 4))
+        _run(K.UpSampling3D((2, 2, 2)), (2, 3, 3, 3), _np(1, 2, 3, 3, 3))
+
+    def test_zeropadding_1d_3d(self):
+        x = _np(2, 4, 3)
+        out, _ = _run(K.ZeroPadding1D(2), (4, 3), x)
+        np.testing.assert_allclose(out[:, 2:6], x)
+        assert (out[:, :2] == 0).all() and (out[:, 6:] == 0).all()
+        _run(K.ZeroPadding3D((1, 1, 1)), (2, 3, 3, 3), _np(1, 2, 3, 3, 3))
+
+    def test_cropping(self):
+        x = _np(2, 6, 3)
+        out, _ = _run(K.Cropping1D((1, 2)), (6, 3), x)
+        np.testing.assert_allclose(out, x[:, 1:4])
+        _run(K.Cropping2D(((1, 1), (0, 2))), (2, 5, 6), _np(1, 2, 5, 6))
+        _run(K.Cropping3D(), (2, 4, 4, 4), _np(1, 2, 4, 4, 4))
+
+    def test_pooling(self):
+        x = _np(2, 6, 3)
+        out, _ = _run(K.AveragePooling1D(2), (6, 3), x)
+        np.testing.assert_allclose(out, x.reshape(2, 3, 2, 3).mean(2),
+                                   rtol=1e-6)
+        out, _ = _run(K.GlobalAveragePooling1D(), (6, 3), x)
+        np.testing.assert_allclose(out, x.mean(1), rtol=1e-6)
+        _run(K.MaxPooling3D((2, 2, 2)), (2, 4, 4, 4), _np(1, 2, 4, 4, 4))
+        _run(K.AveragePooling3D((2, 2, 2)), (2, 4, 4, 4), _np(1, 2, 4, 4, 4))
+
+    def test_conv3d_and_deconv(self):
+        _run(K.Convolution3D(4, 2, 2, 2), (2, 4, 4, 4), _np(1, 2, 4, 4, 4))
+        _run(K.Deconvolution2D(3, 3, 3, subsample=(2, 2)), (2, 4, 4),
+             _np(1, 2, 4, 4))
+        _run(K.AtrousConvolution2D(3, 3, 3, atrous_rate=(2, 2)), (2, 8, 8),
+             _np(1, 2, 8, 8))
+
+    def test_separable_conv_oracle(self):
+        RandomGenerator.set_seed(0)
+        layer = K.SeparableConvolution2D(5, 3, 3, depth_multiplier=2)
+        m = layer.build((4, 8, 8))
+        x = _np(2, 4, 8, 8)
+        out = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+        seq = m  # Sequential(depthwise, pointwise) — no activation configured
+        dw = np.asarray(seq.modules[0].get_params()["weight"])  # (8,1,3,3)
+        pw = np.asarray(seq.modules[1].get_params()["weight"])  # (5,8,1,1)
+        pb = np.asarray(seq.modules[1].get_params()["bias"])
+        ref = F.conv2d(torch.tensor(x), torch.tensor(dw), groups=4)
+        ref = F.conv2d(ref, torch.tensor(pw), torch.tensor(pb)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_locally_connected(self):
+        _run(K.LocallyConnected1D(4, 3), (6, 3), _np(2, 6, 3))
+        _run(K.LocallyConnected2D(4, 2, 2), (2, 5, 5), _np(1, 2, 5, 5))
+
+    def test_advanced_activations(self):
+        x = _np(2, 5)
+        out, _ = _run(K.LeakyReLU(0.1), (5,), x)
+        np.testing.assert_allclose(out, np.where(x >= 0, x, 0.1 * x),
+                                   rtol=1e-6)
+        _run(K.ELU(0.5), (5,), x)
+        out, _ = _run(K.ThresholdedReLU(0.3), (5,), x)
+        np.testing.assert_allclose(out, np.where(x > 0.3, x, 0.0))
+        _run(K.PReLU(), (5,), x)
+
+    def test_regularization_layers(self):
+        for layer, shape in ((K.SpatialDropout1D(0.5), (4, 3)),
+                             (K.SpatialDropout2D(0.5), (3, 4, 4)),
+                             (K.SpatialDropout3D(0.5), (2, 3, 3, 3)),
+                             (K.GaussianDropout(0.3), (5,)),
+                             (K.GaussianNoise(0.1), (5,)),
+                             (K.Masking(0.0), (4, 3))):
+            x = _np(2, *shape)
+            out, _ = _run(layer, shape, x)
+            np.testing.assert_allclose(out, x)  # eval mode = identity for all
+
+    def test_highway_and_maxout(self):
+        _run(K.Highway(activation="relu"), (6,), _np(3, 6))
+        _run(K.MaxoutDense(4, nb_feature=3), (6,), _np(3, 6))
+
+
+class TestWrappers:
+    def test_time_distributed(self):
+        x = _np(2, 5, 6)
+        out, _ = _run(K.TimeDistributed(K.Dense(3)), (5, 6), x)
+        assert out.shape == (2, 5, 3)
+
+    def test_bidirectional_concat_and_sum(self):
+        x = _np(2, 5, 6)
+        out, _ = _run(K.Bidirectional(K.LSTM(4, return_sequences=True)),
+                      (5, 6), x)
+        assert out.shape == (2, 5, 8)
+        out, _ = _run(K.Bidirectional(K.GRU(4), merge_mode="sum"), (5, 6), x)
+        assert out.shape == (2, 4)
+
+
+class TestEndToEnd:
+    def test_fit_with_new_layers(self):
+        RandomGenerator.set_seed(0)
+        model = K.Sequential()
+        model.add(K.Convolution1D(8, 3, input_shape=(12, 4),
+                                  activation="relu"))
+        model.add(K.SpatialDropout1D(0.1))
+        model.add(K.GlobalAveragePooling1D())
+        model.add(K.Highway())
+        model.add(K.Dense(3, activation="log_softmax"))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(48, 12, 4)).astype(np.float32)
+        y = rng.integers(0, 3, size=(48,)).astype(np.int32)
+        model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+        model.fit(x, y, batch_size=16, nb_epoch=2)
+        out = model.predict(x)
+        assert out.shape == (48, 3)
+
+
+class TestReviewFixesKeras:
+    def test_bidirectional_backward_is_full_summary(self):
+        """return_sequences=False must concat [fwd full summary, bwd full
+        summary] (keras semantics), not a one-timestep backward state."""
+        from bigdl_tpu import nn
+        RandomGenerator.set_seed(0)
+        layer = K.Bidirectional(K.LSTM(4))
+        m = layer.build((5, 6)).evaluate()
+        x = _np(2, 5, 6)
+        out = np.asarray(m.forward(jnp.asarray(x)))
+        concat = m.modules[0]
+        fwd_cell = concat.modules[0].modules[0].cell
+        bwd_cell = concat.modules[1].modules[1].cell
+        f = np.asarray(nn.Recurrent(fwd_cell).evaluate()
+                       .forward(jnp.asarray(x)))[:, -1]
+        b = np.asarray(nn.Recurrent(bwd_cell).evaluate()
+                       .forward(jnp.asarray(x[:, ::-1].copy())))[:, -1]
+        np.testing.assert_allclose(out, np.concatenate([f, b], -1),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_prelu_temporal_uses_shared_slope(self):
+        RandomGenerator.set_seed(0)
+        m = K.PReLU().build((12, 4))   # (steps, features) temporal input
+        assert m.get_params()["weight"].shape == (1,)  # ONE shared slope
+        m2 = K.PReLU().build((8, 6, 6))  # NCHW-style
+        assert m2.get_params()["weight"].shape == (8,)  # per-channel

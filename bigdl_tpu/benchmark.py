@@ -233,11 +233,15 @@ def _measure_int8_infer(model_name: str, batch: int, iters: int) -> dict:
         float(jnp.sum(out))  # terminal sync
         return batch * iters / (time.perf_counter() - t0)
 
+    wmodel = model.quantize(mode="weight_only").evaluate()
     bf16_ips = timed(model, cast_bf16=True)
     int8_ips = timed(qmodel, cast_bf16=False)
+    wonly_ips = timed(wmodel, cast_bf16=True)
     return {"bf16_infer_ips": round(bf16_ips, 1),
             "int8_infer_ips": round(int8_ips, 1),
-            "int8_bf16_ratio": round(int8_ips / bf16_ips, 2)}
+            "int8_bf16_ratio": round(int8_ips / bf16_ips, 2),
+            "int8_weight_only_ips": round(wonly_ips, 1),
+            "weight_only_bf16_ratio": round(wonly_ips / bf16_ips, 2)}
 
 
 def run_worker(args) -> None:

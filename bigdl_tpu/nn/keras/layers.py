@@ -985,6 +985,10 @@ class Bidirectional(KerasLayer):
             raise ValueError("merge_mode must be 'concat' or 'sum'")
         if not isinstance(layer, _RecurrentLayer):
             raise TypeError("Bidirectional wraps a recurrent keras layer")
+        if layer.go_backwards:
+            raise ValueError(
+                "Bidirectional already runs both directions; go_backwards on "
+                "the wrapped layer has no keras-consistent meaning here")
         self.layer = layer
         self.merge_mode = merge_mode
 

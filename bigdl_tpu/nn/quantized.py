@@ -14,6 +14,18 @@ fused epilogue rescales to fp32 and adds bias. No JNI/AVX analog is needed — t
 
 Quantized modules are inference-only (the reference's are too): ``apply`` under
 ``training=True`` raises.
+
+Two modes (measured on v5e — see docs/performance.md):
+
+- ``mode="dynamic"`` (default; the bigquant semantics): int8 activations AND
+  weights, int8×int8→int32 on the MXU. On this XLA version the int8 conv path
+  runs at ≈bf16 speed, so the dynamic activation-quantization pass (a full
+  HBM round trip per quantized layer) makes conv nets ~1.8× SLOWER than bf16.
+- ``mode="weight_only"``: weights stored int8 (half of bf16, quarter of fp32
+  HBM) and dequantized into the compute dtype at use; activations untouched —
+  most of bf16 speed (measured 0.77× on v5e ResNet-50; the dequant is not
+  fully fused), the memory win kept. The pragmatic choice for serving big
+  models on TPU; kept opt-in for reference-semantics parity.
 """
 
 from __future__ import annotations
@@ -56,8 +68,12 @@ class _QuantizedBase(TensorModule):
 class QuantizedLinear(_QuantizedBase):
     """Int8 Linear: y = (x_q @ w_q^T) * (s_x * s_w) + b."""
 
-    def __init__(self, input_size: int, output_size: int, with_bias: bool = True):
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 mode: str = "dynamic"):
         super().__init__()
+        if mode not in ("dynamic", "weight_only"):
+            raise ValueError(f"mode must be dynamic|weight_only, got {mode!r}")
+        self.mode = mode
         self.input_size = input_size
         self.output_size = output_size
         self.with_bias = with_bias
@@ -69,8 +85,8 @@ class QuantizedLinear(_QuantizedBase):
             self._params["bias"] = jnp.zeros((output_size,), jnp.float32)
 
     @classmethod
-    def from_float(cls, m: Linear) -> "QuantizedLinear":
-        q = cls(m.input_size, m.output_size, with_bias=m.with_bias)
+    def from_float(cls, m: Linear, mode: str = "dynamic") -> "QuantizedLinear":
+        q = cls(m.input_size, m.output_size, with_bias=m.with_bias, mode=mode)
         w_q, scale = _quantize_weight(np.asarray(m.get_params()["weight"]))
         params = {"weight_q": jnp.asarray(w_q), "w_scale": jnp.asarray(scale)}
         if m.with_bias:
@@ -87,13 +103,18 @@ class QuantizedLinear(_QuantizedBase):
             x = x.reshape(x.shape[0], -1)
         elif x.ndim == 1:
             x = x[None]
-        x_q, s_x = _quantize_activation(x)
-        # int8 x int8 → int32 accumulate: the MXU integer path
-        acc = lax.dot_general(
-            x_q, params["weight_q"],
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32)
-        out = acc.astype(jnp.float32) * (s_x * params["w_scale"][None, :])
+        if self.mode == "weight_only":
+            w = params["weight_q"].astype(x.dtype) \
+                * params["w_scale"][:, None].astype(x.dtype)
+            out = (x @ w.T).astype(jnp.float32)
+        else:
+            x_q, s_x = _quantize_activation(x)
+            # int8 x int8 → int32 accumulate: the MXU integer path
+            acc = lax.dot_general(
+                x_q, params["weight_q"],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (s_x * params["w_scale"][None, :])
         if self.with_bias:
             out = out + params["bias"][None, :]
         if input.ndim == 1:
@@ -110,8 +131,11 @@ class QuantizedSpatialConvolution(_QuantizedBase):
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
                  pad_w: int = 0, pad_h: int = 0, n_group: int = 1,
-                 with_bias: bool = True):
+                 with_bias: bool = True, mode: str = "dynamic"):
         super().__init__()
+        if mode not in ("dynamic", "weight_only"):
+            raise ValueError(f"mode must be dynamic|weight_only, got {mode!r}")
+        self.mode = mode
         self.n_input_plane = n_input_plane
         self.n_output_plane = n_output_plane
         self.kernel_w, self.kernel_h = kernel_w, kernel_h
@@ -128,10 +152,11 @@ class QuantizedSpatialConvolution(_QuantizedBase):
             self._params["bias"] = jnp.zeros((n_output_plane,), jnp.float32)
 
     @classmethod
-    def from_float(cls, m: SpatialConvolution) -> "QuantizedSpatialConvolution":
+    def from_float(cls, m: SpatialConvolution,
+                   mode: str = "dynamic") -> "QuantizedSpatialConvolution":
         q = cls(m.n_input_plane, m.n_output_plane, m.kernel_w, m.kernel_h,
                 m.stride_w, m.stride_h, m.pad_w, m.pad_h, m.n_group,
-                with_bias=m.with_bias)
+                with_bias=m.with_bias, mode=mode)
         w_q, scale = _quantize_weight(np.asarray(m.get_params()["weight"]))
         params = {"weight_q": jnp.asarray(w_q), "w_scale": jnp.asarray(scale)}
         if m.with_bias:
@@ -146,15 +171,26 @@ class QuantizedSpatialConvolution(_QuantizedBase):
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
-        x_q, s_x = _quantize_activation(x)
-        acc = lax.conv_general_dilated(
-            x_q, params["weight_q"],
-            window_strides=(self.stride_h, self.stride_w),
-            padding=_conv_padding(self.pad_w, self.pad_h),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            feature_group_count=self.n_group,
-            preferred_element_type=jnp.int32)
-        out = acc.astype(jnp.float32) * (s_x * params["w_scale"][None, :, None, None])
+        if self.mode == "weight_only":
+            w = params["weight_q"].astype(x.dtype) \
+                * params["w_scale"][:, None, None, None].astype(x.dtype)
+            out = lax.conv_general_dilated(
+                x, w,
+                window_strides=(self.stride_h, self.stride_w),
+                padding=_conv_padding(self.pad_w, self.pad_h),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=self.n_group).astype(jnp.float32)
+        else:
+            x_q, s_x = _quantize_activation(x)
+            acc = lax.conv_general_dilated(
+                x_q, params["weight_q"],
+                window_strides=(self.stride_h, self.stride_w),
+                padding=_conv_padding(self.pad_w, self.pad_h),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=self.n_group,
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) \
+                * (s_x * params["w_scale"][None, :, None, None])
         if self.with_bias:
             out = out + params["bias"][None, :, None, None]
         if squeeze:
@@ -166,26 +202,30 @@ class QuantizedSpatialConvolution(_QuantizedBase):
                 f"{self.n_output_plane}, {self.kernel_w}x{self.kernel_h}, int8)")
 
 
-def quantize_module(m: AbstractModule) -> AbstractModule:
+def quantize_module(m: AbstractModule, mode: str = "dynamic") -> AbstractModule:
     """Deep-convert: Linear/SpatialConvolution leaves → int8 modules; everything
     else is cloned unchanged. The original module is not modified (reference
-    ``module.quantize()`` also returns a new module)."""
+    ``module.quantize()`` also returns a new module). ``mode``: "dynamic"
+    (int8 activations+weights) or "weight_only" (int8 weights dequantized at
+    use — most of bf16 speed, half the weight HBM)."""
+    if mode not in ("dynamic", "weight_only"):
+        raise ValueError(f"mode must be dynamic|weight_only, got {mode!r}")
     from bigdl_tpu.nn.graph import Graph
 
     # exact types only: subclasses may change apply() semantics and fall
     # through to clone() unchanged
     if type(m) is Linear:
-        return QuantizedLinear.from_float(m)
+        return QuantizedLinear.from_float(m, mode)
     if type(m) is SpatialConvolution:
-        return QuantizedSpatialConvolution.from_float(m)
+        return QuantizedSpatialConvolution.from_float(m, mode)
     if isinstance(m, Graph):
         g = m.clone()
         for n in g.exec_nodes:
-            n.module = quantize_module(n.module)
+            n.module = quantize_module(n.module, mode)
         g.modules = [n.module for n in g.exec_nodes]
         return g
     if isinstance(m, Container):
         q = m.clone()
-        q.modules = [quantize_module(c) for c in m.modules]
+        q.modules = [quantize_module(c, mode) for c in m.modules]
         return q
     return m.clone()

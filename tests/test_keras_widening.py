@@ -175,3 +175,7 @@ class TestReviewFixesKeras:
         assert m.get_params()["weight"].shape == (1,)  # ONE shared slope
         m2 = K.PReLU().build((8, 6, 6))  # NCHW-style
         assert m2.get_params()["weight"].shape == (8,)  # per-channel
+
+    def test_bidirectional_rejects_go_backwards(self):
+        with pytest.raises(ValueError, match="go_backwards"):
+            K.Bidirectional(K.LSTM(4, go_backwards=True))

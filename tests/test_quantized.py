@@ -148,3 +148,44 @@ class TestQuantizedSerialization:
         np.testing.assert_allclose(np.asarray(q.evaluate().forward(x)),
                                    np.asarray(loaded.evaluate().forward(x)),
                                    rtol=1e-6)
+
+
+class TestWeightOnlyMode:
+    def test_weight_only_matches_float_within_quant_error(self):
+        import numpy as np
+        from bigdl_tpu import nn
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        RandomGenerator.set_seed(0)
+        m = nn.Sequential()
+        m.add(nn.SpatialConvolution(2, 4, 3, 3, pad_w=1, pad_h=1))
+        m.add(nn.ReLU()).add(nn.Flatten()).add(nn.Linear(4 * 6 * 6, 5))
+        m.evaluate()
+        q = m.quantize(mode="weight_only").evaluate()
+        import jax.numpy as jnp
+        x = jnp.asarray(np.random.default_rng(0)
+                        .normal(size=(2, 2, 6, 6)).astype(np.float32))
+        a = np.asarray(m.forward(x))
+        b = np.asarray(q.forward(x))
+        # int8 per-channel weight error only (no activation quantization)
+        assert np.abs(a - b).max() / (np.abs(a).max() + 1e-6) < 0.02
+
+    def test_mode_validation(self):
+        import pytest as _pt
+        from bigdl_tpu import nn
+        with _pt.raises(ValueError, match="dynamic|weight_only"):
+            nn.QuantizedLinear(4, 3, mode="bogus")
+
+    def test_weight_only_is_smaller(self):
+        from bigdl_tpu import nn
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        RandomGenerator.set_seed(0)
+        m = nn.Linear(64, 64)
+        q = m.quantize(mode="weight_only")
+        assert q._params["weight_q"].dtype.name == "int8"
+
+    def test_quantize_module_validates_mode_at_entry(self):
+        import pytest as _pt
+        from bigdl_tpu import nn
+        model = nn.Sequential().add(nn.ReLU())  # no quantizable leaves
+        with _pt.raises(ValueError, match="dynamic|weight_only"):
+            model.quantize(mode="weight-only")

@@ -236,6 +236,8 @@ EXAMPLES = {
     "Cropping3D": (lambda: nn.Cropping3D((1, 0), (0, 1), (1, 1)),
                    _x(1, 2, 4, 4, 4)),
     "Remat": (lambda: nn.Remat(nn.Linear(4, 3)), _x(2, 4)),
+    "TemporalAveragePooling": (lambda: nn.TemporalAveragePooling(2),
+                               _x(2, 6, 3)),
     # round-3 recurrent sweep
     "RecurrentDecoder": (lambda: nn.RecurrentDecoder(3, nn.RnnCell(4, 4)),
                          _x(2, 4)),

@@ -16,6 +16,7 @@ fuses into the preceding conv epilogue); shortcut type A's zero-pad + stride is 
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from bigdl_tpu import nn
@@ -32,12 +33,17 @@ class _ShortcutA(TensorModule):
         self.n_in, self.n_out, self.stride = n_in, n_out, stride
 
     def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.nn import layout
         x = input
+        nhwc = layout.is_nhwc()
         if self.stride != 1:
-            x = x[:, :, ::self.stride, ::self.stride]
+            s = self.stride
+            x = x[:, ::s, ::s, :] if nhwc else x[:, :, ::s, ::s]
         if self.n_out > self.n_in:
             pad = self.n_out - self.n_in
-            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            widths = ((0, 0), (0, 0), (0, 0), (0, pad)) if nhwc \
+                else ((0, 0), (0, pad), (0, 0), (0, 0))
+            x = jnp.pad(x, widths)
         return x, state
 
 
@@ -97,9 +103,75 @@ def bottleneck(n_in: int, n_mid: int, stride: int, shortcut_type: str,
             .add(nn.ReLU()))
 
 
+class _Conv1SpaceToDepth(TensorModule):
+    """ImageNet stem conv (7x7 stride-2 pad-3, no bias) in space-to-depth form
+    (the MLPerf ResNet TPU trick): the input is space-to-depth'd 2x2 on device
+    (one cheap reshape+transpose) and the conv becomes 4x4 stride-1 over 12
+    channels — much better MXU tiling than a 3-channel 7x7.
+
+    The trainable weight IS the (64, 12, 4, 4) tensor, initialised as the exact
+    rearrangement of an MSRA 7x7x3 stem; the 15 positions with no 7x7 pre-image
+    (the implicit 8th tap) start at zero, so at init the output equals the plain
+    conv bit-for-bit (verified by test). They train afterwards — equivalent to
+    an 8x8 stride-2 stem, a strict superset of the reference's 7x7.
+    """
+
+    def __init__(self, n_out: int = 64):
+        super().__init__()
+        self.n_out = n_out
+        self.reset()
+
+    def reset(self) -> None:
+        import numpy as np
+        # same fan_in/fan_out as the plain 7x7 stem's SpatialConvolution.reset
+        # (fan_out includes the kernel taps) so the init distribution matches
+        w7 = np.asarray(MsraFiller().init((self.n_out, 3, 7, 7),
+                                          fan_in=3 * 7 * 7,
+                                          fan_out=self.n_out * 7 * 7))
+        self._params = {"weight": jnp.asarray(self.transform_7x7(w7))}
+        self.zero_grad_parameters()
+
+    @staticmethod
+    def transform_7x7(w7):
+        """(O, 3, 7, 7) stem weights → the equivalent (O, 12, 4, 4) s2d weights.
+
+        Output position o reads input p = 2o + k - 3 (k in 0..6). Writing
+        p = 2m + r (r the parity), the s2d tap index is mh = m - o + 2 in 0..3
+        and the s2d channel is rh*6 + rw*3 + c (matching the reshape below).
+        """
+        import numpy as np
+        o, c_in = w7.shape[0], w7.shape[1]
+        w4 = np.zeros((o, 4 * c_in, 4, 4), w7.dtype)
+        for kh in range(7):
+            rh, mh = (kh - 3) % 2, ((kh - 3) - (kh - 3) % 2) // 2 + 2
+            for kw in range(7):
+                rw, mw = (kw - 3) % 2, ((kw - 3) - (kw - 3) % 2) // 2 + 2
+                for c in range(c_in):
+                    w4[:, rh * 2 * c_in + rw * c_in + c, mh, mw] = w7[:, c, kh, kw]
+        return w4
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.nn import layout
+        x = input
+        if layout.is_nhwc():
+            n, h, w, c = x.shape
+            xs = x.reshape(n, h // 2, 2, w // 2, 2, c) \
+                  .transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+        else:
+            n, c, h, w = x.shape
+            xs = x.reshape(n, c, h // 2, 2, w // 2, 2) \
+                  .transpose(0, 3, 5, 1, 2, 4).reshape(n, 4 * c, h // 2, w // 2)
+        out = jax.lax.conv_general_dilated(
+            xs, params["weight"], window_strides=(1, 1),
+            padding=((2, 1), (2, 1)),
+            dimension_numbers=layout.conv_dimension_numbers())
+        return out, state
+
+
 class _GlobalAvgPool(TensorModule):
     def apply(self, params, state, input, *, training=False, rng=None):
-        return jnp.mean(input, axis=(2, 3)), state
+        from bigdl_tpu.nn import layout
+        return jnp.mean(input, axis=layout.spatial_axes(input.ndim)), state
 
 
 # (depth -> (block kind, per-stage counts)) for ImageNet variants
@@ -123,7 +195,13 @@ def ResNet(class_num: int, opt: Table | dict | None = None) -> nn.Sequential:
     model = nn.Sequential()
     if dataset == "ImageNet":
         kind, counts = _IMAGENET_CFG[depth]
-        model.add(conv_bn(3, 64, 7, 2, 3))
+        if opt.get("conv1SpaceToDepth"):
+            model.add(nn.Sequential()
+                      .add(_Conv1SpaceToDepth(64))
+                      .add(nn.SpatialBatchNormalization(64))
+                      .add(nn.ReLU()))
+        else:
+            model.add(conv_bn(3, 64, 7, 2, 3))
         model.add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
         n_in = 64
         for stage, n_blocks in enumerate(counts):

@@ -99,8 +99,9 @@ class PReLU(TensorModule):
     def apply(self, params, state, input, *, training=False, rng=None):
         w = params["weight"]
         if self.n_output_plane > 0 and input.ndim >= 3:
+            from bigdl_tpu.nn import layout
             shape = [1] * input.ndim
-            shape[1] = self.n_output_plane  # channel axis of NCHW
+            shape[layout.channel_axis(input.ndim)] = self.n_output_plane
             w = w.reshape(shape)
         return jnp.where(input > 0, input, w * input), state
 

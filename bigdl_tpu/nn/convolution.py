@@ -68,6 +68,7 @@ class SpatialConvolution(TensorModule):
         self.zero_grad_parameters()
 
     def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.nn import layout
         x = input
         squeeze = x.ndim == 3
         if squeeze:
@@ -76,11 +77,12 @@ class SpatialConvolution(TensorModule):
             x, params["weight"],
             window_strides=(self.stride_h, self.stride_w),
             padding=_conv_padding(self.pad_w, self.pad_h),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=layout.conv_dimension_numbers(),
             feature_group_count=self.n_group,
         )
         if self.with_bias:
-            out = out + params["bias"][None, :, None, None]
+            out = out + params["bias"].reshape(layout.bias_shape(
+                self.n_output_plane))
         if squeeze:
             out = out[0]
         return out, state

@@ -1,0 +1,99 @@
+"""Image data layout policy: NCHW (reference parity default) vs NHWC.
+
+The reference fixes NCHW activations end-to-end (SURVEY.md §2.1 layer
+conventions). On TPU, XLA's layout assignment makes channel the minor (lane)
+dimension internally regardless of the logical order, but a logical-NCHW feed
+still pays entry/exit transposes and splits activations across two internal
+layouts inside one program (measured on v5e: ~3.5 ms/step of pure layout churn
+in the ResNet-50 train step). ``set_image_format("NHWC")`` switches the spatial
+layers (SpatialConvolution / SpatialBatchNormalization / pooling / the zoo's
+spatial glue) to channels-last so the logical layout matches the physical one.
+
+Semantics: the format is read at TRACE time. Set it before building/jitting a
+model; a live jitted step keeps the format it was traced with. Parameter
+layouts (OIHW conv weights) are format-independent — checkpoints and the
+portable serializer are unaffected by the activation layout.
+
+Layers honoring the flag: SpatialConvolution (+Share/Map subclasses),
+SpatialBatchNormalization, SpatialMaxPooling, SpatialAveragePooling,
+SpatialDropout2D, SpatialCrossMapLRN, PReLU, UpSampling2D, and the ResNet
+zoo glue (shortcut-A / global-avg-pool / s2d stem). The long tail of exotic
+spatial layers (dilated/full conv, within-channel LRN, subtractive/divisive
+norm, volumetric 3-D ops, ROI ops, keras wrappers) remains NCHW-only — build
+those models with the default format.
+"""
+
+from __future__ import annotations
+
+import os
+
+_FORMAT: str | None = None
+
+_VALID = ("NCHW", "NHWC")
+
+
+def image_format() -> str:
+    """Current image format: explicit ``set_image_format`` wins, else
+    ``BIGDL_IMAGE_FORMAT`` (default NCHW)."""
+    if _FORMAT is not None:
+        return _FORMAT
+    fmt = os.environ.get("BIGDL_IMAGE_FORMAT", "NCHW").upper()
+    return fmt if fmt in _VALID else "NCHW"
+
+
+def set_image_format(fmt: str | None) -> None:
+    """Set the process-wide image format (``None`` → back to env/default)."""
+    global _FORMAT
+    if fmt is not None:
+        fmt = fmt.upper()
+        if fmt not in _VALID:
+            raise ValueError(f"image format must be one of {_VALID}, got {fmt!r}")
+    _FORMAT = fmt
+
+
+def is_nhwc() -> bool:
+    return image_format() == "NHWC"
+
+
+def channel_axis(ndim: int = 4) -> int:
+    """Axis holding channels for a spatial tensor of ``ndim`` dims (4 = NCHW/NHWC,
+    3 = unbatched CHW/HWC)."""
+    return ndim - 3 if not is_nhwc() else ndim - 1
+
+
+def spatial_axes(ndim: int = 4) -> tuple[int, int]:
+    """(H, W) axes for a spatial tensor of ``ndim`` dims."""
+    if is_nhwc():
+        return ndim - 3, ndim - 2
+    return ndim - 2, ndim - 1
+
+
+def conv_dimension_numbers() -> tuple[str, str, str]:
+    """lax.conv dimension numbers for the current format. Weights stay OIHW in
+    both formats (parameter-layout parity: serialization and imports never see
+    the activation layout)."""
+    if is_nhwc():
+        return ("NHWC", "OIHW", "NHWC")
+    return ("NCHW", "OIHW", "NCHW")
+
+
+def spatial_window(kh: int, kw: int, one: int = 1) -> tuple[int, int, int, int]:
+    """4-tuple (per-axis window/stride) with (kh, kw) on the spatial axes."""
+    if is_nhwc():
+        return (one, kh, kw, one)
+    return (one, one, kh, kw)
+
+
+def spatial_padding(ph, pw) -> tuple:
+    """4-tuple of (lo, hi) pads with (ph, pw) on the spatial axes."""
+    zero = (0, 0)
+    if is_nhwc():
+        return (zero, ph, pw, zero)
+    return (zero, zero, ph, pw)
+
+
+def bias_shape(n: int, ndim: int = 4) -> tuple[int, ...]:
+    """Broadcast shape for a per-channel (n,) vector against a spatial tensor."""
+    shape = [1] * ndim
+    shape[channel_axis(ndim)] = n
+    return tuple(shape)

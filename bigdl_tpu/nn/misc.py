@@ -517,16 +517,18 @@ class UpSampling1D(TensorModule):
 
 
 class UpSampling2D(TensorModule):
-    """Nearest-neighbor upsample NCHW by (size_h, size_w) (reference
-    ``UpSampling2D``)."""
+    """Nearest-neighbor upsample by (size_h, size_w) (reference
+    ``UpSampling2D``; spatial axes follow ``nn.layout``)."""
 
     def __init__(self, size=(2, 2)):
         super().__init__()
         self.size = (int(size[0]), int(size[1]))
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        out = jnp.repeat(input, self.size[0], axis=-2)
-        return jnp.repeat(out, self.size[1], axis=-1), state
+        from bigdl_tpu.nn import layout
+        ha, wa = layout.spatial_axes(input.ndim)
+        out = jnp.repeat(input, self.size[0], axis=ha)
+        return jnp.repeat(out, self.size[1], axis=wa), state
 
 
 class UpSampling3D(TensorModule):

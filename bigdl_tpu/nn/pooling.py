@@ -70,11 +70,13 @@ class SpatialMaxPooling(TensorModule):
         return self
 
     def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.nn import layout
         x = input
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
-        h, w = x.shape[2], x.shape[3]
+        ha, wa = layout.spatial_axes(4)
+        h, w = x.shape[ha], x.shape[wa]
         if self.pad_mode == "same":
             ph_lo, ph_hi = _same_pad(h, self.kh, self.dh)
             pw_lo, pw_hi = _same_pad(w, self.kw, self.dw)
@@ -83,9 +85,9 @@ class SpatialMaxPooling(TensorModule):
             pw_lo, pw_hi, _ = _pad_amounts(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
         out = lax.reduce_window(
             x, -jnp.inf, lax.max,
-            window_dimensions=(1, 1, self.kh, self.kw),
-            window_strides=(1, 1, self.dh, self.dw),
-            padding=((0, 0), (0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi)),
+            window_dimensions=layout.spatial_window(self.kh, self.kw),
+            window_strides=layout.spatial_window(self.dh, self.dw),
+            padding=layout.spatial_padding((ph_lo, ph_hi), (pw_lo, pw_hi)),
         )
         if squeeze:
             out = out[0]
@@ -122,11 +124,13 @@ class SpatialAveragePooling(TensorModule):
         return self
 
     def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.nn import layout
         x = input
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
-        h, w = x.shape[2], x.shape[3]
+        ha, wa = layout.spatial_axes(4)
+        h, w = x.shape[ha], x.shape[wa]
         kh, kw = (h, w) if self.global_pooling else (self.kh, self.kw)
         dh, dw = (1, 1) if self.global_pooling else (self.dh, self.dw)
         if self.pad_mode == "same":
@@ -139,15 +143,17 @@ class SpatialAveragePooling(TensorModule):
             pw_lo, pw_hi, _ = _pad_amounts(w, kw, dw, self.pad_w, self.ceil_mode)
             include_pad_in_count = self.count_include_pad and (
                 self.pad_h > 0 or self.pad_w > 0)
-        pad = ((0, 0), (0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi))
+        pad = layout.spatial_padding((ph_lo, ph_hi), (pw_lo, pw_hi))
+        window = layout.spatial_window(kh, kw)
+        strides = layout.spatial_window(dh, dw)
         # fp32 island (nn/precision.py): window sums are reductions — under bf16
         # a global pool over H*W values would lose ~1% relative accuracy, so
         # accumulate fp32 and cast back at the end (same rule as BN statistics).
         x32 = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
         sums = lax.reduce_window(
             x32, 0.0, lax.add,
-            window_dimensions=(1, 1, kh, kw),
-            window_strides=(1, 1, dh, dw),
+            window_dimensions=window,
+            window_strides=strides,
             padding=pad,
         )
         no_pad = ph_lo == ph_hi == pw_lo == pw_hi == 0
@@ -156,11 +162,12 @@ class SpatialAveragePooling(TensorModule):
         elif include_pad_in_count or no_pad:
             out = sums / float(kh * kw)
         else:
-            ones = jnp.ones((1, 1, h, w), jnp.float32)
+            ones_shape = (1, 1, h, w) if not layout.is_nhwc() else (1, h, w, 1)
+            ones = jnp.ones(ones_shape, jnp.float32)
             counts = lax.reduce_window(
                 ones, 0.0, lax.add,
-                window_dimensions=(1, 1, kh, kw),
-                window_strides=(1, 1, dh, dw),
+                window_dimensions=window,
+                window_strides=strides,
                 padding=pad,
             )
             out = sums / jnp.maximum(counts, 1.0)

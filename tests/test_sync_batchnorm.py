@@ -1,0 +1,113 @@
+"""Cross-replica sync-BatchNorm (SURVEY.md §7.4, round-4 verdict item 6).
+
+``SpatialBatchNormalization(sync=True)`` pmean's the batch moments over the
+named mesh axis inside a ``shard_map`` body, so data-parallel shards normalise
+with GLOBAL-batch statistics. Done-criterion test: sync stats on a dp-split
+batch equal single-device stats on the same global batch; sync=False (default)
+keeps per-shard statistics (reference per-worker BN behavior).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu import nn
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:4]), ("data",))
+
+
+def _shard_apply(module, x, training=True):
+    params, state = module.get_params(), module.get_state()
+
+    def body(p, s, xx):
+        out, new_s = module.apply(p, s, xx, training=training, rng=None)
+        return out, new_s
+
+    fn = jax.shard_map(body, mesh=_mesh(),
+                       in_specs=(P(), P(), P("data")),
+                       out_specs=(P("data"), P()))
+    return fn(params, state, jnp.asarray(x))
+
+
+def test_sync_bn_matches_global_batch_stats():
+    rng = np.random.default_rng(0)
+    x = (rng.normal(size=(8, 5, 6, 6)) * 2.0 + 3.0).astype(np.float32)
+
+    ref = nn.SpatialBatchNormalization(5)
+    ref_out, ref_state = ref.apply(ref.get_params(), ref.get_state(),
+                                   jnp.asarray(x), training=True)
+
+    sync = nn.SpatialBatchNormalization(5, sync=True)
+    sync.set_params(ref.get_params())
+    out, new_state = _shard_apply(sync, x)
+
+    assert np.allclose(ref_out, out, atol=1e-5)
+    assert np.allclose(ref_state["running_mean"], new_state["running_mean"],
+                       atol=1e-6)
+    # unbiased correction uses the GLOBAL n (per-shard n would inflate var)
+    assert np.allclose(ref_state["running_var"], new_state["running_var"],
+                       atol=1e-5)
+
+
+def test_default_bn_is_per_shard():
+    rng = np.random.default_rng(1)
+    # make shards statistically different so per-shard != global
+    x = rng.normal(size=(8, 3, 4, 4)).astype(np.float32)
+    x[:4] += 10.0
+
+    ref = nn.SpatialBatchNormalization(3)
+    _, ref_state = ref.apply(ref.get_params(), ref.get_state(),
+                             jnp.asarray(x), training=True)
+
+    per_shard = nn.SpatialBatchNormalization(3)
+    per_shard.set_params(ref.get_params())
+    params, state = per_shard.get_params(), per_shard.get_state()
+
+    def body(p, s, xx):
+        _, new_s = per_shard.apply(p, s, xx, training=True, rng=None)
+        # stats are shard-varying here — stack them for inspection
+        return new_s["running_var"][None]
+
+    fn = jax.shard_map(body, mesh=_mesh(),
+                       in_specs=(P(), P(), P("data")), out_specs=P("data"))
+    shard_vars = np.asarray(fn(params, state, jnp.asarray(x)))
+    assert shard_vars.shape[0] == 4
+    # per-shard running_var misses the cross-shard mean offset entirely
+    for i in range(4):
+        assert not np.allclose(ref_state["running_var"], shard_vars[i],
+                               rtol=0.2)
+
+
+def test_sync_bn_trains_through_grad():
+    """pmean participates in autodiff: grads flow and match the single-device
+    global-batch gradient."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(8, 4, 3, 3)).astype(np.float32)
+
+    ref = nn.SpatialBatchNormalization(4)
+    params = ref.get_params()
+    state = ref.get_state()
+
+    def ref_loss(p):
+        out, _ = ref.apply(p, state, jnp.asarray(x), training=True)
+        return jnp.sum(out ** 2)
+
+    g_ref = jax.grad(ref_loss)(params)
+
+    sync = nn.SpatialBatchNormalization(4, sync=True)
+
+    def sharded_loss(p):
+        def body(pp, xx):
+            out, _ = sync.apply(pp, state, xx, training=True)
+            return jax.lax.psum(jnp.sum(out ** 2), "data")
+
+        fn = jax.shard_map(body, mesh=_mesh(),
+                           in_specs=(P(), P("data")), out_specs=P())
+        return fn(p, jnp.asarray(x))
+
+    g_sync = jax.grad(sharded_loss)(params)
+    for k in g_ref:
+        assert np.allclose(g_ref[k], g_sync[k], atol=1e-4), k

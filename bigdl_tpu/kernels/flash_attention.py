@@ -14,10 +14,16 @@ lives in VMEM scratch carried across k iterations, initialised at k==0 and
 flushed to the output block at the last k step — the standard Pallas
 accumulation pattern.
 
-Semantics: forward = Pallas kernel on TPU (interpreter elsewhere — tests);
-backward = recompute-form VJP of the reference jnp attention
-(rematerialisation: one extra fused forward instead of stashing the
-probability matrix — same trade as kernels/layernorm.py).
+Semantics: forward AND backward are Pallas kernels on TPU (interpreter
+elsewhere — tests). The backward is the standard flash-2 scheme: the forward
+additionally saves the per-row logsumexp L = m + log(l); backward recomputes
+each (block_q, block_k) probability tile from (q, k, L) in VMEM and streams
+  dq += (p * (dO·v^T - D)) · k,   dv += p^T · dO,   dk += ds^T · q
+with D = rowsum(dO * O) precomputed in one fused elementwise pass — so
+TRAINING memory is O(T·d) too, not just inference (the O(T^2) score matrix is
+never materialised in either direction; asserted by test against the compiled
+HLO). Off-TPU (or if the kernel build fails) the recompute-form VJP of the
+reference jnp attention remains as fallback.
 """
 
 from __future__ import annotations
@@ -53,7 +59,7 @@ def _pallas_flash_call(q3, k3, v3, causal, block_q, block_k, interpret):
     scale = 1.0 / (d ** 0.5)
     n_k = t // block_k
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr):
         i = pl.program_id(1)
         j = pl.program_id(2)
 
@@ -96,6 +102,81 @@ def _pallas_flash_call(q3, k3, v3, causal, block_q, block_k, interpret):
         def _flush():
             denom = jnp.maximum(l_scr[:], 1e-37)
             o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+            # per-row logsumexp residual for the flash backward: rows with no
+            # live block (cannot happen causally — the diagonal is live) would
+            # be -inf; clamp through the same denom guard
+            lse_ref[0] = (m_scr[:] + jnp.log(denom))[:, 0]
+
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+                   jax.ShapeDtypeStruct((bh, t), jnp.float32)],
+        grid=(bh, t // block_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out, lse
+
+
+def _pallas_flash_bwd_dq(q3, k3, v3, do3, lse3, dd3, causal,
+                         block_q, block_k, interpret):
+    """dq = Σ_j (p_ij * (dO_i·v_j^T - D_i)) · k_j * scale, streaming over j
+    with the probability tile recomputed from (q, k, lse) in VMEM."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q3.shape
+    scale = 1.0 / (d ** 0.5)
+    n_k = t // block_k
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, acc_scr):
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _init():
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+        @pl.when(live)
+        def _step():
+            q = q_ref[0].astype(jnp.float32)
+            k = k_ref[0].astype(jnp.float32)
+            v = v_ref[0].astype(jnp.float32)
+            do = do_ref[0].astype(jnp.float32)
+            lse = lse_ref[0][:, None]                     # (bq, 1)
+            dd = dd_ref[0][:, None]                       # (bq, 1)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if causal:
+                qi = i * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                kj = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(kj <= qi, s, -jnp.inf)
+            p = jnp.exp(s - lse)                          # (bq, bk)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - dd) * scale
+            acc_scr[:] = acc_scr[:] + jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(j == n_k - 1)
+        def _flush():
+            dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
 
     return pl.pallas_call(
         kernel,
@@ -105,15 +186,92 @@ def _pallas_flash_call(q3, k3, v3, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q3, k3, v3)
+    )(q3, k3, v3, do3, lse3, dd3)
+
+
+def _pallas_flash_bwd_dkv(q3, k3, v3, do3, lse3, dd3, causal,
+                          block_q, block_k, interpret):
+    """dv = Σ_i p_ij^T · dO_i ; dk = Σ_i ds_ij^T · q_i * scale — grid iterates
+    k-blocks outer, q-blocks inner, with (dk, dv) accumulators in VMEM."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q3.shape
+    scale = 1.0 / (d ** 0.5)
+    n_q = t // block_q
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+               dk_ref, dv_ref, dk_scr, dv_scr):
+        j = pl.program_id(1)   # k block
+        i = pl.program_id(2)   # q block (innermost)
+
+        @pl.when(i == 0)
+        def _init():
+            dk_scr[:] = jnp.zeros_like(dk_scr)
+            dv_scr[:] = jnp.zeros_like(dv_scr)
+
+        # causal: a q block entirely above this k block contributes nothing
+        live = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+
+        @pl.when(live)
+        def _step():
+            q = q_ref[0].astype(jnp.float32)
+            k = k_ref[0].astype(jnp.float32)
+            v = v_ref[0].astype(jnp.float32)
+            do = do_ref[0].astype(jnp.float32)
+            lse = lse_ref[0][None, :]                     # (1, bq)
+            dd = dd_ref[0][None, :]                       # (1, bq)
+            # transposed orientation: s_T (bk, bq)
+            s_t = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32) * scale
+            if causal:
+                kj = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_k, block_q), 0)
+                qi = i * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_k, block_q), 1)
+                s_t = jnp.where(kj <= qi, s_t, -jnp.inf)
+            p_t = jnp.exp(s_t - lse)                      # (bk, bq)
+            dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+                p_t, do, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp_t = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+            ds_t = p_t * (dp_t - dd) * scale
+            dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+                ds_t, q, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(i == n_q - 1)
+        def _flush():
+            dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), v3.dtype)],
+        grid=(bh, t // block_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+                   pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, dd3)
 
 
 def _on_tpu() -> bool:
@@ -143,7 +301,7 @@ def flash_attention(q, k, v, causal: bool = False,
 
 def _fa_fwd(q, k, v, causal, force_pallas):
     use_pallas = _on_tpu() if force_pallas is None else force_pallas
-    out = None
+    out = lse = None
     if use_pallas:
         b, h, t, d = q.shape
         # measured on v5e (T=2048, d=64): 256/512 tiles amortise grid-step
@@ -156,9 +314,10 @@ def _fa_fwd(q, k, v, causal, force_pallas):
                 q3 = q.reshape(b * h, t, d)
                 k3 = k.reshape(b * h, t, d)
                 v3 = v.reshape(b * h, t, d)
-                out = _pallas_flash_call(
+                out, lse = _pallas_flash_call(
                     q3, k3, v3, causal, block_q, block_k,
-                    interpret=not _on_tpu()).reshape(b, h, t, d)
+                    interpret=not _on_tpu())
+                out = out.reshape(b, h, t, d)
             except Exception as e:  # pallas unavailable → reference
                 global _fallback_warned
                 if not _fallback_warned:
@@ -168,17 +327,48 @@ def _fa_fwd(q, k, v, causal, force_pallas):
                         "falling back to O(T^2) reference attention — "
                         "long-context memory/speed benefits are lost",
                         type(e).__name__, e)
-                out = None
+                out = lse = None
     if out is None:
         out = _reference_attention(q, k, v, causal)
-    return out, (q, k, v)
+        return out, (q, k, v, None, None)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, force_pallas, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
+    if lse is not None:
+        try:
+            return _flash_bwd(q, k, v, out, lse, g, causal)
+        except Exception as e:  # pallas bwd unavailable → reference VJP
+            global _fallback_warned
+            if not _fallback_warned:
+                _fallback_warned = True
+                logger.warning(
+                    "flash_attention Pallas backward failed (%s: %s); "
+                    "falling back to the O(T^2) reference VJP",
+                    type(e).__name__, e)
     _, vjp = jax.vjp(
         lambda qq, kk, vv: _reference_attention(qq, kk, vv, causal), q, k, v)
     return vjp(g)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal):
+    """Streaming flash-2 backward: O(T·d) memory, probability tiles recomputed
+    from (q, k, lse) in VMEM."""
+    b, h, t, d = q.shape
+    block_q, block_k = _pick_block(t, 128), _pick_block(t, 128)
+    reshape = lambda a: a.reshape(b * h, t, d)
+    q3, k3, v3, do3 = reshape(q), reshape(k), reshape(v), reshape(g)
+    # D_i = rowsum(dO * O): one fused elementwise pass, O(T·d) reads
+    dd3 = jnp.sum(do3.astype(jnp.float32) * reshape(out).astype(jnp.float32),
+                  axis=-1)
+    interp = not _on_tpu()
+    dq = _pallas_flash_bwd_dq(q3, k3, v3, do3, lse, dd3, causal,
+                              block_q, block_k, interp)
+    dk, dv = _pallas_flash_bwd_dkv(q3, k3, v3, do3, lse, dd3, causal,
+                                   block_q, block_k, interp)
+    unshape = lambda a, like: a.reshape(b, h, t, d).astype(like.dtype)
+    return unshape(dq, q), unshape(dk, k), unshape(dv, v)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -1,61 +1,105 @@
 """Pipeline parallelism — GPipe-style stage sharding over the ``pipe`` axis.
 
 No reference counterpart (SURVEY.md §2.3 checklist: PP absent upstream —
-design headroom for the TPU build, like ring attention and MoE). Homogeneous
-stages (identical pytree structure, input shape = output shape) are stacked on
-a leading stage dim sharded over the mesh's ``pipe`` axis; under ``shard_map``
-each device holds one stage and the classic GPipe schedule runs: at tick ``t``
-a device applies its stage to the activation it received, then ``ppermute``\\ s
-the result to its right neighbor. After ``M + S - 1`` ticks every microbatch
-has crossed all ``S`` stages. The backward schedule needs no hand-written code:
-jax reverse-mode differentiates through the ``lax.scan`` + ``ppermute`` chain,
-producing the reversed-communication backward pipeline automatically — the
-whole train step stays ONE jitted program.
+design headroom for the TPU build, like ring attention and MoE). Two stage
+models:
 
-Off-mesh (no ``pipe`` axis) the same microbatch loop runs without
+- **Homogeneous** (``GPipe(stage, n_stages=S)``): S clones of one module.
+  Per-stage params stack on a leading stage dim sharded over ``pipe`` — the
+  cheapest schedule, kept as the fast path.
+- **Heterogeneous** (``GPipe(stages=[embed, block, ..., head])``): arbitrary
+  per-stage modules whose param pytrees and boundary activation shapes may all
+  differ — the shape a real model needs (a TransformerLM's embedding, blocks
+  and tied head are not clones). SPMD still requires every device to run ONE
+  program, so per-rank stage dispatch is a ``lax.switch`` on the device's
+  ``pipe`` rank (XLA compiles all branches, each device executes its own), and
+  the two heterogeneous data planes are engineered flat:
+  * activations cross stage boundaries as zero-padded flat f32 buffers sized
+    to the largest boundary (each branch unflattens its own static shape);
+  * per-stage params are flattened, zero-padded to the largest stage and
+    stacked (S, P) with the stage dim sharded over ``pipe`` — each rank holds
+    ONLY its own stage's weights (true pipeline memory scaling), and each
+    switch branch reconstructs its stage's pytree from its row with static
+    offsets/dtypes.
+
+At tick ``t`` a device applies its stage, then ``ppermute``\\ s the flat buffer
+right; after ``M + S - 1`` ticks every microbatch crossed all stages. The
+backward pipeline needs no hand-written schedule: jax reverse-mode
+differentiates the ``scan`` + ``switch`` + ``ppermute`` chain, yielding the
+reversed-communication schedule automatically — the train step stays ONE
+jitted program. (A manual 1F1B interleave would need a hand-scheduled VJP; the
+GPipe-style all-forward-then-all-backward memory profile is what autodiff
+gives, softened by ``nn.Remat`` on stages when activations dominate.)
+
+Stages must be stateless — BatchNorm running stats would silently diverge per
+rank; use ``BatchNormalization(sync=True)`` inside ``shard_map`` data-parallel
+code instead, or LayerNorm in pipelined transformer stacks — and must not
+need RNG (build blocks with dropout=0).
+
+Off-mesh (no ``pipe`` axis) the same microbatch loop runs sequentially without
 communication, so tests and single-chip runs get identical math.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from bigdl_tpu.nn.abstractnn import AbstractModule, Container
 
 
+def _check_stage(stage: AbstractModule) -> AbstractModule:
+    if jax.tree_util.tree_leaves(stage.get_state()):
+        raise ValueError(
+            "GPipe stages must be stateless: per-rank running statistics "
+            "(e.g. BatchNorm) would silently diverge across pipeline ranks. "
+            "Use LayerNorm in pipelined stacks, or BatchNormalization("
+            "sync=True) under data-parallel shard_map instead.")
+    if stage.needs_rng():
+        raise ValueError(
+            "GPipe stages must not need RNG (build blocks with dropout=0); "
+            "the pipeline schedule replays stages across microbatch ticks")
+    return stage
+
+
 class GPipe(Container):
-    """Pipeline container: ``n_stages`` clones of ``stage`` composed
-    sequentially, executed as a pipeline over the ``pipe`` mesh axis when
-    present. Stages must be stateless (no BatchNorm running stats) and
-    shape-preserving (output shape == input shape)."""
+    """Pipeline container. ``GPipe(stage, n_stages=S)`` composes S fresh
+    clones; ``GPipe(stages=[...])`` pipelines arbitrary heterogeneous modules.
+    Executed as a pipeline over the ``pipe`` mesh axis when present."""
 
     def __init__(self, stage: Optional[AbstractModule] = None,
                  n_stages: int = 1, n_microbatches: int = 2,
-                 axis_name: str = "pipe"):
-        mods = []
-        if stage is not None:
-            if jax.tree_util.tree_leaves(stage.get_state()):
-                raise ValueError("GPipe stages must be stateless")
+                 axis_name: str = "pipe",
+                 stages: Optional[Sequence[AbstractModule]] = None):
+        if (stage is None) == (stages is None):
+            raise ValueError("pass exactly one of `stage` or `stages`")
+        if stages is not None:
+            mods = [_check_stage(m) for m in stages]
+            n_stages = len(mods)
+            self.homogeneous = False
+        else:
+            _check_stage(stage)
             mods = [stage]
             for _ in range(n_stages - 1):
                 c = stage.clone()
                 c.reset()  # independent parameters per stage
                 mods.append(c)
+            self.homogeneous = True
         super().__init__(*mods)
         self.n_stages = n_stages
         self.n_microbatches = n_microbatches
         self.axis_name = axis_name
 
     # ------------------------------------------------------------------ run
-    def _stage_apply(self, params, x, training):
+    def _stage_apply(self, i: int, params, x, training):
         # stages are stateless, but containers still want the structured
         # (empty) state tree
-        out, _ = self.modules[0].apply(params, self.modules[0].get_state(), x,
+        out, _ = self.modules[i].apply(params, self.modules[i].get_state(), x,
                                        training=training, rng=None)
         return out
 
@@ -78,15 +122,18 @@ class GPipe(Container):
                 raise ValueError(
                     f"batch {b} must divide by data size {d} and the local "
                     f"batch by n_microbatches {m}")
-            return self._apply_sharded(params, input, training, mesh,
-                                       data_axis if d > 1 else None), state
+            run = (self._apply_sharded if self.homogeneous
+                   else self._apply_sharded_hetero)
+            return run(params, input, training, mesh,
+                       data_axis if d > 1 else None), state
 
         # sequential fallback: same stage composition, no communication
         y = input
         for i in range(s):
-            y = self._stage_apply(params[str(i)], y, training)
+            y = self._stage_apply(i, params[str(i)], y, training)
         return y, state
 
+    # ------------------------------------------- homogeneous (stacked) path
     def _apply_sharded(self, params, x, training, mesh, data_axis=None):
         s, m = self.n_stages, self.n_microbatches
         axis = self.axis_name
@@ -109,7 +156,7 @@ class GPipe(Container):
                 recv, out_acc = carry
                 feed = micro[jnp.minimum(t, m - 1)]
                 inp = jnp.where(jnp.logical_and(rank == 0, t < m), feed, recv)
-                out = self._stage_apply(p, inp, training)
+                out = self._stage_apply(0, p, inp, training)
                 # last stage banks microbatch t-(s-1) when it emerges
                 slot = jnp.clip(t - (s - 1), 0, m - 1)
                 bank = jnp.logical_and(rank == s - 1, t >= s - 1)
@@ -132,6 +179,120 @@ class GPipe(Container):
                            in_specs=(spec_p, x_spec), out_specs=x_spec)
         return fn(stacked, x)
 
+    # ------------------------------------------ heterogeneous (switch) path
+    def _apply_sharded_hetero(self, params, x, training, mesh, data_axis=None):
+        s, m = self.n_stages, self.n_microbatches
+        axis = self.axis_name
+        x_spec = P(data_axis) if data_axis else P()
+        d = dict(mesh.shape).get(data_axis, 1) if data_axis else 1
+        bm = (x.shape[0] // d) // m  # per-rank microbatch size
+
+        # --- static boundary shapes: chain eval_shape through the stages
+        stage_params = [params[str(i)] for i in range(s)]
+        in_shapes = []   # stage i input aval
+        out_shapes = []  # stage i output aval
+        cur = jax.ShapeDtypeStruct((bm,) + x.shape[1:], x.dtype)
+        for i in range(s):
+            in_shapes.append(cur)
+            cur = jax.eval_shape(
+                lambda p, xx, i=i: self._stage_apply(i, p, xx, training),
+                stage_params[i], cur)
+            if not hasattr(cur, "shape"):
+                raise ValueError("GPipe stages must return a single array")
+            out_shapes.append(cur)
+        # the flat wire must also carry the stage-0 feed (rank 0 reshapes recv
+        # into the feed shape on late ticks), so include the input extent too
+        buf_len = max([int(np.prod(o.shape)) for o in out_shapes]
+                      + [int(np.prod(in_shapes[0].shape))])
+
+        # --- flatten+pad+stack per-stage params: (S, P) sharded over `pipe`,
+        # so each rank materialises only its own stage's weights
+        flat, offsets = [], []
+        for sp in stage_params:
+            leaves = jax.tree_util.tree_leaves(sp)
+            offs, off = [], 0
+            for l in leaves:
+                offs.append((off, l.shape, l.dtype))
+                off += int(np.prod(l.shape))
+            offsets.append(offs)
+            vec = (jnp.concatenate([jnp.ravel(l).astype(jnp.float32)
+                                    for l in leaves])
+                   if leaves else jnp.zeros((0,), jnp.float32))
+            flat.append(vec)
+        p_len = max(v.shape[0] for v in flat)
+        p_stk = jnp.stack([jnp.pad(v, (0, p_len - v.shape[0])) for v in flat])
+        treedefs = [jax.tree_util.tree_structure(sp) for sp in stage_params]
+
+        def unflatten(i, row):
+            leaves = [lax.dynamic_slice(row, (off,), (int(np.prod(shape)),))
+                      .reshape(shape).astype(dtype)
+                      for off, shape, dtype in offsets[i]]
+            return jax.tree_util.tree_unflatten(treedefs[i], leaves)
+
+        def body(p_stk, xs):
+            rank = lax.axis_index(axis)
+            row = p_stk[0]  # my stage's flattened params
+            micro = xs.reshape((m, bm) + xs.shape[1:])
+            # switch branches must agree on varying-axes typing: the feed is
+            # pipe-invariant while recv is pipe-varying — promote everything
+            # to the same set up front
+            micro = lax.pcast(micro, (axis,), to="varying")
+            vaxes = (axis,) if data_axis is None else (axis, data_axis)
+
+            def branch(i):
+                def run(row, recv, t):
+                    if i == 0:
+                        feed = micro[jnp.minimum(t, m - 1)]
+                        inp = jnp.where(
+                            t < m, feed,
+                            recv[:feed.size].reshape(feed.shape)
+                            .astype(feed.dtype))
+                    else:
+                        av = in_shapes[i]
+                        inp = recv[:int(np.prod(av.shape))] \
+                            .reshape(av.shape).astype(av.dtype)
+                    out = self._stage_apply(i, unflatten(i, row), inp,
+                                            training)
+                    vec = jnp.ravel(out).astype(jnp.float32)
+                    return jnp.pad(vec, (0, buf_len - vec.shape[0]))
+                return run
+
+            branches = [branch(i) for i in range(s)]
+            zero = lax.pcast(jnp.zeros((buf_len,), jnp.float32),
+                             vaxes, to="varying")
+            out_acc = lax.pcast(jnp.zeros((m, buf_len), jnp.float32),
+                                vaxes, to="varying")
+            perm = [(i, i + 1) for i in range(s - 1)]
+
+            def tick(carry, t):
+                recv, out_acc = carry
+                out = lax.switch(jnp.clip(rank, 0, s - 1), branches,
+                                 row, recv, t)
+                slot = jnp.clip(t - (s - 1), 0, m - 1)
+                bank = jnp.logical_and(rank == s - 1, t >= s - 1)
+                prev = lax.dynamic_index_in_dim(out_acc, slot, 0,
+                                                keepdims=False)
+                out_acc = lax.dynamic_update_index_in_dim(
+                    out_acc, jnp.where(bank, out, prev), slot, axis=0)
+                recv = lax.ppermute(out, axis, perm)
+                return (recv, out_acc), None
+
+            (_, out_acc), _ = lax.scan(tick, (zero, out_acc),
+                                       jnp.arange(m + s - 1))
+            # banked results live on the last rank only → broadcast, then
+            # unflatten to the last stage's output shape
+            out_acc = jnp.where(rank == s - 1, out_acc, 0.0)
+            out_acc = lax.psum(out_acc, axis)
+            fs = out_shapes[-1]
+            n_out = int(np.prod(fs.shape))
+            out = out_acc[:, :n_out].reshape((m,) + fs.shape).astype(fs.dtype)
+            return out.reshape((m * bm,) + fs.shape[1:])
+
+        fn = jax.shard_map(body, mesh=mesh,
+                           in_specs=(P(axis), x_spec), out_specs=x_spec)
+        return fn(p_stk, x)
+
     def __repr__(self):
-        return (f"GPipe(stages={self.n_stages}, "
+        kind = "homogeneous" if self.homogeneous else "heterogeneous"
+        return (f"GPipe(stages={self.n_stages} [{kind}], "
                 f"microbatches={self.n_microbatches})")

@@ -1,0 +1,149 @@
+"""Heterogeneous GPipe (round-4 verdict item 7): arbitrary per-stage modules —
+differing param pytrees AND differing boundary activation shapes — pipelined
+over the ``pipe`` mesh axis via per-rank ``lax.switch`` dispatch with flat
+padded boundary/param buffers. Done-criterion: a TransformerLM (embedding +
+blocks + head, int tokens in, per-token log-probs out) actually trains under
+dp x pp on the CPU mesh.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.parallel import GPipe
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+VOCAB, DIM, SEQ = 50, 16, 8
+
+
+def _lm_stages():
+    """embed -> block -> block -> head: int32 (N, T) -> (N, T, VOCAB)."""
+    from bigdl_tpu.models.transformerlm.transformerlm import (
+        PositionEmbedding, TransformerBlock)
+    embed = (nn.Sequential()
+             .add(nn.LookupTable(VOCAB, DIM, zero_based=True))
+             .add(PositionEmbedding(SEQ, DIM)))
+    blocks = [TransformerBlock(DIM, num_heads=2, dropout=0.0)
+              for _ in range(2)]
+    head = (nn.Sequential()
+            .add(nn.LayerNorm(DIM))
+            .add(nn.TimeDistributed(nn.Linear(DIM, VOCAB)))
+            .add(nn.TimeDistributed(nn.LogSoftMax())))
+    return [embed] + blocks + [head]
+
+
+def _tokens(n, seed=0):
+    return jnp.asarray(np.random.default_rng(seed)
+                       .integers(0, VOCAB, size=(n, SEQ)).astype(np.int32))
+
+
+class TestHeteroEquivalence:
+    def test_sharded_matches_sequential(self):
+        Engine.reset()
+        Engine.init(mesh_shape=(2, 4), mesh_axes=("data", "pipe"), seed=0)
+        RandomGenerator.set_seed(0)
+        g = GPipe(stages=_lm_stages(), n_microbatches=2).evaluate()
+        x = _tokens(8)
+        out = np.asarray(g.forward(x))
+        assert out.shape == (8, SEQ, VOCAB)
+        y = x
+        for i in range(4):
+            y, _ = g.modules[i].apply(g.get_params()[str(i)],
+                                      g.modules[i].get_state(), y)
+        np.testing.assert_allclose(out, np.asarray(y), rtol=1e-4, atol=1e-5)
+
+    def test_mixed_boundary_shapes(self):
+        """Boundary shapes differ stage-to-stage (narrow -> wide -> narrow)."""
+        Engine.reset()
+        Engine.init(mesh_shape=(2, 4), mesh_axes=("data", "pipe"), seed=0)
+        RandomGenerator.set_seed(0)
+        stages = [
+            nn.Sequential().add(nn.Linear(6, 24)).add(nn.Tanh()),
+            nn.Sequential().add(nn.Linear(24, 12)).add(nn.Tanh()),
+            nn.Sequential().add(nn.Linear(12, 12)).add(nn.Tanh()),
+            nn.Linear(12, 3),
+        ]
+        g = GPipe(stages=stages, n_microbatches=2).evaluate()
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 6))
+                        .astype(np.float32))
+        out = np.asarray(g.forward(x))
+        assert out.shape == (4, 3)
+        y = x
+        for i in range(4):
+            y, _ = g.modules[i].apply(g.get_params()[str(i)],
+                                      g.modules[i].get_state(), y)
+        np.testing.assert_allclose(out, np.asarray(y), rtol=1e-4, atol=1e-5)
+
+    def test_gradients_match_sequential(self):
+        Engine.reset()
+        Engine.init(mesh_shape=(2, 4), mesh_axes=("data", "pipe"), seed=0)
+        RandomGenerator.set_seed(0)
+        g = GPipe(stages=_lm_stages(), n_microbatches=2)
+        x = _tokens(4, seed=2)
+        params = g.get_params()
+
+        def loss_pipe(p):
+            out, _ = g.apply(p, g.get_state(), x, training=True, rng=None)
+            return jnp.mean(jnp.sum(out ** 2, axis=-1))
+
+        def loss_seq(p):
+            y = x
+            for i in range(4):
+                y, _ = g.modules[i].apply(p[str(i)], g.modules[i].get_state(),
+                                          y, training=True, rng=None)
+            return jnp.mean(jnp.sum(y ** 2, axis=-1))
+
+        g_pipe = jax.grad(loss_pipe)(params)
+        g_seq = jax.grad(loss_seq)(params)
+        flat_p = jax.tree_util.tree_leaves_with_path(g_pipe)
+        flat_s = dict(jax.tree_util.tree_leaves_with_path(g_seq))
+        for path, leaf in flat_p:
+            np.testing.assert_allclose(
+                np.asarray(leaf), np.asarray(flat_s[path]),
+                rtol=1e-3, atol=1e-4, err_msg=str(path))
+
+
+class TestHeteroTraining:
+    def test_transformer_lm_trains_under_dp_pp(self):
+        """The done-criterion: loss on a fixed next-token task decreases when
+        the LM trains through the dp x pp pipeline."""
+        Engine.reset()
+        Engine.init(mesh_shape=(2, 4), mesh_axes=("data", "pipe"), seed=0)
+        RandomGenerator.set_seed(0)
+        g = GPipe(stages=_lm_stages(), n_microbatches=2)
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32))
+        y = jnp.asarray(rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32))
+        params = g.get_params()
+
+        def loss_fn(p):
+            out, _ = g.apply(p, g.get_state(), x, training=True, rng=None)
+            return crit.apply(out, y)
+
+        step = jax.jit(jax.value_and_grad(loss_fn))
+        losses = []
+        for _ in range(12):
+            l, grads = step(params)
+            losses.append(float(l))
+            params = jax.tree_util.tree_map(
+                lambda p, gr: p - 0.5 * gr, params, grads)
+        assert losses[-1] < losses[0] - 0.1, losses
+
+
+class TestValidation:
+    def test_rejects_stateful_stage(self):
+        with pytest.raises(ValueError, match="sync=True"):
+            GPipe(stages=[nn.Sequential().add(nn.Linear(4, 4))
+                          .add(nn.SpatialBatchNormalization(4))])
+
+    def test_rejects_rng_stage(self):
+        with pytest.raises(ValueError, match="RNG"):
+            GPipe(stages=[nn.Sequential().add(nn.Linear(4, 4))
+                          .add(nn.Dropout(0.5))])
+
+    def test_requires_exactly_one_of_stage_stages(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            GPipe()

@@ -129,6 +129,7 @@ def dryrun_multichip(n_devices: int) -> None:
                .set_optim_method(SGD(learningrate=0.05, momentum=0.9,
                                      dampening=0.0))
                .set_tensor_parallel(expert_parallel_rules("0"))
+               .set_aux_loss_weight(0.01)  # Switch load-balancing loss in
                .set_end_when(Trigger.max_iteration(1)))
         opt.optimize()
         losses["dp x ep/moe"] = opt.state["loss"]

@@ -73,6 +73,12 @@ class Optimizer:
         self.summary_trigger: Optional[Trigger] = None
         self.grad_clip_const: Optional[tuple[float, float]] = None
         self.grad_clip_norm: Optional[float] = None
+        # Auxiliary-loss convention: modules that declare an ``aux_loss`` leaf
+        # in their state (MoE load balancing, parallel/moe.py) get it added to
+        # the training objective scaled by this weight. 0.01 is the Switch
+        # Transformer default; set_aux_loss_weight(0) trains without it.
+        self.aux_loss_weight: float = float(
+            os.environ.get("BIGDL_AUX_LOSS_WEIGHT", "0.01"))
         self.state: dict = {"epoch": 1, "neval": 1, "epoch_finished": False}
         self.log_every: int = 1
         from bigdl_tpu.optim.metrics import Metrics
@@ -150,6 +156,13 @@ class Optimizer:
         self.optim_method = CompositeOptimMethod(groups, default)
         self._step_cache = None
         self._final_ostate = None
+        return self
+
+    def set_aux_loss_weight(self, weight: float) -> "Optimizer":
+        """Scale for module-declared ``aux_loss`` state leaves added to the
+        objective (MoE load balancing). 0 disables."""
+        self.aux_loss_weight = float(weight)
+        self._step_cache = None
         return self
 
     def set_prefetch(self, depth: int) -> "Optimizer":
@@ -246,6 +259,18 @@ class Optimizer:
 
         model, criterion, method = self.model, self.criterion, self.optim_method
         needs_rng = model.needs_rng()
+        aux_w = self.aux_loss_weight
+
+        def collect_aux(ms):
+            """Sum every ``aux_loss`` leaf in the post-apply module state.
+            Presence is static (pytree structure), so models without aux
+            losses trace to exactly the old program."""
+            from jax.tree_util import tree_flatten_with_path
+            total, found = jnp.zeros((), jnp.float32), False
+            for path, leaf in tree_flatten_with_path(ms)[0]:
+                if path and getattr(path[-1], "key", None) == "aux_loss":
+                    total, found = total + leaf, True
+            return total if found else None
         # Mixed precision (nn/precision.py): params stay fp32 masters; the casts
         # below put the matmul/conv FLOPs in the compute dtype (bf16 → MXU double
         # rate) while the cast's transpose returns fp32 gradients, and the loss /
@@ -265,7 +290,11 @@ class Optimizer:
                 if mixed:
                     out = cast_floating(out, jnp.float32)
                     new_ms = cast_floating(new_ms, jnp.float32)
-                return criterion.apply(out, target), new_ms
+                loss = criterion.apply(out, target)
+                aux = collect_aux(new_ms) if aux_w else None
+                if aux is not None:
+                    loss = loss + aux_w * aux
+                return loss, new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = self._clip_grads(grads)

@@ -124,3 +124,94 @@ class TestSerialization:
         np.testing.assert_allclose(np.asarray(m.evaluate().forward(x)),
                                    np.asarray(loaded.evaluate().forward(x)),
                                    rtol=1e-6)
+
+
+class TestAuxLossTraining:
+    """Round-4 verdict item 5: the Switch load-balancing loss is part of the
+    training objective (Optimizer ``aux_loss_weight``), not just observability
+    state — routing balance measurably improves vs coefficient 0."""
+
+    @staticmethod
+    def _train(aux_w, seed=0, iters=300):
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+
+        Engine.reset()
+        Engine.init(seed=seed)
+        RandomGenerator.set_seed(seed)
+        rng = np.random.default_rng(seed)
+        # 90/10 imbalanced clusters with the gate initialised along the
+        # cluster axis: the natural routing sends 90% of tokens to expert 0.
+        # Only the aux loss creates pressure to re-partition the big cluster.
+        xs = np.concatenate([
+            np.eye(8)[0] * 2 + 0.5 * rng.normal(size=(460, 8)),
+            -np.eye(8)[0] * 2 + 0.5 * rng.normal(size=(52, 8)),
+        ]).astype(np.float32)
+        ys = rng.integers(0, 4, size=(512,)).astype(np.int32)
+        perm = rng.permutation(512)
+        xs, ys = xs[perm], ys[perm]
+        batches = [MiniBatch(xs[i * 64:(i + 1) * 64], ys[i * 64:(i + 1) * 64])
+                   for i in range(8)]
+        moe = MoE(8, 16, 4, capacity_factor=2.0)
+        p = dict(moe.get_params())
+        g = np.asarray(p["w_gate"]) * 0.1
+        g[:, 0] = np.eye(8)[0] * 2
+        g[:, 1] = -np.eye(8)[0] * 2
+        moe.set_params({**p, "w_gate": jnp.asarray(g.astype(np.float32))})
+        model = (nn.Sequential().add(moe)
+                 .add(nn.Linear(8, 4)).add(nn.LogSoftMax()))
+        opt = LocalOptimizer(model, DataSet.array(batches),
+                             nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learningrate=0.3))
+        opt.set_aux_loss_weight(aux_w)
+        opt.log_every = 10 ** 9
+        opt.set_end_when(Trigger.max_iteration(iters))
+        opt.optimize()
+        _, st = model.apply(model.get_params(), model.get_state(),
+                            jnp.asarray(xs), training=True)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(st)[0]:
+            if getattr(path[-1], "key", None) == "aux_loss":
+                return float(leaf)
+        raise AssertionError("no aux_loss leaf found")
+
+    def test_balance_improves_vs_zero_coefficient(self):
+        aux_off = self._train(0.0)
+        aux_on = self._train(0.1)
+        # measured on CPU: ~3.1 collapsed vs ~1.12 rebalanced
+        assert aux_off > 2.0, aux_off
+        assert aux_on < 1.5, aux_on
+        assert aux_on < aux_off - 1.0
+
+    def test_default_weight_changes_objective(self):
+        """The step's loss includes weight * aux: with everything else fixed,
+        first-step loss differs between weight 0 and a large weight."""
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+
+        losses = {}
+        for w in (0.0, 10.0):
+            Engine.reset()
+            Engine.init(seed=0)
+            RandomGenerator.set_seed(0)
+            rng = np.random.default_rng(0)
+            xs = rng.normal(size=(32, 8)).astype(np.float32)
+            ys = rng.integers(0, 3, size=(32,)).astype(np.int32)
+            model = (nn.Sequential().add(MoE(8, 16, 4))
+                     .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+            opt = LocalOptimizer(model, DataSet.array([MiniBatch(xs, ys)]),
+                                 nn.ClassNLLCriterion())
+            opt.set_optim_method(SGD(learningrate=0.0))
+            opt.set_aux_loss_weight(w)
+            opt.log_every = 10 ** 9
+            opt.set_end_when(Trigger.max_iteration(1))
+            opt.optimize()
+            losses[w] = opt.state["loss"]
+        assert losses[10.0] > losses[0.0] + 1.0, losses

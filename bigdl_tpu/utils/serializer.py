@@ -18,8 +18,10 @@ classes up by REGISTERED NAME, not module path), new constructor fields (decoded
 only pass the args that were recorded), and new manifest keys (ignored by old loaders).
 
 Custom topologies (``Graph``) serialize their node/edge structure explicitly.
-Known limitation: module instances appearing twice in one tree (shared weights)
-deserialize as independent copies.
+Instance identity is preserved: a module appearing twice in one tree (shared
+weights, e.g. a tied-embedding LM) encodes once plus ``{"shared_ref": iid}``
+markers, and deserializes back to ONE shared instance — matching the
+reference serializer's identity semantics.
 """
 
 from __future__ import annotations
@@ -107,6 +109,9 @@ def _reg_name(cls: type) -> str:
 class _Arrays:
     def __init__(self) -> None:
         self.arrays: list[np.ndarray] = []
+        # instance identity (shared weights): id(module) -> instance id, so a
+        # module appearing twice in one tree encodes once + a {"shared_ref"}
+        self.seen: dict[int, int] = {}
 
     def add(self, arr) -> int:
         self.arrays.append(np.asarray(arr))
@@ -168,10 +173,18 @@ def _module_spec(m, ctx: _Arrays) -> dict:
     from bigdl_tpu.nn.abstractnn import Container
     from bigdl_tpu.nn.graph import Graph
 
-    if isinstance(m, Graph):
-        return _graph_spec(m, ctx)
+    if id(m) in ctx.seen:  # same INSTANCE again (tied weights) → reference
+        return {"shared_ref": ctx.seen[id(m)]}
+    iid = len(ctx.seen)
+    ctx.seen[id(m)] = iid
 
-    spec: dict[str, Any] = {"type": _reg_name(type(m)), "name": m.name}
+    if isinstance(m, Graph):
+        spec = _graph_spec(m, ctx)
+        spec["iid"] = iid
+        return spec
+
+    spec: dict[str, Any] = {"type": _reg_name(type(m)), "name": m.name,
+                            "iid": iid}
     if m.scale_w != 1.0 or m.scale_b != 1.0:
         spec["scale_w"], spec["scale_b"] = m.scale_w, m.scale_b
     args, kwargs = getattr(m, "_init_args", ((), {}))
@@ -231,15 +244,18 @@ def _graph_spec(g, ctx: _Arrays) -> dict:
 
 
 # ----------------------------------------------------------------------- decode
-def _decode_value(v: Any, arrays: list[np.ndarray], children: list | None) -> Any:
+def _decode_value(v: Any, arrays: list[np.ndarray], children: list | None,
+                  cache: dict | None = None) -> Any:
     if isinstance(v, list):
-        return [_decode_value(x, arrays, children) for x in v]
+        return [_decode_value(x, arrays, children, cache) for x in v]
     if not isinstance(v, dict):
         return v
     if "__tuple__" in v:
-        return tuple(_decode_value(x, arrays, children) for x in v["__tuple__"])
+        return tuple(_decode_value(x, arrays, children, cache)
+                     for x in v["__tuple__"])
     if "__map__" in v:
-        return {k: _decode_value(x, arrays, children) for k, x in v["__map__"].items()}
+        return {k: _decode_value(x, arrays, children, cache)
+                for k, x in v["__map__"].items()}
     if "__dtype__" in v:
         import jax.numpy as jnp
         return jnp.dtype(v["__dtype__"])
@@ -248,7 +264,7 @@ def _decode_value(v: Any, arrays: list[np.ndarray], children: list | None) -> An
     if "__child__" in v:
         return children[v["__child__"]]
     if "__module__" in v:
-        return _build_module(v["__module__"], arrays)
+        return _build_module(v["__module__"], arrays, cache)
     if "__fn__" in v:
         name = v["__fn__"]
         if name not in _FN_WHITELIST:
@@ -266,11 +282,17 @@ def _decode_value(v: Any, arrays: list[np.ndarray], children: list | None) -> An
         kwargs = {k: _decode_value(a, arrays, None)
                   for k, a in v.get("kwargs", {}).items()}
         return cls(*args, **kwargs)
-    return {k: _decode_value(x, arrays, children) for k, x in v.items()}
+    return {k: _decode_value(x, arrays, children, cache) for k, x in v.items()}
 
 
-def _build_module(spec: dict, arrays: list[np.ndarray]):
+def _build_module(spec: dict, arrays: list[np.ndarray],
+                  cache: dict | None = None):
     import jax.numpy as jnp
+
+    if cache is None:
+        cache = {}
+    if "shared_ref" in spec:  # same instance as an earlier subtree (tied
+        return cache[spec["shared_ref"]]  # weights): reuse, don't duplicate
 
     cls = _registry().get(spec["type"])
     if cls is None:
@@ -279,12 +301,15 @@ def _build_module(spec: dict, arrays: list[np.ndarray]):
             f"{len(_registry())} entries")
 
     if "graph" in spec:
-        return _build_graph(cls, spec, arrays)
+        g = _build_graph(cls, spec, arrays, cache)
+        if "iid" in spec:
+            cache[spec["iid"]] = g
+        return g
 
-    children = [_build_module(s, arrays) for s in spec.get("children", [])]
+    children = [_build_module(s, arrays, cache) for s in spec.get("children", [])]
     cfg = spec.get("config", {})
-    args = [_decode_value(a, arrays, children) for a in cfg.get("args", [])]
-    kwargs = {k: _decode_value(a, arrays, children)
+    args = [_decode_value(a, arrays, children, cache) for a in cfg.get("args", [])]
+    kwargs = {k: _decode_value(a, arrays, children, cache)
               for k, a in cfg.get("kwargs", {}).items()}
     m = cls(*args, **kwargs)
     for i in spec.get("added_children", []):
@@ -304,16 +329,20 @@ def _build_module(spec: dict, arrays: list[np.ndarray]):
     m.name = spec.get("name", m.name)
     m.scale_w = spec.get("scale_w", 1.0)
     m.scale_b = spec.get("scale_b", 1.0)
+    if "iid" in spec:
+        cache[spec["iid"]] = m
     return m
 
 
-def _build_graph(cls, spec: dict, arrays: list[np.ndarray]):
+def _build_graph(cls, spec: dict, arrays: list[np.ndarray],
+                 cache: dict | None = None):
     from bigdl_tpu.nn.graph import ModuleNode
 
     g = spec["graph"]
     node_map: dict[int, ModuleNode] = {}
     for ns in g["nodes"]:
-        module = None if ns["module"] is None else _build_module(ns["module"], arrays)
+        module = None if ns["module"] is None else _build_module(
+            ns["module"], arrays, cache)
         node_map[ns["id"]] = ModuleNode(module, [node_map[p] for p in ns["prev"]])
     graph = cls([node_map[i] for i in g["inputs"]],
                 [node_map[i] for i in g["outputs"]])

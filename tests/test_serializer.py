@@ -403,3 +403,16 @@ class TestFormatTolerance:
         p = str(tmp_path / "model.bigdl")
         loaded = _roundtrip(model, p)
         _assert_same_forward(model, loaded, x)
+
+    def test_shared_instance_roundtrip(self, tmp_path):
+        """A module instance appearing twice (tied weights) must deserialize
+        back to ONE shared instance, not two independent copies."""
+        RandomGenerator.set_seed(4)
+        shared = nn.Linear(5, 5)
+        model = _seq(shared, nn.ReLU(), shared, nn.ReLU())
+        x = _x(3, 5)
+        p = str(tmp_path / "shared.bigdl")
+        loaded = _roundtrip(model, p)
+        _assert_same_forward(model, loaded, x)
+        assert loaded.modules[0] is loaded.modules[2], \
+            "shared instance decoded as independent copies"

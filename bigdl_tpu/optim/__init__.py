@@ -1,4 +1,4 @@
-from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer, ParallelOptimizer
 from bigdl_tpu.optim.evaluator import Evaluator, Predictor
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import (

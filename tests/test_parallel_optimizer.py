@@ -1,0 +1,80 @@
+"""ParallelOptimizer — layer-wise parameter sync (SURVEY.md §2.3 row 29).
+
+The upstream variant's whole point is syncing each layer's gradient as its
+backward completes instead of one flat all-reduce at the end. Our redesign
+claims XLA already emits that schedule for the jitted DistriOptimizer step:
+one all-reduce per gradient leaf, scheduled independently. These tests pin
+that claim to the compiled artifact (HLO), not to documentation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.optim import SGD, ParallelOptimizer, Trigger
+from bigdl_tpu.utils.engine import Engine
+
+
+def _model():
+    return nn.Sequential() \
+        .add(nn.Linear(12, 32)).add(nn.ReLU()) \
+        .add(nn.Linear(32, 32)).add(nn.ReLU()) \
+        .add(nn.Linear(32, 4)).add(nn.LogSoftMax())
+
+
+def _data(n_batches=2, batch=16):
+    rng = np.random.default_rng(0)
+    return DataSet.array([
+        MiniBatch(rng.normal(size=(batch, 12)).astype(np.float32),
+                  rng.integers(0, 4, size=(batch,)).astype(np.int32))
+        for _ in range(n_batches)])
+
+
+@pytest.fixture
+def mesh_engine():
+    Engine.reset()
+    Engine.init()
+    yield
+    Engine.reset()
+
+
+class TestParallelOptimizer:
+    def test_trains_like_distri(self, mesh_engine):
+        opt = (ParallelOptimizer(_model(), _data(), nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_end_when(Trigger.max_iteration(4)))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+
+    def test_hlo_gradient_sync_is_leaf_structured(self, mesh_engine):
+        """The gradient sync must enter the collective as per-layer leaves
+        (XLA's combiner may bucket them into one variadic all-reduce, and on
+        TPU the latency-hiding scheduler overlaps them with backward) — NOT
+        as one flat concatenated f32[total] vector, which is the upstream
+        DistriOptimizer design ParallelOptimizer exists to replace."""
+        opt = (ParallelOptimizer(_model(), _data(), nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1)))
+        step = opt._compile_step()
+        params = opt.model.get_params()
+        mstate = opt.model.get_state()
+        ostate = opt.optim_method.init_state(params)
+        x = jnp.zeros((16, 12), jnp.float32)
+        y = jnp.zeros((16,), jnp.int32)
+        hlo = step.lower(params, mstate, ostate,
+                         jnp.zeros((), jnp.float32), x, y, None) \
+            .compile().as_text()
+        total = sum(int(np.prod(np.shape(p)))
+                    for p in jax.tree_util.tree_leaves(params))
+        ar_lines = [ln for ln in hlo.splitlines()
+                    if " all-reduce(" in ln or " all-reduce-start(" in ln]
+        assert ar_lines, "no gradient all-reduce in the compiled step"
+        assert not any(f"f32[{total}]" in ln for ln in ar_lines), (
+            "gradient sync runs on a flat concatenated vector — layer "
+            "structure was lost before the collective")
+        # the per-layer weight-matrix shape must survive into the collective
+        assert any("f32[32,12]" in ln for ln in ar_lines), (
+            f"per-leaf gradient shapes not found in all-reduce ops: {ar_lines}")

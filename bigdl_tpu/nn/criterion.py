@@ -571,7 +571,6 @@ class SoftmaxWithCriterion(AbstractCriterion):
         # channel dim = axis 1 (NC or NCHW); move classes last, flatten the rest
         logp = jnp.moveaxis(logp, 1, -1).reshape(-1, input.shape[1])
         idx = _class_index(jnp.reshape(target, (-1,)), self.one_based)
-        picked = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
         if self.ignore_label is not None:
             ignore = _class_index(jnp.asarray(self.ignore_label), self.one_based)
             mask = (idx != ignore).astype(logp.dtype)
@@ -582,6 +581,7 @@ class SoftmaxWithCriterion(AbstractCriterion):
             picked = picked * mask
             valid = jnp.sum(mask)
         else:
+            picked = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
             valid = jnp.asarray(picked.shape[0], picked.dtype)
         total = jnp.sum(picked)
         if self.normalize_mode == "valid":

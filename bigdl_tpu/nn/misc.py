@@ -432,7 +432,9 @@ class PairwiseDistance(AbstractModule):
         if d.ndim == 1:
             d = d[None]
         p = float(self.norm)
-        out = jnp.sum(jnp.abs(d) ** p + 1e-12, axis=-1) ** (1.0 / p)
+        # epsilon once on the summed value, not per element — identical inputs
+        # stay ~0 regardless of feature count (torch semantics)
+        out = (jnp.sum(jnp.abs(d) ** p, axis=-1) + 1e-12) ** (1.0 / p)
         return out, state
 
 
@@ -605,6 +607,10 @@ class Cropping2D(TensorModule):
     def apply(self, params, state, input, *, training=False, rng=None):
         (t, b), (l, r) = self.height_crop, self.width_crop
         h, w = input.shape[-2], input.shape[-1]
+        if t + b >= h or l + r >= w:
+            raise ValueError(
+                f"Cropping2D extents {self.height_crop}/{self.width_crop} "
+                f"consume the whole {h}x{w} input")
         return input[..., t:h - b or None, l:w - r or None], state
 
 
@@ -622,5 +628,9 @@ class Cropping3D(TensorModule):
         (a0, a1), (b0, b1), (c0, c1) = \
             self.dim1_crop, self.dim2_crop, self.dim3_crop
         d, h, w = input.shape[-3], input.shape[-2], input.shape[-1]
+        if a0 + a1 >= d or b0 + b1 >= h or c0 + c1 >= w:
+            raise ValueError(
+                f"Cropping3D extents {self.dim1_crop}/{self.dim2_crop}/"
+                f"{self.dim3_crop} consume the whole {d}x{h}x{w} input")
         return input[..., a0:d - a1 or None, b0:h - b1 or None,
                      c0:w - c1 or None], state

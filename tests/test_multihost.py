@@ -23,7 +23,6 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.timeout(300)
 def test_two_process_distri_training(tmp_path):
     port = _free_port()
     env = dict(os.environ)

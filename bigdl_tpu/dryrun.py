@@ -12,24 +12,32 @@ from __future__ import annotations
 
 
 def entry():
-    """Jittable forward step of the flagship model + example args (single chip)."""
+    """Jittable forward step of the flagship model + example args (single chip).
+
+    The flagship is the TransformerLM family (PARITY.md/README): causal
+    decoder with the Pallas flash-attention path on TPU. Sizes are kept
+    modest so the driver's compile-check stays fast while exercising the
+    real showcase stack (embeddings, flash/causal attention blocks,
+    time-distributed decoder head).
+    """
     import jax.numpy as jnp
 
-    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.models.transformerlm import TransformerLM
     from bigdl_tpu.utils.engine import Engine
 
     if not Engine.is_initialized():
         Engine.init()
-    model = LeNet5(10).evaluate()
+    model = TransformerLM(vocab_size=1024, embed_dim=256, num_heads=4,
+                          num_layers=2, max_len=256, dropout=0.0).evaluate()
     params = model.get_params()
     mstate = model.get_state()
 
-    def forward(params, x):
-        out, _ = model.apply(params, mstate, x, training=False, rng=None)
+    def forward(params, tokens):
+        out, _ = model.apply(params, mstate, tokens, training=False, rng=None)
         return out
 
-    x = jnp.zeros((8, 1, 28, 28), jnp.float32)
-    return forward, (params, x)
+    tokens = jnp.zeros((4, 256), jnp.int32)
+    return forward, (params, tokens)
 
 
 def dryrun_multichip(n_devices: int) -> None:

@@ -184,3 +184,54 @@ class TestFlashAttention:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(_reference_attention(q, k, v, False)),
             rtol=1e-4, atol=1e-5)
+
+
+class TestFlashBackwardMemory:
+    """VERDICT r3 item 4 done-criterion: training at long T must not scale
+    O(T^2). Pinned by shape math — the traced grad program may not contain
+    ANY (T, T)-shaped intermediate on the flash path (the reference-VJP path
+    materialises scores/probs at exactly that shape, so the assertion
+    separates the two)."""
+
+    T = 8192
+
+    def _quadratic_shapes(self, jaxpr, T):
+        found = []
+
+        def walk(jpr):
+            for eqn in jpr.eqns:
+                for var in eqn.outvars:
+                    shape = tuple(getattr(var.aval, "shape", ()))
+                    if shape.count(T) >= 2:
+                        found.append((str(eqn.primitive), shape))
+                for v in eqn.params.values():
+                    for sub in (v if isinstance(v, (list, tuple)) else [v]):
+                        inner = getattr(sub, "jaxpr", None)
+                        if inner is not None and hasattr(inner, "eqns"):
+                            walk(inner)
+                        elif hasattr(sub, "eqns"):
+                            walk(sub)
+
+        walk(jaxpr.jaxpr)
+        return found
+
+    def _grad_jaxpr(self, force_pallas):
+        from bigdl_tpu.kernels.flash_attention import flash_attention
+        T, d = self.T, 64
+        q = jnp.zeros((1, 1, T, d), jnp.bfloat16)
+
+        def loss(a, b, c):
+            return jnp.sum(
+                flash_attention(a, b, c, True, force_pallas)
+                .astype(jnp.float32))
+
+        return jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
+
+    def test_flash_backward_no_quadratic_intermediate(self):
+        found = self._quadratic_shapes(self._grad_jaxpr(True), self.T)
+        assert not found, f"O(T^2) intermediates on the flash path: {found}"
+
+    def test_reference_path_is_quadratic(self):
+        """Sanity: the assertion actually detects the O(T^2) pattern."""
+        found = self._quadratic_shapes(self._grad_jaxpr(False), self.T)
+        assert found, "reference VJP should materialise (T, T) scores"

@@ -218,6 +218,14 @@ def quantize_module(m: AbstractModule, mode: str = "dynamic") -> AbstractModule:
         return QuantizedLinear.from_float(m, mode)
     if type(m) is SpatialConvolution:
         return QuantizedSpatialConvolution.from_float(m, mode)
+    # TF-imported graphs: their conv/matmul adapters quantize too (lazy import
+    # keeps nn free of the utils.tf layer unless an imported graph is present)
+    if type(m).__name__ in ("TFConv2D", "TFMatMul"):
+        from bigdl_tpu.utils.tf import ops as _tf_ops
+        if type(m) is _tf_ops.TFConv2D:
+            return _tf_ops.QuantizedTFConv2D.from_float(m, mode)
+        if type(m) is _tf_ops.TFMatMul:
+            return _tf_ops.QuantizedTFMatMul.from_float(m, mode)
     if isinstance(m, Graph):
         g = m.clone()
         for n in g.exec_nodes:

@@ -45,17 +45,35 @@ def _data_format(node) -> None:
             f"{node.name}: only NHWC frozen graphs are supported (got {fmt})")
 
 
+_MULTI_OUTPUT = ("Split", "SplitV", "Unpack")
+
+
 class _Importer:
     def __init__(self, graph_def):
         self.nodes = {n.name: n for n in graph_def.node}
         self.consts: dict[str, np.ndarray] = {}
         self.module_nodes: dict[str, object] = {}   # tf node name → ModuleNode
         self.input_names: list[str] = []
+        # data-consumer counts drive Conv/MatMul+BiasAdd fusion (fuse only
+        # when the producer has no other consumer)
+        self.consumers: dict[str, int] = {}
+        for n in graph_def.node:
+            for i in n.input:
+                if not i.startswith("^"):
+                    base = i.split(":")[0]
+                    self.consumers[base] = self.consumers.get(base, 0) + 1
 
     # ---------------------------------------------------------------- consts
     def _clean(self, name: str) -> str:
         name = name.split(":")[0]
         return name[1:] if name.startswith("^") else name
+
+    def _parse(self, name: str) -> tuple[str, int]:
+        """Node reference → (base name, output index)."""
+        if name.startswith("^"):
+            name = name[1:]
+        base, _, idx = name.partition(":")
+        return base, int(idx) if idx else 0
 
     def const_value(self, name: str) -> Optional[np.ndarray]:
         """Resolve a node to a numpy constant through Const/Identity chains."""
@@ -70,7 +88,8 @@ class _Importer:
             val = tensor_util.MakeNdarray(node.attr["value"].tensor)
             self.consts[name] = val
             return val
-        if node.op in ("Identity", "CheckNumerics") and node.input:
+        if node.op in ("Identity", "CheckNumerics",
+                       "PlaceholderWithDefault") and node.input:
             return self.const_value(node.input[0])
         return None
 
@@ -80,15 +99,39 @@ class _Importer:
         from bigdl_tpu import nn
 
         def get(name):
-            name = self._clean(name)
-            if name in self.module_nodes:
-                return self.module_nodes[name]
-            node = self.nodes.get(name)
+            base, idx = self._parse(name)
+            key = f"{base}:{idx}" if idx else base
+            if key in self.module_nodes:
+                return self.module_nodes[key]
+            node = self.nodes.get(base)
             if node is None:
-                raise TFImportError(f"unknown node {name!r}")
-            mn = self._convert(node, get)
-            self.module_nodes[name] = mn
-            return mn
+                raise TFImportError(f"unknown node {base!r}")
+            if node.op == "Switch":
+                # frozen-graph control flow: the predicate must be static;
+                # output :0 is the false branch, :1 the true branch
+                pred = self.const_value(node.input[1])
+                if pred is None:
+                    raise TFImportError(
+                        f"{base}: dynamic Switch predicate (only frozen "
+                        f"statically-resolvable control flow is supported)")
+                if idx != int(bool(pred)):
+                    raise TFImportError(f"{base}: dead branch (output {idx}) "
+                                        f"reached")
+                mn = get(node.input[0])
+                self.module_nodes[key] = mn
+                return mn
+            if node.op in _MULTI_OUTPUT:
+                raw = self.module_nodes.get(base + ":raw")
+                if raw is None:
+                    raw = self._convert(node, get)
+                    self.module_nodes[base + ":raw"] = raw
+                sel = nn.SelectTable(idx + 1) \
+                    .set_name(f"{base}.{idx}").inputs(raw)
+                self.module_nodes[key] = sel
+                return sel
+            if base not in self.module_nodes:
+                self.module_nodes[base] = self._convert(node, get)
+            return self.module_nodes[base]
 
         # placeholders discovered lazily unless pinned by `inputs`
         out_nodes = [get(o) for o in outputs]
@@ -105,7 +148,7 @@ class _Importer:
                         out_nodes if len(out_nodes) > 1 else out_nodes[0])
 
     # ------------------------------------------------------------- converters
-    def _convert(self, node, get):
+    def _convert(self, node, get, fused_bias=None):
         from bigdl_tpu import nn
         from bigdl_tpu.utils.tf import ops as O
 
@@ -117,7 +160,11 @@ class _Importer:
         def wire(module, *tf_inputs):
             return module.set_name(node.name).inputs(*[get(i) for i in tf_inputs])
 
-        if op == "Placeholder":
+        if fused_bias is not None and op not in (
+                "Conv2D", "DepthwiseConv2dNative", "MatMul"):
+            raise TFImportError(f"{node.name}: bias fusion into {op!r}")
+
+        if op in ("Placeholder", "PlaceholderWithDefault"):
             self.input_names.append(node.name)
             mn = nn.Input()
             return mn
@@ -135,8 +182,8 @@ class _Importer:
                 raise TFImportError(f"{node.name}: non-const conv weights")
             s = _attr_list(node, "strides")
             d = _attr_list(node, "dilations") or [1, 1, 1, 1]
-            return wire(O.TFConv2D(w, s[1:3], _padding(node), d[1:3]),
-                        node.input[0])
+            return wire(O.TFConv2D(w, s[1:3], _padding(node), d[1:3],
+                                   bias=fused_bias), node.input[0])
         if op == "DepthwiseConv2dNative":
             _data_format(node)
             w = self.const_value(node.input[1])
@@ -144,13 +191,28 @@ class _Importer:
                 raise TFImportError(f"{node.name}: non-const depthwise weights")
             s = _attr_list(node, "strides")
             d = _attr_list(node, "dilations") or [1, 1, 1, 1]
-            return wire(O.TFDepthwiseConv2D(w, s[1:3], _padding(node), d[1:3]),
-                        node.input[0])
+            return wire(O.TFDepthwiseConv2D(w, s[1:3], _padding(node), d[1:3],
+                                            bias=fused_bias), node.input[0])
         if op == "BiasAdd":
             _data_format(node)
             b = self.const_value(node.input[1])
             if b is None:
                 raise TFImportError(f"{node.name}: non-const bias")
+            # semantic fusion (the reference's pattern-fusion analog): fold
+            # the bias into a sole-consumer Conv2D/DepthwiseConv/MatMul so
+            # the pair imports as ONE module — quantizable/serializable as a
+            # unit (XLA would fuse the add for speed either way; this fusion
+            # is about module semantics, not scheduling)
+            src_name = self._clean(node.input[0])
+            src = self.nodes.get(src_name)
+            if (src is not None and src_name not in self.module_nodes
+                    and self.consumers.get(src_name, 0) == 1
+                    and src.op in ("Conv2D", "DepthwiseConv2dNative",
+                                   "MatMul")):
+                mn = self._convert(src, get, fused_bias=b)
+                if mn is not None:
+                    self.module_nodes[src_name] = mn
+                    return mn
             return wire(O.TFBiasAdd(b), node.input[0])
         if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
             _data_format(node)
@@ -189,7 +251,8 @@ class _Importer:
             w = self.const_value(node.input[1])
             if w is None:
                 raise TFImportError(f"{node.name}: non-const matmul weights")
-            return wire(O.TFMatMul(w, node.attr["transpose_b"].b), node.input[0])
+            return wire(O.TFMatMul(w, node.attr["transpose_b"].b,
+                                   bias=fused_bias), node.input[0])
         if op == "Reshape":
             shape = self.const_value(node.input[1])
             if shape is None:
@@ -270,6 +333,176 @@ class _Importer:
             s = _attr_list(node, "strides")
             return wire(O.TFConvTranspose(w, s[1:3], _padding(node),
                                           out_shape), node.input[2])
+
+        if op == "LRN":
+            a = node.attr
+            return wire(O.TFLRN(
+                a["depth_radius"].i if "depth_radius" in a else 5,
+                a["bias"].f if "bias" in a else 1.0,
+                a["alpha"].f if "alpha" in a else 1.0,
+                a["beta"].f if "beta" in a else 0.5), node.input[0])
+        if op in ("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3"):
+            adj_x = node.attr["adj_x"].b
+            adj_y = node.attr["adj_y"].b
+            a, b = data_inputs()
+            ca, cb = self.const_value(a), self.const_value(b)
+            if ca is not None and cb is None:
+                return wire(O.TFBatchMatMul(adj_x, adj_y, const=ca,
+                                            const_on_left=True), b)
+            if cb is not None and ca is None:
+                return wire(O.TFBatchMatMul(adj_x, adj_y, const=cb), a)
+            if ca is None and cb is None:
+                return wire(O.TFBatchMatMul(adj_x, adj_y), a, b)
+            raise TFImportError(f"{node.name}: both inputs const")
+        if op in ("ResizeBilinear", "ResizeNearestNeighbor"):
+            size = self.const_value(node.input[1])
+            if size is None:
+                raise TFImportError(f"{node.name}: dynamic resize size")
+            method = "bilinear" if op == "ResizeBilinear" else "nearest"
+            ac = node.attr["align_corners"].b if "align_corners" in node.attr \
+                else False
+            hp = node.attr["half_pixel_centers"].b \
+                if "half_pixel_centers" in node.attr else False
+            return wire(O.TFResize(method, size, ac, hp), node.input[0])
+        if op == "StridedSlice":
+            begin = self.const_value(node.input[1])
+            end = self.const_value(node.input[2])
+            strides = self.const_value(node.input[3])
+            if begin is None or end is None or strides is None:
+                raise TFImportError(f"{node.name}: dynamic strided-slice spec")
+            a = node.attr
+            return wire(O.TFStridedSlice(
+                np.atleast_1d(begin), np.atleast_1d(end),
+                np.atleast_1d(strides), a["begin_mask"].i, a["end_mask"].i,
+                a["shrink_axis_mask"].i, a["ellipsis_mask"].i,
+                a["new_axis_mask"].i), node.input[0])
+        if op == "Slice":
+            begin = self.const_value(node.input[1])
+            size = self.const_value(node.input[2])
+            if begin is None or size is None:
+                raise TFImportError(f"{node.name}: dynamic slice spec")
+            return wire(O.TFSlice(np.atleast_1d(begin), np.atleast_1d(size)),
+                        node.input[0])
+        if op == "Split":
+            axis = self.const_value(node.input[0])
+            if axis is None:
+                raise TFImportError(f"{node.name}: dynamic split axis")
+            return wire(O.TFSplit(int(axis), node.attr["num_split"].i),
+                        node.input[1])
+        if op == "SplitV":
+            sizes = self.const_value(node.input[1])
+            axis = self.const_value(node.input[2])
+            if axis is None or sizes is None:
+                raise TFImportError(f"{node.name}: dynamic splitv spec")
+            if len(set(np.atleast_1d(sizes).tolist())) != 1:
+                raise TFImportError(
+                    f"{node.name}: unequal SplitV sizes unsupported")
+            return wire(O.TFSplit(int(axis), len(np.atleast_1d(sizes))),
+                        node.input[0])
+        if op == "Unpack":
+            return wire(O.TFUnpack(node.attr["axis"].i, node.attr["num"].i),
+                        node.input[0])
+        if op in ("Pack", "Stack"):
+            return wire(O.TFPack(node.attr["axis"].i), *data_inputs())
+        if op == "Tile":
+            mult = self.const_value(node.input[1])
+            if mult is None:
+                raise TFImportError(f"{node.name}: dynamic tile multiples")
+            return wire(O.TFTile(np.atleast_1d(mult)), node.input[0])
+        if op in ("Gather", "GatherV2"):
+            ins = data_inputs()
+            axis = 0
+            if op == "GatherV2":
+                ax = self.const_value(ins[2])
+                if ax is None:
+                    raise TFImportError(f"{node.name}: dynamic gather axis")
+                axis = int(ax)
+            cp, ci = self.const_value(ins[0]), self.const_value(ins[1])
+            if cp is not None and ci is None:   # embedding lookup
+                return wire(O.TFGather(axis, params_const=cp), ins[1])
+            if ci is not None and cp is None:
+                return wire(O.TFGather(axis, indices_const=ci), ins[0])
+            if cp is None and ci is None:
+                return wire(O.TFGather(axis), ins[0], ins[1])
+            raise TFImportError(f"{node.name}: both inputs const")
+        if op == "ArgMax":
+            axis = self.const_value(node.input[1])
+            if axis is None:
+                raise TFImportError(f"{node.name}: dynamic argmax axis")
+            dt = node.attr["output_type"].type if "output_type" in node.attr \
+                else 9  # DT_INT64
+            return wire(O.TFArgMax(int(axis),
+                                   "int32" if dt == 3 else "int64"),
+                        node.input[0])
+        if op == "Cast":
+            from tensorflow.python.framework import dtypes as tf_dtypes
+            dt = tf_dtypes.as_dtype(node.attr["DstT"].type)
+            return wire(O.TFCast(dt.as_numpy_dtype.__name__), node.input[0])
+        if op in ("Select", "SelectV2"):
+            ins = data_inputs()
+            consts = [self.const_value(i) for i in ins]
+            live = [i for i, c in zip(ins, consts) if c is None]
+            if not live:
+                raise TFImportError(f"{node.name}: all Select inputs const")
+            return wire(O.TFSelect(cond_const=consts[0],
+                                   then_const=consts[1],
+                                   else_const=consts[2]), *live)
+        if op == "LogSoftmax":
+            return wire(nn.LogSoftMax(), node.input[0])
+        if op == "SpaceToBatchND":
+            bs = self.const_value(node.input[1])
+            pads = self.const_value(node.input[2])
+            if bs is None or pads is None:
+                raise TFImportError(f"{node.name}: dynamic space-to-batch spec")
+            return wire(O.TFSpaceToBatchND(bs, pads), node.input[0])
+        if op == "BatchToSpaceND":
+            bs = self.const_value(node.input[1])
+            crops = self.const_value(node.input[2])
+            if bs is None or crops is None:
+                raise TFImportError(f"{node.name}: dynamic batch-to-space spec")
+            return wire(O.TFBatchToSpaceND(bs, crops), node.input[0])
+        if op == "Merge":
+            # frozen control flow: exactly one branch is live under a static
+            # Switch predicate — take the importable one
+            errs = []
+            for i in data_inputs():
+                try:
+                    return get(i)
+                except TFImportError as e:
+                    errs.append(str(e))
+            raise TFImportError(
+                f"{node.name}: no live Merge branch imports: {errs}")
+        _comparisons = {"Greater": "greater", "GreaterEqual": "greater_equal",
+                        "Less": "less", "LessEqual": "less_equal",
+                        "Equal": "equal", "NotEqual": "not_equal",
+                        "LogicalAnd": "logical_and", "LogicalOr": "logical_or",
+                        "Pow": "pow", "FloorDiv": "floordiv",
+                        "FloorMod": "mod", "Mod": "mod"}
+        if op in _comparisons:
+            kind = _comparisons[op]
+            a, b = data_inputs()
+            ca, cb = self.const_value(a), self.const_value(b)
+            if ca is not None and cb is None:
+                return wire(O.TFBinaryOp(kind, const=ca, const_on_left=True), b)
+            if cb is not None and ca is None:
+                return wire(O.TFBinaryOp(kind, const=cb), a)
+            if ca is None and cb is None:
+                return wire(O.TFBinaryOp(kind), a, b)
+            raise TFImportError(f"{node.name}: both inputs const")
+        _more_unary = {"Floor": "floor", "Ceil": "ceil", "Round": "round",
+                       "Sign": "sign", "Sin": "sin", "Cos": "cos",
+                       "Erf": "erf", "Reciprocal": "reciprocal",
+                       "Inv": "reciprocal", "Log1p": "log1p",
+                       "Expm1": "expm1", "LogicalNot": "logical_not"}
+        if op in _more_unary:
+            return wire(O.TFUnary(_more_unary[op]), node.input[0])
+        if op in ("Prod", "All", "Any"):
+            axes = self.const_value(node.input[1])
+            if axes is None:
+                raise TFImportError(f"{node.name}: dynamic reduction axes")
+            keep = node.attr["keep_dims"].b
+            return wire(O.TFReduce(op.lower(), np.atleast_1d(axes), keep),
+                        node.input[0])
 
         raise TFImportError(
             f"unsupported op {op!r} at node {node.name!r} — add a converter in "

@@ -23,16 +23,25 @@ from bigdl_tpu.nn.abstractnn import AbstractModule, TensorModule
 from bigdl_tpu.utils.table import Table
 
 
+def jax_erf(x):
+    from jax.scipy.special import erf
+    return erf(x)
+
+
 class TFConv2D(TensorModule):
-    """NHWC Conv2D; weights HWIO (TF layout, kept as-is)."""
+    """NHWC Conv2D; weights HWIO (TF layout, kept as-is). ``bias`` present
+    when the importer fused a trailing BiasAdd into this module."""
 
     def __init__(self, weight: np.ndarray, strides: Sequence[int],
-                 padding: str, dilations: Sequence[int] = (1, 1)):
+                 padding: str, dilations: Sequence[int] = (1, 1),
+                 bias: np.ndarray | None = None):
         super().__init__()
         self.strides = tuple(strides)
         self.padding = padding
         self.dilations = tuple(dilations)
         self._params = {"weight": jnp.asarray(weight)}
+        if bias is not None:
+            self._params["bias"] = jnp.asarray(bias)
 
     def apply(self, params, state, input, *, training=False, rng=None):
         out = lax.conv_general_dilated(
@@ -41,6 +50,8 @@ class TFConv2D(TensorModule):
             padding=self.padding,
             rhs_dilation=self.dilations,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if "bias" in params:
+            out = out + params["bias"]
         return out, state
 
 
@@ -48,7 +59,8 @@ class TFDepthwiseConv2D(TensorModule):
     """NHWC DepthwiseConv2dNative; TF weight (H, W, C, M) → grouped conv."""
 
     def __init__(self, weight: np.ndarray, strides: Sequence[int], padding: str,
-                 dilations: Sequence[int] = (1, 1)):
+                 dilations: Sequence[int] = (1, 1),
+                 bias: np.ndarray | None = None):
         super().__init__()
         self.strides = tuple(strides)
         self.padding = padding
@@ -57,6 +69,8 @@ class TFDepthwiseConv2D(TensorModule):
         self.channels = c
         # grouped-conv weight: (H, W, 1, C*M) with feature_group_count=C
         self._params = {"weight": jnp.asarray(weight.reshape(h, w, 1, c * m))}
+        if bias is not None:
+            self._params["bias"] = jnp.asarray(bias)
 
     def apply(self, params, state, input, *, training=False, rng=None):
         out = lax.conv_general_dilated(
@@ -66,6 +80,8 @@ class TFDepthwiseConv2D(TensorModule):
             rhs_dilation=self.dilations,
             feature_group_count=self.channels,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if "bias" in params:
+            out = out + params["bias"]
         return out, state
 
 
@@ -119,13 +135,19 @@ class TFPool(TensorModule):
 
 
 class TFMatMul(TensorModule):
-    def __init__(self, weight: np.ndarray, transpose_b: bool = False):
+    def __init__(self, weight: np.ndarray, transpose_b: bool = False,
+                 bias: np.ndarray | None = None):
         super().__init__()
         self._params = {"weight": jnp.asarray(
             weight.T if transpose_b else weight)}
+        if bias is not None:
+            self._params["bias"] = jnp.asarray(bias)
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        return input @ params["weight"], state
+        out = input @ params["weight"]
+        if "bias" in params:
+            out = out + params["bias"]
+        return out, state
 
 
 class TFReshape(TensorModule):
@@ -199,7 +221,13 @@ class TFBinaryOp(AbstractModule):
 
     _FNS = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
             "div": jnp.divide, "max": jnp.maximum, "min": jnp.minimum,
-            "sqdiff": lambda a, b: jnp.square(a - b)}
+            "sqdiff": lambda a, b: jnp.square(a - b),
+            "pow": jnp.power, "floordiv": jnp.floor_divide,
+            "mod": jnp.mod,
+            "greater": jnp.greater, "greater_equal": jnp.greater_equal,
+            "less": jnp.less, "less_equal": jnp.less_equal,
+            "equal": jnp.equal, "not_equal": jnp.not_equal,
+            "logical_and": jnp.logical_and, "logical_or": jnp.logical_or}
 
     def __init__(self, op: str, const=None, const_on_left: bool = False):
         super().__init__()
@@ -233,6 +261,17 @@ class TFUnary(TensorModule):
         "log": jnp.log,
         "softplus": lambda x: jnp.logaddexp(x, 0.0),
         "elu": lambda x: jnp.where(x > 0, x, jnp.expm1(x)),
+        "floor": jnp.floor,
+        "ceil": jnp.ceil,
+        "round": jnp.round,
+        "sign": jnp.sign,
+        "sin": jnp.sin,
+        "cos": jnp.cos,
+        "erf": lambda x: jax_erf(x),
+        "reciprocal": jnp.reciprocal,
+        "log1p": jnp.log1p,
+        "expm1": jnp.expm1,
+        "logical_not": jnp.logical_not,
     }
 
     def __init__(self, op: str):
@@ -258,7 +297,8 @@ class TFReduce(TensorModule):
     """Sum/Max/Min reductions over const axes (Mean has its own class for
     backward compatibility of serialized graphs)."""
 
-    _FNS = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}
+    _FNS = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+            "prod": jnp.prod, "all": jnp.all, "any": jnp.any}
 
     def __init__(self, op: str, axes, keepdims: bool = False):
         super().__init__()
@@ -313,4 +353,436 @@ class TFConvTranspose(TensorModule):
             lhs_dilation=(sh, sw),
             dimension_numbers=("NHWC", "HWOI", "NHWC"),
         )
+        return out, state
+
+
+class TFLRN(TensorModule):
+    """Local Response Normalization over the channel (last) axis — TF's
+    ``tf.nn.lrn``: out = x / (bias + alpha * sum_{d-r..d+r} x_d^2) ** beta.
+    Inception-v1/AlexNet-era frozen graphs use it."""
+
+    def __init__(self, depth_radius: int = 5, bias: float = 1.0,
+                 alpha: float = 1.0, beta: float = 0.5):
+        super().__init__()
+        self.depth_radius = int(depth_radius)
+        self.bias = float(bias)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        r = self.depth_radius
+        sq = jnp.square(input)
+        window = (1,) * (input.ndim - 1) + (2 * r + 1,)
+        sums = lax.reduce_window(sq, 0.0, lax.add, window, (1,) * input.ndim,
+                                 [(0, 0)] * (input.ndim - 1) + [(r, r)])
+        return input / jnp.power(self.bias + self.alpha * sums, self.beta), state
+
+
+class TFBatchMatMul(AbstractModule):
+    """BatchMatMul(V2/V3) over two graph inputs (Table), or one input and a
+    captured const side."""
+
+    def __init__(self, adj_x: bool = False, adj_y: bool = False,
+                 const=None, const_on_left: bool = False):
+        super().__init__()
+        self.adj_x, self.adj_y = bool(adj_x), bool(adj_y)
+        self.const_on_left = const_on_left
+        if const is not None:
+            self._state = {"const": jnp.asarray(const)}
+
+    def _mm(self, a, b):
+        if self.adj_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.adj_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if "const" in state:
+            c = state["const"]
+            out = self._mm(c, input) if self.const_on_left else self._mm(input, c)
+            return out, state
+        xs = input.values() if isinstance(input, Table) else list(input)
+        return self._mm(xs[0], xs[1]), state
+
+
+class TFResize(TensorModule):
+    """ResizeBilinear / ResizeNearestNeighbor with TF's exact coordinate
+    conventions (legacy align_corners / half_pixel_centers included) via
+    explicit gather + lerp — ``jax.image.resize`` only matches the
+    half-pixel convention, and frozen TF1 graphs mostly use the legacy one."""
+
+    def __init__(self, method: str, size: Sequence[int],
+                 align_corners: bool = False, half_pixel_centers: bool = False):
+        super().__init__()
+        if method not in ("bilinear", "nearest"):
+            raise ValueError(method)
+        self.method = method
+        self.size = tuple(int(s) for s in size)       # (out_h, out_w)
+        self.align_corners = bool(align_corners)
+        self.half_pixel_centers = bool(half_pixel_centers)
+
+    def _src_coords(self, out_len: int, in_len: int):
+        o = jnp.arange(out_len, dtype=jnp.float32)
+        if self.align_corners and out_len > 1:
+            scale = (in_len - 1) / (out_len - 1)
+            return o * scale
+        scale = in_len / out_len
+        if self.half_pixel_centers:
+            return (o + 0.5) * scale - 0.5
+        return o * scale
+
+    def _axis_nearest(self, x, axis, out_len):
+        in_len = x.shape[axis]
+        src = self._src_coords(out_len, in_len)
+        if self.half_pixel_centers and not self.align_corners:
+            idx = jnp.floor(src + 0.5)
+        elif self.align_corners:
+            idx = jnp.round(src)
+        else:
+            idx = jnp.floor(src)
+        idx = jnp.clip(idx, 0, in_len - 1).astype(jnp.int32)
+        return jnp.take(x, idx, axis=axis)
+
+    def _axis_bilinear(self, x, axis, out_len):
+        in_len = x.shape[axis]
+        src = jnp.clip(self._src_coords(out_len, in_len), 0.0, in_len - 1)
+        lo = jnp.floor(src).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, in_len - 1)
+        frac = src - lo
+        shape = [1] * x.ndim
+        shape[axis] = out_len
+        frac = frac.reshape(shape)
+        return (jnp.take(x, lo, axis=axis) * (1.0 - frac)
+                + jnp.take(x, hi, axis=axis) * frac)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        fn = self._axis_bilinear if self.method == "bilinear" \
+            else self._axis_nearest
+        out = fn(input, 1, self.size[0])
+        out = fn(out, 2, self.size[1])
+        return out, state
+
+
+class TFStridedSlice(TensorModule):
+    """StridedSlice with const begin/end/strides and full mask semantics
+    (begin/end/ellipsis/new-axis/shrink)."""
+
+    def __init__(self, begin, end, strides, begin_mask: int = 0,
+                 end_mask: int = 0, shrink_axis_mask: int = 0,
+                 ellipsis_mask: int = 0, new_axis_mask: int = 0):
+        super().__init__()
+        self.begin = [int(v) for v in begin]
+        self.end = [int(v) for v in end]
+        self.strides = [int(v) for v in strides]
+        self.begin_mask = int(begin_mask)
+        self.end_mask = int(end_mask)
+        self.shrink_axis_mask = int(shrink_axis_mask)
+        self.ellipsis_mask = int(ellipsis_mask)
+        self.new_axis_mask = int(new_axis_mask)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        idx: list = []
+        consumed = 0  # input dims consumed by the spec entries so far
+        n = len(self.begin)
+        for d in range(n):
+            if self.new_axis_mask & (1 << d):
+                idx.append(None)  # np.newaxis
+                continue
+            if self.ellipsis_mask & (1 << d):
+                after = sum(1 for k in range(d + 1, n)
+                            if not self.new_axis_mask & (1 << k))
+                fill = input.ndim - consumed - after
+                idx.extend([slice(None)] * fill)
+                consumed += fill
+                continue
+            if self.shrink_axis_mask & (1 << d):
+                b = self.begin[d]
+                idx.append(b if b >= 0 else input.shape[consumed] + b)
+                consumed += 1
+                continue
+            b = None if self.begin_mask & (1 << d) else self.begin[d]
+            e = None if self.end_mask & (1 << d) else self.end[d]
+            idx.append(slice(b, e, self.strides[d]))
+            consumed += 1
+        idx.extend([slice(None)] * (input.ndim - consumed))
+        return input[tuple(idx)], state
+
+
+class TFSlice(TensorModule):
+    def __init__(self, begin, size):
+        super().__init__()
+        self.begin = [int(v) for v in begin]
+        self.size = [int(v) for v in size]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        idx = tuple(slice(b, None if s == -1 else b + s)
+                    for b, s in zip(self.begin, self.size))
+        return input[idx], state
+
+
+class TFSplit(AbstractModule):
+    """Split into ``num`` equal parts along ``axis`` → Table (consumers pick
+    entries through the importer's output-index wiring)."""
+
+    def __init__(self, axis: int, num: int):
+        super().__init__()
+        self.axis = int(axis)
+        self.num = int(num)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        parts = jnp.split(input, self.num, axis=self.axis)
+        return Table(*parts), state
+
+
+class TFUnpack(AbstractModule):
+    """Unpack/Unstack along ``axis`` → Table."""
+
+    def __init__(self, axis: int, num: int):
+        super().__init__()
+        self.axis = int(axis)
+        self.num = int(num)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        parts = [jnp.squeeze(p, axis=self.axis)
+                 for p in jnp.split(input, self.num, axis=self.axis)]
+        return Table(*parts), state
+
+
+class TFPack(AbstractModule):
+    """Pack/Stack graph inputs along a new ``axis``."""
+
+    def __init__(self, axis: int):
+        super().__init__()
+        self.axis = int(axis)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else [input]
+        return jnp.stack(xs, axis=self.axis), state
+
+
+class TFTile(TensorModule):
+    def __init__(self, multiples):
+        super().__init__()
+        self.multiples = tuple(int(m) for m in multiples)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.tile(input, self.multiples), state
+
+
+class TFGather(AbstractModule):
+    """GatherV2. The common frozen-graph shape is const params + dynamic
+    indices (embedding lookup) — the const side is captured; fully dynamic
+    (both graph inputs) also supported via Table."""
+
+    def __init__(self, axis: int = 0, params_const=None, indices_const=None):
+        super().__init__()
+        self.axis = int(axis)
+        if params_const is not None:
+            self._state = {"params_const": jnp.asarray(params_const)}
+        elif indices_const is not None:
+            self._state = {"indices_const": jnp.asarray(indices_const)}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if "params_const" in state:
+            return jnp.take(state["params_const"], input, axis=self.axis), state
+        if "indices_const" in state:
+            return jnp.take(input, state["indices_const"], axis=self.axis), state
+        xs = input.values() if isinstance(input, Table) else list(input)
+        return jnp.take(xs[0], xs[1], axis=self.axis), state
+
+
+class TFArgMax(TensorModule):
+    def __init__(self, axis: int, out_dtype: str = "int64"):
+        super().__init__()
+        self.axis = int(axis)
+        self.out_dtype = out_dtype
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.argmax(input, axis=self.axis).astype(self.out_dtype), state
+
+
+class TFCast(TensorModule):
+    def __init__(self, dtype: str):
+        super().__init__()
+        self.dtype = dtype
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input.astype(self.dtype), state
+
+
+class TFSelect(AbstractModule):
+    """Select/SelectV2 (where). Const operands (e.g. a frozen ``zeros_like``
+    branch) are captured at import; the remaining graph inputs arrive in
+    (cond, then, else) order."""
+
+    def __init__(self, cond_const=None, then_const=None, else_const=None):
+        super().__init__()
+        st = {}
+        if cond_const is not None:
+            st["cond"] = jnp.asarray(cond_const)
+        if then_const is not None:
+            st["then"] = jnp.asarray(then_const)
+        if else_const is not None:
+            st["else"] = jnp.asarray(else_const)
+        self._state = st
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else (
+            list(input) if isinstance(input, (list, tuple)) else [input])
+        it = iter(xs)
+        cond = state["cond"] if "cond" in state else next(it)
+        then = state["then"] if "then" in state else next(it)
+        other = state["else"] if "else" in state else next(it)
+        return jnp.where(cond, then, other), state
+
+
+class TFSpaceToBatchND(TensorModule):
+    """SpaceToBatchND — TF1's dilated-conv rewrite companion."""
+
+    def __init__(self, block_shape, paddings):
+        super().__init__()
+        self.block_shape = [int(b) for b in np.atleast_1d(block_shape)]
+        self.paddings = [(int(a), int(b)) for a, b in np.asarray(paddings)]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        m = len(self.block_shape)
+        pads = [(0, 0)] + self.paddings + [(0, 0)] * (x.ndim - m - 1)
+        x = jnp.pad(x, pads)
+        n = x.shape[0]
+        spatial = x.shape[1:1 + m]
+        rest = x.shape[1 + m:]
+        # (N, s1/b1, b1, ..., sm/bm, bm, rest)
+        shape = [n]
+        for s, b in zip(spatial, self.block_shape):
+            shape += [s // b, b]
+        shape += list(rest)
+        x = x.reshape(shape)
+        # blocks to the front of batch
+        perm = ([2 * i + 2 for i in range(m)] + [0]
+                + [2 * i + 1 for i in range(m)]
+                + list(range(1 + 2 * m, x.ndim)))
+        x = jnp.transpose(x, perm)
+        out_shape = ([n * int(np.prod(self.block_shape))]
+                     + [s // b for s, b in zip(spatial, self.block_shape)]
+                     + list(rest))
+        return x.reshape(out_shape), state
+
+
+class TFBatchToSpaceND(TensorModule):
+    def __init__(self, block_shape, crops):
+        super().__init__()
+        self.block_shape = [int(b) for b in np.atleast_1d(block_shape)]
+        self.crops = [(int(a), int(b)) for a, b in np.asarray(crops)]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        m = len(self.block_shape)
+        prod_b = int(np.prod(self.block_shape))
+        n = x.shape[0] // prod_b
+        spatial = x.shape[1:1 + m]
+        rest = x.shape[1 + m:]
+        x = x.reshape(self.block_shape + [n] + list(spatial) + list(rest))
+        # interleave blocks back into spatial dims
+        perm = [m]
+        for i in range(m):
+            perm += [m + 1 + i, i]
+        perm += list(range(2 * m + 1, x.ndim))
+        x = jnp.transpose(x, perm)
+        x = x.reshape([n] + [s * b for s, b in zip(spatial, self.block_shape)]
+                      + list(rest))
+        idx = [slice(None)]
+        for (c0, c1), s, b in zip(self.crops, spatial, self.block_shape):
+            idx.append(slice(c0, s * b - c1))
+        return x[tuple(idx)], state
+
+
+class QuantizedTFConv2D(TensorModule):
+    """Int8 NHWC conv for imported graphs — the bigquant path applied to
+    ``TFConv2D`` (weight HWIO, per-output-channel scales on axis 3)."""
+
+    def __init__(self, strides, padding, dilations=(1, 1), mode="dynamic"):
+        super().__init__()
+        if mode not in ("dynamic", "weight_only"):
+            raise ValueError(mode)
+        self.mode = mode
+        self.strides = tuple(strides)
+        self.padding = padding
+        self.dilations = tuple(dilations)
+
+    @classmethod
+    def from_float(cls, m: TFConv2D, mode: str = "dynamic"):
+        from bigdl_tpu.nn.quantized import _quantize_weight
+        q = cls(m.strides, m.padding, m.dilations, mode)
+        w_q, scale = _quantize_weight(np.asarray(m.get_params()["weight"]),
+                                      channel_axis=3)
+        q._params = {"weight_q": jnp.asarray(w_q),
+                     "w_scale": jnp.asarray(scale)}
+        if "bias" in m.get_params():
+            q._params["bias"] = jnp.asarray(m.get_params()["bias"])
+        q.name = m.name
+        return q
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if training:
+            raise RuntimeError("QuantizedTFConv2D is inference-only")
+        from bigdl_tpu.nn.quantized import _quantize_activation
+        kw = dict(window_strides=self.strides, padding=self.padding,
+                  rhs_dilation=self.dilations,
+                  dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.mode == "weight_only":
+            w = params["weight_q"].astype(input.dtype) \
+                * params["w_scale"].astype(input.dtype)
+            out = lax.conv_general_dilated(input, w, **kw).astype(jnp.float32)
+        else:
+            x_q, s_x = _quantize_activation(input)
+            acc = lax.conv_general_dilated(
+                x_q, params["weight_q"],
+                preferred_element_type=jnp.int32, **kw)
+            out = acc.astype(jnp.float32) * (s_x * params["w_scale"])
+        if "bias" in params:
+            out = out + params["bias"]
+        return out, state
+
+
+class QuantizedTFMatMul(TensorModule):
+    """Int8 matmul for imported graphs (weight (in, out), scales on axis 1)."""
+
+    def __init__(self, mode: str = "dynamic"):
+        super().__init__()
+        if mode not in ("dynamic", "weight_only"):
+            raise ValueError(mode)
+        self.mode = mode
+
+    @classmethod
+    def from_float(cls, m: TFMatMul, mode: str = "dynamic"):
+        from bigdl_tpu.nn.quantized import _quantize_weight
+        q = cls(mode)
+        w_q, scale = _quantize_weight(np.asarray(m.get_params()["weight"]),
+                                      channel_axis=1)
+        q._params = {"weight_q": jnp.asarray(w_q),
+                     "w_scale": jnp.asarray(scale)}
+        if "bias" in m.get_params():
+            q._params["bias"] = jnp.asarray(m.get_params()["bias"])
+        q.name = m.name
+        return q
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if training:
+            raise RuntimeError("QuantizedTFMatMul is inference-only")
+        from bigdl_tpu.nn.quantized import _quantize_activation
+        from jax import lax as _lax
+        if self.mode == "weight_only":
+            w = params["weight_q"].astype(input.dtype) \
+                * params["w_scale"][None, :].astype(input.dtype)
+            out = (input @ w).astype(jnp.float32)
+        else:
+            x_q, s_x = _quantize_activation(input)
+            acc = _lax.dot_general(x_q, params["weight_q"],
+                                   dimension_numbers=(((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (s_x * params["w_scale"][None, :])
+        if "bias" in params:
+            out = out + params["bias"]
         return out, state

@@ -1,0 +1,263 @@
+"""Widened TF frozen-graph importer (SURVEY.md §2.5 — round-3 verdict Missing
+#6: op depth, pattern fusion, control flow): TF-execution oracles for the new
+converter families, Conv/MatMul+BiasAdd semantic fusion, multi-output (Split/
+Unpack) wiring, and static Switch/Merge control flow."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax.numpy as jnp  # noqa: E402
+
+from bigdl_tpu.utils.tf import TFImportError, load_frozen_graph  # noqa: E402
+
+
+def _freeze(fn, *specs):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+    cf = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    out_name = frozen.outputs[0].name.split(":")[0]
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    return gd, in_names, out_name, frozen
+
+
+def _check(fn, x, rtol=1e-4, atol=1e-5):
+    spec = tf.TensorSpec(x.shape, tf.as_dtype(x.dtype))
+    gd, ins, out, frozen = _freeze(fn, spec)
+    g = load_frozen_graph(gd, outputs=[out], inputs=ins)
+    ref = np.asarray(frozen(tf.constant(x))[0])
+    ours = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+    np.testing.assert_allclose(ours, ref, rtol=rtol, atol=atol)
+    return g
+
+
+class TestWideOpSet:
+    def test_lrn(self):
+        x = np.random.default_rng(0).normal(size=(2, 4, 4, 8)).astype(np.float32)
+        _check(lambda x: tf.nn.lrn(x, depth_radius=2, bias=1.5, alpha=0.8,
+                                   beta=0.6), x)
+
+    @pytest.mark.parametrize("method,kwargs", [
+        ("bilinear", {}),
+        ("bilinear", {"half_pixel_centers": True}),
+        ("nearest", {}),
+        ("nearest", {"half_pixel_centers": True}),
+    ])
+    def test_resize(self, method, kwargs):
+        x = np.random.default_rng(1).normal(size=(1, 5, 7, 3)).astype(np.float32)
+        if method == "bilinear":
+            fn = lambda x: tf.compat.v1.image.resize_bilinear(x, [9, 13], **kwargs)
+        else:
+            fn = lambda x: tf.compat.v1.image.resize_nearest_neighbor(
+                x, [9, 13], **kwargs)
+        _check(fn, x)
+
+    def test_strided_slice_and_shape_ops(self):
+        x = np.random.default_rng(2).normal(size=(2, 6, 8)).astype(np.float32)
+        _check(lambda x: x[:, 1:5:2, ::-1] + tf.tile(x[:, :1, :1], [1, 2, 8]),
+               x)
+
+    def test_split_concat_roundtrip(self):
+        x = np.random.default_rng(3).normal(size=(2, 12)).astype(np.float32)
+
+        def f(x):
+            a, b, c = tf.split(x, 3, axis=1)
+            return tf.concat([c * 2.0, a, b], axis=1)
+
+        _check(f, x)
+
+    def test_pack_unpack(self):
+        x = np.random.default_rng(4).normal(size=(3, 5)).astype(np.float32)
+
+        def f(x):
+            rows = tf.unstack(x, axis=0)
+            return tf.stack([rows[2], rows[0] + rows[1]], axis=0)
+
+        _check(f, x)
+
+    def test_gather_embedding(self):
+        table = np.random.default_rng(5).normal(size=(20, 6)).astype(np.float32)
+        ids = np.array([[1, 4, 9], [0, 19, 3]], dtype=np.int32)
+        v = tf.Variable(table)
+        spec = tf.TensorSpec(ids.shape, tf.int32)
+        gd, ins, out, frozen = _freeze(lambda i: tf.gather(v, i), spec)
+        g = load_frozen_graph(gd, outputs=[out], inputs=ins)
+        ref = np.asarray(frozen(tf.constant(ids))[0])
+        ours = np.asarray(g.evaluate().forward(jnp.asarray(ids)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-6)
+
+    def test_argmax_cast_select(self):
+        x = np.random.default_rng(6).normal(size=(4, 7)).astype(np.float32)
+
+        def f(x):
+            m = tf.cast(tf.argmax(x, axis=1), tf.float32)
+            return tf.where(x > 0.0, x, tf.zeros_like(x)) \
+                + m[:, None] * 0.01
+
+        _check(f, x)
+
+    def test_batch_matmul(self):
+        x = np.random.default_rng(7).normal(size=(3, 4, 5)).astype(np.float32)
+        w = tf.Variable(np.random.default_rng(8)
+                        .normal(size=(3, 5, 6)).astype(np.float32))
+        _check(lambda x: tf.matmul(x, w), x)
+
+    def test_comparisons_pow_floor(self):
+        x = np.abs(np.random.default_rng(9)
+                   .normal(size=(3, 5)).astype(np.float32)) + 0.1
+
+        def f(x):
+            g = tf.cast(tf.greater(x, 0.5), tf.float32)
+            return g * tf.pow(x, 1.5) + tf.floor(x) + tf.math.erf(x)
+
+        _check(f, x)
+
+    def test_prod_reduction(self):
+        x = np.random.default_rng(10).normal(size=(2, 4)).astype(np.float32)
+        _check(lambda x: tf.reduce_prod(x * 0.5 + 1.0, axis=1, keepdims=True),
+               x)
+
+    def test_log_softmax(self):
+        x = np.random.default_rng(11).normal(size=(4, 9)).astype(np.float32)
+        _check(lambda x: tf.nn.log_softmax(x), x)
+
+    def test_atrous_conv_space_to_batch(self):
+        """tf.nn.atrous_conv2d lowers through SpaceToBatchND/BatchToSpaceND
+        in graph form — the dilated-conv rewrite pattern."""
+        x = np.random.default_rng(12).normal(size=(1, 12, 12, 3)).astype(np.float32)
+        w = tf.Variable(np.random.default_rng(13)
+                        .normal(scale=0.3, size=(3, 3, 3, 4)).astype(np.float32))
+
+        def f(x):
+            y = tf.space_to_batch_nd(x, block_shape=[2, 2],
+                                     paddings=[[2, 2], [2, 2]])
+            y = tf.nn.conv2d(y, w, strides=1, padding="VALID")
+            return tf.batch_to_space(y, block_shape=[2, 2],
+                                     crops=[[0, 0], [0, 0]])
+
+        _check(f, x)
+
+
+class TestBiasFusion:
+    def test_conv_bias_fuses_to_one_module(self):
+        w = tf.Variable(np.random.default_rng(0)
+                        .normal(scale=0.3, size=(3, 3, 3, 4)).astype(np.float32))
+        b = tf.Variable(np.random.default_rng(1)
+                        .normal(size=(4,)).astype(np.float32))
+        x = np.random.default_rng(2).normal(size=(1, 8, 8, 3)).astype(np.float32)
+
+        def f(x):
+            return tf.nn.relu(tf.nn.bias_add(
+                tf.nn.conv2d(x, w, strides=1, padding="SAME"), b))
+
+        g = _check(f, x)
+        from bigdl_tpu.utils.tf import ops as O
+        convs = [m for m in g.modules if type(m) is O.TFConv2D]
+        bias_adds = [m for m in g.modules if type(m) is O.TFBiasAdd]
+        assert len(convs) == 1 and "bias" in convs[0].get_params()
+        assert not bias_adds, "BiasAdd should have fused into the conv"
+
+    def test_shared_conv_output_does_not_fuse(self):
+        """A conv consumed by BiasAdd AND another op must stay unfused."""
+        w = tf.Variable(np.random.default_rng(3)
+                        .normal(scale=0.3, size=(1, 1, 3, 3)).astype(np.float32))
+        b = tf.Variable(np.random.default_rng(4)
+                        .normal(size=(3,)).astype(np.float32))
+        x = np.random.default_rng(5).normal(size=(1, 4, 4, 3)).astype(np.float32)
+
+        def f(x):
+            y = tf.nn.conv2d(x, w, strides=1, padding="SAME")
+            return tf.nn.bias_add(y, b) + y * 0.5
+
+        _check(f, x)
+
+
+class TestFrozenControlFlow:
+    def _switch_merge_graph(self, pred_value: bool):
+        """Hand-built TF1-style Switch/Merge: relu branch vs neg branch under
+        a Const predicate (what a frozen is_training flag leaves behind)."""
+        from tensorflow.core.framework import graph_pb2
+        from tensorflow.python.framework import tensor_util
+
+        gd = graph_pb2.GraphDef()
+        x = gd.node.add()
+        x.name, x.op = "x", "Placeholder"
+        x.attr["dtype"].type = tf.float32.as_datatype_enum
+
+        pred = gd.node.add()
+        pred.name, pred.op = "pred", "Const"
+        pred.attr["dtype"].type = tf.bool.as_datatype_enum
+        pred.attr["value"].tensor.CopyFrom(
+            tensor_util.make_tensor_proto(bool(pred_value)))
+
+        sw = gd.node.add()
+        sw.name, sw.op = "cond/Switch", "Switch"
+        sw.input.extend(["x", "pred"])
+
+        f = gd.node.add()
+        f.name, f.op = "cond/neg", "Neg"
+        f.input.append("cond/Switch:0")     # false branch
+
+        t = gd.node.add()
+        t.name, t.op = "cond/relu", "Relu"
+        t.input.append("cond/Switch:1")     # true branch
+
+        m = gd.node.add()
+        m.name, m.op = "cond/Merge", "Merge"
+        m.input.extend(["cond/neg", "cond/relu"])
+        return gd
+
+    @pytest.mark.parametrize("pred", [True, False])
+    def test_static_switch_merge(self, pred):
+        gd = self._switch_merge_graph(pred)
+        g = load_frozen_graph(gd, outputs=["cond/Merge"], inputs=["x"])
+        x = np.array([[-2.0, 3.0]], dtype=np.float32)
+        out = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+        ref = np.maximum(x, 0) if pred else -x
+        np.testing.assert_allclose(out, ref)
+
+    def test_dynamic_predicate_fails_loudly(self):
+        from tensorflow.core.framework import graph_pb2
+
+        gd = self._switch_merge_graph(True)
+        # repoint the predicate at a placeholder → not statically resolvable
+        p = gd.node.add()
+        p.name, p.op = "dyn_pred", "Placeholder"
+        p.attr["dtype"].type = tf.bool.as_datatype_enum
+        for n in gd.node:
+            if n.op == "Switch":
+                n.input[1] = "dyn_pred"
+        with pytest.raises(TFImportError, match="Switch predicate"):
+            load_frozen_graph(gd, outputs=["cond/Merge"], inputs=["x"])
+
+
+class TestImportedGraphQuantizes:
+    def test_quantize_imported_cnn(self):
+        """module.quantize() on an imported graph must actually convert the
+        conv/matmul adapters to int8 (not silently no-op) and stay close."""
+        w = tf.Variable(np.random.default_rng(0)
+                        .normal(scale=0.3, size=(3, 3, 3, 8)).astype(np.float32))
+        b = tf.Variable(np.random.default_rng(1)
+                        .normal(size=(8,)).astype(np.float32))
+        wd = tf.Variable(np.random.default_rng(2)
+                         .normal(scale=0.3, size=(8, 5)).astype(np.float32))
+
+        def f(x):
+            y = tf.nn.relu(tf.nn.bias_add(
+                tf.nn.conv2d(x, w, strides=2, padding="SAME"), b))
+            y = tf.reduce_mean(y, axis=[1, 2])
+            return tf.matmul(y, wd)
+
+        x = np.random.default_rng(3).normal(size=(2, 8, 8, 3)).astype(np.float32)
+        g = _check(f, x)
+        q = g.quantize(mode="weight_only").evaluate()
+        from bigdl_tpu.utils.tf import ops as O
+        kinds = {type(m).__name__ for m in q.modules}
+        assert "QuantizedTFConv2D" in kinds and "QuantizedTFMatMul" in kinds, kinds
+        out_f = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+        out_q = np.asarray(q.forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out_q, out_f, rtol=0.1, atol=0.05)

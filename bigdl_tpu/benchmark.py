@@ -298,6 +298,43 @@ def _measure_int8_infer(model_name: str, batch: int, iters: int) -> dict:
             "weight_only_bf16_ratio": round(wonly_ips / bf16_ips, 2)}
 
 
+def _measure_serving(model_name: str, batch: int, iters: int) -> dict:
+    """Serving-path micro-bench: Predictor.predict and Evaluator.test
+    throughput through the framework's own eval machinery (per-batch h2d,
+    cached jitted forward, chunked d2h fetches) — the inference half of the
+    reference's Evaluator/Predictor story."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim.evaluator import Evaluator, Predictor
+    from bigdl_tpu.optim.validation import Top1Accuracy
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    Engine.init(compute_dtype=jnp.bfloat16)
+    n_batches = 4
+    model, dataset, _ = _build(model_name, batch, n_batches=n_batches,
+                               dtype="bf16")
+    model.evaluate()
+    predictor, evaluator = Predictor(model), Evaluator(model)
+    total = batch * n_batches
+
+    predictor.predict(dataset)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        predictor.predict(dataset)
+    predict_sps = total * iters / (time.perf_counter() - t0)
+
+    evaluator.test(dataset, [Top1Accuracy()])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        evaluator.test(dataset, [Top1Accuracy()])
+    eval_sps = total * iters / (time.perf_counter() - t0)
+
+    return {"predict_samples_per_sec": round(predict_sps, 1),
+            "evaluate_samples_per_sec": round(eval_sps, 1),
+            "batch": batch, "dtype": "bf16"}
+
+
 def run_worker(args) -> None:
     """The measured child process: ONE dtype, one JSON line, exit.
 
@@ -383,6 +420,8 @@ def run_orchestrator(args) -> None:
     worker_argv.append("--streamed" if args.streamed else "--no-streamed")
     if args.int8_infer:
         worker_argv.append("--int8-infer")
+    if args.serving:
+        worker_argv.append("--serving")
     env = dict(os.environ)
     # TPU attach in this environment swings from ~20 s to outright hangs; give a
     # real attempt generous headroom (the subprocess timeout still bounds it)
@@ -396,7 +435,7 @@ def run_orchestrator(args) -> None:
             # comparison leg in its OWN subprocess: its failure can never
             # discard the good primary number above
             if args.compare_dtypes and args.dtype == "bf16" \
-                    and not args.int8_infer:
+                    and not args.int8_infer and not args.serving:
                 # the comparison leg only feeds the ratio — skip its streamed
                 # measurement (it would be discarded)
                 cmp_argv = ["--run", "--model", args.model,
@@ -429,13 +468,14 @@ def run_orchestrator(args) -> None:
         attempts.append(f"attempt{attempt}: {err}")
         print(f"bench: {err}", file=sys.stderr)
 
-    if args.int8_infer:
-        # a LeNet training number would not answer an int8-inference request:
+    if args.int8_infer or args.serving:
+        # a LeNet training number would not answer an inference-path request:
         # fail loudly with the metric the caller asked for
+        kind = "int8_vs_bf16_infer" if args.int8_infer else "serving"
         print(json.dumps({
-            "metric": f"{args.model}_int8_vs_bf16_infer",
+            "metric": f"{args.model}_{kind}",
             "value": None,
-            "unit": "images/sec",
+            "unit": "samples/sec",
             "vs_baseline": None,
             "error": "; ".join(attempts)[-1200:],
         }))
@@ -487,6 +527,9 @@ def main(argv=None):
                    help="per-attempt subprocess timeout (s)")
     p.add_argument("--int8-infer", action="store_true",
                    help="inference micro-bench: bf16 vs int8-quantized forward")
+    p.add_argument("--serving", action="store_true",
+                   help="serving-path micro-bench: Predictor.predict and "
+                        "Evaluator.test samples/sec")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
     args = p.parse_args(argv)
@@ -499,6 +542,11 @@ def main(argv=None):
             res = _measure_int8_infer(args.model, args.batch,
                                       max(args.iters, 10))
             res["metric"] = f"{args.model}_int8_vs_bf16_infer"
+            print(json.dumps(res))
+        elif args.serving:
+            res = _measure_serving(args.model, args.batch,
+                                   max(args.iters // 4, 3))
+            res["metric"] = f"{args.model}_serving"
             print(json.dumps(res))
         else:
             run_worker(args)

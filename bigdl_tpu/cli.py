@@ -47,6 +47,9 @@ def main(argv=None) -> int:
     if argv[:1] == ["bench"]:
         from bigdl_tpu import benchmark
         return benchmark.main(argv[1:])
+    if argv[:1] == ["converge"]:
+        from bigdl_tpu import convergence
+        return convergence.main(argv[1:])
     p = argparse.ArgumentParser(
         prog="bigdl-tpu",
         description="TPU-native BigDL: train models, benchmark, validate "
@@ -60,6 +63,9 @@ def main(argv=None) -> int:
 
     sub.add_parser("bench", help="single-chip ResNet-50 benchmark "
                                   "(all bench.py options forwarded)")
+    sub.add_parser("converge", help="accuracy-parity harness: train a "
+                                    "BASELINE config on real data and judge "
+                                    "the final metric against its target")
     dry = sub.add_parser("dryrun-multichip",
                          help="compile+run one sharded step on an n-device mesh")
     dry.add_argument("-n", "--n-devices", type=int, default=8)

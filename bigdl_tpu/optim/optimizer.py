@@ -261,16 +261,26 @@ class Optimizer:
         needs_rng = model.needs_rng()
         aux_w = self.aux_loss_weight
 
-        def collect_aux(ms):
-            """Sum every ``aux_loss`` leaf in the post-apply module state.
-            Presence is static (pytree structure), so models without aux
-            losses trace to exactly the old program."""
+        def collect_state_losses(ms):
+            """Sum declared objective terms from the post-apply module state.
+            Two conventions, by leaf name (presence is static pytree
+            structure, so models without either trace to the old program):
+
+            - ``aux_loss`` — scaled by the Optimizer's aux_loss_weight
+              (MoE load balancing; the coefficient is a training-run knob);
+            - ``penalty`` — added at FULL strength (ActivityRegularization /
+              NegativeEntropyPenalty, whose coefficient belongs to the layer
+              — keras semantics; the global knob must not rescale it).
+            """
             from jax.tree_util import tree_flatten_with_path
-            total, found = jnp.zeros((), jnp.float32), False
+            aux = pen = None
             for path, leaf in tree_flatten_with_path(ms)[0]:
-                if path and getattr(path[-1], "key", None) == "aux_loss":
-                    total, found = total + leaf, True
-            return total if found else None
+                key = path and getattr(path[-1], "key", None)
+                if key == "aux_loss":
+                    aux = leaf if aux is None else aux + leaf
+                elif key == "penalty":
+                    pen = leaf if pen is None else pen + leaf
+            return aux, pen
         # Mixed precision (nn/precision.py): params stay fp32 masters; the casts
         # below put the matmul/conv FLOPs in the compute dtype (bf16 → MXU double
         # rate) while the cast's transpose returns fp32 gradients, and the loss /
@@ -291,9 +301,11 @@ class Optimizer:
                     out = cast_floating(out, jnp.float32)
                     new_ms = cast_floating(new_ms, jnp.float32)
                 loss = criterion.apply(out, target)
-                aux = collect_aux(new_ms) if aux_w else None
-                if aux is not None:
+                aux, pen = collect_state_losses(new_ms)
+                if aux is not None and aux_w:
                     loss = loss + aux_w * aux
+                if pen is not None:
+                    loss = loss + pen
                 return loss, new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)

@@ -13,6 +13,7 @@ import pytest
 
 from bigdl_tpu import nn
 from bigdl_tpu.utils import serializer
+from bigdl_tpu.utils.table import Table
 from bigdl_tpu.utils.random_generator import RandomGenerator
 from bigdl_tpu.utils.table import T
 
@@ -31,6 +32,20 @@ def _seq(*layers):
 # class name → (factory, sample_input). Factories are thunks so each test run
 # builds fresh instances under a fixed seed.
 EXAMPLES = {
+    # round-4 zoo tail
+    "SReLU": (lambda: nn.SReLU(shape=(3,)), _x(2, 3)),
+    "ActivityRegularization": (lambda: nn.ActivityRegularization(l1=0.1),
+                               _x(2, 3)),
+    "NegativeEntropyPenalty": (lambda: nn.NegativeEntropyPenalty(0.1),
+                               jnp.abs(_x(2, 3)) + 0.1),
+    "CrossProduct": (lambda: nn.CrossProduct(),
+                     Table(_x(2, 4), _x(2, 4), _x(2, 4))),
+    "SpatialConvolutionMap": (
+        lambda: nn.SpatialConvolutionMap(
+            nn.SpatialConvolutionMap.one_to_one(3), 3, 3), _x(1, 3, 6, 6)),
+    "SpatialSeparableConvolution": (
+        lambda: nn.SpatialSeparableConvolution(3, 4, 2, 3, 3),
+        _x(1, 3, 6, 6)),
     # activations
     "Abs": (lambda: nn.Abs(), _x(2, 3)),
     "AddConstant": (lambda: nn.AddConstant(1.5), _x(2, 3)),

@@ -1,0 +1,199 @@
+"""Round-4 layer-zoo tail (SURVEY.md §2.1 row 10): SReLU, activity penalties
+(riding the aux_loss convention), CrossProduct, connection-table and
+depthwise-separable convolutions — torch oracles where torch has the op."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.table import Table
+
+
+def _x(*shape, seed=0):
+    return jnp.asarray(np.random.default_rng(seed)
+                       .normal(size=shape).astype(np.float32))
+
+
+class TestSReLU:
+    def test_identity_band_and_slopes(self):
+        m = nn.SReLU(shape=(4,))
+        params = m.get_params()
+        params["t_left"] = jnp.full((4,), -1.0)
+        params["a_left"] = jnp.full((4,), 0.5)
+        params["t_right"] = jnp.full((4,), 1.0)
+        params["a_right"] = jnp.full((4,), 2.0)
+        m.set_params(params)
+        x = jnp.asarray([[-3.0, -0.5, 0.5, 3.0]])
+        out = np.asarray(m.forward(jnp.broadcast_to(x, (1, 4))))
+        # x=-3: t_l + a_l (x - t_l) = -1 + 0.5*(-2) = -2
+        # x in (-1, 1): identity; x=3: 1 + 2*(3-1) = 5
+        np.testing.assert_allclose(out[0], [-2.0, -0.5, 0.5, 5.0])
+
+    def test_default_init_is_identity_above_zero(self):
+        m = nn.SReLU(shape=(6,))
+        x = _x(3, 6)
+        out = np.asarray(m.forward(x))
+        ref = np.asarray(x)
+        # defaults: t_l=0, a_l=0 (hard zero below 0), t_r=1, a_r=1 (identity)
+        np.testing.assert_allclose(out, np.where(ref >= 0, ref, 0.0),
+                                   atol=1e-6)
+
+    def test_learns(self):
+        m = nn.SReLU(shape=(5,))
+        x = _x(8, 5)
+
+        def loss(p):
+            out, _ = m.apply(p, m.get_state(), x, training=True, rng=None)
+            return jnp.sum(jnp.square(out - 1.0))
+
+        g = jax.grad(loss)(m.get_params())
+        assert any(float(jnp.sum(jnp.abs(v))) > 0 for v in g.values())
+
+
+class TestActivityPenalties:
+    def test_activity_regularization_aux_loss(self):
+        m = nn.ActivityRegularization(l1=0.1, l2=0.01)
+        x = _x(4, 3)
+        out, new_state = m.apply(m.get_params(), m.get_state(), x,
+                                 training=True, rng=None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+        xf = np.asarray(x)
+        expect = 0.1 * np.abs(xf).sum() + 0.01 * np.square(xf).sum()
+        np.testing.assert_allclose(float(new_state["penalty"]), expect,
+                                   rtol=1e-5)
+
+    def test_negative_entropy_penalty(self):
+        m = nn.NegativeEntropyPenalty(beta=0.5)
+        p = jnp.asarray([[0.25, 0.25, 0.25, 0.25]])
+        out, new_state = m.apply(m.get_params(), m.get_state(), p,
+                                 training=True, rng=None)
+        expect = 0.5 * 4 * 0.25 * np.log(0.25)   # beta * sum(p log p)
+        np.testing.assert_allclose(float(new_state["penalty"]), expect,
+                                   rtol=1e-5)
+
+    def test_penalty_trains_through_optimizer(self):
+        """The penalty reaches the objective at FULL strength without
+        touching the global aux knob (keras semantics: the coefficient is
+        the layer's): with an l2 activity penalty the trained activations
+        shrink vs penalty-free."""
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+        from bigdl_tpu.utils.engine import Engine
+
+        Engine.reset()
+        Engine.init()
+        rng = np.random.default_rng(0)
+        batches = [MiniBatch(rng.normal(size=(16, 6)).astype(np.float32),
+                             rng.integers(0, 3, size=(16,)).astype(np.int32))]
+
+        def act_norm(l2):
+            from bigdl_tpu.utils.random_generator import RandomGenerator
+            RandomGenerator.set_seed(7)
+            model = (nn.Sequential()
+                     .add(nn.Linear(6, 16))
+                     .add(nn.ActivityRegularization(l2=l2))
+                     .add(nn.ReLU())
+                     .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+            (LocalOptimizer(model, DataSet.array(batches),
+                            nn.ClassNLLCriterion())
+             .set_optim_method(SGD(learningrate=0.5))
+             .set_end_when(Trigger.max_iteration(30))
+             .optimize())
+            h = model.modules[0].forward(jnp.asarray(batches[0].input))
+            return float(jnp.sum(jnp.square(h)))
+
+        assert act_norm(0.05) < 0.5 * act_norm(0.0)
+
+
+class TestCrossProduct:
+    def test_pairwise_order(self):
+        a, b, c = _x(4, 5, seed=1), _x(4, 5, seed=2), _x(4, 5, seed=3)
+        out = np.asarray(nn.CrossProduct().forward(Table(a, b, c)))
+        an, bn, cn = np.asarray(a), np.asarray(b), np.asarray(c)
+        expect = np.stack([(an * bn).sum(-1), (an * cn).sum(-1),
+                           (bn * cn).sum(-1)], axis=-1)
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+        assert out.shape == (4, 3)
+
+
+class TestSpatialConvolutionMap:
+    def test_full_table_matches_dense_conv(self):
+        """A full connection table must equal a plain dense conv with the
+        same per-connection kernels."""
+        table = nn.SpatialConvolutionMap.full(3, 4)
+        m = nn.SpatialConvolutionMap(table, 3, 3)
+        x = _x(2, 3, 8, 8)
+        w = np.asarray(m.get_params()["weight"])      # (K, kh, kw)
+        b = np.asarray(m.get_params()["bias"])
+        dense = np.zeros((4, 3, 3, 3), np.float32)
+        for k, (fi, to) in enumerate(table):
+            dense[to - 1, fi - 1] = w[k]
+        ref = F.conv2d(torch.from_numpy(np.asarray(x)),
+                       torch.from_numpy(dense),
+                       torch.from_numpy(b)).numpy()
+        np.testing.assert_allclose(np.asarray(m.forward(x)), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_one_to_one_is_depthwise(self):
+        m = nn.SpatialConvolutionMap(nn.SpatialConvolutionMap.one_to_one(3),
+                                     3, 3)
+        x = _x(1, 3, 6, 6)
+        w = np.asarray(m.get_params()["weight"])[:, None]  # (3,1,3,3)
+        b = np.asarray(m.get_params()["bias"])
+        ref = F.conv2d(torch.from_numpy(np.asarray(x)), torch.from_numpy(w),
+                       torch.from_numpy(b), groups=3).numpy()
+        np.testing.assert_allclose(np.asarray(m.forward(x)), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_duplicate_connections_accumulate(self):
+        """Duplicate (from, to) pairs sum their kernels (the reference's
+        per-connection loop semantics), not last-writer-wins."""
+        m = nn.SpatialConvolutionMap([(1, 1), (1, 1)], 1, 1)
+        params = m.get_params()
+        params["weight"] = jnp.asarray([[[2.0]], [[3.0]]])
+        params["bias"] = jnp.zeros((1,))
+        m.set_params(params)
+        x = jnp.ones((1, 1, 2, 2), jnp.float32)
+        np.testing.assert_allclose(np.asarray(m.forward(x)), 5.0)
+
+    def test_random_table_unconnected_stays_zero(self):
+        table = [(1, 1), (2, 2)]   # plane 3 feeds nothing; out 3 unused
+        m = nn.SpatialConvolutionMap(table + [(3, 3)], 1, 1)
+        params = m.get_params()
+        params["weight"] = jnp.asarray([[[1.0]], [[1.0]], [[0.0]]])
+        params["bias"] = jnp.zeros((3,))
+        m.set_params(params)
+        x = _x(1, 3, 4, 4)
+        out = np.asarray(m.forward(x))
+        np.testing.assert_allclose(out[:, 2], 0.0, atol=1e-6)
+
+
+class TestSpatialSeparableConvolution:
+    def test_matches_torch_depthwise_plus_pointwise(self):
+        m = nn.SpatialSeparableConvolution(3, 8, 2, 3, 3, pad_w=1, pad_h=1)
+        x = _x(2, 3, 8, 8)
+        dw = np.asarray(m.get_params()["depth_weight"])   # (6,1,3,3)
+        pw = np.asarray(m.get_params()["point_weight"])   # (8,6,1,1)
+        b = np.asarray(m.get_params()["bias"])
+        xt = torch.from_numpy(np.asarray(x))
+        mid = F.conv2d(xt, torch.from_numpy(dw), groups=3, padding=1)
+        ref = F.conv2d(mid, torch.from_numpy(pw),
+                       torch.from_numpy(b)).numpy()
+        np.testing.assert_allclose(np.asarray(m.forward(x)), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_trains(self):
+        m = nn.SpatialSeparableConvolution(2, 4, 1, 3, 3)
+        x = _x(2, 2, 6, 6)
+
+        def loss(p):
+            out, _ = m.apply(p, m.get_state(), x, training=True, rng=None)
+            return jnp.sum(jnp.square(out))
+
+        g = jax.grad(loss)(m.get_params())
+        for k in ("depth_weight", "point_weight", "bias"):
+            assert float(jnp.sum(jnp.abs(g[k]))) > 0, k

@@ -142,29 +142,41 @@ def dryrun_multichip(n_devices: int) -> None:
         opt.optimize()
         losses["dp x ep/moe"] = opt.state["loss"]
 
-    # 4) dp x pp: GPipe schedule over the pipe axis
+    # 4) dp x pp: heterogeneous GPipe — a real TransformerLM split into
+    # embed / block(s) / head stages with DIFFERENT param trees and boundary
+    # shapes per rank (the shape a production pipeline has)
     pp = 4 if n_devices % 4 == 0 else (2 if n_devices % 2 == 0 else 1)
     if pp > 1:
+        from bigdl_tpu.models.transformerlm.transformerlm import (
+            PositionEmbedding, TransformerBlock)
         from bigdl_tpu.parallel import GPipe
         Engine.reset()
         Engine.init(mesh_shape=(n_devices // pp, pp),
                     mesh_axes=(Engine.DATA_AXIS, Engine.PIPE_AXIS))
+        vocab, dim, seq = 32, 16, 8
+        embed = (nn.Sequential()
+                 .add(nn.LookupTable(vocab, dim, zero_based=True))
+                 .add(PositionEmbedding(seq, dim)))
+        blocks = [TransformerBlock(dim, num_heads=2, dropout=0.0)
+                  for _ in range(pp - 2)]
+        head = (nn.Sequential()
+                .add(nn.LayerNorm(dim))
+                .add(nn.TimeDistributed(nn.Linear(dim, vocab)))
+                .add(nn.TimeDistributed(nn.LogSoftMax())))
+        model = GPipe(stages=[embed] + blocks + [head], n_microbatches=2)
         rng = np.random.default_rng(3)
-        samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
-                          np.int32(rng.integers(0, 3)))
+        samples = [Sample(rng.integers(0, vocab, size=(seq,)).astype(np.int32),
+                          rng.integers(0, vocab, size=(seq,)).astype(np.int32))
                    for _ in range(4 * n_devices)]
         data = DataSet.array(samples, distributed=True) \
             >> SampleToMiniBatch(2 * n_devices)
-        stage = nn.Sequential().add(nn.Linear(8, 8)).add(nn.Tanh())
-        model = (nn.Sequential()
-                 .add(GPipe(stage, n_stages=pp, n_microbatches=2))
-                 .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
-        opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion())
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        opt = (DistriOptimizer(model, data, crit)
                .set_optim_method(SGD(learningrate=0.05, momentum=0.9,
                                      dampening=0.0))
                .set_end_when(Trigger.max_iteration(1)))
         opt.optimize()
-        losses["dp x pp/gpipe"] = opt.state["loss"]
+        losses["dp x pp/gpipe-hetero-lm"] = opt.state["loss"]
 
     # 5) sequence parallel: causal ring attention over the seq axis
     Engine.reset()

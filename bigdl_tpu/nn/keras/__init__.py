@@ -5,12 +5,14 @@ from bigdl_tpu.nn.keras.layers import (
     Activation, AtrousConvolution2D, AveragePooling1D, AveragePooling2D,
     AveragePooling3D, BatchNormalization, Bidirectional, Convolution1D,
     Convolution2D, Convolution3D, Cropping1D, Cropping2D, Cropping3D,
-    Deconvolution2D, Dense, Dropout, ELU, Embedding, Flatten, GRU,
+    ConvLSTM2D, Deconvolution2D, Dense, Dropout, ELU, Embedding, Flatten, GRU,
     GaussianDropout, GaussianNoise, GlobalAveragePooling1D,
-    GlobalAveragePooling2D, GlobalMaxPooling1D, GlobalMaxPooling2D, Highway,
+    GlobalAveragePooling2D, GlobalAveragePooling3D, GlobalMaxPooling1D,
+    GlobalMaxPooling2D, GlobalMaxPooling3D, Highway,
     KerasLayer, LSTM, LayerNormalization, LeakyReLU, LocallyConnected1D,
     LocallyConnected2D, Masking, MaxPooling1D, MaxPooling2D, MaxPooling3D,
-    MaxoutDense, PReLU, Permute, RepeatVector, Reshape, SeparableConvolution2D,
+    MaxoutDense, PReLU, Permute, RepeatVector, Reshape, SReLU,
+    SeparableConvolution2D,
     SimpleRNN, SpatialDropout1D, SpatialDropout2D, SpatialDropout3D,
     ThresholdedReLU, TimeDistributed, UpSampling1D, UpSampling2D, UpSampling3D,
     ZeroPadding1D, ZeroPadding2D, ZeroPadding3D,

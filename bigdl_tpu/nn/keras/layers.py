@@ -305,10 +305,13 @@ class _RecurrentLayer(KerasLayer):
     def _make_cell(self, input_size):
         return self._cell(input_size, self.output_dim)
 
-    def build(self, input_shape):
+    def _check_input_shape(self, input_shape):
         if len(input_shape) != 2:
             raise ValueError(
                 f"recurrent layers expect (time, features) input, got {input_shape}")
+
+    def build(self, input_shape):
+        self._check_input_shape(input_shape)
         seq = N.Sequential()
         if self.go_backwards:
             seq.add(_ReverseTime())
@@ -1017,3 +1020,74 @@ class Bidirectional(KerasLayer):
         if self.layer.return_sequences:
             return (input_shape[0], width)
         return (width,)
+
+
+class SReLU(KerasLayer):
+    """S-shaped ReLU with four learnable parameter tensors (keras-1.2
+    ``SReLU``); ``shared_axes`` shares parameters across those (1-based,
+    non-batch) axes."""
+
+    def __init__(self, shared_axes=None, **kw):
+        super().__init__(**kw)
+        self.shared_axes = shared_axes
+
+    def build(self, input_shape):
+        return N.SReLU(shape=tuple(input_shape),
+                       shared_axes=self.shared_axes)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class GlobalAveragePooling3D(KerasLayer):
+    def build(self, input_shape):
+        c, t, h, w = input_shape
+        return N.Sequential() \
+            .add(N.VolumetricAveragePooling(t, w, h)) \
+            .add(N.Reshape([c]))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class GlobalMaxPooling3D(KerasLayer):
+    def build(self, input_shape):
+        c, t, h, w = input_shape
+        return N.Sequential() \
+            .add(N.VolumetricMaxPooling(t, w, h)) \
+            .add(N.Reshape([c]))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class ConvLSTM2D(_RecurrentLayer):
+    """Convolutional LSTM over (time, channels, rows, cols) input (keras-1.2
+    ``ConvLSTM2D``); maps onto the native peephole ConvLSTM cell unrolled by
+    ``nn.Recurrent`` (lax.scan — two MXU conv GEMMs per step). Reuses the
+    shared recurrent scaffolding (go_backwards/return_sequences)."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int = 3,
+                 return_sequences: bool = False, go_backwards: bool = False,
+                 with_peephole: bool = True, **kw):
+        super().__init__(nb_filter, return_sequences=return_sequences,
+                         go_backwards=go_backwards, **kw)
+        self.nb_kernel = nb_kernel
+        self.with_peephole = with_peephole
+
+    def _make_cell(self, input_size):
+        return N.ConvLSTMPeephole(
+            input_size, self.output_dim, self.nb_kernel, self.nb_kernel,
+            with_peephole=self.with_peephole)
+
+    def _check_input_shape(self, input_shape):
+        if len(input_shape) != 4:
+            raise ValueError(
+                f"ConvLSTM2D expects (time, channels, rows, cols) input, "
+                f"got {input_shape}")
+
+    def compute_output_shape(self, input_shape):
+        t, _, h, w = input_shape
+        if self.return_sequences:
+            return (t, self.output_dim, h, w)
+        return (self.output_dim, h, w)

@@ -61,8 +61,28 @@ def merge_nodes(nodes, mode: str = "concat", concat_axis: int = 1) -> KerasNode:
     elif mode in ("sum", "add"):
         shape = nodes[0].shape
         module = N.CAddTable()
+    elif mode == "mul":
+        shape = nodes[0].shape
+        module = N.CMulTable()
+    elif mode == "ave":
+        shape = nodes[0].shape
+        module = N.CAveTable()
+    elif mode == "max":
+        shape = nodes[0].shape
+        module = N.CMaxTable()
+    elif mode == "dot":
+        if len(nodes) != 2:
+            raise ValueError("dot merge takes exactly two nodes")
+        shape = (1,)
+        module = N.Sequential().add(N.DotProduct()).add(N.Unsqueeze(2))
+    elif mode == "cos":
+        if len(nodes) != 2:
+            raise ValueError("cos merge takes exactly two nodes")
+        shape = (1,)
+        module = N.Sequential().add(N.CosineDistance()).add(N.Unsqueeze(2))
     else:
-        raise ValueError(f"unknown merge mode {mode!r}")
+        raise ValueError(f"unknown merge mode {mode!r} "
+                         f"(concat|sum|mul|ave|max|dot|cos)")
     return KerasNode(make_node(module, [n.node for n in nodes]), shape)
 
 
@@ -158,6 +178,24 @@ class KerasModel:
 
     def _to_samples(self, x, y):
         from bigdl_tpu.dataset.sample import Sample
+        if isinstance(x, (list, tuple)):
+            # functional multi-input model: one array per Input node → each
+            # Sample carries a tuple feature (MiniBatch stacks per input)
+            xs = [np.asarray(xi) for xi in x]
+            xs = [xi.astype(np.float32)
+                  if not np.issubdtype(xi.dtype, np.floating) else xi
+                  for xi in xs]
+            if len({len(xi) for xi in xs}) != 1:
+                raise ValueError("multi-input arrays disagree on n_samples")
+            if y is None:
+                return [Sample(tuple(row)) for row in zip(*xs)]
+            y = np.asarray(y)
+            if self._classification() and y.ndim == 2 and y.shape[1] > 1:
+                y = y.argmax(axis=1)
+            y = y.astype(np.int32) if np.issubdtype(y.dtype, np.integer) \
+                else y.astype(np.float32)
+            return [Sample(tuple(row), yi) for *row, yi
+                    in zip(*xs, y)]
         x = np.asarray(x)
         if not np.issubdtype(x.dtype, np.floating):
             x = x.astype(np.float32)
@@ -206,16 +244,23 @@ class KerasModel:
     def evaluate(self, x, y=None, batch_size: int = 32):
         from bigdl_tpu.optim.evaluator import Evaluator
         methods = self._metrics or [_resolve_metric("accuracy")]
-        samples = self._to_samples(x, y) if isinstance(x, np.ndarray) else x
+        samples = self._to_samples(x, y) \
+            if isinstance(x, (np.ndarray, list, tuple)) else x
         results = Evaluator(self._module()).test(samples, methods, batch_size)
         return [r.result()[0] for r, _ in results]
 
+    def _predict_data(self, x):
+        # multi-input list → Sample list; Predictor's _as_dataset batches it
+        if isinstance(x, (list, tuple)):
+            return self._to_samples(x, None)
+        return x
+
     def predict(self, x, batch_size: int = 32) -> np.ndarray:
         self._check_input(x if isinstance(x, np.ndarray) else None)
-        return self._module().predict(x, batch_size)
+        return self._module().predict(self._predict_data(x), batch_size)
 
     def predict_classes(self, x, batch_size: int = 32) -> np.ndarray:
-        return self._module().predict_class(x, batch_size)
+        return self._module().predict_class(self._predict_data(x), batch_size)
 
     # persistence passthrough
     def save(self, path: str, overwrite: bool = True) -> None:

@@ -1,0 +1,117 @@
+"""Round-4 keras additions: ConvLSTM2D, 3D global pooling, SReLU, and the
+full keras-1.2 merge-mode set (mul/ave/max/dot/cos on top of concat/sum)."""
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn.keras as K
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+@pytest.fixture(autouse=True)
+def engine():
+    Engine.reset()
+    Engine.init(seed=0)
+    RandomGenerator.set_seed(0)
+    yield
+    Engine.reset()
+
+
+class TestNewLayers:
+    def test_convlstm2d_shapes(self):
+        m = K.Sequential()
+        m.add(K.ConvLSTM2D(4, 3, return_sequences=True,
+                           input_shape=(5, 2, 6, 6)))
+        assert m.output_shape == (5, 4, 6, 6)
+        out = m.predict(np.zeros((2, 5, 2, 6, 6), np.float32), batch_size=2)
+        assert out.shape == (2, 5, 4, 6, 6)
+        assert np.isfinite(out).all()
+
+    def test_convlstm2d_last_step(self):
+        m = K.Sequential()
+        m.add(K.ConvLSTM2D(3, 3, input_shape=(4, 2, 5, 5)))
+        assert m.output_shape == (3, 5, 5)
+        out = m.predict(np.zeros((1, 4, 2, 5, 5), np.float32), batch_size=1)
+        assert out.shape == (1, 3, 5, 5)
+
+    @pytest.mark.parametrize("cls,ref", [
+        (K.GlobalAveragePooling3D, lambda x: x.mean(axis=(2, 3, 4))),
+        (K.GlobalMaxPooling3D, lambda x: x.max(axis=(2, 3, 4))),
+    ])
+    def test_global_pooling_3d(self, cls, ref):
+        m = K.Sequential()
+        m.add(cls(input_shape=(4, 3, 6, 6)))
+        assert m.output_shape == (4,)
+        x = np.random.default_rng(0).normal(
+            size=(2, 4, 3, 6, 6)).astype(np.float32)
+        out = m.predict(x, batch_size=2)
+        assert out.shape == (2, 4)
+        np.testing.assert_allclose(out, ref(x), rtol=1e-5)
+
+    def test_srelu(self):
+        m = K.Sequential()
+        m.add(K.SReLU(input_shape=(6,)))
+        assert m.output_shape == (6,)
+        x = np.random.default_rng(1).normal(size=(3, 6)).astype(np.float32)
+        out = m.predict(x, batch_size=3)
+        # default init: zero below 0, identity above
+        np.testing.assert_allclose(out, np.where(x >= 0, x, 0.0), atol=1e-6)
+
+
+class TestMergeModes:
+    def _two(self):
+        a = K.Input(shape=(6,))
+        b = K.Input(shape=(6,))
+        return a, b
+
+    @pytest.mark.parametrize("mode,ref", [
+        ("mul", lambda x, y: x * y),
+        ("ave", lambda x, y: (x + y) / 2),
+        ("max", lambda x, y: np.maximum(x, y)),
+        ("sum", lambda x, y: x + y),
+    ])
+    def test_elementwise_modes(self, mode, ref):
+        a, b = self._two()
+        m = K.Model([a, b], K.merge([a, b], mode=mode))
+        x = np.random.default_rng(2).normal(size=(4, 6)).astype(np.float32)
+        y = np.random.default_rng(3).normal(size=(4, 6)).astype(np.float32)
+        out = m.predict([x, y], batch_size=4)
+        np.testing.assert_allclose(out, ref(x, y), rtol=1e-5)
+
+    def test_dot_mode(self):
+        a, b = self._two()
+        m = K.Model([a, b], K.merge([a, b], mode="dot"))
+        x = np.random.default_rng(4).normal(size=(4, 6)).astype(np.float32)
+        y = np.random.default_rng(5).normal(size=(4, 6)).astype(np.float32)
+        out = m.predict([x, y], batch_size=4)
+        np.testing.assert_allclose(out[:, 0], (x * y).sum(-1), rtol=1e-4)
+
+    def test_cos_mode(self):
+        a, b = self._two()
+        m = K.Model([a, b], K.merge([a, b], mode="cos"))
+        x = np.random.default_rng(6).normal(size=(4, 6)).astype(np.float32)
+        out = m.predict([x, x * 2.0], batch_size=4)
+        np.testing.assert_allclose(out[:, 0], 1.0, rtol=1e-4)
+
+    def test_unknown_mode_rejected(self):
+        a, b = self._two()
+        with pytest.raises(ValueError, match="merge mode"):
+            K.merge([a, b], mode="nope")
+
+
+class TestMultiInputEvaluate:
+    def test_multi_input_fit_evaluate(self):
+        a = K.Input(shape=(6,))
+        b = K.Input(shape=(6,))
+        h = K.merge([a, b], mode="concat")
+        rng = np.random.default_rng(7)
+        x1 = rng.normal(size=(32, 6)).astype(np.float32)
+        x2 = rng.normal(size=(32, 6)).astype(np.float32)
+        y = rng.integers(0, 2, size=(32,)).astype(np.int32)
+        d = K.Dense(2, activation="softmax")(h)
+        m = K.Model([a, b], d)
+        m.compile("sgd", "sparse_categorical_crossentropy", ["accuracy"])
+        m.fit([x1, x2], y, batch_size=8, nb_epoch=1)
+        res = m.evaluate([x1, x2], y, batch_size=8)
+        assert 0.0 <= res[0] <= 1.0

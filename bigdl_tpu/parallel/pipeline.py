@@ -75,9 +75,16 @@ class GPipe(Container):
     def __init__(self, stage: Optional[AbstractModule] = None,
                  n_stages: int = 1, n_microbatches: int = 2,
                  axis_name: str = "pipe",
-                 stages: Optional[Sequence[AbstractModule]] = None):
+                 stages: Optional[Sequence[AbstractModule]] = None,
+                 remat: bool = False):
         if (stage is None) == (stages is None):
             raise ValueError("pass exactly one of `stage` or `stages`")
+        # remat: recompute each stage's internals in backward instead of
+        # stashing them across the whole GPipe schedule — the standard relief
+        # for the all-forward-then-all-backward activation profile autodiff
+        # gives this scan (a hand-scheduled 1F1B would change the SCHEDULE;
+        # remat changes what is LIVE, which is the memory that matters here)
+        self.remat = bool(remat)
         if stages is not None:
             mods = [_check_stage(m) for m in stages]
             n_stages = len(mods)
@@ -99,9 +106,13 @@ class GPipe(Container):
     def _stage_apply(self, i: int, params, x, training):
         # stages are stateless, but containers still want the structured
         # (empty) state tree
-        out, _ = self.modules[i].apply(params, self.modules[i].get_state(), x,
-                                       training=training, rng=None)
-        return out
+        def run(p, xx):
+            out, _ = self.modules[i].apply(p, self.modules[i].get_state(), xx,
+                                           training=training, rng=None)
+            return out
+        if self.remat:
+            run = jax.checkpoint(run)
+        return run(params, x)
 
     def apply(self, params, state, input, *, training=False, rng=None):
         from bigdl_tpu.utils.engine import Engine

@@ -147,3 +147,33 @@ class TestValidation:
     def test_requires_exactly_one_of_stage_stages(self):
         with pytest.raises(ValueError, match="exactly one"):
             GPipe()
+
+
+class TestRemat:
+    def test_remat_matches_plain(self):
+        """remat=True must change memory, not math: identical loss+grads."""
+        Engine.reset()
+        Engine.init(mesh_shape=(2, 4), mesh_axes=("data", "pipe"), seed=0)
+
+        def build(remat):
+            RandomGenerator.set_seed(5)
+            return GPipe(stages=_lm_stages(), n_microbatches=2, remat=remat)
+
+        x = _tokens(8, seed=9)
+
+        def loss_for(g):
+            params = g.get_params()
+
+            def loss(p):
+                out, _ = g.apply(p, g.get_state(), x, training=True, rng=None)
+                return jnp.sum(jnp.square(out))
+
+            return loss(params), jax.grad(loss)(params)
+
+        l0, g0 = loss_for(build(False))
+        l1, g1 = loss_for(build(True))
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda v0, v1: np.testing.assert_allclose(
+                np.asarray(v0), np.asarray(v1), rtol=1e-3, atol=1e-5),
+            g0, g1)

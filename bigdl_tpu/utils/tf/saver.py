@@ -134,8 +134,177 @@ def _emit(module, x, tf):
             return tf.reshape(x, [x.shape[0] or -1] + size)
         return tf.reshape(x, size)
 
+    if t.startswith("TF") or t == "SelectTable":
+        return _emit_tf_adapter(module, x, tf, t, params, state)
+
     raise TFExportError(
         f"layer {t!r} has no TF export rule — add one in "
+        f"bigdl_tpu/utils/tf/saver.py")
+
+
+_TF_UNARY = {
+    "neg": lambda tf, x: -x, "abs": lambda tf, x: tf.abs(x),
+    "square": lambda tf, x: tf.square(x), "sqrt": lambda tf, x: tf.sqrt(x),
+    "rsqrt": lambda tf, x: tf.math.rsqrt(x), "exp": lambda tf, x: tf.exp(x),
+    "log": lambda tf, x: tf.math.log(x),
+    "softplus": lambda tf, x: tf.nn.softplus(x),
+    "elu": lambda tf, x: tf.nn.elu(x), "floor": lambda tf, x: tf.floor(x),
+    "ceil": lambda tf, x: tf.math.ceil(x),
+    "round": lambda tf, x: tf.round(x), "sign": lambda tf, x: tf.sign(x),
+    "sin": lambda tf, x: tf.sin(x), "cos": lambda tf, x: tf.cos(x),
+    "erf": lambda tf, x: tf.math.erf(x),
+    "reciprocal": lambda tf, x: tf.math.reciprocal(x),
+    "log1p": lambda tf, x: tf.math.log1p(x),
+    "expm1": lambda tf, x: tf.math.expm1(x),
+    "logical_not": lambda tf, x: tf.logical_not(x),
+}
+
+_TF_BINARY = {
+    "add": lambda tf, a, b: a + b, "sub": lambda tf, a, b: a - b,
+    "mul": lambda tf, a, b: a * b, "div": lambda tf, a, b: a / b,
+    "max": lambda tf, a, b: tf.maximum(a, b),
+    "min": lambda tf, a, b: tf.minimum(a, b),
+    "sqdiff": lambda tf, a, b: tf.math.squared_difference(a, b),
+    "pow": lambda tf, a, b: tf.pow(a, b),
+    "floordiv": lambda tf, a, b: tf.math.floordiv(a, b),
+    "mod": lambda tf, a, b: tf.math.floormod(a, b),
+    "greater": lambda tf, a, b: tf.greater(a, b),
+    "greater_equal": lambda tf, a, b: tf.greater_equal(a, b),
+    "less": lambda tf, a, b: tf.less(a, b),
+    "less_equal": lambda tf, a, b: tf.less_equal(a, b),
+    "equal": lambda tf, a, b: tf.equal(a, b),
+    "not_equal": lambda tf, a, b: tf.not_equal(a, b),
+    "logical_and": lambda tf, a, b: tf.logical_and(a, b),
+    "logical_or": lambda tf, a, b: tf.logical_or(a, b),
+}
+
+
+def _emit_tf_adapter(module, x, tf, t, params, state):
+    """Export rules for the importer's adapter modules (utils/tf/ops.py) —
+    they carry TF-native attributes (NHWC, SAME/VALID strings), so an
+    imported-then-finetuned graph exports straight back to its TF form with
+    the updated weights, no layout juggling."""
+    m = module
+
+    if t == "TFConv2D":
+        y = tf.nn.conv2d(x, tf.constant(params["weight"]),
+                         strides=[1, *m.strides, 1], padding=m.padding,
+                         dilations=[1, *m.dilations, 1])
+        if "bias" in params:
+            y = tf.nn.bias_add(y, tf.constant(params["bias"]))
+        return y
+    if t == "TFDepthwiseConv2D":
+        w = params["weight"]                      # stored (h, w, 1, c*mult)
+        h, ww, _, cm = w.shape
+        w = w.reshape(h, ww, m.channels, cm // m.channels)
+        y = tf.nn.depthwise_conv2d(x, tf.constant(w),
+                                   strides=[1, *m.strides, 1],
+                                   padding=m.padding,
+                                   dilations=m.dilations)
+        if "bias" in params:
+            y = tf.nn.bias_add(y, tf.constant(params["bias"]))
+        return y
+    if t == "TFBiasAdd":
+        return tf.nn.bias_add(x, tf.constant(params["bias"]))
+    if t == "TFBatchNorm":
+        return tf.nn.batch_normalization(
+            x, tf.constant(state["mean"]), tf.constant(state["variance"]),
+            tf.constant(params["offset"]), tf.constant(params["scale"]),
+            m.epsilon)
+    if t == "TFPool":
+        fn = tf.nn.max_pool2d if m.kind == "max" else tf.nn.avg_pool2d
+        return fn(x, ksize=[1, *m.ksize, 1], strides=[1, *m.strides, 1],
+                  padding=m.padding)
+    if t == "TFMatMul":
+        y = tf.matmul(x, tf.constant(params["weight"]))
+        if "bias" in params:
+            y = tf.nn.bias_add(y, tf.constant(params["bias"]))
+        return y
+    if t == "TFReshape":
+        return tf.reshape(x, m.shape)
+    if t == "TFMean":
+        return tf.reduce_mean(x, axis=list(m.axes), keepdims=m.keepdims)
+    if t == "TFPad":
+        return tf.pad(x, m.paddings)
+    if t == "TFTranspose":
+        return tf.transpose(x, m.perm)
+    if t == "TFExpandDims":
+        return tf.expand_dims(x, m.axis)
+    if t == "TFSqueeze":
+        return tf.squeeze(x, axis=list(m.axes) if m.axes else None)
+    if t == "TFConcat":
+        return tf.concat(x, axis=m.axis)
+    if t == "TFLeakyRelu":
+        return tf.nn.leaky_relu(x, alpha=m.alpha)
+    if t == "TFLRN":
+        return tf.nn.lrn(x, depth_radius=m.depth_radius, bias=m.bias,
+                         alpha=m.alpha, beta=m.beta)
+    if t == "TFCast":
+        return tf.cast(x, m.dtype)
+    if t == "TFTile":
+        return tf.tile(x, m.multiples)
+    if t == "TFSlice":
+        return tf.slice(x, m.begin, m.size)
+    if t == "TFArgMax":
+        return tf.argmax(x, axis=m.axis,
+                         output_type=getattr(tf, m.out_dtype))
+    if t == "TFUnary":
+        if m.op not in _TF_UNARY:
+            raise TFExportError(f"TFUnary op {m.op!r} has no export rule")
+        return _TF_UNARY[m.op](tf, x)
+    if t == "TFBinaryOp":
+        if m.op not in _TF_BINARY:
+            raise TFExportError(f"TFBinaryOp op {m.op!r} has no export rule")
+        fn = _TF_BINARY[m.op]
+        if "const" in state:
+            c = tf.constant(state["const"])
+            return fn(tf, c, x) if m.const_on_left else fn(tf, x, c)
+        return fn(tf, x[0], x[1])
+    if t == "TFReduce":
+        fns = {"sum": tf.reduce_sum, "max": tf.reduce_max,
+               "min": tf.reduce_min, "prod": tf.reduce_prod,
+               "all": tf.reduce_all, "any": tf.reduce_any}
+        return fns[m.op](x, axis=list(m.axes), keepdims=m.keepdims)
+    if t == "TFGather":
+        if "params_const" in state:
+            return tf.gather(tf.constant(state["params_const"]), x,
+                             axis=m.axis)
+        if "indices_const" in state:
+            return tf.gather(x, tf.constant(state["indices_const"]),
+                             axis=m.axis)
+        return tf.gather(x[0], x[1], axis=m.axis)
+    if t == "TFBatchMatMul":
+        if "const" in state:
+            c = tf.constant(state["const"])
+            a, b = (c, x) if m.const_on_left else (x, c)
+        else:
+            a, b = x[0], x[1]
+        return tf.matmul(a, b, adjoint_a=m.adj_x, adjoint_b=m.adj_y)
+    if t == "TFSelect":
+        vals = list(x) if isinstance(x, (list, tuple)) else [x]
+        it = iter(vals)
+        cond = tf.constant(np.asarray(state["cond"])) if "cond" in state \
+            else next(it)
+        then = tf.constant(np.asarray(state["then"])) if "then" in state \
+            else next(it)
+        other = tf.constant(np.asarray(state["else"])) if "else" in state \
+            else next(it)
+        return tf.where(cond, then, other)
+    if t == "TFPack":
+        return tf.stack(list(x) if isinstance(x, (list, tuple)) else [x],
+                        axis=m.axis)
+    if t == "TFSplit":
+        return tf.split(x, m.num, axis=m.axis)
+    if t == "TFUnpack":
+        return tf.unstack(x, num=m.num, axis=m.axis)
+    if t == "SelectTable":
+        if not isinstance(x, (list, tuple)):
+            raise TFExportError("SelectTable export expects a list input")
+        i = m.index - 1 if m.index > 0 else m.index
+        return x[i]
+
+    raise TFExportError(
+        f"imported-graph adapter {t!r} has no TF export rule — add one in "
         f"bigdl_tpu/utils/tf/saver.py")
 
 

@@ -261,3 +261,42 @@ class TestImportedGraphQuantizes:
         out_f = np.asarray(g.evaluate().forward(jnp.asarray(x)))
         out_q = np.asarray(q.forward(jnp.asarray(x)))
         np.testing.assert_allclose(out_q, out_f, rtol=0.1, atol=0.05)
+
+
+class TestImportedGraphReExports:
+    def test_import_finetune_export_roundtrip(self):
+        """import → (weights live in _params, could be fine-tuned) → save_tf
+        → execute the re-exported frozen graph under TF and match."""
+        import tempfile, os
+        from bigdl_tpu.utils.tf.saver import save_tf
+
+        w = tf.Variable(np.random.default_rng(0)
+                        .normal(scale=0.3, size=(3, 3, 3, 8)).astype(np.float32))
+        b = tf.Variable(np.random.default_rng(1)
+                        .normal(size=(8,)).astype(np.float32))
+
+        def f(x):
+            y = tf.nn.relu(tf.nn.bias_add(
+                tf.nn.conv2d(x, w, strides=2, padding="SAME"), b))
+            a, c = tf.split(y, 2, axis=3)
+            y = tf.concat([a * 2.0, c], axis=3)
+            return tf.reduce_mean(y, axis=[1, 2])
+
+        x = np.random.default_rng(2).normal(size=(2, 8, 8, 3)).astype(np.float32)
+        g = _check(f, x)
+        ours = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "reexport.pb")
+            save_tf(g, path, input_shape=(None, 8, 8, 3))
+            from tensorflow.core.framework import graph_pb2
+            gd = graph_pb2.GraphDef()
+            with open(path, "rb") as fh:
+                gd.ParseFromString(fh.read())
+
+            tfg = tf.Graph()
+            with tfg.as_default():
+                tf.import_graph_def(gd, name="")
+            with tf.compat.v1.Session(graph=tfg) as sess:
+                out = sess.run("output:0", feed_dict={"input:0": x})
+        np.testing.assert_allclose(out, ours, rtol=1e-4, atol=1e-5)

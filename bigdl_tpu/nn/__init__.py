@@ -61,7 +61,10 @@ from bigdl_tpu.nn.initialization import (
 )
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.quantized import QuantizedLinear, QuantizedSpatialConvolution
-from bigdl_tpu.nn.sparse import SparseEmbeddingSum, SparseLinear
+from bigdl_tpu.nn.sparse import (
+    DenseToSparse, LookupTableSparse, SparseEmbeddingSum, SparseJoinTable,
+    SparseLinear,
+)
 from bigdl_tpu.nn.roi import RoiPooling
 from bigdl_tpu.nn.tree import BinaryTreeLSTM
 from bigdl_tpu.nn.volumetric import (

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.nn.abstractnn import AbstractModule
@@ -122,3 +123,92 @@ class SparseEmbeddingSum(AbstractModule):
     def __repr__(self):
         return (f"SparseEmbeddingSum({self.n_index} -> {self.n_output}, "
                 f"{self.combiner})")
+
+
+class DenseToSparse(AbstractModule):
+    """Dense one-hot/multi-hot row → padded (ids, values) pair (reference
+    ``DenseToSparse``, which emitted a COO SparseTensor). ``k`` is the static
+    max non-zeros per row; rows are scanned by magnitude via top-k so the K
+    largest-|x| entries survive — identical to the reference when rows have
+    ≤ k non-zeros (the Wide&Deep contract), shape-static always."""
+
+    def __init__(self, k: int):
+        super().__init__()
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.k = int(k)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        mag = jnp.abs(x)
+        vals, ids = jax.lax.top_k(mag, self.k)
+        taken = jnp.take_along_axis(x, ids, axis=-1)
+        live = vals > 0
+        ids = jnp.where(live, ids, PAD_ID).astype(jnp.int32)
+        taken = jnp.where(live, taken, 0.0)
+        return Table(ids, taken), state
+
+
+class SparseJoinTable(AbstractModule):
+    """Concatenate several padded (ids, values) pairs along the feature axis
+    (reference ``SparseJoinTable(dim=2)`` over COO tensors). Each input's ids
+    index ITS OWN feature space; ``offsets`` shift them into one combined
+    space, matching the reference's dimension-wise concat semantics."""
+
+    def __init__(self, offsets):
+        super().__init__()
+        self.offsets = [int(o) for o in offsets]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        pairs = input.values() if isinstance(input, Table) else list(input)
+        if len(pairs) != len(self.offsets):
+            raise ValueError(
+                f"SparseJoinTable got {len(pairs)} inputs for "
+                f"{len(self.offsets)} offsets")
+        all_ids, all_vals = [], []
+        for p, off in zip(pairs, self.offsets):
+            ids, values = _split_ids_values(p)
+            live = ids != PAD_ID
+            shifted = jnp.where(live, ids + off, PAD_ID)
+            all_ids.append(shifted)
+            if values is None:
+                values = live.astype(jnp.float32)
+            all_vals.append(jnp.where(live, values, 0.0))
+        return Table(jnp.concatenate(all_ids, axis=-1),
+                     jnp.concatenate(all_vals, axis=-1)), state
+
+
+class LookupTableSparse(AbstractModule):
+    """Embedding lookup over padded sparse ids with sum/mean/sqrtn combiners
+    (reference ``LookupTableSparse``; TF ``embedding_lookup_sparse``
+    semantics). Input ``ids (N, K)`` [+ optional ``values``] → (N, dim)."""
+
+    def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError("combiner must be sum|mean|sqrtn")
+        self.n_index = n_index
+        self.n_output = n_output
+        self.combiner = combiner
+        self.w_init = w_init or RandomUniform()
+        self.reset()
+
+    def reset(self) -> None:
+        self._params = {"weight": jnp.asarray(self.w_init.init(
+            (self.n_index, self.n_output),
+            fan_in=self.n_index, fan_out=self.n_output))}
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        ids, values = _split_ids_values(input)
+        live = ids != PAD_ID
+        weights = values if values is not None else live.astype(jnp.float32)
+        weights = jnp.where(live, weights, 0.0)
+        rows = params["weight"][jnp.where(live, ids, 0)]       # (N, K, dim)
+        summed = jnp.sum(rows * weights[..., None], axis=-2)   # (N, dim)
+        if self.combiner == "sum":
+            return summed, state
+        norm = jnp.sum(weights, axis=-1, keepdims=True) if self.combiner == "mean" \
+            else jnp.sqrt(jnp.sum(jnp.square(weights), axis=-1, keepdims=True))
+        return summed / jnp.maximum(norm, 1e-12), state

@@ -32,6 +32,14 @@ def _seq(*layers):
 # class name → (factory, sample_input). Factories are thunks so each test run
 # builds fresh instances under a fixed seed.
 EXAMPLES = {
+    # round-4 sparse family tail
+    "DenseToSparse": (lambda: nn.DenseToSparse(k=2), _x(2, 6)),
+    "SparseJoinTable": (
+        lambda: nn.SparseJoinTable(offsets=[0, 4]),
+        Table(Table(jnp.asarray([[0, 1]], jnp.int32)),
+              Table(jnp.asarray([[2, -1]], jnp.int32)))),
+    "LookupTableSparse": (lambda: nn.LookupTableSparse(8, 4),
+                          Table(jnp.asarray([[1, 3, -1]], jnp.int32))),
     # round-4 zoo tail
     "SReLU": (lambda: nn.SReLU(shape=(3,)), _x(2, 3)),
     "ActivityRegularization": (lambda: nn.ActivityRegularization(l1=0.1),

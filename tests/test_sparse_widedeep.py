@@ -5,6 +5,7 @@ oracles, gradient flow through gathers, and end-to-end learning."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+from bigdl_tpu.utils.table import Table
 import pytest
 
 from bigdl_tpu import Engine, nn
@@ -103,3 +104,68 @@ class TestWideAndDeep:
         acc = main(["--max-epoch", "3", "--examples", "3072",
                     "--wide-features", "200", "--deep-vocab", "100"])
         assert acc > 0.7, acc  # class prior is ~0.5
+
+
+class TestSparseFamilyTail:
+    """Round-4: DenseToSparse / SparseJoinTable / LookupTableSparse on the
+    padded-id representation (SURVEY §2.1 sparse rows)."""
+
+    def test_dense_to_sparse_roundtrip(self):
+        x = np.zeros((3, 10), np.float32)
+        x[0, 2], x[0, 7] = 1.5, -2.0
+        x[1, 4] = 3.0
+        m = nn.DenseToSparse(k=3)
+        out, _ = m.apply(m.get_params(), m.get_state(), jnp.asarray(x),
+                         training=False, rng=None)
+        ids, vals = out.values()
+        ids, vals = np.asarray(ids), np.asarray(vals)
+        # row 0: ids {2,7} live with values {1.5,-2.0}; row 2 all pads
+        assert set(ids[0][ids[0] >= 0]) == {2, 7}
+        got = {int(i): float(v) for i, v in zip(ids[0], vals[0]) if i >= 0}
+        assert got == {2: 1.5, 7: -2.0}
+        assert (ids[2] == -1).all() and (vals[2] == 0).all()
+
+    def test_sparse_join_offsets(self):
+        a = jnp.asarray([[0, 1, -1]], jnp.int32)
+        b = jnp.asarray([[2, -1]], jnp.int32)
+        m = nn.SparseJoinTable(offsets=[0, 5])
+        out, _ = m.apply(m.get_params(), m.get_state(),
+                         Table(Table(a), Table(b)), training=False, rng=None)
+        ids, vals = out.values()
+        np.testing.assert_array_equal(np.asarray(ids),
+                                      [[0, 1, -1, 7, -1]])
+        np.testing.assert_array_equal(np.asarray(vals),
+                                      [[1, 1, 0, 1, 0]])
+
+    def test_lookup_table_sparse_combiners(self):
+        table = np.arange(12, dtype=np.float32).reshape(6, 2)
+        ids = jnp.asarray([[1, 3, -1]], jnp.int32)
+        for combiner, expect in [
+            ("sum", table[1] + table[3]),
+            ("mean", (table[1] + table[3]) / 2.0),
+            ("sqrtn", (table[1] + table[3]) / np.sqrt(2.0)),
+        ]:
+            m = nn.LookupTableSparse(6, 2, combiner=combiner)
+            p = m.get_params(); p["weight"] = jnp.asarray(table)
+            m.set_params(p)
+            out, _ = m.apply(m.get_params(), m.get_state(), Table(ids),
+                             training=False, rng=None)
+            np.testing.assert_allclose(np.asarray(out)[0], expect, rtol=1e-6), combiner
+
+    def test_wide_pipeline_trains(self):
+        """DenseToSparse >> LookupTableSparse end of a learnable pipeline."""
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        RandomGenerator.set_seed(3)
+        m = nn.Sequential() \
+            .add(nn.DenseToSparse(k=4)) \
+            .add(nn.LookupTableSparse(16, 8, combiner="mean")) \
+            .add(nn.Linear(8, 2)).add(nn.LogSoftMax())
+        x = jnp.asarray(np.eye(16, dtype=np.float32)[[1, 5, 9, 13]])
+
+        def loss(p):
+            out, _ = m.apply(p, m.get_state(), x, training=True, rng=None)
+            return -jnp.mean(out[jnp.arange(4), jnp.asarray([0, 1, 0, 1])])
+
+        g = jax.grad(loss)(m.get_params())
+        leaves = jax.tree_util.tree_leaves(g)
+        assert any(float(jnp.sum(jnp.abs(l))) > 0 for l in leaves)

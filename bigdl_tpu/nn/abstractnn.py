@@ -97,6 +97,22 @@ class AbstractModule(metaclass=RecordsInit):
     def set_params(self, params: dict) -> None:
         self._params = dict(params)
 
+    # per-layer LR multipliers (reference setScaleW/setScaleB): applied to
+    # this module's weight/bias GRADIENTS inside the jitted step
+    def set_scale_w(self, scale: float) -> "AbstractModule":
+        self.scale_w = float(scale)
+        return self
+
+    def set_scale_b(self, scale: float) -> "AbstractModule":
+        self.scale_b = float(scale)
+        return self
+
+    def grad_scales(self) -> dict:
+        """Pytree matching get_params() of per-leaf gradient multipliers:
+        bias-like leaves get scale_b, everything else scale_w."""
+        return {k: (self.scale_b if "bias" in k else self.scale_w)
+                for k in self._params}
+
     def get_state(self) -> dict:
         return dict(self._state)
 
@@ -384,6 +400,23 @@ class Container(AbstractModule):
     # nested pytree checkout/checkin --------------------------------------
     def get_params(self) -> dict:
         return {name: m.get_params() for name, m in self.named_children()}
+
+    # container setScaleW/setScaleB propagate the SET to the whole subtree
+    # (reference Container semantics)
+    def set_scale_w(self, scale: float) -> "AbstractModule":
+        self.scale_w = float(scale)
+        for m in self.modules:
+            m.set_scale_w(scale)
+        return self
+
+    def set_scale_b(self, scale: float) -> "AbstractModule":
+        self.scale_b = float(scale)
+        for m in self.modules:
+            m.set_scale_b(scale)
+        return self
+
+    def grad_scales(self) -> dict:
+        return {name: m.grad_scales() for name, m in self.named_children()}
 
     def set_params(self, params: dict) -> None:
         for name, m in self.named_children():

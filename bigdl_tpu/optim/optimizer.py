@@ -260,6 +260,11 @@ class Optimizer:
         model, criterion, method = self.model, self.criterion, self.optim_method
         needs_rng = model.needs_rng()
         aux_w = self.aux_loss_weight
+        # per-layer LR multipliers (setScaleW/setScaleB): static constants —
+        # all-ones trees trace to exactly the unscaled program
+        scale_tree = model.grad_scales()
+        if all(s == 1.0 for s in jax.tree_util.tree_leaves(scale_tree)):
+            scale_tree = None
 
         def collect_state_losses(ms):
             """Sum declared objective terms from the post-apply module state.
@@ -309,6 +314,9 @@ class Optimizer:
                 return loss, new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if scale_tree is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda g, s: g * s, grads, scale_tree)
             grads = self._clip_grads(grads)
             new_p, new_os = method.update(params, grads, ostate, step_idx)
             return new_p, new_ms, new_os, loss

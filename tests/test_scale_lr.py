@@ -1,0 +1,51 @@
+"""Per-layer LR multipliers (reference setScaleW/setScaleB, SURVEY §2.3 SGD
+row): scales multiply the layer's gradients inside the jitted step."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _train(scale_w):
+    Engine.reset()
+    Engine.init()
+    RandomGenerator.set_seed(11)
+    model = (nn.Sequential()
+             .add(nn.Linear(6, 8).set_name("a").set_scale_w(scale_w)
+                  .set_scale_b(scale_w))
+             .add(nn.ReLU())
+             .add(nn.Linear(8, 3).set_name("b"))
+             .add(nn.LogSoftMax()))
+    before = np.asarray(model.modules[0].get_params()["weight"]).copy()
+    rng = np.random.default_rng(0)
+    data = DataSet.array([MiniBatch(
+        rng.normal(size=(16, 6)).astype(np.float32),
+        rng.integers(0, 3, size=(16,)).astype(np.int32))])
+    (LocalOptimizer(model, data, nn.ClassNLLCriterion())
+     .set_optim_method(SGD(learningrate=0.1))
+     .set_end_when(Trigger.max_iteration(1))
+     .optimize())
+    after = np.asarray(model.modules[0].get_params()["weight"])
+    return np.abs(after - before).sum()
+
+
+class TestScaleLR:
+    def test_zero_scale_freezes_layer(self):
+        assert _train(0.0) == 0.0
+
+    def test_scale_multiplies_update(self):
+        d1, d2 = _train(1.0), _train(2.0)
+        np.testing.assert_allclose(d2, 2.0 * d1, rtol=1e-5)
+
+    def test_container_propagates(self):
+        m = nn.Sequential().add(nn.Linear(2, 2)).add(nn.Linear(2, 2))
+        m.set_scale_w(0.5)
+        scales = m.grad_scales()
+        assert scales["0"]["weight"] == 0.5 and scales["1"]["weight"] == 0.5
+        assert scales["0"]["bias"] == 1.0  # scale_b untouched

@@ -113,6 +113,23 @@ class AbstractModule(metaclass=RecordsInit):
         return {k: (self.scale_b if "bias" in k else self.scale_w)
                 for k in self._params}
 
+    def has_regularizers(self) -> bool:
+        return (getattr(self, "w_regularizer", None) is not None
+                or getattr(self, "b_regularizer", None) is not None)
+
+    def regularizer_penalty(self, params: dict):
+        """Scalar penalty over this module's params (optim/regularizer.py);
+        called inside the jitted loss when any regularizer is attached."""
+        import jax.numpy as jnp
+        total = jnp.zeros((), jnp.float32)
+        w_reg = getattr(self, "w_regularizer", None)
+        b_reg = getattr(self, "b_regularizer", None)
+        for k, v in params.items():
+            reg = b_reg if "bias" in k else w_reg
+            if reg is not None:
+                total = total + reg.penalty(v)
+        return total
+
     def get_state(self) -> dict:
         return dict(self._state)
 
@@ -417,6 +434,17 @@ class Container(AbstractModule):
 
     def grad_scales(self) -> dict:
         return {name: m.grad_scales() for name, m in self.named_children()}
+
+    def has_regularizers(self) -> bool:
+        return any(m.has_regularizers() for m in self.modules)
+
+    def regularizer_penalty(self, params: dict):
+        import jax.numpy as jnp
+        total = jnp.zeros((), jnp.float32)
+        for name, m in self.named_children():
+            if m.has_regularizers():
+                total = total + m.regularizer_penalty(params.get(name, {}))
+        return total
 
     def set_params(self, params: dict) -> None:
         for name, m in self.named_children():

@@ -70,6 +70,10 @@ class SpatialConvolution(TensorModule):
     def apply(self, params, state, input, *, training=False, rng=None):
         from bigdl_tpu.nn import layout
         x = input
+        if not self.propagate_back:
+            # reference propagateBack=false: no gradient to the INPUT (first
+            # conv of a frozen stem); weight gradients still flow
+            x = lax.stop_gradient(x)
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]

@@ -10,6 +10,9 @@ from bigdl_tpu.optim.schedules import (
     Default, Exponential, LearningRateSchedule, MultiStep, NaturalExp, Plateau, Poly,
     SequentialSchedule, Step, Warmup,
 )
+from bigdl_tpu.optim.regularizer import (
+    L1L2Regularizer, L1Regularizer, L2Regularizer, Regularizer,
+)
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     AccuracyResult, HitRatio, Loss, LossResult, MAE, NDCG, Top1Accuracy, Top5Accuracy,

@@ -265,6 +265,8 @@ class Optimizer:
         scale_tree = model.grad_scales()
         if all(s == 1.0 for s in jax.tree_util.tree_leaves(scale_tree)):
             scale_tree = None
+        # static: models without attached regularizers trace unchanged
+        has_reg = model.has_regularizers()
 
         def collect_state_losses(ms):
             """Sum declared objective terms from the post-apply module state.
@@ -311,6 +313,8 @@ class Optimizer:
                     loss = loss + aux_w * aux
                 if pen is not None:
                     loss = loss + pen
+                if has_reg:  # per-layer L1/L2 weight penalties (regularizer.py)
+                    loss = loss + model.regularizer_penalty(p)
                 return loss, new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)

@@ -1,0 +1,50 @@
+"""Weight regularizers (reference parity: SURVEY.md §2.3, expected
+``<dl>/optim/Regularizer.scala`` — L1/L2/L1L2 attached per-layer via the
+``wRegularizer``/``bRegularizer`` constructor args, applied during gradient
+accumulation).
+
+TPU-native: instead of hand-adding ``lambda * sign(w)`` / ``lambda * w`` terms
+to gradients (the reference's accGradParameters hook), the penalty joins the
+LOSS inside the jitted step and autodiff produces those exact gradient terms —
+one fused program, and the penalty also shows up in the reported loss the way
+keras users expect. Layers with no regularizer trace to the identical
+unregularized program (static presence check in optim/optimizer.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Regularizer:
+    def penalty(self, w) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class L1Regularizer(Regularizer):
+    def __init__(self, l1: float):
+        self.l1 = float(l1)
+
+    def penalty(self, w):
+        return self.l1 * jnp.sum(jnp.abs(w.astype(jnp.float32)))
+
+
+class L2Regularizer(Regularizer):
+    def __init__(self, l2: float):
+        self.l2 = float(l2)
+
+    def penalty(self, w):
+        # reference L2: lambda/2 * ||w||^2 (gradient = lambda * w)
+        return 0.5 * self.l2 * jnp.sum(jnp.square(w.astype(jnp.float32)))
+
+
+class L1L2Regularizer(Regularizer):
+    def __init__(self, l1: float, l2: float):
+        self.l1, self.l2 = float(l1), float(l2)
+
+    def penalty(self, w):
+        w = w.astype(jnp.float32)
+        return (self.l1 * jnp.sum(jnp.abs(w))
+                + 0.5 * self.l2 * jnp.sum(jnp.square(w)))

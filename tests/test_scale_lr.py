@@ -49,3 +49,62 @@ class TestScaleLR:
         scales = m.grad_scales()
         assert scales["0"]["weight"] == 0.5 and scales["1"]["weight"] == 0.5
         assert scales["0"]["bias"] == 1.0  # scale_b untouched
+
+
+class TestRegularizers:
+    """w/b_regularizer args now reach the objective (reference Regularizer)."""
+
+    def _train_l2(self, l2):
+        from bigdl_tpu.optim import L2Regularizer
+        Engine.reset()
+        Engine.init()
+        RandomGenerator.set_seed(4)
+        reg = L2Regularizer(l2) if l2 else None
+        model = (nn.Sequential()
+                 .add(nn.Linear(6, 32, w_regularizer=reg))
+                 .add(nn.ReLU())
+                 .add(nn.Linear(32, 3)).add(nn.LogSoftMax()))
+        rng = np.random.default_rng(0)
+        data = DataSet.array([MiniBatch(
+            rng.normal(size=(16, 6)).astype(np.float32),
+            rng.integers(0, 3, size=(16,)).astype(np.int32))])
+        (LocalOptimizer(model, data, nn.ClassNLLCriterion())
+         .set_optim_method(SGD(learningrate=0.2))
+         .set_end_when(Trigger.max_iteration(25))
+         .optimize())
+        return float(jnp.sum(jnp.square(
+            model.modules[0].get_params()["weight"])))
+
+    def test_l2_shrinks_weights(self):
+        assert self._train_l2(0.5) < 0.5 * self._train_l2(0.0)
+
+    def test_penalty_math(self):
+        from bigdl_tpu.optim import L1L2Regularizer, L1Regularizer
+        w = jnp.asarray([[1.0, -2.0], [3.0, -4.0]])
+        np.testing.assert_allclose(float(L1Regularizer(0.1).penalty(w)), 1.0)
+        np.testing.assert_allclose(
+            float(L1L2Regularizer(0.1, 0.2).penalty(w)), 1.0 + 0.1 * 30.0)
+
+
+class TestPropagateBack:
+    def test_no_input_gradient(self):
+        import jax
+        conv = nn.SpatialConvolution(2, 4, 3, 3, propagate_back=False)
+        x = jnp.asarray(np.random.default_rng(0)
+                        .normal(size=(1, 2, 6, 6)).astype(np.float32))
+
+        def loss_wrt_input(xx):
+            out, _ = conv.apply(conv.get_params(), conv.get_state(), xx,
+                                training=True, rng=None)
+            return jnp.sum(jnp.square(out))
+
+        g = jax.grad(loss_wrt_input)(x)
+        assert float(jnp.sum(jnp.abs(g))) == 0.0
+
+        def loss_wrt_params(p):
+            out, _ = conv.apply(p, conv.get_state(), x, training=True,
+                                rng=None)
+            return jnp.sum(jnp.square(out))
+
+        gw = jax.grad(loss_wrt_params)(conv.get_params())
+        assert float(jnp.sum(jnp.abs(gw["weight"]))) > 0  # weights still learn

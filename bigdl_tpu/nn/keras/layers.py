@@ -19,6 +19,25 @@ from typing import Optional, Sequence
 from bigdl_tpu import nn as N
 
 
+def _resolve_init(init):
+    """Keras-1.2 init strings → InitializationMethods; objects/None pass
+    through (None lets each native layer keep its default)."""
+    if init is None or not isinstance(init, str):
+        return init
+    from bigdl_tpu.nn.initialization import (
+        MsraFiller, Ones, RandomNormal, RandomUniform, Xavier, Zeros,
+    )
+    table = {
+        "glorot_uniform": Xavier, "glorot_normal": Xavier,
+        "he_normal": MsraFiller, "he_uniform": MsraFiller,
+        "uniform": RandomUniform, "normal": RandomNormal,
+        "zero": Zeros, "one": Ones,
+    }
+    if init not in table:
+        raise ValueError(f"unknown keras init {init!r}; have {sorted(table)}")
+    return table[init]()
+
+
 def _act(name: Optional[str]):
     if name is None or name == "linear":
         return None
@@ -85,7 +104,7 @@ class Dense(KerasLayer):
                 f"Dense expects 1-D (features,) input shape, got {input_shape}; "
                 "add Flatten() first")
         lin = N.Linear(input_shape[0], self.output_dim, with_bias=self.bias,
-                       w_init=self.init)
+                       w_init=_resolve_init(self.init))
         return self._with_activation(lin, self.activation)
 
     def compute_output_shape(self, input_shape):
@@ -173,7 +192,7 @@ class Convolution2D(KerasLayer):
         conv = N.SpatialConvolution(
             c, self.nb_filter, kw, kh,
             self.subsample[1], self.subsample[0], pw, ph,
-            with_bias=self.bias, w_init=self.init)
+            with_bias=self.bias, w_init=_resolve_init(self.init))
         if pre_pad is not None:
             conv = N.Sequential().add(pre_pad).add(conv)
         return self._with_activation(conv, self.activation)
@@ -285,7 +304,7 @@ class Embedding(KerasLayer):
         self.init = init
 
     def build(self, input_shape):
-        return N.LookupTable(self.input_dim, self.output_dim, w_init=self.init,
+        return N.LookupTable(self.input_dim, self.output_dim, w_init=_resolve_init(self.init),
                              zero_based=True)
 
     def compute_output_shape(self, input_shape):
@@ -374,7 +393,7 @@ class Convolution1D(KerasLayer):
         conv = N.TemporalConvolution(features, self.nb_filter,
                                      self.filter_length,
                                      self.subsample_length,
-                                     with_bias=self.bias, w_init=self.init)
+                                     with_bias=self.bias, w_init=_resolve_init(self.init))
         if self.border_mode == "same":
             # exact TF/keras SAME split (shared helper — pooling.py)
             from bigdl_tpu.nn.pooling import _same_pad

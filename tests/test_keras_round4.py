@@ -115,3 +115,19 @@ class TestMultiInputEvaluate:
         m.fit([x1, x2], y, batch_size=8, nb_epoch=1)
         res = m.evaluate([x1, x2], y, batch_size=8)
         assert 0.0 <= res[0] <= 1.0
+
+
+class TestStringInits:
+    def test_keras_init_strings_resolve(self):
+        for init in ("glorot_uniform", "glorot_normal", "he_normal",
+                     "he_uniform", "uniform", "normal", "zero", "one"):
+            m = K.Sequential()
+            m.add(K.Dense(4, init=init, input_shape=(3,)))
+            out = m.predict(np.ones((2, 3), np.float32), batch_size=2)
+            assert out.shape == (2, 4)
+
+    def test_unknown_init_rejected(self):
+        import pytest as _pytest
+        from bigdl_tpu.nn.keras.layers import _resolve_init
+        with _pytest.raises(ValueError, match="keras init"):
+            _resolve_init("nope")

@@ -91,12 +91,15 @@ class KerasLayer:
 
 class Dense(KerasLayer):
     def __init__(self, output_dim: int, activation: Optional[str] = None,
-                 bias: bool = True, init=None, **kw):
+                 bias: bool = True, init=None, W_regularizer=None,
+                 b_regularizer=None, **kw):
         super().__init__(**kw)
         self.output_dim = output_dim
         self.activation = activation
         self.bias = bias
         self.init = init
+        self.W_regularizer = W_regularizer
+        self.b_regularizer = b_regularizer
 
     def build(self, input_shape):
         if len(input_shape) != 1:
@@ -104,7 +107,9 @@ class Dense(KerasLayer):
                 f"Dense expects 1-D (features,) input shape, got {input_shape}; "
                 "add Flatten() first")
         lin = N.Linear(input_shape[0], self.output_dim, with_bias=self.bias,
-                       w_init=_resolve_init(self.init))
+                       w_init=_resolve_init(self.init),
+                       w_regularizer=self.W_regularizer,
+                       b_regularizer=self.b_regularizer)
         return self._with_activation(lin, self.activation)
 
     def compute_output_shape(self, input_shape):
@@ -164,7 +169,8 @@ class Convolution2D(KerasLayer):
 
     def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
                  activation: Optional[str] = None, border_mode: str = "valid",
-                 subsample=(1, 1), bias: bool = True, init=None, **kw):
+                 subsample=(1, 1), bias: bool = True, init=None,
+                 W_regularizer=None, b_regularizer=None, **kw):
         super().__init__(**kw)
         if border_mode not in ("valid", "same"):
             raise ValueError(f"border_mode must be valid|same, got {border_mode!r}")
@@ -174,6 +180,8 @@ class Convolution2D(KerasLayer):
         self.subsample = _pair(subsample)
         self.bias = bias
         self.init = init
+        self.W_regularizer = W_regularizer
+        self.b_regularizer = b_regularizer
 
     def build(self, input_shape):
         c = input_shape[0]
@@ -192,7 +200,9 @@ class Convolution2D(KerasLayer):
         conv = N.SpatialConvolution(
             c, self.nb_filter, kw, kh,
             self.subsample[1], self.subsample[0], pw, ph,
-            with_bias=self.bias, w_init=_resolve_init(self.init))
+            with_bias=self.bias, w_init=_resolve_init(self.init),
+            w_regularizer=self.W_regularizer,
+            b_regularizer=self.b_regularizer)
         if pre_pad is not None:
             conv = N.Sequential().add(pre_pad).add(conv)
         return self._with_activation(conv, self.activation)

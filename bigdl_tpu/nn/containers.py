@@ -22,11 +22,18 @@ class Sequential(Container):
     """Chain children; output of child i feeds child i+1."""
 
     def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+
         x = input
         new_state = {}
         rngs = split_rng(rng, len(self.modules))
         for (name, m), r in zip(self.named_children(), rngs):
-            x, s = m.apply(params[name], state[name], x, training=training, rng=r)
+            # named_scope = the profiler-attribution analog of the reference's
+            # per-module getTimes counters (SURVEY §5.1): trace rows group by
+            # layer name in the TensorBoard trace viewer
+            with jax.named_scope(m.name):
+                x, s = m.apply(params[name], state[name], x,
+                               training=training, rng=r)
             new_state[name] = s
         return x, new_state
 

@@ -95,6 +95,19 @@ class DataSet:
         return ImageFolderDataSet(root, num_workers=num_workers,
                                   one_based=one_based, distributed=distributed)
 
+    @staticmethod
+    def record_files(paths, decoder=None, num_workers: int = 8,
+                     distributed: bool = False) -> AbstractDataSet:
+        """Packed ``.bdlrec`` shards (dataset/recordio.py — the SeqFileFolder
+        analog). ``decoder`` maps payload bytes → record; defaults to the
+        image decoder (ImageFeature records)."""
+        from bigdl_tpu.dataset.recordio import (
+            RecordFileDataSet, image_record_decoder,
+        )
+        return RecordFileDataSet(paths, decoder or image_record_decoder,
+                                 num_workers=num_workers,
+                                 distributed=distributed)
+
 
 def is_distributed(dataset: AbstractDataSet) -> bool:
     if isinstance(dataset, DistributedDataSet):

@@ -129,6 +129,7 @@ class RecordFileDataSet(AbstractDataSet):
         if not self._index:
             raise RecordIOError(f"no records in {self.paths}")
         self._order = np.arange(len(self._index))
+        self._fds: dict[int, int] = {}
 
     def size(self) -> int:
         return len(self._index)
@@ -137,17 +138,31 @@ class RecordFileDataSet(AbstractDataSet):
         perm = RandomGenerator.numpy().permutation(len(self._index))
         self._order = self._order[perm]
 
+    def _fd(self, fi: int) -> int:
+        fd = self._fds.get(fi)
+        if fd is None:
+            fd = os.open(self.paths[fi], os.O_RDONLY)
+            self._fds[fi] = fd
+        return fd
+
     def _read(self, i: int) -> bytes:
+        # os.pread on a shared fd: positioned reads are thread-safe (no seek
+        # state), so the decode pool reads concurrently without re-opening
         fi, off, ln = self._index[i]
-        with open(self.paths[fi], "rb") as f:
-            f.seek(off)
-            rec = f.read(_REC.size + ln)
+        rec = os.pread(self._fd(fi), _REC.size + ln, off)
         length, crc = _REC.unpack(rec[:_REC.size])
         payload = rec[_REC.size:]
         if len(payload) != length or zlib.crc32(payload) != crc:
             raise RecordIOError(
                 f"{self.paths[fi]}: corrupt record @ {off} (crc mismatch)")
         return payload
+
+    def __del__(self):
+        for fd in getattr(self, "_fds", {}).values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
 
     def _load(self, i: int):
         return self.decoder(self._read(i))

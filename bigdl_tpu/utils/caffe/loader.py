@@ -244,6 +244,58 @@ class _CaffeImporter:
             beta = blobs[1] if layer.scale_param.bias_term and len(blobs) > 1 \
                 else None
             return CaffeScale(blobs[0], beta)
+        if t == "Sigmoid":
+            return nn.Sigmoid()
+        if t == "TanH":
+            return nn.Tanh()
+        if t == "ELU":
+            return nn.ELU(alpha=float(layer.elu_param.alpha))
+        if t == "AbsVal":
+            return nn.Abs()
+        if t == "Power":
+            p = layer.power_param
+            # Caffe: (shift + scale * x) ^ power — the native Power layer's
+            # exact parameterization
+            return nn.Power(float(p.power), scale=float(p.scale),
+                            shift=float(p.shift))
+        if t == "PReLU":
+            if not blobs:
+                raise CaffeImportError(f"{layer.name}: PReLU without weights")
+            slopes = blobs[0].reshape(-1)
+            n = 0 if layer.prelu_param.channel_shared else slopes.shape[0]
+            m = nn.PReLU(n)
+            m.set_params({"weight": jnp.asarray(slopes[:max(n, 1)])})
+            return m
+        if t == "Flatten":
+            if layer.flatten_param.axis != 1:
+                raise CaffeImportError(
+                    f"{layer.name}: Flatten axis != 1 not supported")
+            return nn.Flatten()
+        if t == "Reshape":
+            shape = list(layer.reshape_param.shape.dim)
+            if shape[:1] == [0]:  # 0 = copy batch dim (the common form)
+                return nn.Reshape([int(d) for d in shape[1:]])
+            return nn.Reshape([int(d) for d in shape])
+        if t == "Deconvolution":
+            p = layer.convolution_param
+            kh, kw = _pair(p, p.kernel_size, "kernel_h", "kernel_w")
+            sh, sw = _pair(p, p.stride, "stride_h", "stride_w", default=1)
+            ph, pw = _pair(p, p.pad, "pad_h", "pad_w", default=0)
+            if int(p.group) != 1:
+                raise CaffeImportError(
+                    f"{layer.name}: grouped Deconvolution not supported")
+            if not blobs:
+                raise CaffeImportError(
+                    f"{layer.name}: Deconvolution without weights")
+            w = blobs[0]  # caffe deconv weight: (in, out, kh, kw)
+            m = nn.SpatialFullConvolution(
+                w.shape[0], w.shape[1], kw, kh, sw, sh, pw, ph,
+                no_bias=not p.bias_term)
+            params = {"weight": jnp.asarray(w)}
+            if p.bias_term:
+                params["bias"] = jnp.asarray(blobs[1])
+            m.set_params(params)
+            return m
         raise CaffeImportError(
             f"unsupported Caffe layer type {t!r} at {layer.name!r} — add a "
             f"converter in bigdl_tpu/utils/caffe/loader.py")

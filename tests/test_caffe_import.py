@@ -293,3 +293,102 @@ class TestCaffeImport:
         np.testing.assert_allclose(np.asarray(loaded.evaluate().forward(x)),
                                    np.asarray(g.evaluate().forward(x)),
                                    rtol=1e-6)
+
+
+class TestWidenedLayerSet:
+    """Round-4 tier: activations, Power, PReLU, Flatten/Reshape, Deconvolution."""
+
+    def _one_layer_net(self, tmp_path, name, ltype, configure=None,
+                       in_shape=(1, 3, 6, 6)):
+        from google.protobuf import text_format
+        net = pb2.NetParameter()
+        net.input.append("data")
+        shp = net.input_shape.add()
+        shp.dim.extend(in_shape)
+        l = net.layer.add()
+        l.name, l.type = name, ltype
+        l.bottom.append("data")
+        l.top.append(name)
+        if configure:
+            configure(l)
+        p = str(tmp_path / f"{name}.prototxt")
+        with open(p, "w") as f:
+            f.write(text_format.MessageToString(net))
+        return p
+
+    def test_simple_activations(self, tmp_path):
+        x = np.random.default_rng(0).normal(size=(1, 3, 6, 6)).astype(np.float32)
+        xt = torch.tensor(x)
+        cases = [
+            ("Sigmoid", None, torch.sigmoid(xt)),
+            ("TanH", None, torch.tanh(xt)),
+            ("AbsVal", None, torch.abs(xt)),
+            ("ELU", None, F.elu(xt)),
+        ]
+        for ltype, cfg, ref in cases:
+            g = load_caffe(self._one_layer_net(tmp_path, ltype.lower(), ltype,
+                                               cfg))
+            out = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+            np.testing.assert_allclose(out, ref.numpy(), rtol=1e-5,
+                                       atol=1e-6), ltype
+
+    def test_power(self, tmp_path):
+        def cfg(l):
+            l.power_param.power = 2.0
+            l.power_param.scale = 0.5
+            l.power_param.shift = 1.0
+
+        x = np.random.default_rng(1).normal(size=(1, 2, 4, 4)).astype(np.float32)
+        g = load_caffe(self._one_layer_net(tmp_path, "pow", "Power", cfg,
+                                           in_shape=(1, 2, 4, 4)))
+        out = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, (1.0 + 0.5 * x) ** 2, rtol=1e-5)
+
+    def test_prelu_per_channel(self, tmp_path):
+        slopes = np.asarray([0.1, 0.5, 0.9], np.float32)
+
+        def cfg(l):
+            _fill_blob(l.blobs.add(), slopes)
+
+        x = np.random.default_rng(2).normal(size=(1, 3, 5, 5)).astype(np.float32)
+        g = load_caffe(self._one_layer_net(tmp_path, "prelu", "PReLU", cfg))
+        out = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+        ref = F.prelu(torch.tensor(x), torch.tensor(slopes)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_flatten_reshape(self, tmp_path):
+        x = np.random.default_rng(3).normal(size=(2, 3, 4, 4)).astype(np.float32)
+        g = load_caffe(self._one_layer_net(tmp_path, "flat", "Flatten",
+                                           in_shape=(2, 3, 4, 4)))
+        out = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+        assert out.shape == (2, 48)
+
+        def cfg(l):
+            l.reshape_param.shape.dim.extend([0, 3, 16])
+
+        g = load_caffe(self._one_layer_net(tmp_path, "resh", "Reshape", cfg,
+                                           in_shape=(2, 3, 4, 4)))
+        out = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, x.reshape(2, 3, 16))
+
+    def test_deconvolution_matches_torch(self, tmp_path):
+        rng = np.random.default_rng(4)
+        w = rng.normal(scale=0.3, size=(3, 5, 4, 4)).astype(np.float32)
+        b = rng.normal(size=(5,)).astype(np.float32)
+
+        def cfg(l):
+            l.convolution_param.num_output = 5
+            l.convolution_param.kernel_size.append(4)
+            l.convolution_param.stride.append(2)
+            l.convolution_param.pad.append(1)
+            l.convolution_param.bias_term = True
+            _fill_blob(l.blobs.add(), w)
+            _fill_blob(l.blobs.add(), b)
+
+        x = rng.normal(size=(1, 3, 6, 6)).astype(np.float32)
+        g = load_caffe(self._one_layer_net(tmp_path, "deconv", "Deconvolution",
+                                           cfg))
+        out = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+        ref = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                 torch.tensor(b), stride=2, padding=1).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)

@@ -288,14 +288,20 @@ def _measure_int8_infer(model_name: str, batch: int, iters: int) -> dict:
         return batch * iters / (time.perf_counter() - t0)
 
     wmodel = model.quantize(mode="weight_only").evaluate()
+    from bigdl_tpu.nn.quantized import calibrate
+    smodel = model.quantize(mode="static").evaluate()
+    calibrate(smodel, [np.asarray(x)])
     bf16_ips = timed(model, cast_bf16=True)
     int8_ips = timed(qmodel, cast_bf16=False)
     wonly_ips = timed(wmodel, cast_bf16=True)
+    static_ips = timed(smodel, cast_bf16=False)
     return {"bf16_infer_ips": round(bf16_ips, 1),
             "int8_infer_ips": round(int8_ips, 1),
             "int8_bf16_ratio": round(int8_ips / bf16_ips, 2),
             "int8_weight_only_ips": round(wonly_ips, 1),
-            "weight_only_bf16_ratio": round(wonly_ips / bf16_ips, 2)}
+            "weight_only_bf16_ratio": round(wonly_ips / bf16_ips, 2),
+            "int8_static_ips": round(static_ips, 1),
+            "static_bf16_ratio": round(static_ips / bf16_ips, 2)}
 
 
 def _measure_serving(model_name: str, batch: int, iters: int) -> dict:

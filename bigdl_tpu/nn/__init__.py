@@ -60,7 +60,9 @@ from bigdl_tpu.nn.initialization import (
     RandomNormal, RandomUniform, Xavier, Zeros,
 )
 from bigdl_tpu.nn.linear import Linear
-from bigdl_tpu.nn.quantized import QuantizedLinear, QuantizedSpatialConvolution
+from bigdl_tpu.nn.quantized import (
+    QuantizedLinear, QuantizedSpatialConvolution, calibrate,
+)
 from bigdl_tpu.nn.sparse import (
     DenseToSparse, LookupTableSparse, SparseEmbeddingSum, SparseJoinTable,
     SparseLinear,

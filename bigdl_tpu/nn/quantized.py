@@ -26,6 +26,11 @@ Two modes (measured on v5e — see docs/performance.md):
   most of bf16 speed (measured 0.77× on v5e ResNet-50; the dequant is not
   fully fused), the memory win kept. The pragmatic choice for serving big
   models on TPU; kept opt-in for reference-semantics parity.
+- ``mode="static"``: int8 activations+weights like dynamic, but the
+  activation scale is BAKED by a calibration pass (``quantized.calibrate``)
+  instead of reduced per batch — removing exactly the per-layer
+  full-activation reduction the dynamic measurement identified as the cost
+  (no serve-time reduce feeding the quantize; pinned by an HLO test).
 """
 
 from __future__ import annotations
@@ -58,11 +63,38 @@ def _quantize_activation(x):
 
 
 class _QuantizedBase(TensorModule):
+    calibrating: bool = False
+
     def _check_inference(self, training: bool) -> None:
         if training:
             raise RuntimeError(
                 f"{type(self).__name__} is inference-only; quantize() after "
                 f"training, not before")
+
+    def _static_scale_and_state(self, x, state):
+        """mode="static": activation scale from the CALIBRATED absmax instead
+        of a per-batch reduction — kills the dynamic mode's per-layer
+        full-activation reduction (its measured cost on v5e). During
+        calibration the running absmax updates through the state thread."""
+        absmax = state["x_absmax"]
+        if self.calibrating:
+            absmax = jnp.maximum(absmax,
+                                 jnp.max(jnp.abs(x)).astype(jnp.float32))
+            state = {**state, "x_absmax": absmax}
+        s_x = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        return s_x, state
+
+    def _quantize_input(self, x, state):
+        """(x_q int8, s_x, new_state) for dynamic/static modes."""
+        if self.mode == "static":
+            s_x, state = self._static_scale_and_state(x, state)
+            x_q = jnp.clip(jnp.round(x / s_x), -127, 127).astype(jnp.int8)
+            return x_q, s_x, state
+        x_q, s_x = _quantize_activation(x)
+        return x_q, s_x, state
+
+
+_MODES = ("dynamic", "weight_only", "static")
 
 
 class QuantizedLinear(_QuantizedBase):
@@ -71,8 +103,8 @@ class QuantizedLinear(_QuantizedBase):
     def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
                  mode: str = "dynamic"):
         super().__init__()
-        if mode not in ("dynamic", "weight_only"):
-            raise ValueError(f"mode must be dynamic|weight_only, got {mode!r}")
+        if mode not in _MODES:
+            raise ValueError(f"mode must be dynamic|weight_only|static, got {mode!r}")
         self.mode = mode
         self.input_size = input_size
         self.output_size = output_size
@@ -83,6 +115,8 @@ class QuantizedLinear(_QuantizedBase):
         }
         if with_bias:
             self._params["bias"] = jnp.zeros((output_size,), jnp.float32)
+        if mode == "static":
+            self._state = {"x_absmax": jnp.zeros((), jnp.float32)}
 
     @classmethod
     def from_float(cls, m: Linear, mode: str = "dynamic") -> "QuantizedLinear":
@@ -108,7 +142,7 @@ class QuantizedLinear(_QuantizedBase):
                 * params["w_scale"][:, None].astype(x.dtype)
             out = (x @ w.T).astype(jnp.float32)
         else:
-            x_q, s_x = _quantize_activation(x)
+            x_q, s_x, state = self._quantize_input(x, state)
             # int8 x int8 → int32 accumulate: the MXU integer path
             acc = lax.dot_general(
                 x_q, params["weight_q"],
@@ -133,8 +167,8 @@ class QuantizedSpatialConvolution(_QuantizedBase):
                  pad_w: int = 0, pad_h: int = 0, n_group: int = 1,
                  with_bias: bool = True, mode: str = "dynamic"):
         super().__init__()
-        if mode not in ("dynamic", "weight_only"):
-            raise ValueError(f"mode must be dynamic|weight_only, got {mode!r}")
+        if mode not in _MODES:
+            raise ValueError(f"mode must be dynamic|weight_only|static, got {mode!r}")
         self.mode = mode
         self.n_input_plane = n_input_plane
         self.n_output_plane = n_output_plane
@@ -150,6 +184,8 @@ class QuantizedSpatialConvolution(_QuantizedBase):
         }
         if with_bias:
             self._params["bias"] = jnp.zeros((n_output_plane,), jnp.float32)
+        if mode == "static":
+            self._state = {"x_absmax": jnp.zeros((), jnp.float32)}
 
     @classmethod
     def from_float(cls, m: SpatialConvolution,
@@ -181,7 +217,7 @@ class QuantizedSpatialConvolution(_QuantizedBase):
                 dimension_numbers=("NCHW", "OIHW", "NCHW"),
                 feature_group_count=self.n_group).astype(jnp.float32)
         else:
-            x_q, s_x = _quantize_activation(x)
+            x_q, s_x, state = self._quantize_input(x, state)
             acc = lax.conv_general_dilated(
                 x_q, params["weight_q"],
                 window_strides=(self.stride_h, self.stride_w),
@@ -208,8 +244,8 @@ def quantize_module(m: AbstractModule, mode: str = "dynamic") -> AbstractModule:
     ``module.quantize()`` also returns a new module). ``mode``: "dynamic"
     (int8 activations+weights) or "weight_only" (int8 weights dequantized at
     use — most of bf16 speed, half the weight HBM)."""
-    if mode not in ("dynamic", "weight_only"):
-        raise ValueError(f"mode must be dynamic|weight_only, got {mode!r}")
+    if mode not in _MODES:
+        raise ValueError(f"mode must be dynamic|weight_only|static, got {mode!r}")
     from bigdl_tpu.nn.graph import Graph
 
     # exact types only: subclasses may change apply() semantics and fall
@@ -237,3 +273,36 @@ def quantize_module(m: AbstractModule, mode: str = "dynamic") -> AbstractModule:
         q.modules = [quantize_module(c, mode) for c in m.modules]
         return q
     return m.clone()
+
+
+def _walk_quantized(m: AbstractModule):
+    if isinstance(m, _QuantizedBase):
+        yield m
+    if isinstance(m, Container):
+        for c in m.modules:
+            yield from _walk_quantized(c)
+
+
+def calibrate(qmodule: AbstractModule, inputs) -> AbstractModule:
+    """Calibrate a ``mode="static"`` quantized model: run the given inputs
+    (arrays or MiniBatch-like objects with ``.input``) through the model while
+    each quantized layer records the running absmax of ITS OWN activations
+    into state. After calibration the baked scales replace the dynamic
+    per-batch reduction. Returns the model (fluent)."""
+    leaves = [q for q in _walk_quantized(qmodule) if q.mode == "static"]
+    if not leaves:
+        raise ValueError(
+            'calibrate() expects a model quantized with mode="static"')
+    for q in leaves:
+        q.calibrating = True
+    try:
+        for x in inputs:
+            x = getattr(x, "input", x)
+            params, state = qmodule.get_params(), qmodule.get_state()
+            _, new_state = qmodule.apply(params, state, x, training=False,
+                                         rng=None)
+            qmodule.set_state(new_state)
+    finally:
+        for q in leaves:
+            q.calibrating = False
+    return qmodule

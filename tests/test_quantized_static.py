@@ -1,0 +1,88 @@
+"""Static (calibrated) int8 mode: the activation scale comes from a
+calibration pass instead of a per-batch reduction — the dynamic mode's
+measured cost on v5e (docs/performance.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.quantized import calibrate
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+@pytest.fixture(autouse=True)
+def engine():
+    Engine.reset()
+    Engine.init(seed=0)
+    RandomGenerator.set_seed(0)
+    yield
+    Engine.reset()
+
+
+def _model():
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1))
+            .add(nn.ReLU())
+            .add(nn.Reshape([8 * 8 * 8]))
+            .add(nn.Linear(8 * 8 * 8, 10)))
+
+
+def _x(seed=0, n=4):
+    return jnp.asarray(np.random.default_rng(seed)
+                       .normal(size=(n, 3, 8, 8)).astype(np.float32))
+
+
+class TestStaticQuantization:
+    def test_calibrated_matches_dynamic_closely(self):
+        m = _model().evaluate()
+        q_dyn = m.quantize(mode="dynamic").evaluate()
+        q_st = m.quantize(mode="static").evaluate()
+        calibrate(q_st, [_x(s) for s in range(4)])
+        x = _x(9)
+        out_d = np.asarray(q_dyn.forward(x))
+        out_s = np.asarray(q_st.forward(x))
+        # same weights, near-identical scales after calibration on the same
+        # distribution → outputs track each other and the float model
+        ref = np.asarray(m.forward(x))
+        assert np.abs(out_s - ref).mean() < 2.5 * np.abs(out_d - ref).mean() \
+            + 1e-3
+
+    def test_no_activation_reduction_at_serve_time(self):
+        """The compiled static forward must not reduce over the activations
+        to find a scale (that is the whole point): no f32 full-tensor
+        reduce feeding the quantize, unlike dynamic mode."""
+        m = _model().evaluate()
+        q_st = m.quantize(mode="static").evaluate()
+        calibrate(q_st, [_x()])
+
+        def fwd(q):
+            params, state = q.get_params(), q.get_state()
+            return jax.jit(
+                lambda p, s, xx: q.apply(p, s, xx, training=False,
+                                         rng=None)[0]).lower(
+                params, state, _x()).compile().as_text()
+
+        hlo_static = fwd(q_st)
+        hlo_dynamic = fwd(m.quantize(mode="dynamic").evaluate())
+        # dynamic emits abs+reduce-max over activations; static must emit
+        # strictly fewer reduce ops
+        n_red_s = hlo_static.count("reduce(")
+        n_red_d = hlo_dynamic.count("reduce(")
+        assert n_red_s < n_red_d, (n_red_s, n_red_d)
+
+    def test_calibration_requires_static(self):
+        m = _model()
+        with pytest.raises(ValueError, match="static"):
+            calibrate(m.quantize(mode="dynamic"), [_x()])
+
+    def test_absmax_monotone_over_batches(self):
+        m = _model().evaluate()
+        q = m.quantize(mode="static")
+        calibrate(q, [_x(0) * 0.1])
+        small = float(q.modules[0].get_state()["x_absmax"])
+        calibrate(q, [_x(1) * 10.0])
+        big = float(q.modules[0].get_state()["x_absmax"])
+        assert big > small > 0

@@ -698,18 +698,23 @@ class TFBatchToSpaceND(TensorModule):
         return x[tuple(idx)], state
 
 
-class QuantizedTFConv2D(TensorModule):
+from bigdl_tpu.nn.quantized import _QuantizedBase as _QuantizedBaseTF  # noqa: E402
+
+
+class QuantizedTFConv2D(_QuantizedBaseTF):
     """Int8 NHWC conv for imported graphs — the bigquant path applied to
     ``TFConv2D`` (weight HWIO, per-output-channel scales on axis 3)."""
 
     def __init__(self, strides, padding, dilations=(1, 1), mode="dynamic"):
         super().__init__()
-        if mode not in ("dynamic", "weight_only"):
+        if mode not in ("dynamic", "weight_only", "static"):
             raise ValueError(mode)
         self.mode = mode
         self.strides = tuple(strides)
         self.padding = padding
         self.dilations = tuple(dilations)
+        if mode == "static":
+            self._state = {"x_absmax": jnp.zeros((), jnp.float32)}
 
     @classmethod
     def from_float(cls, m: TFConv2D, mode: str = "dynamic"):
@@ -725,9 +730,7 @@ class QuantizedTFConv2D(TensorModule):
         return q
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        if training:
-            raise RuntimeError("QuantizedTFConv2D is inference-only")
-        from bigdl_tpu.nn.quantized import _quantize_activation
+        self._check_inference(training)
         kw = dict(window_strides=self.strides, padding=self.padding,
                   rhs_dilation=self.dilations,
                   dimension_numbers=("NHWC", "HWIO", "NHWC"))
@@ -736,7 +739,7 @@ class QuantizedTFConv2D(TensorModule):
                 * params["w_scale"].astype(input.dtype)
             out = lax.conv_general_dilated(input, w, **kw).astype(jnp.float32)
         else:
-            x_q, s_x = _quantize_activation(input)
+            x_q, s_x, state = self._quantize_input(input, state)
             acc = lax.conv_general_dilated(
                 x_q, params["weight_q"],
                 preferred_element_type=jnp.int32, **kw)
@@ -746,14 +749,16 @@ class QuantizedTFConv2D(TensorModule):
         return out, state
 
 
-class QuantizedTFMatMul(TensorModule):
+class QuantizedTFMatMul(_QuantizedBaseTF):
     """Int8 matmul for imported graphs (weight (in, out), scales on axis 1)."""
 
     def __init__(self, mode: str = "dynamic"):
         super().__init__()
-        if mode not in ("dynamic", "weight_only"):
+        if mode not in ("dynamic", "weight_only", "static"):
             raise ValueError(mode)
         self.mode = mode
+        if mode == "static":
+            self._state = {"x_absmax": jnp.zeros((), jnp.float32)}
 
     @classmethod
     def from_float(cls, m: TFMatMul, mode: str = "dynamic"):
@@ -769,16 +774,14 @@ class QuantizedTFMatMul(TensorModule):
         return q
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        if training:
-            raise RuntimeError("QuantizedTFMatMul is inference-only")
-        from bigdl_tpu.nn.quantized import _quantize_activation
+        self._check_inference(training)
         from jax import lax as _lax
         if self.mode == "weight_only":
             w = params["weight_q"].astype(input.dtype) \
                 * params["w_scale"][None, :].astype(input.dtype)
             out = (input @ w).astype(jnp.float32)
         else:
-            x_q, s_x = _quantize_activation(input)
+            x_q, s_x, state = self._quantize_input(input, state)
             acc = _lax.dot_general(x_q, params["weight_q"],
                                    dimension_numbers=(((1,), (0,)), ((), ())),
                                    preferred_element_type=jnp.int32)

@@ -22,9 +22,11 @@ import jax.numpy as jnp
 from bigdl_tpu import nn
 from bigdl_tpu.nn.abstractnn import TensorModule
 from bigdl_tpu.nn.initialization import MsraFiller, Zeros
+from bigdl_tpu.utils.serializer import register as _register_serializable
 from bigdl_tpu.utils.table import Table
 
 
+@_register_serializable
 class _ShortcutA(TensorModule):
     """Type-A shortcut: stride-subsample spatially, zero-pad extra channels (no params)."""
 
@@ -103,6 +105,7 @@ def bottleneck(n_in: int, n_mid: int, stride: int, shortcut_type: str,
             .add(nn.ReLU()))
 
 
+@_register_serializable
 class _Conv1SpaceToDepth(TensorModule):
     """ImageNet stem conv (7x7 stride-2 pad-3, no bias) in space-to-depth form
     (the MLPerf ResNet TPU trick): the input is space-to-depth'd 2x2 on device
@@ -168,6 +171,7 @@ class _Conv1SpaceToDepth(TensorModule):
         return out, state
 
 
+@_register_serializable
 class _GlobalAvgPool(TensorModule):
     def apply(self, params, state, input, *, training=False, rng=None):
         from bigdl_tpu.nn import layout

@@ -24,8 +24,10 @@ import numpy as np
 from bigdl_tpu import nn
 from bigdl_tpu.nn.abstractnn import TensorModule
 from bigdl_tpu.nn.initialization import RandomNormal
+from bigdl_tpu.utils.serializer import register as _register_serializable
 
 
+@_register_serializable
 class PositionEmbedding(TensorModule):
     """Learned absolute position embedding added to (N, T, E) token embeddings."""
 

@@ -354,6 +354,10 @@ class GradientReversal(TensorModule):
     def set_lambda(self, lam: float) -> "GradientReversal":
         self.the_lambda = float(lam)
         self._apply_cache = {}  # lambda is baked into the trace — invalidate
+        # keep the recorded constructor args in sync (portable serializer
+        # rebuilds from them; see pooling.ceil for the failure mode)
+        args, _ = self._init_args
+        self._init_args = ((), {"the_lambda": float(lam)})
         return self
 
     def apply(self, params, state, input, *, training=False, rng=None):

@@ -61,13 +61,20 @@ class SpatialMaxPooling(TensorModule):
             raise ValueError(f"pad_mode must be torch|same, got {pad_mode!r}")
         self.pad_mode = pad_mode
 
-    def ceil(self) -> "SpatialMaxPooling":
-        self.ceil_mode = True
+    def _set_ceil(self, value: bool):
+        self.ceil_mode = value
+        # fluent mutators must also update the RECORDED constructor args —
+        # the portable serializer rebuilds from those, and a .ceil() lost in
+        # round-trip silently shrinks every downstream spatial dim
+        args, kwargs = self._init_args
+        self._init_args = (args, {**kwargs, "ceil_mode": value})
         return self
 
+    def ceil(self) -> "SpatialMaxPooling":
+        return self._set_ceil(True)
+
     def floor(self) -> "SpatialMaxPooling":
-        self.ceil_mode = False
-        return self
+        return self._set_ceil(False)
 
     def apply(self, params, state, input, *, training=False, rng=None):
         from bigdl_tpu.nn import layout
@@ -121,6 +128,8 @@ class SpatialAveragePooling(TensorModule):
 
     def ceil(self) -> "SpatialAveragePooling":
         self.ceil_mode = True
+        args, kwargs = self._init_args
+        self._init_args = (args, {**kwargs, "ceil_mode": True})
         return self
 
     def apply(self, params, state, input, *, training=False, rng=None):

@@ -1,0 +1,92 @@
+"""Portable serialization of the MODEL ZOO (SURVEY.md §4 serialization
+round-trips): every model family saves → loads → produces the identical
+forward. The all-modules sweep covers layer classes; this covers the real
+composed networks users actually persist."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+@pytest.fixture(autouse=True)
+def engine():
+    Engine.reset()
+    Engine.init(seed=0)
+    RandomGenerator.set_seed(0)
+    yield
+    Engine.reset()
+
+
+def _roundtrip_forward(model, x, tmp_path, atol=1e-5):
+    model = model.evaluate()
+    before = np.asarray(model.forward(x))
+    p = str(tmp_path / "zoo.bigdl")
+    model.save_module(p)
+    loaded = nn.AbstractModule.load(p).evaluate()
+    after = np.asarray(loaded.forward(x))
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=atol)
+
+
+def _img(n, c, s, seed=0):
+    return jnp.asarray(np.random.default_rng(seed)
+                       .normal(size=(n, c, s, s)).astype(np.float32))
+
+
+def _ids(n, t, vocab, seed=0):
+    return jnp.asarray(np.random.default_rng(seed)
+                       .integers(0, vocab, size=(n, t)).astype(np.int32))
+
+
+class TestZooRoundTrips:
+    def test_lenet(self, tmp_path):
+        from bigdl_tpu.models.lenet import LeNet5
+        _roundtrip_forward(LeNet5(10), _img(2, 1, 28), tmp_path)
+
+    def test_resnet_cifar(self, tmp_path):
+        from bigdl_tpu.models.resnet import ResNet
+        m = ResNet(10, {"depth": 20, "dataSet": "CIFAR-10"})
+        _roundtrip_forward(m, _img(2, 3, 32), tmp_path)
+
+    def test_vgg_cifar(self, tmp_path):
+        from bigdl_tpu.models.vgg import VggForCifar10
+        _roundtrip_forward(VggForCifar10(10), _img(2, 3, 32), tmp_path)
+
+    def test_inception_v1(self, tmp_path):
+        from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier
+        m = Inception_v1_NoAuxClassifier(1000, has_dropout=False)
+        _roundtrip_forward(m, _img(1, 3, 224), tmp_path, atol=1e-4)
+
+    def test_ptb_lstm(self, tmp_path):
+        from bigdl_tpu.models.rnn import PTBModel
+        m = PTBModel(200, 32, num_layers=1)
+        _roundtrip_forward(m, _ids(2, 8, 200), tmp_path)
+
+    def test_autoencoder(self, tmp_path):
+        from bigdl_tpu.models.autoencoder import Autoencoder
+        m = Autoencoder(32)
+        x = jnp.asarray(np.random.default_rng(0)
+                        .uniform(size=(2, 784)).astype(np.float32))
+        _roundtrip_forward(m, x, tmp_path)
+
+    def test_textclassifier(self, tmp_path):
+        from bigdl_tpu.models.textclassifier import TextClassifier
+        m = TextClassifier(vocab_size=100, class_num=4, embed_dim=16,
+                           seq_len=24)
+        _roundtrip_forward(m, _ids(2, 24, 100), tmp_path)
+
+    def test_transformerlm(self, tmp_path):
+        from bigdl_tpu.models.transformerlm import TransformerLM
+        m = TransformerLM(vocab_size=64, embed_dim=32, num_heads=2,
+                          num_layers=2, max_len=16)
+        _roundtrip_forward(m, _ids(2, 16, 64), tmp_path)
+
+    def test_ncf(self, tmp_path):
+        from bigdl_tpu.models.ncf import NeuralCF
+        m = NeuralCF(user_count=20, item_count=30, mf_embed=4,
+                     hidden_layers=(16, 8))
+        pairs = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+        _roundtrip_forward(m, pairs, tmp_path)

@@ -90,3 +90,44 @@ class TestZooRoundTrips:
                      hidden_layers=(16, 8))
         pairs = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
         _roundtrip_forward(m, pairs, tmp_path)
+
+
+class TestZooQuantizeAndPredict:
+    """Cross-cutting sweep: quantize() and the Predictor path run on real
+    composed networks, not just leaf layers."""
+
+    def _check_quantize(self, model, x, rtol=0.25, atol=0.25):
+        model = model.evaluate()
+        ref = np.asarray(model.forward(x))
+        q = model.quantize(mode="weight_only").evaluate()
+        out = np.asarray(q.forward(x))
+        assert out.shape == ref.shape
+        # int8 weights: outputs track the float model closely on logits
+        assert np.mean(np.abs(out - ref)) < max(0.1 * np.mean(np.abs(ref)),
+                                                atol)
+
+    def test_quantize_lenet(self):
+        from bigdl_tpu.models.lenet import LeNet5
+        self._check_quantize(LeNet5(10), _img(2, 1, 28))
+
+    def test_quantize_resnet_cifar(self):
+        from bigdl_tpu.models.resnet import ResNet
+        self._check_quantize(ResNet(10, {"depth": 20, "dataSet": "CIFAR-10"}),
+                             _img(2, 3, 32))
+
+    def test_quantize_transformerlm(self):
+        from bigdl_tpu.models.transformerlm import TransformerLM
+        m = TransformerLM(vocab_size=64, embed_dim=32, num_heads=2,
+                          num_layers=1, max_len=16)
+        self._check_quantize(m, _ids(2, 16, 64))
+
+    def test_predict_pads_ragged_batch(self):
+        """Predictor on a zoo model with a non-divisible sample count: the
+        padded tail must be dropped from the returned rows."""
+        from bigdl_tpu.models.lenet import LeNet5
+        m = LeNet5(10).evaluate()
+        x = np.asarray(_img(7, 1, 28))
+        out = m.predict(x, batch_size=4)
+        assert out.shape == (7, 10)
+        direct = np.asarray(m.forward(_img(7, 1, 28)))
+        np.testing.assert_allclose(out, direct, rtol=1e-4, atol=1e-5)

@@ -789,3 +789,18 @@ class QuantizedTFMatMul(_QuantizedBaseTF):
         if "bias" in params:
             out = out + params["bias"]
         return out, state
+
+
+# Portable serialization: imported graphs are first-class modules, so every
+# adapter registers with the serializer (the Caffe adapters already do).
+def _register_all() -> None:
+    from bigdl_tpu.nn.abstractnn import AbstractModule
+    from bigdl_tpu.utils.serializer import register
+
+    for obj in list(globals().values()):
+        if isinstance(obj, type) and issubclass(obj, AbstractModule) \
+                and obj.__module__ == __name__:
+            register(obj)
+
+
+_register_all()

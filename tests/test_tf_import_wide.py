@@ -300,3 +300,29 @@ class TestImportedGraphReExports:
             with tf.compat.v1.Session(graph=tfg) as sess:
                 out = sess.run("output:0", feed_dict={"input:0": x})
         np.testing.assert_allclose(out, ours, rtol=1e-4, atol=1e-5)
+
+
+class TestImportedGraphSerializes:
+    def test_portable_roundtrip_of_imported_graph(self, tmp_path):
+        """import TF graph → save_module (portable archive) → load →
+        identical forward: imported models persist like native ones."""
+        import bigdl_tpu.nn as nn
+
+        w = tf.Variable(np.random.default_rng(0)
+                        .normal(scale=0.3, size=(3, 3, 3, 4)).astype(np.float32))
+        b = tf.Variable(np.random.default_rng(1)
+                        .normal(size=(4,)).astype(np.float32))
+
+        def f(x):
+            y = tf.nn.relu(tf.nn.bias_add(
+                tf.nn.conv2d(x, w, strides=1, padding="SAME"), b))
+            return tf.reduce_mean(y, axis=[1, 2])
+
+        x = np.random.default_rng(2).normal(size=(2, 8, 8, 3)).astype(np.float32)
+        g = _check(f, x)
+        before = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+        p = str(tmp_path / "imported.bigdl")
+        g.save_module(p)
+        loaded = nn.AbstractModule.load(p).evaluate()
+        after = np.asarray(loaded.forward(jnp.asarray(x)))
+        np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)

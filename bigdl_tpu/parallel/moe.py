@@ -136,3 +136,10 @@ def expert_parallel_rules(moe_path_prefix: str = "", axis: str = "model",
     r.add(f"{pre}w2$", P(axis, None, None))
     r.add(f"{pre}b2$", P(axis, None))
     return r
+
+
+# portable serialization (utils/serializer.py): MoE checkpoints/archives like
+# any other module
+from bigdl_tpu.utils.serializer import register as _register_serializable  # noqa: E402
+
+_register_serializable(MoE)

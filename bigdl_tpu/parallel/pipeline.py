@@ -307,3 +307,8 @@ class GPipe(Container):
         kind = "homogeneous" if self.homogeneous else "heterogeneous"
         return (f"GPipe(stages={self.n_stages} [{kind}], "
                 f"microbatches={self.n_microbatches})")
+
+
+from bigdl_tpu.utils.serializer import register as _register_serializable  # noqa: E402
+
+_register_serializable(GPipe)

@@ -40,6 +40,61 @@ def _run_module(modname: str, argv) -> int:
     return out if isinstance(out, int) else 0
 
 
+def _launch_multihost(args) -> int:
+    """Spawn args.nnodes processes, each a jax.distributed 'node' running the
+    chosen train main with --distributed (reference parity: the spark-submit
+    / bigdl.sh cluster launch, SURVEY.md §2.5 — one process per executor).
+    On one machine this is the local[N] analog; across machines, run the same
+    command per host with an explicit --port and a reachable coordinator."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    port = args.port
+    if port == 0:
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+    mod, _ = _TRAIN_MAINS[args.model]
+    rest = [a for a in args.rest if a != "--"]
+    if "--distributed" not in rest:
+        rest.append("--distributed")
+    cpu = bool(args.devices_per_node)
+    pre = ""
+    if cpu:
+        # the site hook preloads jax._src, so env alone is too late —
+        # re-assert platform selection in-process (same dance as
+        # tests/multihost_worker.py); cross-process CPU collectives ride gloo
+        pre = ("import jax\n"
+               "jax.config.update('jax_platforms', 'cpu')\n")
+    backend_arg = "backend='cpu', " if cpu else ""
+    code = (
+        "import sys\n"
+        f"{pre}"
+        "from bigdl_tpu.utils.engine import Engine\n"
+        f"Engine.init({backend_arg}"
+        f"coordinator_address='localhost:{port}', "
+        f"node_number={args.nnodes}, process_id=int(sys.argv[1]))\n"
+        f"import importlib\n"
+        f"importlib.import_module({mod!r}).main(sys.argv[2:])\n")
+    procs = []
+    for pid in range(args.nnodes):
+        env = dict(os.environ)
+        if cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                f"--xla_force_host_platform_device_count="
+                f"{args.devices_per_node}")
+            env.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code, str(pid)] + rest, env=env))
+    rc = 0
+    for p in procs:
+        rc = rc or p.wait()
+    return rc
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # bench forwards option-style args; argparse REMAINDER cannot capture a
@@ -72,10 +127,26 @@ def main(argv=None) -> int:
     sub.add_parser("models", help="list available training mains")
     sub.add_parser("env", help="print the BIGDL_* environment flags in effect")
 
+    launch = sub.add_parser(
+        "launch", help="spawn an N-process jax.distributed training run on "
+                       "this host (the spark-submit analog; each process = "
+                       "one 'node')")
+    launch.add_argument("-n", "--nnodes", type=int, default=2)
+    launch.add_argument("--port", type=int, default=0,
+                        help="coordinator port (0 = pick a free one)")
+    launch.add_argument("--devices-per-node", type=int, default=None,
+                        help="virtual CPU devices per process (default: "
+                        "leave device discovery alone — real accelerators)")
+    launch.add_argument("model", choices=sorted(_TRAIN_MAINS))
+    launch.add_argument("rest", nargs=argparse.REMAINDER,
+                        help="arguments forwarded to the model's own CLI")
+
     args = p.parse_args(argv)
     if args.command == "train":
         mod, _ = _TRAIN_MAINS[args.model]
         return _run_module(mod, args.rest)
+    if args.command == "launch":
+        return _launch_multihost(args)
     if args.command == "dryrun-multichip":
         import os
         # virtual CPU mesh: override any preset accelerator platform — this

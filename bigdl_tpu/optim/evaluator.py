@@ -68,6 +68,17 @@ def _put_eval_batch(inp):
     return jax.device_put(inp)
 
 
+def _fetch(out):
+    """Device→host fetch that works under multi-process meshes: an output
+    sharded over the GLOBAL mesh spans non-addressable devices, so gather it
+    across processes first (every process then holds the full array — the
+    reference's driver-side aggregation shape)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        out = multihost_utils.process_allgather(out, tiled=True)
+    return jax.device_get(out)
+
+
 def _as_dataset(data, batch_size: Optional[int]) -> AbstractDataSet:
     """Accept a DataSet (already batched), a list of Samples, or a numpy array."""
     if isinstance(data, AbstractDataSet):
@@ -100,7 +111,7 @@ class Predictor:
         params, mstate = self.model.get_params(), self.model.get_state()
         outs = []
         for batch in dataset.data(train=False):
-            out = np.asarray(jax.device_get(fwd(params, mstate,
+            out = np.asarray(_fetch(fwd(params, mstate,
                                                 _put_eval_batch(batch.input))))
             outs.append(out[: batch.valid])
         if not outs:
@@ -130,7 +141,7 @@ class Evaluator:
         params, mstate = self.model.get_params(), self.model.get_state()
         results: list[Optional[ValidationResult]] = [None] * len(methods)
         for batch in dataset.data(train=False):
-            out = jax.device_get(fwd(params, mstate, _put_eval_batch(batch.input)))
+            out = _fetch(fwd(params, mstate, _put_eval_batch(batch.input)))
             target = np.asarray(batch.target)
             for i, m in enumerate(methods):
                 r = m.apply(np.asarray(out), target, batch.valid)

@@ -771,16 +771,17 @@ class Optimizer:
         # dispatch eval steps asynchronously and fetch outputs in chunks — one
         # host round trip per chunk instead of per batch (this backend charges
         # ~75 ms per fetch; per-batch sync made validation throughput ugly)
+        from bigdl_tpu.optim.evaluator import _fetch as _fetch_eval
         chunk, metas = [], []
         for batch in self.val_dataset.data(train=False):
             inp = self._put_input(batch)
             chunk.append(eval_fn(params, mstate, inp))
             metas.append((np.asarray(batch.target), batch.valid))
             if len(chunk) >= 16:
-                _apply(jax.device_get(chunk), metas)
+                _apply(_fetch_eval(chunk), metas)
                 chunk, metas = [], []
         if chunk:
-            _apply(jax.device_get(chunk), metas)
+            _apply(_fetch_eval(chunk), metas)
         state.setdefault("scores", {})
         for m, r in zip(self.val_methods, results):
             if r is not None:

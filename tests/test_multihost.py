@@ -55,3 +55,20 @@ def test_two_process_distri_training(tmp_path):
         assert pl["neval"] >= 4
     # SPMD: both processes computed the identical replicated loss
     assert payloads[0]["loss"] == pytest.approx(payloads[1]["loss"], rel=1e-6)
+
+
+def test_cli_launch_two_nodes():
+    """`bigdl-tpu launch -n 2` — the spark-submit analog — runs a zoo main
+    under jax.distributed across two processes (CLI-level coverage on top of
+    the direct DistriOptimizer test above)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.cli", "launch", "-n", "2",
+         "--devices-per-node", "4", "lenet", "--",
+         "--max-epoch", "1", "--synthetic-size", "128", "-b", "32"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    losses = [l for l in (p.stdout + p.stderr).splitlines()
+              if "final loss" in l]
+    assert len(losses) == 2 and losses[0] == losses[1], losses

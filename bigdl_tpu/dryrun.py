@@ -185,9 +185,12 @@ def dryrun_multichip(n_devices: int) -> None:
         opt.optimize()
         losses["dp x pp/gpipe-hetero-lm"] = opt.state["loss"]
 
-    # 5) sequence parallel: causal ring attention over the seq axis
+    # 5) dp x sp: causal ring attention over the seq axis COMPOSED with data
+    # parallelism (batch sharded over `data`, sequence over `seq`)
     Engine.reset()
-    Engine.init(mesh_shape=(1, n_devices),
+    sp = n_devices // 2 if n_devices % 2 == 0 else n_devices
+    dp = n_devices // sp
+    Engine.init(mesh_shape=(dp, sp),
                 mesh_axes=(Engine.DATA_AXIS, Engine.SEQ_AXIS))
     rng = np.random.default_rng(1)
     t = 2 * n_devices
@@ -202,7 +205,7 @@ def dryrun_multichip(n_devices: int) -> None:
            .set_optim_method(SGD(learningrate=0.05, momentum=0.9, dampening=0.0))
            .set_end_when(Trigger.max_iteration(1)))
     opt.optimize()
-    losses["sp/ring-attention"] = opt.state["loss"]
+    losses[f"dp{dp} x sp{sp}/ring-attention"] = opt.state["loss"]
 
     # provenance so each round's artifact is self-identifying (round-2 advisor:
     # byte-identical dryrun outputs across rounds were indistinguishable from
@@ -218,7 +221,7 @@ def dryrun_multichip(n_devices: int) -> None:
         commit = "unknown"
     kind = jax.devices()[0].device_kind
     print(f"dryrun_multichip({n_devices}): OK — dp, dp x tp (Megatron MLP), "
-          f"dp x ep (MoE), dp x pp (GPipe), sp (ring attention); "
+          f"dp x ep (MoE), dp x pp (hetero GPipe), dp x sp (ring attention); "
           f"losses={losses}; "
           f"provenance=commit:{commit},device:{kind},platform:"
           f"{jax.devices()[0].platform}")

@@ -111,6 +111,28 @@ class Optimizer:
         self._step_cache = None
 
     # fluent config (reference API shape) ----------------------------------
+    def set_model(self, model: AbstractModule) -> "Optimizer":
+        """Swap the model (reference ``setModel`` — fine-tuning flows: train,
+        swap in a modified network, continue). Invalidates the compiled step
+        and the optimizer slots (new parameter tree)."""
+        self.model = model
+        self._step_cache = None
+        self._final_ostate = None
+        return self
+
+    def set_criterion(self, criterion: AbstractCriterion) -> "Optimizer":
+        """Swap the training criterion (reference ``setCriterion``)."""
+        self.criterion = criterion
+        self._step_cache = None
+        return self
+
+    def set_train_data(self, dataset: AbstractDataSet) -> "Optimizer":
+        """Swap the training dataset (reference ``setTrainData`` — curriculum
+        phases). The device batch cache is dropped with the old data."""
+        self.dataset = dataset
+        self._device_batch_cache = None
+        return self
+
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
         self.optim_method = method
         self._step_cache = None

@@ -108,3 +108,51 @@ class TestPropagateBack:
 
         gw = jax.grad(loss_wrt_params)(conv.get_params())
         assert float(jnp.sum(jnp.abs(gw["weight"]))) > 0  # weights still learn
+
+
+class TestFluentSwaps:
+    """Reference setModel/setCriterion/setTrainData: swap mid-run, continue."""
+
+    def test_curriculum_swap(self):
+        Engine.reset()
+        Engine.init()
+        RandomGenerator.set_seed(2)
+        rng = np.random.default_rng(0)
+
+        def batches(scale):
+            return DataSet.array([MiniBatch(
+                (rng.normal(size=(16, 6)) * scale).astype(np.float32),
+                rng.integers(0, 3, size=(16,)).astype(np.int32))])
+
+        model = (nn.Sequential().add(nn.Linear(6, 3)).add(nn.LogSoftMax()))
+        opt = (LocalOptimizer(model, batches(1.0), nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_end_when(Trigger.max_iteration(2)))
+        opt.optimize()
+        l1 = opt.state["loss"]
+        # phase 2: new data + more iterations through the SAME optimizer
+        (opt.set_train_data(batches(2.0))
+            .set_end_when(Trigger.max_iteration(6)))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"]) and opt.state["neval"] > 2
+
+    def test_set_model_resets_step(self):
+        Engine.reset()
+        Engine.init()
+        RandomGenerator.set_seed(3)
+        rng = np.random.default_rng(1)
+        data = DataSet.array([MiniBatch(
+            rng.normal(size=(8, 6)).astype(np.float32),
+            rng.integers(0, 3, size=(8,)).astype(np.int32))])
+        opt = (LocalOptimizer(
+                   nn.Sequential().add(nn.Linear(6, 3)).add(nn.LogSoftMax()),
+                   data, nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_end_when(Trigger.max_iteration(1)))
+        opt.optimize()
+        bigger = (nn.Sequential().add(nn.Linear(6, 16)).add(nn.ReLU())
+                  .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+        opt.set_model(bigger).set_end_when(Trigger.max_iteration(3))
+        trained = opt.optimize()
+        assert trained is bigger
+        assert np.isfinite(opt.state["loss"])

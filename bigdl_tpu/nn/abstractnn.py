@@ -109,9 +109,27 @@ class AbstractModule(metaclass=RecordsInit):
 
     def grad_scales(self) -> dict:
         """Pytree matching get_params() of per-leaf gradient multipliers:
-        bias-like leaves get scale_b, everything else scale_w."""
+        bias-like leaves get scale_b, everything else scale_w; frozen modules
+        contribute zeros."""
+        if getattr(self, "_frozen", False):
+            return {k: 0.0 for k in self._params}
         return {k: (self.scale_b if "bias" in k else self.scale_w)
                 for k in self._params}
+
+    def freeze(self) -> "AbstractModule":
+        """Exclude this module's parameters from training updates (reference
+        ``freeze`` — fine-tuning: freeze the pretrained trunk, train the
+        head). Zeroes the gradients inside the jitted step; scale_w/scale_b
+        are restored on ``unfreeze``."""
+        self._frozen = True
+        return self
+
+    def unfreeze(self) -> "AbstractModule":
+        self._frozen = False
+        return self
+
+    def is_frozen(self) -> bool:
+        return getattr(self, "_frozen", False)
 
     def has_regularizers(self) -> bool:
         return (getattr(self, "w_regularizer", None) is not None
@@ -433,7 +451,24 @@ class Container(AbstractModule):
         return self
 
     def grad_scales(self) -> dict:
+        if getattr(self, "_frozen", False):
+            import jax
+            return {name: jax.tree_util.tree_map(lambda _: 0.0,
+                                                 m.grad_scales())
+                    for name, m in self.named_children()}
         return {name: m.grad_scales() for name, m in self.named_children()}
+
+    def freeze(self) -> "AbstractModule":
+        self._frozen = True
+        for m in self.modules:
+            m.freeze()
+        return self
+
+    def unfreeze(self) -> "AbstractModule":
+        self._frozen = False
+        for m in self.modules:
+            m.unfreeze()
+        return self
 
     def has_regularizers(self) -> bool:
         return any(m.has_regularizers() for m in self.modules)

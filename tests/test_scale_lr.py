@@ -156,3 +156,37 @@ class TestFluentSwaps:
         trained = opt.optimize()
         assert trained is bigger
         assert np.isfinite(opt.state["loss"])
+
+
+class TestFreeze:
+    """Reference freeze/unFreeze: fine-tuning with a frozen trunk."""
+
+    def test_frozen_trunk_untouched_head_learns(self):
+        Engine.reset()
+        Engine.init()
+        RandomGenerator.set_seed(9)
+        trunk = nn.Sequential().add(nn.Linear(6, 16)).add(nn.ReLU())
+        head = nn.Linear(16, 3)
+        model = nn.Sequential().add(trunk).add(head).add(nn.LogSoftMax())
+        trunk.freeze()
+        w_trunk = np.asarray(trunk.modules[0].get_params()["weight"]).copy()
+        w_head = np.asarray(head.get_params()["weight"]).copy()
+        rng = np.random.default_rng(0)
+        data = DataSet.array([MiniBatch(
+            rng.normal(size=(16, 6)).astype(np.float32),
+            rng.integers(0, 3, size=(16,)).astype(np.int32))])
+        (LocalOptimizer(model, data, nn.ClassNLLCriterion())
+         .set_optim_method(SGD(learningrate=0.2))
+         .set_end_when(Trigger.max_iteration(4))
+         .optimize())
+        np.testing.assert_array_equal(
+            np.asarray(trunk.modules[0].get_params()["weight"]), w_trunk)
+        assert np.abs(np.asarray(head.get_params()["weight"])
+                      - w_head).sum() > 0
+
+    def test_unfreeze_restores_scales(self):
+        m = nn.Linear(4, 4).set_scale_w(0.5)
+        m.freeze()
+        assert set(m.grad_scales().values()) == {0.0}
+        m.unfreeze()
+        assert m.grad_scales()["weight"] == 0.5  # original scale survives

@@ -154,6 +154,49 @@ class _Exporter:
             return name
         if t in ("Identity", "Contiguous"):
             return bottom
+        if t == "Sigmoid":
+            _, name = self._layer("sigmoid", "Sigmoid", [bottom])
+            return name
+        if t == "Tanh":
+            _, name = self._layer("tanh", "TanH", [bottom])
+            return name
+        if t == "Abs":
+            _, name = self._layer("abs", "AbsVal", [bottom])
+            return name
+        if t == "ELU":
+            l, name = self._layer("elu", "ELU", [bottom])
+            l.elu_param.alpha = float(module.alpha)
+            return name
+        if t == "Power":
+            l, name = self._layer("power", "Power", [bottom])
+            l.power_param.power = float(module.power)
+            l.power_param.scale = float(module.scale)
+            l.power_param.shift = float(module.shift)
+            return name
+        if t == "PReLU":
+            slopes = np.asarray(params["weight"], np.float32)
+            l, name = self._layer("prelu", "PReLU", [bottom], [slopes])
+            l.prelu_param.channel_shared = module.n_output_plane == 0
+            return name
+        if t == "Flatten":
+            l, name = self._layer("flat", "Flatten", [bottom])
+            return name
+        if t == "SpatialFullConvolution":
+            if module.n_group != 1 or module.adj_w or module.adj_h:
+                raise CaffeExportError(
+                    "grouped/adjusted deconvolution has no Caffe export rule")
+            l, name = self._layer(
+                "deconv", "Deconvolution", [bottom],
+                [np.asarray(params["weight"], np.float32)]
+                + ([np.asarray(params["bias"], np.float32)]
+                   if "bias" in params else []))
+            p = l.convolution_param
+            p.num_output = module.n_output_plane
+            p.kernel_h, p.kernel_w = module.kh, module.kw
+            p.stride_h, p.stride_w = module.dh, module.dw
+            p.pad_h, p.pad_w = module.pad_h, module.pad_w
+            p.bias_term = "bias" in params
+            return name
         # importer-produced adapter modules (utils/caffe/ops.py) — exact Caffe
         # layers, so the import → export round trip stays closed
         if t == "CaffeSoftmax":

@@ -110,3 +110,31 @@ class TestImportThenExport:
         np.testing.assert_allclose(
             np.asarray(loaded.evaluate().forward(x)),
             np.asarray(model.forward(x)), rtol=1e-4, atol=1e-5)
+
+
+class TestRound4TierRoundTrip:
+    def test_new_layers_export_import_roundtrip(self, tmp_path):
+        """Native net using the round-4 layer tier exports to Caffe and
+        re-imports to the identical forward (the closed-loop oracle)."""
+        import jax.numpy as jnp
+
+        from bigdl_tpu.utils.caffe import load_caffe
+        from bigdl_tpu.utils.caffe.saver import save_caffe
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+
+        RandomGenerator.set_seed(5)
+        m = (nn.Sequential()
+             .add(nn.SpatialFullConvolution(3, 6, 3, 3, 2, 2, 1, 1))
+             .add(nn.PReLU(6))
+             .add(nn.Sigmoid())
+             .add(nn.Power(2.0, scale=0.5, shift=1.0))
+             .add(nn.Tanh())).evaluate()
+        x = jnp.asarray(np.random.default_rng(0)
+                        .normal(size=(1, 3, 6, 6)).astype(np.float32))
+        before = np.asarray(m.forward(x))
+        proto = str(tmp_path / "net.prototxt")
+        model = str(tmp_path / "net.caffemodel")
+        save_caffe(m, proto, model, input_shape=(1, 3, 6, 6))
+        g = load_caffe(proto, model).evaluate()
+        after = np.asarray(g.forward(x))
+        np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)

@@ -131,3 +131,22 @@ class TestStringInits:
         from bigdl_tpu.nn.keras.layers import _resolve_init
         with _pytest.raises(ValueError, match="keras init"):
             _resolve_init("nope")
+
+
+class TestKerasPersistence:
+    def test_save_load_roundtrip(self, tmp_path):
+        """KerasModel.save → AbstractModule.load: the built module persists
+        and reproduces the forward (keras facade over native persistence)."""
+        import bigdl_tpu.nn as nn
+
+        m = K.Sequential()
+        m.add(K.Dense(8, activation="relu", input_shape=(5,)))
+        m.add(K.Dense(3, activation="softmax"))
+        x = np.random.default_rng(0).normal(size=(4, 5)).astype(np.float32)
+        before = m.predict(x, batch_size=4)
+        p = str(tmp_path / "keras.bigdl")
+        m.save(p)
+        loaded = nn.AbstractModule.load(p).evaluate()
+        import jax.numpy as jnp
+        after = np.asarray(loaded.forward(jnp.asarray(x)))
+        np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)

@@ -89,10 +89,10 @@ def _launch_multihost(args) -> int:
             env.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
         procs.append(subprocess.Popen(
             [sys.executable, "-c", code, str(pid)] + rest, env=env))
-    rc = 0
-    for p in procs:
-        rc = rc or p.wait()
-    return rc
+    # wait for EVERY process (no short-circuit: an early crash must not
+    # orphan the surviving workers), then report the first failure
+    rcs = [p.wait() for p in procs]
+    return next((rc for rc in rcs if rc), 0)
 
 
 def main(argv=None) -> int:

@@ -451,11 +451,9 @@ class Container(AbstractModule):
         return self
 
     def grad_scales(self) -> dict:
-        if getattr(self, "_frozen", False):
-            import jax
-            return {name: jax.tree_util.tree_map(lambda _: 0.0,
-                                                 m.grad_scales())
-                    for name, m in self.named_children()}
+        # no container-level short-circuit: freeze() already propagated to
+        # children, and `model.freeze(); head.unfreeze()` must honor the
+        # child's unfreeze (a parent-level zeros branch would ignore it)
         return {name: m.grad_scales() for name, m in self.named_children()}
 
     def freeze(self) -> "AbstractModule":

@@ -65,9 +65,15 @@ class SpatialMaxPooling(TensorModule):
         self.ceil_mode = value
         # fluent mutators must also update the RECORDED constructor args —
         # the portable serializer rebuilds from those, and a .ceil() lost in
-        # round-trip silently shrinks every downstream spatial dim
+        # round-trip silently shrinks every downstream spatial dim. Bind the
+        # recorded positionals to parameter NAMES first, else a positionally
+        # passed ceil_mode would collide with (or silently override) the
+        # kwarg at rebuild time.
+        import inspect
         args, kwargs = self._init_args
-        self._init_args = (args, {**kwargs, "ceil_mode": value})
+        names = list(inspect.signature(type(self).__init__).parameters)[1:]
+        merged = {**dict(zip(names, args)), **kwargs, "ceil_mode": value}
+        self._init_args = ((), merged)
         return self
 
     def ceil(self) -> "SpatialMaxPooling":
@@ -128,8 +134,11 @@ class SpatialAveragePooling(TensorModule):
 
     def ceil(self) -> "SpatialAveragePooling":
         self.ceil_mode = True
+        import inspect
         args, kwargs = self._init_args
-        self._init_args = (args, {**kwargs, "ceil_mode": True})
+        names = list(inspect.signature(type(self).__init__).parameters)[1:]
+        self._init_args = ((), {**dict(zip(names, args)), **kwargs,
+                                "ceil_mode": True})
         return self
 
     def apply(self, params, state, input, *, training=False, rng=None):

@@ -64,6 +64,25 @@ def _quantize_activation(x):
 
 class _QuantizedBase(TensorModule):
     calibrating: bool = False
+    _calibrated: bool = False
+
+    def _init_quantized(self, mode: str) -> None:
+        """Shared mode validation + static-state init for every quantized
+        module kind (native and TF-adapter)."""
+        if mode not in _MODES:
+            raise ValueError(
+                f"mode must be {'|'.join(_MODES)}, got {mode!r}")
+        self.mode = mode
+        if mode == "static":
+            self._state = {"x_absmax": jnp.zeros((), jnp.float32)}
+
+    def set_state(self, state: dict) -> None:
+        super().set_state(state)
+        # restoring a calibrated checkpoint re-arms the serve path (the
+        # concrete absmax is visible here, python-side)
+        absmax = state.get("x_absmax")
+        if absmax is not None and float(np.asarray(absmax)) > 0:
+            self._calibrated = True
 
     def _check_inference(self, training: bool) -> None:
         if training:
@@ -87,6 +106,12 @@ class _QuantizedBase(TensorModule):
     def _quantize_input(self, x, state):
         """(x_q int8, s_x, new_state) for dynamic/static modes."""
         if self.mode == "static":
+            if not (self.calibrating or self._calibrated):
+                # absmax=0 would silently quantize with scale 1.0 (garbage
+                # predictions); refuse loudly instead
+                raise RuntimeError(
+                    f"{type(self).__name__}(mode='static') serving before "
+                    f"calibration — run nn.calibrate(model, batches) first")
             s_x, state = self._static_scale_and_state(x, state)
             x_q = jnp.clip(jnp.round(x / s_x), -127, 127).astype(jnp.int8)
             return x_q, s_x, state
@@ -103,9 +128,7 @@ class QuantizedLinear(_QuantizedBase):
     def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
                  mode: str = "dynamic"):
         super().__init__()
-        if mode not in _MODES:
-            raise ValueError(f"mode must be dynamic|weight_only|static, got {mode!r}")
-        self.mode = mode
+        self._init_quantized(mode)
         self.input_size = input_size
         self.output_size = output_size
         self.with_bias = with_bias
@@ -115,8 +138,6 @@ class QuantizedLinear(_QuantizedBase):
         }
         if with_bias:
             self._params["bias"] = jnp.zeros((output_size,), jnp.float32)
-        if mode == "static":
-            self._state = {"x_absmax": jnp.zeros((), jnp.float32)}
 
     @classmethod
     def from_float(cls, m: Linear, mode: str = "dynamic") -> "QuantizedLinear":
@@ -167,9 +188,7 @@ class QuantizedSpatialConvolution(_QuantizedBase):
                  pad_w: int = 0, pad_h: int = 0, n_group: int = 1,
                  with_bias: bool = True, mode: str = "dynamic"):
         super().__init__()
-        if mode not in _MODES:
-            raise ValueError(f"mode must be dynamic|weight_only|static, got {mode!r}")
-        self.mode = mode
+        self._init_quantized(mode)
         self.n_input_plane = n_input_plane
         self.n_output_plane = n_output_plane
         self.kernel_w, self.kernel_h = kernel_w, kernel_h
@@ -184,8 +203,6 @@ class QuantizedSpatialConvolution(_QuantizedBase):
         }
         if with_bias:
             self._params["bias"] = jnp.zeros((n_output_plane,), jnp.float32)
-        if mode == "static":
-            self._state = {"x_absmax": jnp.zeros((), jnp.float32)}
 
     @classmethod
     def from_float(cls, m: SpatialConvolution,
@@ -305,4 +322,5 @@ def calibrate(qmodule: AbstractModule, inputs) -> AbstractModule:
     finally:
         for q in leaves:
             q.calibrating = False
+            q._calibrated = True
     return qmodule

@@ -517,11 +517,17 @@ class Optimizer:
             ostate = self.optim_method.init_state(params)
         self._resume_ostate = None
         # step cache is keyed on the Engine compute dtype (the casts are baked
-        # into the trace); config setters that change the program clear it
+        # into the trace) AND the model's gradient-scale fingerprint — freeze/
+        # unfreeze/set_scale_* between optimize() calls change the program and
+        # happen on the MODULE, where they can't clear this cache directly
         cdt = Engine.compute_dtype()
-        if self._step_cache is None or getattr(self, "_step_cache_dtype", None) != cdt:
+        scales_key = tuple(jax.tree_util.tree_leaves(self.model.grad_scales()))
+        if (self._step_cache is None
+                or getattr(self, "_step_cache_dtype", None) != cdt
+                or getattr(self, "_step_cache_scales", None) != scales_key):
             self._step_cache = self._compile_step()
             self._step_cache_dtype = cdt
+            self._step_cache_scales = scales_key
         step_fn = self._step_cache
         base_rng = RandomGenerator.next_key()
         self._setup_device_cache()

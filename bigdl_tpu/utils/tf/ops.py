@@ -707,14 +707,10 @@ class QuantizedTFConv2D(_QuantizedBaseTF):
 
     def __init__(self, strides, padding, dilations=(1, 1), mode="dynamic"):
         super().__init__()
-        if mode not in ("dynamic", "weight_only", "static"):
-            raise ValueError(mode)
-        self.mode = mode
+        self._init_quantized(mode)
         self.strides = tuple(strides)
         self.padding = padding
         self.dilations = tuple(dilations)
-        if mode == "static":
-            self._state = {"x_absmax": jnp.zeros((), jnp.float32)}
 
     @classmethod
     def from_float(cls, m: TFConv2D, mode: str = "dynamic"):
@@ -754,11 +750,7 @@ class QuantizedTFMatMul(_QuantizedBaseTF):
 
     def __init__(self, mode: str = "dynamic"):
         super().__init__()
-        if mode not in ("dynamic", "weight_only", "static"):
-            raise ValueError(mode)
-        self.mode = mode
-        if mode == "static":
-            self._state = {"x_absmax": jnp.zeros((), jnp.float32)}
+        self._init_quantized(mode)
 
     @classmethod
     def from_float(cls, m: TFMatMul, mode: str = "dynamic"):

@@ -86,3 +86,21 @@ class TestStaticQuantization:
         calibrate(q, [_x(1) * 10.0])
         big = float(q.modules[0].get_state()["x_absmax"])
         assert big > small > 0
+
+
+class TestReviewFindings:
+    def test_uncalibrated_static_refuses_loudly(self):
+        m = _model().evaluate().quantize(mode="static").evaluate()
+        with pytest.raises(RuntimeError, match="calibration"):
+            m.forward(_x())
+
+    def test_loaded_calibrated_model_serves(self, tmp_path):
+        m = _model().evaluate()
+        q = m.quantize(mode="static").evaluate()
+        calibrate(q, [_x()])
+        p = str(tmp_path / "static.bigdl")
+        q.save_module(p)
+        import bigdl_tpu.nn as nn
+        loaded = nn.AbstractModule.load(p).evaluate()
+        out = np.asarray(loaded.forward(_x(5)))   # no re-calibration needed
+        assert np.isfinite(out).all()

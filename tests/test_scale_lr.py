@@ -190,3 +190,54 @@ class TestFreeze:
         assert set(m.grad_scales().values()) == {0.0}
         m.unfreeze()
         assert m.grad_scales()["weight"] == 0.5  # original scale survives
+
+
+class TestFreezeReviewFindings:
+    def test_child_unfreeze_after_parent_freeze(self):
+        """model.freeze(); head.unfreeze() — the head must train."""
+        m = nn.Sequential().add(nn.Linear(4, 4).set_name("trunk")) \
+                           .add(nn.Linear(4, 2).set_name("head"))
+        m.freeze()
+        m.modules[1].unfreeze()
+        scales = m.grad_scales()
+        assert set(scales["0"].values()) == {0.0}
+        assert scales["1"]["weight"] == 1.0
+
+    def test_freeze_between_optimize_calls_recompiles(self):
+        """freeze() AFTER the step compiled must invalidate the cached step
+        (the scales are baked into the trace)."""
+        Engine.reset()
+        Engine.init()
+        RandomGenerator.set_seed(5)
+        model = (nn.Sequential().add(nn.Linear(6, 8).set_name("a"))
+                 .add(nn.ReLU()).add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+        rng = np.random.default_rng(0)
+        data = DataSet.array([MiniBatch(
+            rng.normal(size=(16, 6)).astype(np.float32),
+            rng.integers(0, 3, size=(16,)).astype(np.int32))])
+        opt = (LocalOptimizer(model, data, nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.2))
+               .set_end_when(Trigger.max_iteration(2)))
+        opt.optimize()
+        model.modules[0].freeze()
+        w = np.asarray(model.modules[0].get_params()["weight"]).copy()
+        opt.set_end_when(Trigger.max_iteration(6))
+        opt.optimize()
+        np.testing.assert_array_equal(
+            np.asarray(model.modules[0].get_params()["weight"]), w)
+
+
+class TestCeilPositionalSerialization:
+    def test_positional_ceil_mode_roundtrips(self, tmp_path):
+        """ceil_mode passed POSITIONALLY then .floor(): must not crash the
+        serializer rebuild nor resurrect the stale positional value."""
+        import jax.numpy as jnp
+        m = nn.SpatialMaxPooling(2, 2, 2, 2, 0, 0, True).floor()
+        p = str(tmp_path / "pool.bigdl")
+        m.save_module(p)
+        loaded = nn.AbstractModule.load(p)
+        assert loaded.ceil_mode is False
+        x = jnp.asarray(np.random.default_rng(0)
+                        .normal(size=(1, 2, 5, 5)).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(loaded.forward(x)),
+                                   np.asarray(m.forward(x)))

@@ -2,7 +2,8 @@ from bigdl_tpu.optim.distri_optimizer import DistriOptimizer, ParallelOptimizer
 from bigdl_tpu.optim.evaluator import Evaluator, Predictor
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import (
-    Adadelta, Adagrad, Adam, Adamax, CompositeOptimMethod, Ftrl, LBFGS, LarsSGD,
+    Adadelta, Adagrad, Adam, AdamW, Adamax, CompositeOptimMethod, Ftrl, LBFGS,
+    LarsSGD,
     OptimMethod, RMSprop, SGD,
 )
 from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer

@@ -172,6 +172,30 @@ class Adam(OptimMethod):
         return new_params, {"m": m, "v": v}
 
 
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (the reference's BERT-era
+    ``AdamWeightDecay``; Loshchilov & Hutter): decay applies to the
+    parameters directly, not through the gradient/moment path."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8,
+                 weightdecay: float = 1e-2):
+        super().__init__(learningrate, learningrate_decay, beta1, beta2,
+                         epsilon)
+        self.weightdecay = weightdecay
+
+    def update(self, params, grads, state, step):
+        lr = decayed_lr(self.learningrate, self.learningrate_decay,
+                        step.astype(jnp.float32))
+        new_params, new_state = super().update(params, grads, state, step)
+        if self.weightdecay:
+            wd = lr * self.weightdecay
+            new_params = tree_map(lambda np_, p: np_ - wd * p,
+                                  new_params, params)
+        return new_params, new_state
+
+
 class Adagrad(OptimMethod):
     """Adagrad (reference ``<dl>/optim/Adagrad.scala`` — unverified).
 

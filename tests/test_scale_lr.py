@@ -241,3 +241,39 @@ class TestCeilPositionalSerialization:
                         .normal(size=(1, 2, 5, 5)).astype(np.float32))
         np.testing.assert_allclose(np.asarray(loaded.forward(x)),
                                    np.asarray(m.forward(x)))
+
+
+class TestAdamW:
+    def test_decoupled_decay_matches_torch(self):
+        import torch
+
+        from bigdl_tpu.optim import AdamW
+        rng = np.random.default_rng(0)
+        w0 = rng.normal(size=(4, 3)).astype(np.float32)
+        g = rng.normal(size=(4, 3)).astype(np.float32)
+
+        m = AdamW(learningrate=0.1, weightdecay=0.05)
+        params = {"w": jnp.asarray(w0)}
+        state = m.init_state(params)
+        for step in range(3):
+            params, state = m.update(params, {"w": jnp.asarray(g)}, state,
+                                     jnp.asarray(step))
+
+        t = torch.nn.Parameter(torch.tensor(w0.copy()))
+        opt = torch.optim.AdamW([t], lr=0.1, weight_decay=0.05, eps=1e-8)
+        for _ in range(3):
+            t.grad = torch.tensor(g.copy())
+            opt.step()
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   t.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_zero_decay_is_adam(self):
+        from bigdl_tpu.optim import Adam, AdamW
+        rng = np.random.default_rng(1)
+        w0 = {"w": jnp.asarray(rng.normal(size=(5,)).astype(np.float32))}
+        g = {"w": jnp.asarray(rng.normal(size=(5,)).astype(np.float32))}
+        a, aw = Adam(learningrate=0.01), AdamW(learningrate=0.01,
+                                               weightdecay=0.0)
+        pa, sa = a.update(w0, g, a.init_state(w0), jnp.asarray(0))
+        pw, sw = aw.update(w0, g, aw.init_state(w0), jnp.asarray(0))
+        np.testing.assert_allclose(np.asarray(pa["w"]), np.asarray(pw["w"]))

@@ -71,19 +71,61 @@ def _peak_flops(device_kind: str):
     return None
 
 
+# Models the bench runs channels-last (the TPU-native fast path; numerics
+# pinned equal to NCHW by tests/test_layout_nhwc.py). LeNet stays NCHW — its
+# front Reshape([1,28,28]) hard-codes the reference layout, and it's a
+# CPU-trivial config anyway. Opt out with BIGDL_BENCH_LAYOUT=nchw (reference-
+# parity layout), BIGDL_BENCH_S2D=0 (plain 7x7 stride-2 stem).
+_NHWC_MODELS = {"resnet50", "inception", "vgg16"}
+
+
+def _bench_layout(model_name: str):
+    """Layout the bench pins for ``model_name``: NHWC/NCHW for image models,
+    None for sequence models (layout is irrelevant — leave the process
+    setting alone)."""
+    mode = os.environ.get("BIGDL_BENCH_LAYOUT", "auto").lower()
+    if mode not in ("auto", "nchw", "nhwc"):
+        raise ValueError(
+            f"BIGDL_BENCH_LAYOUT must be auto|nchw|nhwc, got {mode!r}")
+    if model_name in ("ptb-lstm", "transformerlm"):
+        return None
+    if mode == "nchw" or model_name not in _NHWC_MODELS:
+        return "NCHW"
+    return "NHWC"
+
+
 def _build(model_name: str, batch: int, n_batches: int, dtype: str):
     import numpy as np
 
     from bigdl_tpu import nn
+    from bigdl_tpu.nn import layout
     from bigdl_tpu.dataset.dataset import DataSet
     from bigdl_tpu.dataset.sample import MiniBatch
+
+    fmt = _bench_layout(model_name)
+    if fmt is not None:
+        layout.set_image_format(fmt)
+    nhwc = fmt == "NHWC"
+
+    def _img(c, h, w):
+        return (batch, h, w, c) if nhwc else (batch, c, h, w)
+
+    def _with_normalize(m, n_ch):
+        # TPU-native input path: the feed stays uint8 (4x less wire traffic
+        # than fp32 — what a real decode pipeline ships) and normalization
+        # runs on device, fused into the first conv (nn.ImageNormalize).
+        norm = (nn.ImageNormalize(mean=(0.1307,), std=(0.3081,)) if n_ch == 1
+                else nn.ImageNormalize())
+        return nn.Sequential().add(norm).add(m)
 
     criterion = nn.ClassNLLCriterion()
     seq = None
     if model_name == "resnet50":
         from bigdl_tpu.models.resnet import ResNet
-        model = ResNet(1000, {"depth": 50, "dataSet": "ImageNet"})
-        shape, n_classes = (batch, 3, 224, 224), 1000
+        s2d = os.environ.get("BIGDL_BENCH_S2D", "1") != "0"
+        model = ResNet(1000, {"depth": 50, "dataSet": "ImageNet",
+                              "conv1SpaceToDepth": s2d})
+        shape, n_classes = _img(3, 224, 224), 1000
     elif model_name == "lenet":
         from bigdl_tpu.models.lenet import LeNet5
         model = LeNet5(10)
@@ -91,11 +133,11 @@ def _build(model_name: str, batch: int, n_batches: int, dtype: str):
     elif model_name == "inception":
         from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier
         model = Inception_v1_NoAuxClassifier(1000, has_dropout=False)
-        shape, n_classes = (batch, 3, 224, 224), 1000
+        shape, n_classes = _img(3, 224, 224), 1000
     elif model_name == "vgg16":
         from bigdl_tpu.models.vgg import VggForCifar10
         model = VggForCifar10(10, has_dropout=False)
-        shape, n_classes = (batch, 3, 32, 32), 10
+        shape, n_classes = _img(3, 32, 32), 10
     elif model_name == "ptb-lstm":
         from bigdl_tpu.models.rnn import PTBModel
         model = PTBModel(10000, 650, num_layers=2)
@@ -112,11 +154,15 @@ def _build(model_name: str, batch: int, n_batches: int, dtype: str):
     else:
         raise ValueError(f"unknown model {model_name!r}")
 
+    if seq is None:
+        n_ch = shape[3] if nhwc else shape[1]
+        model = _with_normalize(model, n_ch)
+
     rng = np.random.default_rng(0)
     batches = []
     for _ in range(n_batches):
-        if seq is None:
-            x = rng.normal(size=shape).astype(np.float32)
+        if seq is None:  # image models: uint8 pixels (device-side normalize)
+            x = rng.integers(0, 256, size=shape).astype(np.uint8)
             y = rng.integers(0, n_classes, size=(batch,)).astype(np.int32)
         else:  # language models: token ids in, next-token ids out
             x = rng.integers(0, n_classes, size=shape).astype(np.int32)
@@ -205,6 +251,7 @@ def _measure(model_name: str, batch: int, iters: int, warmup: int,
         "device_kind": dev.device_kind,
         "platform": dev.platform,
         "peak_flops": peak,
+        "layout": _bench_layout(model_name),
         "feed_wait_ms": 1e3 * opt.metrics.summary().get("feed", 0.0),
     }
 

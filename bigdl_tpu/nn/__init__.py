@@ -20,7 +20,7 @@ from bigdl_tpu.nn.misc import (
     RReLU, ResizeBilinear, Scale, SoftShrink, SpatialUpSamplingBilinear,
     SpatialUpSamplingNearest, Sum, Threshold, UpSampling1D, UpSampling2D,
     UpSampling3D, Cropping2D, Cropping3D, ActivityRegularization,
-    CrossProduct, NegativeEntropyPenalty,
+    CrossProduct, NegativeEntropyPenalty, ImageNormalize,
 )
 from bigdl_tpu.nn.cosine import Cosine, CosineDistance
 from bigdl_tpu.nn.convolution import (

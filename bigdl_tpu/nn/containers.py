@@ -46,12 +46,19 @@ class Concat(Container):
     """Apply each child to the same input; concatenate outputs along ``dimension``.
 
     The workhorse of Inception's branch blocks. ``dimension`` is 1-based counting the batch
-    dim first (reference convention): default 2 = channel axis of NCHW.
+    dim first (reference convention): default 2 = channel axis of NCHW. Under
+    ``nn.layout`` NHWC mode, dimension 2 on a 4-D activation means "the channel
+    axis" semantically, so it resolves to the last axis — this is what lets the
+    Inception zoo run channels-last unmodified (spatial-glue rule — see the
+    nn/layout.py module docstring). Concatenating 4-D NON-image tables along a
+    literal second axis under NHWC mode is outside that rule: pass
+    ``literal_dim=True`` to suppress the channel-axis resolution.
     """
 
-    def __init__(self, dimension: int = 2):
+    def __init__(self, dimension: int = 2, literal_dim: bool = False):
         super().__init__()
         self.dimension = dimension
+        self.literal_dim = literal_dim
 
     def apply(self, params, state, input, *, training=False, rng=None):
         outs, new_state = [], {}
@@ -60,7 +67,12 @@ class Concat(Container):
             o, s = m.apply(params[name], state[name], input, training=training, rng=r)
             outs.append(o)
             new_state[name] = s
-        return jnp.concatenate(outs, axis=self.dimension - 1), new_state
+        axis = self.dimension - 1
+        if axis == 1 and outs and outs[0].ndim == 4 \
+                and not getattr(self, "literal_dim", False):
+            from bigdl_tpu.nn import layout
+            axis = layout.channel_axis(4)
+        return jnp.concatenate(outs, axis=axis), new_state
 
     def __repr__(self):
         inner = " | ".join(repr(m) for m in self.modules)

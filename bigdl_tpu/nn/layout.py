@@ -16,11 +16,19 @@ portable serializer are unaffected by the activation layout.
 
 Layers honoring the flag: SpatialConvolution (+Share/Map subclasses),
 SpatialBatchNormalization, SpatialMaxPooling, SpatialAveragePooling,
-SpatialDropout2D, SpatialCrossMapLRN, PReLU, UpSampling2D, and the ResNet
-zoo glue (shortcut-A / global-avg-pool / s2d stem). The long tail of exotic
-spatial layers (dilated/full conv, within-channel LRN, subtractive/divisive
-norm, volumetric 3-D ops, ROI ops, keras wrappers) remains NCHW-only — build
-those models with the default format.
+SpatialDropout2D, SpatialCrossMapLRN, PReLU, UpSampling2D, ImageNormalize,
+Concat, and the ResNet zoo glue (shortcut-A / global-avg-pool / s2d stem).
+The long tail of exotic spatial layers (dilated/full conv, within-channel
+LRN, subtractive/divisive norm, volumetric 3-D ops, ROI ops, keras wrappers)
+remains NCHW-only — build those models with the default format.
+
+**Spatial-glue rule:** under NHWC mode, glue layers that address "the channel
+axis" by the reference's positional convention (``Concat(dimension=2)`` on a
+4-D activation, per-channel broadcasts) re-resolve that position to the
+channels-last axis, because the semantic intent — branch merge / broadcast
+over channels — is layout-invariant. This applies to ALL 4-D activations
+while NHWC mode is on; concatenating 4-D non-image tables along a literal
+second axis in an NHWC model needs ``Concat(dim, literal_dim=True)``.
 """
 
 from __future__ import annotations

@@ -701,3 +701,47 @@ class CrossProduct(AbstractModule):
         outs = [jnp.sum(xs[i] * xs[j], axis=-1)
                 for i in range(len(xs)) for j in range(i + 1, len(xs))]
         return jnp.stack(outs, axis=-1), state
+
+
+class ImageNormalize(TensorModule):
+    """On-device image normalization: ``(x * scale - mean) / std`` per channel.
+
+    The TPU-native input path (SURVEY.md §2.2 redesign): the reference's
+    pipeline normalizes on the CPU and ships float32 activations to the
+    compute tier; on TPU the wire (PCIe/tunnel) is the scarce resource, so the
+    feed stays ``uint8`` (4x fewer bytes than fp32) and this layer casts +
+    normalizes on device, where XLA fuses it into the first convolution's
+    epilogue at zero marginal cost. Defaults are the ImageNet mean/std in
+    0-1 range with ``scale=1/255`` (uint8 pixels); pass ``scale=1.0`` for
+    pre-scaled float input. Channel broadcasting follows ``nn.layout``.
+    """
+
+    def __init__(self, mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225),
+                 scale: float = 1.0 / 255.0):
+        super().__init__()
+        mean = mean if isinstance(mean, (tuple, list)) else (mean,)
+        std = std if isinstance(std, (tuple, list)) else (std,)
+        self.mean = tuple(float(m) for m in mean)
+        self.std = tuple(float(s) for s in std)
+        if len(self.mean) != len(self.std):
+            raise ValueError(
+                f"ImageNormalize: mean has {len(self.mean)} channels but std "
+                f"has {len(self.std)} — they must pair up")
+        self.scale = float(scale)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.nn import layout
+        from bigdl_tpu.utils.engine import Engine
+        x = jnp.asarray(input)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(Engine.compute_dtype() if Engine.is_initialized()
+                         else jnp.float32)
+        shape = layout.bias_shape(len(self.mean), x.ndim) if x.ndim >= 3 \
+            else (len(self.mean),)
+        mean = jnp.asarray(self.mean, x.dtype).reshape(shape)
+        std = jnp.asarray(self.std, x.dtype).reshape(shape)
+        return (x * jnp.asarray(self.scale, x.dtype) - mean) / std, state
+
+    def __repr__(self):
+        return (f"ImageNormalize(mean={self.mean}, std={self.std}, "
+                f"scale={self.scale:g})")

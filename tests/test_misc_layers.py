@@ -184,3 +184,53 @@ class TestUpsampling:
             leaves = jax.tree_util.tree_leaves(g)
             assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
             assert any(np.abs(np.asarray(l)).max() > 0 for l in leaves)
+
+
+class TestImageNormalize:
+    """nn.ImageNormalize: the TPU-native uint8-feed input path (round 4)."""
+
+    def test_uint8_matches_torchvision_semantics(self):
+        import jax
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 256, size=(2, 3, 8, 8)).astype(np.uint8)
+        m = nn.ImageNormalize()
+        out, _ = m.apply({}, {}, jnp.asarray(x))
+        mean = np.array([0.485, 0.456, 0.406]).reshape(1, 3, 1, 1)
+        std = np.array([0.229, 0.224, 0.225]).reshape(1, 3, 1, 1)
+        want = (x.astype(np.float32) / 255.0 - mean) / std
+        assert np.allclose(np.asarray(out), want, atol=1e-5)
+
+    def test_nhwc_layout(self):
+        from bigdl_tpu.nn import layout
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, 256, size=(2, 3, 8, 8)).astype(np.uint8)
+        m = nn.ImageNormalize()
+        o1, _ = m.apply({}, {}, jnp.asarray(x))
+        layout.set_image_format("NHWC")
+        try:
+            o2, _ = m.apply({}, {}, jnp.asarray(x.transpose(0, 2, 3, 1)))
+        finally:
+            layout.set_image_format(None)
+        assert np.allclose(np.transpose(np.asarray(o1), (0, 2, 3, 1)),
+                           np.asarray(o2), atol=1e-5)
+
+    def test_float_passthrough_keeps_dtype_and_scale(self):
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 1, 4, 4)),
+                        jnp.float32)
+        m = nn.ImageNormalize(mean=(0.5,), std=(2.0,), scale=1.0)
+        out, _ = m.apply({}, {}, x)
+        assert out.dtype == jnp.float32
+        assert np.allclose(np.asarray(out), (np.asarray(x) - 0.5) / 2.0,
+                           atol=1e-6)
+
+    def test_serializer_roundtrip(self, tmp_path):
+        from bigdl_tpu.utils.serializer import load_module, save_module
+        m = nn.Sequential().add(nn.ImageNormalize()).add(nn.Linear(3, 2))
+        p = str(tmp_path / "m.bigdl")
+        save_module(m, p)
+        m2 = load_module(p)
+        x = jnp.asarray(np.random.default_rng(3).integers(0, 256, (2, 3)),
+                        jnp.uint8)
+        o1, _ = m.apply(m.get_params(), m.get_state(), x)
+        o2, _ = m2.apply(m2.get_params(), m2.get_state(), x)
+        assert np.allclose(np.asarray(o1), np.asarray(o2), atol=1e-6)

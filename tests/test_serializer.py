@@ -256,6 +256,8 @@ EXAMPLES = {
     "UpSampling3D": (lambda: nn.UpSampling3D((2, 2, 2)), _x(1, 2, 2, 2, 2)),
     "ResizeBilinear": (lambda: nn.ResizeBilinear(5, 7), _x(1, 2, 3, 4)),
     "Cropping2D": (lambda: nn.Cropping2D((1, 1), (1, 1)), _x(1, 2, 5, 5)),
+    "ImageNormalize": (lambda: nn.ImageNormalize(mean=(0.4, 0.5), std=(0.2, 0.3)),
+                       _x(1, 2, 4, 4)),
     "Cropping3D": (lambda: nn.Cropping3D((1, 0), (0, 1), (1, 1)),
                    _x(1, 2, 4, 4, 4)),
     "Remat": (lambda: nn.Remat(nn.Linear(4, 3)), _x(2, 4)),

@@ -68,8 +68,7 @@ class Concat(Container):
             outs.append(o)
             new_state[name] = s
         axis = self.dimension - 1
-        if axis == 1 and outs and outs[0].ndim == 4 \
-                and not getattr(self, "literal_dim", False):
+        if axis == 1 and outs and outs[0].ndim == 4 and not self.literal_dim:
             from bigdl_tpu.nn import layout
             axis = layout.channel_axis(4)
         return jnp.concatenate(outs, axis=axis), new_state

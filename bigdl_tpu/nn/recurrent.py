@@ -53,6 +53,22 @@ class Cell(TensorModule):
     def cell_apply(self, params, x, hidden, *, training=False, rng=None):
         raise NotImplementedError
 
+    # --- input-projection hoisting (cuDNN-style split, TPU-native) ---------
+    # The input half of the gate pre-activation (x @ w_ih.T + b_ih) has no
+    # recurrent dependency, so `Recurrent` computes it for ALL timesteps as
+    # ONE (N*T, F) x (F, G) matmul before the scan — a large MXU-friendly
+    # contraction — leaving only the (N, H) x (H, G) recurrent half inside
+    # the scan body. Cells that support the split implement `input_proj` +
+    # `cell_apply_from_proj`; others return None and scan the full step.
+
+    def input_proj(self, params, x_seq):
+        """(N, T, F) -> per-step input contribution, or None (no hoisting)."""
+        return None
+
+    def cell_apply_from_proj(self, params, gi, hidden, *, training=False,
+                             rng=None):
+        raise NotImplementedError
+
     def apply(self, params, state, input, *, training=False, rng=None):
         xs = input.values() if isinstance(input, Table) else list(input)
         x, hidden = xs[0], tuple(xs[1:])
@@ -64,7 +80,21 @@ def _uniform_init(init, shape, fan_in):
     return jnp.asarray(init.init(shape, fan_in=fan_in, fan_out=shape[0]))
 
 
-class RnnCell(Cell):
+class _GateCell(Cell):
+    """Cells whose gate pre-activation splits as ``x @ w_ih.T + b_ih`` (input
+    half, hoistable) + recurrent half: the single-step ``cell_apply`` is the
+    projected step fed with the per-step input contribution."""
+
+    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+        return self.cell_apply_from_proj(
+            params, x @ params["w_ih"].T + params["b_ih"], hidden,
+            training=training, rng=rng)
+
+    def input_proj(self, params, x_seq):
+        return x_seq @ params["w_ih"].T + params["b_ih"]
+
+
+class RnnCell(_GateCell):
     """Vanilla RNN cell: ``h' = act(W_x x + b_x + W_h h + b_h)``."""
 
     def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh,
@@ -89,17 +119,17 @@ class RnnCell(Cell):
     def init_hidden(self, batch_size: int):
         return (jnp.zeros((batch_size, self.hidden_size), jnp.float32),)
 
-    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+    def cell_apply_from_proj(self, params, gi, hidden, *, training=False,
+                             rng=None):
         (h,) = hidden
-        new_h = self.activation(
-            x @ params["w_ih"].T + params["b_ih"] + h @ params["w_hh"].T + params["b_hh"])
+        new_h = self.activation(gi + h @ params["w_hh"].T + params["b_hh"])
         return new_h, (new_h,)
 
     def __repr__(self):
         return f"RnnCell({self.input_size}, {self.hidden_size})"
 
 
-class LSTM(Cell):
+class LSTM(_GateCell):
     """LSTM cell (reference ``nn.LSTM``); gates fused into one (4H) GEMM, i|f|g|o order."""
 
     def __init__(self, input_size: int, hidden_size: int,
@@ -124,10 +154,10 @@ class LSTM(Cell):
         z = jnp.zeros((batch_size, self.hidden_size), jnp.float32)
         return (z, z)
 
-    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+    def cell_apply_from_proj(self, params, gi, hidden, *, training=False,
+                             rng=None):
         h, c = hidden
-        gates = (x @ params["w_ih"].T + params["b_ih"]
-                 + h @ params["w_hh"].T + params["b_hh"])
+        gates = gi + h @ params["w_hh"].T + params["b_hh"]
         i_g, f_g, g_g, o_g = jnp.split(gates, 4, axis=-1)
         i_g = jax.nn.sigmoid(i_g)
         f_g = jax.nn.sigmoid(f_g)
@@ -153,10 +183,10 @@ class LSTMPeephole(LSTM):
         self._params["w_co"] = _uniform_init(init, (h,), h)
         self.zero_grad_parameters()
 
-    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+    def cell_apply_from_proj(self, params, gi, hidden, *, training=False,
+                             rng=None):
         h, c = hidden
-        gates = (x @ params["w_ih"].T + params["b_ih"]
-                 + h @ params["w_hh"].T + params["b_hh"])
+        gates = gi + h @ params["w_hh"].T + params["b_hh"]
         i_g, f_g, g_g, o_g = jnp.split(gates, 4, axis=-1)
         i_g = jax.nn.sigmoid(i_g + c * params["w_ci"])
         f_g = jax.nn.sigmoid(f_g + c * params["w_cf"])
@@ -167,7 +197,7 @@ class LSTMPeephole(LSTM):
         return new_h, (new_h, new_c)
 
 
-class GRU(Cell):
+class GRU(_GateCell):
     """GRU cell (reference ``nn.GRU``); gate order r|z|n matching torch.nn.GRU."""
 
     def __init__(self, input_size: int, hidden_size: int,
@@ -191,9 +221,9 @@ class GRU(Cell):
     def init_hidden(self, batch_size: int):
         return (jnp.zeros((batch_size, self.hidden_size), jnp.float32),)
 
-    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+    def cell_apply_from_proj(self, params, gi, hidden, *, training=False,
+                             rng=None):
         (h,) = hidden
-        gi = x @ params["w_ih"].T + params["b_ih"]
         gh = h @ params["w_hh"].T + params["b_hh"]
         i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
         h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
@@ -213,13 +243,22 @@ def _scan_cell(cell: "Cell", cparams, x, *, training: bool, rng):
     Returns the (N, T, H) output sequence. Per-step rng is derived by ``fold_in`` on the
     step index so the scan body stays pure.
     """
-    xs = jnp.swapaxes(x, 0, 1)  # (T, N, F)
+    # Hoist the input projection out of the scan when the cell supports the
+    # split: one (N·T, F) x (F, G) MXU matmul up front instead of T small
+    # per-step matmuls (see Cell.input_proj).
+    proj = cell.input_proj(cparams, x)
+    xs = jnp.swapaxes(proj if proj is not None else x, 0, 1)  # (T, N, ·)
     steps = jnp.arange(xs.shape[0])
 
     def step(h, xt_i):
         x_t, i = xt_i
         r = jax.random.fold_in(rng, i) if rng is not None else None
-        out, new_h = cell.cell_apply(cparams, x_t, h, training=training, rng=r)
+        if proj is not None:
+            out, new_h = cell.cell_apply_from_proj(cparams, x_t, h,
+                                                   training=training, rng=r)
+        else:
+            out, new_h = cell.cell_apply(cparams, x_t, h,
+                                         training=training, rng=r)
         return new_h, out
 
     _, outs = jax.lax.scan(step, cell.init_hidden_from(x[:, 0]), (xs, steps))

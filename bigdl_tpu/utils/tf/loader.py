@@ -49,7 +49,8 @@ _MULTI_OUTPUT = ("Split", "SplitV", "Unpack")
 
 
 class _Importer:
-    def __init__(self, graph_def):
+    def __init__(self, graph_def, fold_batchnorm: bool = False):
+        self.fold_batchnorm = fold_batchnorm
         self.nodes = {n.name: n for n in graph_def.node}
         self.consts: dict[str, np.ndarray] = {}
         self.module_nodes: dict[str, object] = {}   # tf node name → ModuleNode
@@ -147,6 +148,61 @@ class _Importer:
         return nn.Graph(in_nodes if len(in_nodes) > 1 else in_nodes[0],
                         out_nodes if len(out_nodes) > 1 else out_nodes[0])
 
+    # ------------------------------------------------------------- fusion
+    def _fold_bn_into_conv(self, node, scale, offset, mean, var, eps, get):
+        """Pattern fusion: fold an inference-form FusedBatchNorm into its
+        sole-producer ``Conv2D``/``DepthwiseConv2dNative`` (optionally through
+        an intervening ``BiasAdd``), the reference Fusion pass's conv+bn case
+        (SURVEY.md §2.1, expected ``<dl>/nn/mkldnn/Fusion.scala`` — unverified,
+        mount empty). w' = w·k, b' = (b − mean)·k + offset with
+        k = scale·rsqrt(var + eps): one conv module imports in place of the
+        conv/bias/bn triple. Returns None when the pattern doesn't apply
+        (caller falls back to a standalone TFBatchNorm)."""
+        from bigdl_tpu.utils.tf import ops as O
+
+        k = (scale / np.sqrt(var + eps)).astype(np.float32)
+
+        bias = None
+        conv_name = self._clean(node.input[0])
+        conv = self.nodes.get(conv_name)
+        if conv is not None and conv.op == "BiasAdd" \
+                and self.consumers.get(conv_name, 0) == 1 \
+                and conv_name not in self.module_nodes:
+            _data_format(conv)  # NCHW BiasAdd must fail loudly, not fold wrong
+            b = self.const_value(conv.input[1])
+            inner_name = self._clean(conv.input[0])
+            inner = self.nodes.get(inner_name)
+            if b is None or inner is None:
+                return None
+            bias, conv_name, conv = b, inner_name, inner
+        if conv is None or conv.op not in ("Conv2D", "DepthwiseConv2dNative") \
+                or self.consumers.get(conv_name, 0) != 1 \
+                or conv_name in self.module_nodes:
+            return None
+        w = self.const_value(conv.input[1])
+        if w is None:
+            return None
+        if conv.op == "Conv2D":
+            w2 = w * k.reshape(1, 1, 1, -1)
+        else:
+            # depthwise (H, W, C, M): BN channels are (c, m) row-major
+            w2 = w * k.reshape(1, 1, w.shape[2], w.shape[3])
+        b2 = ((bias if bias is not None else 0.0) - mean) * k + offset
+        m = self._conv_module(conv, w2.astype(w.dtype), b2.astype(np.float32))
+        return m.set_name(node.name).inputs(get(conv.input[0]))
+
+    def _conv_module(self, conv, w, bias):
+        """Construct the TFConv2D/TFDepthwiseConv2D adapter for a conv node —
+        single point for attr extraction, shared by the direct converters and
+        the BN fold so the two paths cannot drift."""
+        from bigdl_tpu.utils.tf import ops as O
+
+        _data_format(conv)
+        s = _attr_list(conv, "strides")
+        d = _attr_list(conv, "dilations") or [1, 1, 1, 1]
+        cls = O.TFConv2D if conv.op == "Conv2D" else O.TFDepthwiseConv2D
+        return cls(w, s[1:3], _padding(conv), d[1:3], bias=bias)
+
     # ------------------------------------------------------------- converters
     def _convert(self, node, get, fused_bias=None):
         from bigdl_tpu import nn
@@ -175,24 +231,11 @@ class _Importer:
                 f"{node.name}: Const consumed as activation (only weight-feeding "
                 f"Consts are supported)")
 
-        if op == "Conv2D":
-            _data_format(node)
+        if op in ("Conv2D", "DepthwiseConv2dNative"):
             w = self.const_value(node.input[1])
             if w is None:
                 raise TFImportError(f"{node.name}: non-const conv weights")
-            s = _attr_list(node, "strides")
-            d = _attr_list(node, "dilations") or [1, 1, 1, 1]
-            return wire(O.TFConv2D(w, s[1:3], _padding(node), d[1:3],
-                                   bias=fused_bias), node.input[0])
-        if op == "DepthwiseConv2dNative":
-            _data_format(node)
-            w = self.const_value(node.input[1])
-            if w is None:
-                raise TFImportError(f"{node.name}: non-const depthwise weights")
-            s = _attr_list(node, "strides")
-            d = _attr_list(node, "dilations") or [1, 1, 1, 1]
-            return wire(O.TFDepthwiseConv2D(w, s[1:3], _padding(node), d[1:3],
-                                            bias=fused_bias), node.input[0])
+            return wire(self._conv_module(node, w, fused_bias), node.input[0])
         if op == "BiasAdd":
             _data_format(node)
             b = self.const_value(node.input[1])
@@ -224,6 +267,11 @@ class _Importer:
             eps = node.attr["epsilon"].f if "epsilon" in node.attr else 1e-4
             if eps == 0.0:
                 eps = 1e-4
+            if self.fold_batchnorm:
+                folded = self._fold_bn_into_conv(node, scale, offset, mean,
+                                                 var, eps, get)
+                if folded is not None:
+                    return folded
             return wire(O.TFBatchNorm(scale, offset, mean, var, eps), node.input[0])
         if op == "Relu":
             return wire(nn.ReLU(), node.input[0])
@@ -510,13 +558,20 @@ class _Importer:
 
 
 def load_frozen_graph(graph, outputs: Sequence[str],
-                      inputs: Optional[Sequence[str]] = None):
+                      inputs: Optional[Sequence[str]] = None,
+                      fold_batchnorm: bool = False):
     """Import a frozen TF graph.
 
     ``graph``: path to a GraphDef protobuf (binary ``.pb``) or an in-memory
     GraphDef. ``outputs``: output node names; ``inputs``: optional input
     (Placeholder) names to pin the input order. Returns ``nn.Graph`` taking
     NHWC inputs like the TF original.
+
+    ``fold_batchnorm=True`` additionally folds inference-form FusedBatchNorm
+    nodes into their producing conv (through BiasAdd when present) — the
+    reference Fusion pass's conv+bn pattern. Off by default so the imported
+    module tree keeps the BN parameters visible for fine-tuning; turn it on
+    for serving-path imports (fewer modules, same numerics).
     """
     if isinstance(graph, (str, bytes)):
         from tensorflow.core.framework import graph_pb2
@@ -525,7 +580,7 @@ def load_frozen_graph(graph, outputs: Sequence[str],
             gd.ParseFromString(f.read())
     else:
         gd = graph
-    imp = _Importer(gd)
+    imp = _Importer(gd, fold_batchnorm=fold_batchnorm)
     g = imp.build(inputs, outputs)
     logger.info("imported TF graph: %d nodes -> %d modules",
                 len(imp.nodes), len(g.modules))

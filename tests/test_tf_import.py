@@ -213,3 +213,59 @@ class TestWidenedOpSet:
         d.attr["padding"].s = b"SAME"
         with pytest.raises(TFImportError, match="dilated deconv"):
             load_frozen_graph(gd, ["deconv"], ["x"])
+
+
+class TestFoldBatchNorm:
+    """fold_batchnorm=True: the conv+(bias)+bn chain imports as ONE conv
+    module with folded weights — the reference Fusion pass's conv+bn case."""
+
+    def _nets(self):
+        rng = np.random.default_rng(3)
+        w = tf.Variable(rng.normal(scale=0.2, size=(3, 3, 3, 8)).astype(np.float32))
+        b = tf.Variable(rng.normal(size=(8,)).astype(np.float32))
+        wd = tf.Variable(rng.normal(scale=0.2, size=(3, 3, 8, 2)).astype(np.float32))
+        scale = tf.Variable(np.abs(rng.normal(size=(8,))).astype(np.float32) + 0.5)
+        offset = tf.Variable(rng.normal(size=(8,)).astype(np.float32))
+        mean = tf.Variable(rng.normal(size=(8,)).astype(np.float32))
+        var = tf.Variable(np.abs(rng.normal(size=(8,))).astype(np.float32) + 0.5)
+        dscale = tf.Variable(np.abs(rng.normal(size=(16,))).astype(np.float32) + 0.5)
+        doffset = tf.Variable(rng.normal(size=(16,)).astype(np.float32))
+        dmean = tf.Variable(rng.normal(size=(16,)).astype(np.float32))
+        dvar = tf.Variable(np.abs(rng.normal(size=(16,))).astype(np.float32) + 0.5)
+
+        def conv_bias_bn(x):
+            y = tf.nn.conv2d(x, w, strides=1, padding="SAME")
+            y = tf.nn.bias_add(y, b)
+            y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+                y, scale, offset, mean=mean, variance=var, is_training=False)
+            return tf.nn.relu(y)
+
+        def depthwise_bn(x):
+            y = tf.nn.conv2d(x, w, strides=1, padding="SAME")
+            y = tf.nn.depthwise_conv2d(y, wd,
+                                       strides=[1, 1, 1, 1], padding="SAME")
+            y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+                y, dscale, doffset, mean=dmean, variance=dvar,
+                is_training=False)
+            return tf.nn.relu(y)
+
+        return conv_bias_bn, depthwise_bn
+
+    @pytest.mark.parametrize("which", ["conv_bias_bn", "depthwise_bn"])
+    def test_fold_matches_tf_and_shrinks_graph(self, which):
+        conv_bias_bn, depthwise_bn = self._nets()
+        fn = {"conv_bias_bn": conv_bias_bn, "depthwise_bn": depthwise_bn}[which]
+        spec = tf.TensorSpec([2, 8, 8, 3], tf.float32)
+        gd, in_name, out_name, frozen = _freeze(fn, spec)
+
+        plain = load_frozen_graph(gd, outputs=[out_name], inputs=[in_name])
+        folded = load_frozen_graph(gd, outputs=[out_name], inputs=[in_name],
+                                   fold_batchnorm=True)
+        assert len(folded.modules) < len(plain.modules), \
+            "folding did not reduce the module count"
+
+        x = np.random.default_rng(5).normal(size=(2, 8, 8, 3)).astype(np.float32)
+        ref = frozen(tf.constant(x))[0].numpy()
+        for g in (plain, folded):
+            ours = np.asarray(g.evaluate().forward(jnp.asarray(x)))
+            np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)

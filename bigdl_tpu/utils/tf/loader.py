@@ -158,8 +158,6 @@ class _Importer:
         k = scale·rsqrt(var + eps): one conv module imports in place of the
         conv/bias/bn triple. Returns None when the pattern doesn't apply
         (caller falls back to a standalone TFBatchNorm)."""
-        from bigdl_tpu.utils.tf import ops as O
-
         k = (scale / np.sqrt(var + eps)).astype(np.float32)
 
         bias = None

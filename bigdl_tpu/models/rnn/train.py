@@ -25,6 +25,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--summary-dir", default=None)
     p.add_argument("--distributed", action="store_true")
+    # reference rnn Test.scala generates text after training; same here via
+    # SequenceBeamSearch (nn/beam_search.py)
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, beam-decode N tokens from a seed")
+    p.add_argument("--beam", type=int, default=3)
+    p.add_argument("--alpha", type=float, default=0.6,
+                   help="beam length-penalty exponent")
     return p
 
 
@@ -73,6 +80,15 @@ def main(argv=None):
     trained = optimizer.optimize()
     loss = optimizer.state["loss"]
     print(f"final loss: {loss:.4f}  perplexity: {np.exp(min(loss, 20.0)):.2f}")
+    if args.generate:
+        seed = np.asarray(vxs[0][: max(2, args.bptt // 4)])[None].astype(np.int32)
+        bs = nn.SequenceBeamSearch(
+            trained, beam_size=args.beam,
+            eos_id=dictionary.get_index("<eos>"),
+            decode_length=args.generate, alpha=args.alpha).evaluate()
+        out = bs.forward(seed)
+        toks = np.asarray(out[1])[0, 0]
+        print("generated:", " ".join(dictionary.get_word(int(t)) for t in toks))
     return trained
 
 

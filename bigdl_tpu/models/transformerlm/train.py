@@ -30,6 +30,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--learning-rate", type=float, default=3e-4)
     p.add_argument("--distributed", action="store_true")
     p.add_argument("--synthetic-tokens", type=int, default=200_000)
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, beam-decode N tokens from a seed")
+    p.add_argument("--beam", type=int, default=3)
     return p
 
 
@@ -72,6 +75,16 @@ def main(argv=None):
            .set_end_when(Trigger.max_iteration(args.max_iteration)))
     opt.optimize()
     print(f"final loss: {opt.state['loss']:.4f}")
+    if args.generate:
+        if args.generate + args.seq_len // 4 > args.seq_len:
+            raise SystemExit("--generate must fit in --seq-len (the model's "
+                             "max_len) together with the seed prefix")
+        seed = np.asarray(xs[0][: args.seq_len // 4])[None].astype(np.int32)
+        bs = nn.SequenceBeamSearch(model, beam_size=args.beam, eos_id=-1,
+                                   decode_length=args.generate,
+                                   alpha=0.6).evaluate()
+        out = bs.forward(seed)
+        print("generated ids:", np.asarray(out[1])[0, 0].tolist())
     return opt.state["loss"]
 
 

@@ -82,9 +82,13 @@ def main(argv=None):
     print(f"final loss: {loss:.4f}  perplexity: {np.exp(min(loss, 20.0)):.2f}")
     if args.generate:
         seed = np.asarray(vxs[0][: max(2, args.bptt // 4)])[None].astype(np.int32)
+        # synthetic corpora have no <eos>; get_index would alias <unk>(0) and
+        # prematurely finish beams — decode the full length instead
+        eos = dictionary.get_index("<eos>")
+        if dictionary.get_word(eos) != "<eos>":
+            eos = -1
         bs = nn.SequenceBeamSearch(
-            trained, beam_size=args.beam,
-            eos_id=dictionary.get_index("<eos>"),
+            trained, beam_size=args.beam, eos_id=eos,
             decode_length=args.generate, alpha=args.alpha).evaluate()
         out = bs.forward(seed)
         toks = np.asarray(out[1])[0, 0]

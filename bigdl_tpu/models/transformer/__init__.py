@@ -1,0 +1,4 @@
+from bigdl_tpu.models.transformer.transformer import (
+    Transformer, TransformerDecoderBlock, beam_translate)
+
+__all__ = ["Transformer", "TransformerDecoderBlock", "beam_translate"]

@@ -1,0 +1,170 @@
+"""Encoder-decoder Transformer (seq2seq) — the reference Transformer analog.
+
+Reference parity (SURVEY.md §2.1 tail; expected upstream
+``<dl>/nn/Transformer.scala`` + ``Attention``/``FeedForwardNetwork`` — the
+translation-model family added to the reference's late line, unverified,
+mount empty). TPU-first build: pre-LN blocks from the stock zoo, causal self
+attention through the flash/ring-capable ``MultiHeadAttention``, encoder
+memory through ``nn.CrossAttention``, and decode-time search through
+``nn.SequenceBeamSearch`` (one static-shape scan program).
+
+``Transformer(...)`` maps ``T(src_ids, tgt_ids)`` → (N, Tt, tgt_vocab)
+log-probs (teacher forcing); :func:`beam_translate` runs inference-time
+beam search against the encoded memory.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.abstractnn import Container
+from bigdl_tpu.utils.table import T
+from bigdl_tpu.models.transformerlm.transformerlm import (
+    PositionEmbedding, TransformerBlock)
+from bigdl_tpu.utils.serializer import register as _register_serializable
+
+
+def _two(input):
+    """Accept the pair as a Table (1-based) or a tuple/list (training feeds
+    multi-input MiniBatches as tuples)."""
+    if isinstance(input, (tuple, list)):
+        a, b = input
+        return a, b
+    return input[1], input[2]
+
+
+@_register_serializable
+class TransformerDecoderBlock(Container):
+    """Pre-LN decoder block: causal self-attention, cross-attention over the
+    memory, MLP — input/output ``T(x, memory)`` so blocks chain in a
+    Sequential."""
+
+    def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
+                 dropout: float = 0.0, attention_impl: str = "auto"):
+        self_attn = nn.Sequential().add(nn.LayerNorm(embed_dim)).add(
+            nn.MultiHeadAttention(embed_dim, num_heads, causal=True,
+                                  attention_impl=attention_impl))
+        cross = nn.Sequential().add(nn.CrossAttention(embed_dim, num_heads))
+        cross_norm = nn.LayerNorm(embed_dim)
+        mlp = (nn.Sequential()
+               .add(nn.LayerNorm(embed_dim))
+               .add(nn.TimeDistributed(nn.Linear(embed_dim, mlp_ratio * embed_dim)))
+               .add(nn.GELU())
+               .add(nn.TimeDistributed(nn.Linear(mlp_ratio * embed_dim, embed_dim))))
+        if dropout > 0:
+            self_attn.add(nn.Dropout(dropout))
+            cross.add(nn.Dropout(dropout))
+            mlp.add(nn.Dropout(dropout))
+        super().__init__(self_attn, cross_norm, cross, mlp)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.nn.abstractnn import split_rng
+        x, memory = _two(input)
+        r = split_rng(rng, 4)
+        sa, cn, ca, mlp = self.modules
+        new_s = {}
+        h, new_s["0"] = sa.apply(params["0"], state["0"], x,
+                                 training=training, rng=r[0])
+        x = x + h
+        hn, new_s["1"] = cn.apply(params["1"], state["1"], x,
+                                  training=training, rng=r[1])
+        h, new_s["2"] = ca.apply(params["2"], state["2"], T(hn, memory),
+                                 training=training, rng=r[2])
+        x = x + h
+        h, new_s["3"] = mlp.apply(params["3"], state["3"], x,
+                                  training=training, rng=r[3])
+        return T(x + h, memory), new_s
+
+
+@_register_serializable
+class Transformer(Container):
+    """Seq2seq transformer. ``forward(T(src, tgt))`` → (N, Tt, tgt_vocab)
+    log-probs; ``src``/``tgt`` int32 token ids (teacher-forced targets)."""
+
+    def __init__(self, src_vocab: int, tgt_vocab: int, embed_dim: int = 256,
+                 num_heads: int = 4, num_encoder_layers: int = 2,
+                 num_decoder_layers: int = 2, max_len: int = 512,
+                 mlp_ratio: int = 4, dropout: float = 0.0,
+                 attention_impl: str = "auto"):
+        encoder = (nn.Sequential()
+                   .add(nn.LookupTable(src_vocab, embed_dim, zero_based=True))
+                   .add(PositionEmbedding(max_len, embed_dim)))
+        for i in range(num_encoder_layers):
+            blk = TransformerBlock(embed_dim, num_heads, mlp_ratio, dropout,
+                                   attention_impl, causal=False)
+            encoder.add(blk.set_name(f"enc{i + 1}"))
+        encoder.add(nn.LayerNorm(embed_dim).set_name("enc_norm"))
+
+        tgt_embed = (nn.Sequential()
+                     .add(nn.LookupTable(tgt_vocab, embed_dim, zero_based=True))
+                     .add(PositionEmbedding(max_len, embed_dim)))
+        decoder = nn.Sequential()
+        for i in range(num_decoder_layers):
+            decoder.add(TransformerDecoderBlock(
+                embed_dim, num_heads, mlp_ratio, dropout,
+                attention_impl).set_name(f"dec{i + 1}"))
+        head = (nn.Sequential()
+                .add(nn.LayerNorm(embed_dim))
+                .add(nn.TimeDistributed(nn.Linear(embed_dim, tgt_vocab)))
+                .add(nn.TimeDistributed(nn.LogSoftMax())))
+        super().__init__(encoder, tgt_embed, decoder, head)
+        self.tgt_vocab = tgt_vocab
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.nn.abstractnn import split_rng
+        src, tgt = _two(input)
+        r = split_rng(rng, 4)
+        enc, emb, dec, head = self.modules
+        new_s = {}
+        memory, new_s["0"] = enc.apply(params["0"], state["0"], src,
+                                       training=training, rng=r[0])
+        x, new_s["1"] = emb.apply(params["1"], state["1"], tgt,
+                                  training=training, rng=r[1])
+        out, new_s["2"] = dec.apply(params["2"], state["2"], T(x, memory),
+                                    training=training, rng=r[2])
+        logp, new_s["3"] = head.apply(params["3"], state["3"], out[1],
+                                      training=training, rng=r[3])
+        return logp, new_s
+
+
+class _MemoryDecoder(Container):
+    """Decode-time adapter binding a Transformer to a fixed encoded memory so
+    ``SequenceBeamSearch`` (which drives token-block decoders) can search over
+    the target side. Beam flattening multiplies the batch: the memory is tiled
+    to match the incoming (N*beam) rows. Eval-path helper — not serialized."""
+
+    def __init__(self, transformer: Transformer, memory):
+        super().__init__(transformer)
+        self._memory = jnp.asarray(memory)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        model = self.modules[0]
+        m, L = input.shape
+        reps = m // self._memory.shape[0]
+        memory = jnp.repeat(self._memory, reps, axis=0)
+        _, emb, dec, head = model.modules
+        p, s = params["0"], state["0"]
+        x, _ = emb.apply(p["1"], s["1"], input, training=False, rng=None)
+        out, _ = dec.apply(p["2"], s["2"], T(x, memory),
+                           training=False, rng=None)
+        logp, _ = head.apply(p["3"], s["3"], out[1], training=False, rng=None)
+        return logp, state
+
+
+def beam_translate(model: Transformer, src, *, beam_size: int = 4,
+                   eos_id: int, bos_id: int, decode_length: int,
+                   alpha: float = 0.6, pad_id: int = 0):
+    """Beam-search translate ``src`` (N, Ts) int32 → (sequences, scores):
+    sequences (N, beam, 1 + decode_length) starting with ``bos_id``."""
+    src = jnp.asarray(src, jnp.int32)
+    enc = model.modules[0]
+    memory, _ = enc.apply(model.get_params()["0"], model.get_state()["0"],
+                          src, training=False, rng=None)
+    wrapped = _MemoryDecoder(model, memory)
+    bs = nn.SequenceBeamSearch(wrapped, beam_size, eos_id, decode_length,
+                               alpha=alpha, pad_id=pad_id).evaluate()
+    prompt = jnp.full((src.shape[0], 1), bos_id, jnp.int32)
+    out = bs.forward(prompt)
+    return np.asarray(out[1]), np.asarray(out[2])

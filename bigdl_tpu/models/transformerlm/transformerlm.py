@@ -58,9 +58,10 @@ def _residual(inner: nn.AbstractModule) -> nn.Sequential:
 
 def TransformerBlock(embed_dim: int, num_heads: int, mlp_ratio: int = 4,
                      dropout: float = 0.0,
-                     attention_impl: str = "auto") -> nn.Sequential:
+                     attention_impl: str = "auto",
+                     causal: bool = True) -> nn.Sequential:
     attn = nn.Sequential().add(nn.LayerNorm(embed_dim)).add(
-        nn.MultiHeadAttention(embed_dim, num_heads, causal=True,
+        nn.MultiHeadAttention(embed_dim, num_heads, causal=causal,
                               attention_impl=attention_impl))
     mlp = (nn.Sequential()
            .add(nn.LayerNorm(embed_dim))

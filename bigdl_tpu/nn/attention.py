@@ -95,3 +95,69 @@ class MultiHeadAttention(TensorModule):
     def __repr__(self):
         return (f"MultiHeadAttention(embed={self.embed_dim}, heads={self.num_heads}, "
                 f"causal={self.causal}, impl={self.attention_impl})")
+
+
+class CrossAttention(TensorModule):
+    """Encoder-decoder attention: queries from the first Table element,
+    keys/values from the second (the memory).
+
+    Input ``T(x, memory)`` with x (N, Tq, E), memory (N, Tk, E) → (N, Tq, E).
+    The reference's ``Attention`` layer covers this case in its transformer
+    (SURVEY.md §2.1 tail; expected upstream ``<dl>/nn/Attention.scala`` —
+    unverified, mount empty). Routed through the plain fused attention path:
+    cross-attention is never causal and Tq ≠ Tk, which is where the fused
+    jnp form is already the right TPU program (one (Tq,Tk) einsum chain,
+    fused by XLA — the flash kernel's streaming-softmax trick buys nothing
+    at parity-scale memory lengths)."""
+
+    def __init__(self, embed_dim: int, num_heads: int, with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        if embed_dim % num_heads != 0:
+            raise ValueError(f"embed_dim {embed_dim} % num_heads {num_heads} != 0")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.with_bias = with_bias
+        self.w_init = w_init or Xavier()
+        self.reset()
+
+    def reset(self) -> None:
+        e = self.embed_dim
+        self._params = {
+            "q_weight": jnp.asarray(self.w_init.init((e, e), fan_in=e, fan_out=e)),
+            "kv_weight": jnp.asarray(
+                self.w_init.init((2 * e, e), fan_in=e, fan_out=2 * e)),
+            "out_weight": jnp.asarray(
+                self.w_init.init((e, e), fan_in=e, fan_out=e)),
+        }
+        if self.with_bias:
+            self._params["q_bias"] = jnp.zeros((e,), jnp.float32)
+            self._params["kv_bias"] = jnp.zeros((2 * e,), jnp.float32)
+            self._params["out_bias"] = jnp.zeros((e,), jnp.float32)
+        self.zero_grad_parameters()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.parallel.ring_attention import full_attention
+
+        x, memory = input[1], input[2]
+        b, tq, e = x.shape
+        tk = memory.shape[1]
+        h, d = self.num_heads, self.head_dim
+        q = x @ params["q_weight"].T
+        kv = memory @ params["kv_weight"].T
+        if self.with_bias:
+            q = q + params["q_bias"]
+            kv = kv + params["kv_bias"]
+        q = q.reshape(b, tq, h, d).transpose(0, 2, 1, 3)
+        kv = kv.reshape(b, tk, 2, h, d)
+        k, v = (kv[:, :, i].transpose(0, 2, 1, 3) for i in range(2))
+        o = full_attention(q, k, v, causal=False)
+        o = o.transpose(0, 2, 1, 3).reshape(b, tq, e)
+        out = o @ params["out_weight"].T
+        if self.with_bias:
+            out = out + params["out_bias"]
+        return out, state
+
+    def __repr__(self):
+        return f"CrossAttention(embed={self.embed_dim}, heads={self.num_heads})"

@@ -1,0 +1,70 @@
+"""Torch oracle for the attention layers (SURVEY.md §4 oracle backbone):
+torch.nn.MultiheadAttention with copied weights must match
+nn.MultiHeadAttention (self, causal and bidirectional) and nn.CrossAttention
+(query vs memory) to float tolerance.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from bigdl_tpu import nn  # noqa: E402
+from bigdl_tpu.utils.table import T  # noqa: E402
+
+E, H = 16, 4
+
+
+def _torch_mha():
+    torch.manual_seed(0)
+    return torch.nn.MultiheadAttention(E, H, batch_first=True, bias=True)
+
+
+class TestSelfAttentionOracle:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_torch(self, causal):
+        tm = _torch_mha()
+        ours = nn.MultiHeadAttention(E, H, causal=causal,
+                                     attention_impl="full")
+        ours.set_params({
+            "qkv_weight": jnp.asarray(tm.in_proj_weight.detach().numpy()),
+            "qkv_bias": jnp.asarray(tm.in_proj_bias.detach().numpy()),
+            "out_weight": jnp.asarray(tm.out_proj.weight.detach().numpy()),
+            "out_bias": jnp.asarray(tm.out_proj.bias.detach().numpy()),
+        })
+        x = np.random.default_rng(1).normal(size=(2, 6, E)).astype(np.float32)
+        mask = None
+        if causal:
+            mask = torch.triu(torch.ones(6, 6, dtype=torch.bool), diagonal=1)
+        want, _ = tm(torch.from_numpy(x), torch.from_numpy(x),
+                     torch.from_numpy(x), attn_mask=mask, need_weights=False)
+        got = np.asarray(ours.evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(got, want.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestCrossAttentionOracle:
+    def test_matches_torch(self):
+        tm = _torch_mha()
+        w = tm.in_proj_weight.detach().numpy()
+        b = tm.in_proj_bias.detach().numpy()
+        ours = nn.CrossAttention(E, H)
+        ours.set_params({
+            "q_weight": jnp.asarray(w[:E]),
+            "q_bias": jnp.asarray(b[:E]),
+            "kv_weight": jnp.asarray(w[E:]),
+            "kv_bias": jnp.asarray(b[E:]),
+            "out_weight": jnp.asarray(tm.out_proj.weight.detach().numpy()),
+            "out_bias": jnp.asarray(tm.out_proj.bias.detach().numpy()),
+        })
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(2, 5, E)).astype(np.float32)     # queries
+        mem = rng.normal(size=(2, 9, E)).astype(np.float32)   # memory
+        want, _ = tm(torch.from_numpy(x), torch.from_numpy(mem),
+                     torch.from_numpy(mem), need_weights=False)
+        got = np.asarray(ours.evaluate().forward(T(jnp.asarray(x),
+                                                   jnp.asarray(mem))))
+        np.testing.assert_allclose(got, want.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)

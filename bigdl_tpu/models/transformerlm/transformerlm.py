@@ -44,6 +44,12 @@ class PositionEmbedding(TensorModule):
         self.zero_grad_parameters()
 
     def apply(self, params, state, input, *, training=False, rng=None):
+        if isinstance(state, dict) and "pos_idx" in state:
+            # cached incremental decode (nn.incremental): input is the single
+            # next position — add its embedding, advance the counter
+            idx = state["pos_idx"]
+            emb = jnp.take(params["pos"], idx, axis=0)
+            return input + emb[None, None, :], {"pos_idx": idx + 1}
         t = input.shape[1]
         if t > self.max_len:
             raise ValueError(f"sequence length {t} > max_len {self.max_len}")

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.nn.abstractnn import TensorModule
@@ -85,12 +86,42 @@ class MultiHeadAttention(TensorModule):
             qkv = qkv + params["qkv_bias"]
         qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
         q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))  # b,h,t,d
+        if isinstance(state, dict) and "cache_k" in state:
+            return self._decode_step(params, state, q, k, v, b, t, e)
         o = self._attend(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, e)
         out = o @ params["out_weight"].T
         if self.with_bias:
             out = out + params["out_bias"]
         return out, state
+
+    def _decode_step(self, params, state, q, k, v, b, t, e):
+        """KV-cached incremental decode (``nn.incremental.install_decode_cache``
+        puts the cache in this module's state; containers thread it through
+        unchanged APIs). Input is the single next position (t == 1): append
+        k/v at ``pos``, attend q against the cached prefix under a ``<= pos``
+        mask — O(L) per step instead of the O(L^2) full-prefix re-run. The
+        reference SequenceBeamSearch's numHiddenLayers/hiddenSize constructor
+        args exist for exactly this cache; here it is module state, not a
+        search-owned buffer."""
+        from jax import lax
+
+        from bigdl_tpu.parallel.ring_attention import full_attention
+
+        if t != 1:
+            raise ValueError(
+                f"cached decode feeds one position at a time, got t={t}")
+        pos = state["pos"]
+        ck = lax.dynamic_update_slice(state["cache_k"], k, (0, 0, pos, 0))
+        cv = lax.dynamic_update_slice(state["cache_v"], v, (0, 0, pos, 0))
+        lmax = ck.shape[2]
+        o = full_attention(q, ck, cv, causal=False,
+                           kv_mask=(jnp.arange(lmax) <= pos)[None, None, None])
+        o = o.transpose(0, 2, 1, 3).reshape(b, 1, e)
+        out = o @ params["out_weight"].T
+        if self.with_bias:
+            out = out + params["out_bias"]
+        return out, {"cache_k": ck, "cache_v": cv, "pos": pos + 1}
 
     def __repr__(self):
         return (f"MultiHeadAttention(embed={self.embed_dim}, heads={self.num_heads}, "

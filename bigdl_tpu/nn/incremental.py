@@ -1,0 +1,120 @@
+"""KV-cached incremental decoding utilities.
+
+The reference's ``SequenceBeamSearch`` constructor takes
+``numHiddenLayers``/``hiddenSize`` to preallocate a per-layer decode cache
+(SURVEY.md §2.1 tail — unverified, mount empty). The TPU-first redesign keeps
+the cache OUT of the search and IN module state: ``install_decode_cache``
+writes zeroed (N, H, Lmax, hd) K/V buffers plus a position counter into every
+``MultiHeadAttention`` (and a position index into every ``PositionEmbedding``)
+of a model, and the ordinary container state-threading delivers them — no
+special decoder class, any stack built from these modules decodes
+incrementally. Each ``apply`` on a single-position input then costs O(L)
+attention instead of the O(L^2) full-prefix re-run that
+``SequenceBeamSearch``'s static-block form pays.
+
+``greedy_generate`` is the consumer: one ``lax.scan`` over prompt + generated
+positions with a single compiled step — the serving-path decode loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, Container
+from bigdl_tpu.nn.attention import MultiHeadAttention
+
+
+def _iter_modules(m: AbstractModule):
+    yield m
+    if isinstance(m, Container):
+        for c in m.modules:
+            yield from _iter_modules(c)
+
+
+def install_decode_cache(model: AbstractModule, batch_size: int,
+                         max_len: int, dtype=jnp.float32) -> dict:
+    """Install zeroed decode caches into ``model``'s attention/position
+    modules and return the full state pytree to carry through decode steps.
+
+    The model's regular (training/eval) path is restored by
+    :func:`clear_decode_cache` — cached state and full-sequence apply are
+    mutually exclusive."""
+    from bigdl_tpu.models.transformerlm.transformerlm import PositionEmbedding
+
+    # validate the WHOLE tree before touching any state, so a raise never
+    # leaves the model half-cached
+    mods = list(_iter_modules(model))
+    attns = [m for m in mods if isinstance(m, MultiHeadAttention)]
+    if not attns:
+        raise ValueError("model has no MultiHeadAttention modules to cache")
+    for mod in attns:
+        if not mod.causal:
+            raise ValueError(
+                "decode cache requires causal attention (bidirectional "
+                f"attention in {mod!r} cannot decode incrementally)")
+    for mod in mods:
+        if isinstance(mod, PositionEmbedding) and max_len > mod.max_len:
+            raise ValueError(
+                f"decode length {max_len} exceeds the model's position table "
+                f"(max_len={mod.max_len}); the cached path would otherwise "
+                f"silently clamp positions the uncached path rejects")
+
+    for mod in attns:
+        mod.set_state({
+            "cache_k": jnp.zeros((batch_size, mod.num_heads, max_len,
+                                  mod.head_dim), dtype),
+            "cache_v": jnp.zeros((batch_size, mod.num_heads, max_len,
+                                  mod.head_dim), dtype),
+            "pos": jnp.asarray(0, jnp.int32),
+        })
+    for mod in mods:
+        if isinstance(mod, PositionEmbedding):
+            mod.set_state({"pos_idx": jnp.asarray(0, jnp.int32)})
+    return model.get_state()
+
+
+def clear_decode_cache(model: AbstractModule) -> None:
+    """Remove decode caches, restoring the full-sequence apply path."""
+    from bigdl_tpu.models.transformerlm.transformerlm import PositionEmbedding
+
+    for mod in _iter_modules(model):
+        if isinstance(mod, MultiHeadAttention) and "cache_k" in mod._state:
+            mod.set_state({})
+        elif isinstance(mod, PositionEmbedding) and "pos_idx" in mod._state:
+            mod.set_state({})
+
+
+def greedy_generate(model: AbstractModule, prompt, decode_length: int,
+                    dtype=jnp.float32):
+    """KV-cached greedy decode: ``prompt`` (N, T0) int32 → (N, T0 +
+    decode_length) int32. One jitted ``lax.scan`` step reused for prompt
+    prefill and generation (token source switches by position). ``dtype``
+    is the KV-cache dtype — pass ``jnp.bfloat16`` when serving with bf16
+    params (the cache must match the activations)."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    n, t0 = prompt.shape
+    total = t0 + decode_length
+    params = model.get_params()
+    state0 = install_decode_cache(model, n, total, dtype=dtype)
+    try:
+
+        def step(carry, i):
+            state, tok, seqs = carry
+            logits, state = model.apply(params, state, tok[:, None],
+                                        training=False, rng=None)
+            nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+            # positions still inside the prompt feed the prompt token next
+            nxt = jnp.where(i + 1 < t0, prompt[:, jnp.minimum(i + 1, t0 - 1)],
+                            nxt)
+            seqs = lax.dynamic_update_slice(seqs, nxt[:, None], (0, i + 1))
+            return (state, nxt, seqs), None
+
+        seqs0 = jnp.zeros((n, total), jnp.int32)
+        seqs0 = lax.dynamic_update_slice(seqs0, prompt, (0, 0))
+        (_, _, seqs), _ = lax.scan(
+            step, (state0, prompt[:, 0], seqs0), jnp.arange(total - 1))
+    finally:
+        clear_decode_cache(model)
+    return seqs

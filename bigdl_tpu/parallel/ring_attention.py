@@ -111,13 +111,18 @@ def _present_axis(axes: dict, batch: int, name: str = "data"):
     return name if size > 1 and batch % size == 0 else None
 
 
-def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
+def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
+                   kv_mask=None):
     """Single-device reference attention (also the oracle in tests).
 
     Mixed-precision contract: the two matmuls run in the input dtype (bf16 →
     MXU double rate) with fp32 accumulation (``preferred_element_type`` — the
     MXU accumulates fp32 natively, this just keeps XLA from truncating), and the
     softmax itself is an fp32 island. Output returns in the input dtype.
+
+    ``kv_mask``: optional boolean mask broadcastable to the (b, h, q, k)
+    score shape; False positions are excluded from the softmax (the KV-cache
+    decode path masks the unwritten cache tail with this).
     """
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -126,6 +131,8 @@ def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None)
         t_q, t_k = s.shape[-2], s.shape[-1]
         mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)

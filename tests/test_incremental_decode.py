@@ -1,0 +1,90 @@
+"""KV-cached incremental decode (nn/incremental.py): the cached greedy path
+must produce EXACTLY the sequences of the uncached static-block search
+(SequenceBeamSearch beam=1) — any cache/mask/position bug breaks equality.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.models.transformerlm import TransformerLM
+
+
+def _lm(**kw):
+    kw.setdefault("vocab_size", 40)
+    kw.setdefault("embed_dim", 16)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("max_len", 24)
+    return TransformerLM(**kw)
+
+
+class TestCachedDecode:
+    def test_matches_uncached_greedy(self):
+        lm = _lm().evaluate()
+        rng = np.random.default_rng(0)
+        prompt = jnp.asarray(rng.integers(0, 40, (3, 5)), jnp.int32)
+        steps = 7
+
+        cached = np.asarray(nn.greedy_generate(lm, prompt, steps))
+        # uncached oracle: beam-1 static-block search with unreachable EOS
+        bs = nn.SequenceBeamSearch(lm, 1, eos_id=-1,
+                                   decode_length=steps).evaluate()
+        out = bs.forward(prompt)
+        uncached = np.asarray(out[1])[:, 0]
+        np.testing.assert_array_equal(cached, uncached)
+
+    def test_cache_cleared_after_generate(self):
+        """greedy_generate must restore the full-sequence path (training and
+        eval applies must not see stale caches)."""
+        lm = _lm().evaluate()
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        before = np.asarray(lm.forward(prompt))
+        nn.greedy_generate(lm, prompt, 4)
+        after = np.asarray(lm.forward(prompt))
+        np.testing.assert_array_equal(before, after)
+
+    def test_single_step_logits_match_full_forward(self):
+        """Stepwise cached logits at every prompt position equal the full
+        forward's log-probs at that position."""
+        lm = _lm(num_layers=1).evaluate()
+        rng = np.random.default_rng(1)
+        prompt = np.asarray(rng.integers(0, 40, (2, 6)), np.int32)
+        full = np.asarray(lm.forward(jnp.asarray(prompt)))
+
+        params = lm.get_params()
+        state = nn.install_decode_cache(lm, 2, 8)
+        try:
+            for t in range(6):
+                logp, state = lm.apply(params, state,
+                                       jnp.asarray(prompt[:, t:t + 1]),
+                                       training=False, rng=None)
+                np.testing.assert_allclose(np.asarray(logp)[:, 0], full[:, t],
+                                           rtol=1e-4, atol=1e-5)
+        finally:
+            nn.clear_decode_cache(lm)
+
+    def test_bidirectional_attention_refuses_cache(self):
+        mha = nn.Sequential().add(
+            nn.MultiHeadAttention(8, 2, causal=False))
+        with pytest.raises(ValueError, match="causal"):
+            nn.install_decode_cache(mha, 1, 4)
+
+    def test_overrun_max_len_raises(self):
+        lm = _lm(max_len=8).evaluate()
+        prompt = jnp.asarray([[1, 2, 3, 4, 5, 6]], jnp.int32)
+        with pytest.raises(ValueError, match="position table"):
+            nn.greedy_generate(lm, prompt, 5)  # 6 + 5 > 8
+
+    def test_half_install_never_happens(self):
+        """Validation failure must leave NO cached state behind."""
+        m = nn.Sequential() \
+            .add(nn.MultiHeadAttention(8, 2, causal=True)) \
+            .add(nn.MultiHeadAttention(8, 2, causal=False))
+        with pytest.raises(ValueError, match="causal"):
+            nn.install_decode_cache(m, 1, 4)
+        x = jnp.asarray(np.random.default_rng(0)
+                        .normal(size=(1, 3, 8)), jnp.float32)
+        m.evaluate().forward(x)  # full-sequence path must still work

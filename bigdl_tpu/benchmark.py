@@ -351,6 +351,46 @@ def _measure_int8_infer(model_name: str, batch: int, iters: int) -> dict:
             "static_bf16_ratio": round(static_ips / bf16_ips, 2)}
 
 
+def _measure_decode_infer(batch: int, prompt_len: int = 32,
+                          decode_length: int = 96) -> dict:
+    """LM decode serving leg: KV-cached greedy_generate tokens/sec vs the
+    uncached static-block beam-1 search on the same TransformerLM — the
+    O(L) vs O(L^2) per-token trade, measured."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models.transformerlm import TransformerLM
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    Engine.init(compute_dtype=jnp.bfloat16)
+    total = prompt_len + decode_length
+    lm = TransformerLM(32000, embed_dim=512, num_heads=8, num_layers=6,
+                       max_len=total).evaluate()
+    prompt = jnp.asarray(np.random.default_rng(0)
+                         .integers(0, 32000, (batch, prompt_len)), jnp.int32)
+
+    def timed(fn, reps=3):
+        jax.block_until_ready(fn())  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        jax.block_until_ready(out)
+        return batch * decode_length * reps / (time.perf_counter() - t0)
+
+    cached_tps = timed(lambda: nn.greedy_generate(lm, prompt, decode_length))
+    bs = nn.SequenceBeamSearch(lm, 1, eos_id=-1,
+                               decode_length=decode_length).evaluate()
+    uncached_tps = timed(lambda: bs.forward(prompt)[1])
+    return {"batch": batch, "prompt_len": prompt_len,
+            "decode_length": decode_length,
+            "cached_decode_tokens_per_sec": round(cached_tps, 1),
+            "uncached_decode_tokens_per_sec": round(uncached_tps, 1),
+            "cached_uncached_ratio": round(cached_tps / uncached_tps, 2)}
+
+
 def _measure_serving(model_name: str, batch: int, iters: int) -> dict:
     """Serving-path micro-bench: Predictor.predict and Evaluator.test
     throughput through the framework's own eval machinery (per-batch h2d,
@@ -475,6 +515,8 @@ def run_orchestrator(args) -> None:
         worker_argv.append("--int8-infer")
     if args.serving:
         worker_argv.append("--serving")
+    if args.decode_infer:
+        worker_argv.append("--decode-infer")
     env = dict(os.environ)
     # TPU attach in this environment swings from ~20 s to outright hangs; give a
     # real attempt generous headroom (the subprocess timeout still bounds it)
@@ -488,7 +530,8 @@ def run_orchestrator(args) -> None:
             # comparison leg in its OWN subprocess: its failure can never
             # discard the good primary number above
             if args.compare_dtypes and args.dtype == "bf16" \
-                    and not args.int8_infer and not args.serving:
+                    and not args.int8_infer and not args.serving \
+                    and not args.decode_infer:
                 # the comparison leg only feeds the ratio — skip its streamed
                 # measurement (it would be discarded)
                 cmp_argv = ["--run", "--model", args.model,
@@ -521,10 +564,11 @@ def run_orchestrator(args) -> None:
         attempts.append(f"attempt{attempt}: {err}")
         print(f"bench: {err}", file=sys.stderr)
 
-    if args.int8_infer or args.serving:
+    if args.int8_infer or args.serving or args.decode_infer:
         # a LeNet training number would not answer an inference-path request:
         # fail loudly with the metric the caller asked for
-        kind = "int8_vs_bf16_infer" if args.int8_infer else "serving"
+        kind = ("int8_vs_bf16_infer" if args.int8_infer
+                else "serving" if args.serving else "decode_infer")
         print(json.dumps({
             "metric": f"{args.model}_{kind}",
             "value": None,
@@ -583,6 +627,9 @@ def main(argv=None):
     p.add_argument("--serving", action="store_true",
                    help="serving-path micro-bench: Predictor.predict and "
                         "Evaluator.test samples/sec")
+    p.add_argument("--decode-infer", action="store_true",
+                   help="LM decode micro-bench: KV-cached greedy_generate "
+                        "tokens/sec vs the uncached static-block search")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
     args = p.parse_args(argv)
@@ -600,6 +647,11 @@ def main(argv=None):
             res = _measure_serving(args.model, args.batch,
                                    max(args.iters // 4, 3))
             res["metric"] = f"{args.model}_serving"
+            print(json.dumps(res))
+        elif args.decode_infer:
+            res = _measure_decode_infer(min(args.batch, 16))
+            res["metric"] = "transformerlm_decode_infer"
+            res["vs_baseline"] = None
             print(json.dumps(res))
         else:
             run_worker(args)

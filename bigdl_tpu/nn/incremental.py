@@ -99,22 +99,34 @@ def greedy_generate(model: AbstractModule, prompt, decode_length: int,
     params = model.get_params()
     state0 = install_decode_cache(model, n, total, dtype=dtype)
     try:
+        # one jitted program per (shape, dtype) signature, cached on the module
+        # like _jitted_apply — repeat generate calls must not re-trace the scan
+        key = ("greedy_generate", n, t0, decode_length, jnp.dtype(dtype).name)
+        fn = model._apply_cache.get(key)
+        if fn is None:
 
-        def step(carry, i):
-            state, tok, seqs = carry
-            logits, state = model.apply(params, state, tok[:, None],
-                                        training=False, rng=None)
-            nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
-            # positions still inside the prompt feed the prompt token next
-            nxt = jnp.where(i + 1 < t0, prompt[:, jnp.minimum(i + 1, t0 - 1)],
-                            nxt)
-            seqs = lax.dynamic_update_slice(seqs, nxt[:, None], (0, i + 1))
-            return (state, nxt, seqs), None
+            def run(params, state0, prompt):
+                def step(carry, i):
+                    state, tok, seqs = carry
+                    logits, state = model.apply(params, state, tok[:, None],
+                                                training=False, rng=None)
+                    nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+                    # positions still inside the prompt feed the prompt token
+                    nxt = jnp.where(
+                        i + 1 < t0, prompt[:, jnp.minimum(i + 1, t0 - 1)], nxt)
+                    seqs = lax.dynamic_update_slice(seqs, nxt[:, None],
+                                                    (0, i + 1))
+                    return (state, nxt, seqs), None
 
-        seqs0 = jnp.zeros((n, total), jnp.int32)
-        seqs0 = lax.dynamic_update_slice(seqs0, prompt, (0, 0))
-        (_, _, seqs), _ = lax.scan(
-            step, (state0, prompt[:, 0], seqs0), jnp.arange(total - 1))
+                seqs0 = jnp.zeros((n, total), jnp.int32)
+                seqs0 = lax.dynamic_update_slice(seqs0, prompt, (0, 0))
+                (_, _, seqs), _ = lax.scan(
+                    step, (state0, prompt[:, 0], seqs0), jnp.arange(total - 1))
+                return seqs
+
+            fn = jax.jit(run)
+            model._apply_cache[key] = fn
+        seqs = fn(params, state0, prompt)
     finally:
         clear_decode_cache(model)
     return seqs

@@ -71,7 +71,7 @@ from bigdl_tpu.nn.roi import RoiPooling
 from bigdl_tpu.nn.tree import BinaryTreeLSTM
 from bigdl_tpu.nn.beam_search import SequenceBeamSearch, greedy_decode
 from bigdl_tpu.nn.incremental import (
-    clear_decode_cache, greedy_generate, install_decode_cache)
+    clear_decode_cache, generate, greedy_generate, install_decode_cache)
 from bigdl_tpu.nn.volumetric import (
     VolumetricAveragePooling, VolumetricConvolution, VolumetricFullConvolution,
     VolumetricMaxPooling,

@@ -93,24 +93,51 @@ def greedy_generate(model: AbstractModule, prompt, decode_length: int,
     prefill and generation (token source switches by position). ``dtype``
     is the KV-cache dtype — pass ``jnp.bfloat16`` when serving with bf16
     params (the cache must match the activations)."""
+    return generate(model, prompt, decode_length, dtype=dtype)
+
+
+def generate(model: AbstractModule, prompt, decode_length: int,
+             dtype=jnp.float32, *, sample: bool = False,
+             temperature: float = 1.0, top_k: int | None = None,
+             rng=None):
+    """KV-cached decode with optional sampling (the reference rnn example's
+    text generation, TPU-form). ``sample=False`` = greedy argmax;
+    ``sample=True`` draws from ``softmax(logits / temperature)`` restricted
+    to the ``top_k`` most probable tokens when given. ``rng`` is a JAX PRNG
+    key (defaults to the framework RandomGenerator stream)."""
     prompt = jnp.asarray(prompt, jnp.int32)
     n, t0 = prompt.shape
     total = t0 + decode_length
+    if sample and rng is None:
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        rng = RandomGenerator.next_key()
+    if not sample:
+        rng = jax.random.PRNGKey(0)  # traced but unused; keeps ONE program
     params = model.get_params()
     state0 = install_decode_cache(model, n, total, dtype=dtype)
     try:
-        # one jitted program per (shape, dtype) signature, cached on the module
-        # like _jitted_apply — repeat generate calls must not re-trace the scan
-        key = ("greedy_generate", n, t0, decode_length, jnp.dtype(dtype).name)
+        # one jitted program per (shape, dtype, mode) signature, cached on the
+        # module like _jitted_apply — repeat calls must not re-trace the scan
+        key = ("generate", n, t0, decode_length, jnp.dtype(dtype).name,
+               sample, float(temperature), top_k)
         fn = model._apply_cache.get(key)
         if fn is None:
 
-            def run(params, state0, prompt):
+            def pick(logits, r):
+                if not sample:
+                    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                logits = logits / max(temperature, 1e-6)
+                if top_k is not None:
+                    kth = lax.top_k(logits, top_k)[0][:, -1:]
+                    logits = jnp.where(logits < kth, -jnp.inf, logits)
+                return jax.random.categorical(r, logits).astype(jnp.int32)
+
+            def run(params, state0, prompt, rng):
                 def step(carry, i):
                     state, tok, seqs = carry
                     logits, state = model.apply(params, state, tok[:, None],
                                                 training=False, rng=None)
-                    nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+                    nxt = pick(logits[:, 0, :], jax.random.fold_in(rng, i))
                     # positions still inside the prompt feed the prompt token
                     nxt = jnp.where(
                         i + 1 < t0, prompt[:, jnp.minimum(i + 1, t0 - 1)], nxt)
@@ -126,7 +153,7 @@ def greedy_generate(model: AbstractModule, prompt, decode_length: int,
 
             fn = jax.jit(run)
             model._apply_cache[key] = fn
-        seqs = fn(params, state0, prompt)
+        seqs = fn(params, state0, prompt, rng)
     finally:
         clear_decode_cache(model)
     return seqs

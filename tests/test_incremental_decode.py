@@ -88,3 +88,45 @@ class TestCachedDecode:
         x = jnp.asarray(np.random.default_rng(0)
                         .normal(size=(1, 3, 8)), jnp.float32)
         m.evaluate().forward(x)  # full-sequence path must still work
+
+
+class TestSampling:
+    def test_sample_respects_top_k_and_temperature(self):
+        """top_k=1 sampling must equal greedy regardless of temperature; and
+        unrestricted sampling must actually vary across keys."""
+        import jax
+
+        lm = _lm(num_layers=1).evaluate()
+        prompt = jnp.asarray([[3, 1]], jnp.int32)
+        greedy = np.asarray(nn.greedy_generate(lm, prompt, 6))
+        topk1 = np.asarray(nn.generate(lm, prompt, 6, sample=True,
+                                       temperature=2.5, top_k=1,
+                                       rng=jax.random.PRNGKey(7)))
+        np.testing.assert_array_equal(greedy, topk1)
+
+        a = np.asarray(nn.generate(lm, prompt, 6, sample=True,
+                                   temperature=1.5,
+                                   rng=jax.random.PRNGKey(1)))
+        b = np.asarray(nn.generate(lm, prompt, 6, sample=True,
+                                   temperature=1.5,
+                                   rng=jax.random.PRNGKey(2)))
+        assert not np.array_equal(a, b), "sampling ignored the PRNG key"
+
+    def test_sampled_tokens_within_topk_support(self):
+        """With top_k=2 every generated token must be one of the 2 most
+        probable next tokens given the decoded prefix (checked against the
+        full uncached forward)."""
+        import jax
+
+        lm = _lm(num_layers=1).evaluate()
+        prompt = np.asarray([[5, 9, 2]], np.int32)
+        steps = 5
+        seqs = np.asarray(nn.generate(lm, jnp.asarray(prompt), steps,
+                                      sample=True, top_k=2,
+                                      rng=jax.random.PRNGKey(3)))
+        t0 = prompt.shape[1]
+        for i in range(steps):
+            prefix = jnp.asarray(seqs[:, : t0 + i])
+            logp = np.asarray(lm.forward(prefix))[0, -1]
+            top2 = set(np.argsort(logp)[-2:].tolist())
+            assert int(seqs[0, t0 + i]) in top2

@@ -109,6 +109,16 @@ EXAMPLES = {
         lambda: nn.QuantizedSpatialConvolution.from_float(
             nn.SpatialConvolution(2, 4, 3, 3)), _x(1, 2, 6, 6)),
     "MultiHeadAttention": (lambda: nn.MultiHeadAttention(8, 2), _x(2, 5, 8)),
+    "CrossAttention": (lambda: nn.CrossAttention(8, 2),
+                       T(_x(2, 4, 8), _x(2, 6, 8))),
+    "SequenceBeamSearch": (
+        lambda: nn.SequenceBeamSearch(
+            nn.Sequential()
+            .add(nn.LookupTable(9, 8, zero_based=True))
+            .add(nn.TimeDistributed(nn.Linear(8, 9)))
+            .add(nn.TimeDistributed(nn.LogSoftMax())),
+            2, 8, 3),
+        jnp.asarray([[1, 2]], dtype=jnp.int32)),
     # normalization-ish
     "BatchNormalization": (lambda: nn.BatchNormalization(4), _x(3, 4)),
     "LayerNorm": (lambda: nn.LayerNorm(4), _x(3, 4)),

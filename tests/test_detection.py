@@ -1,0 +1,266 @@
+"""Detection layers: NormalizeScale / PriorBox / Anchor / Proposal /
+DetectionOutputSSD — oracle-pinned (numpy greedy NMS + torch normalize +
+Caffe prior recipe replicas written independently here)."""
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import Table
+
+
+# ------------------------------------------------------------ numpy oracles
+
+def np_greedy_nms(boxes, scores, thresh):
+    """Classic host-side greedy NMS: returns kept indices, score-descending."""
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        a = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        b = ((boxes[order[1:], 2] - boxes[order[1:], 0])
+             * (boxes[order[1:], 3] - boxes[order[1:], 1]))
+        iou = inter / np.maximum(a + b - inter, 1e-12)
+        order = order[1:][iou <= thresh]
+    return keep
+
+
+def random_boxes(rng, n, lo=0, hi=100):
+    x1 = rng.uniform(lo, hi - 5, n)
+    y1 = rng.uniform(lo, hi - 5, n)
+    w = rng.uniform(1, 30, n)
+    h = rng.uniform(1, 30, n)
+    return np.stack([x1, y1, x1 + w, y1 + h], 1).astype(np.float32)
+
+
+# -------------------------------------------------------------------- tests
+
+def test_nms_mask_matches_numpy_greedy():
+    rng = np.random.RandomState(0)
+    for trial in range(5):
+        boxes = random_boxes(rng, 64)
+        scores = rng.uniform(0.1, 1.0, 64).astype(np.float32)
+        order, keep = nn.nms_mask(jnp.asarray(boxes), jnp.asarray(scores), 0.5)
+        got = np.asarray(order)[np.asarray(keep)]
+        want = np_greedy_nms(boxes, scores, 0.5)
+        assert got.tolist() == want
+
+
+def test_nms_mask_respects_valid_mask():
+    boxes = jnp.asarray([[0, 0, 10, 10], [0, 0, 10, 10], [50, 50, 60, 60]],
+                        jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    valid = jnp.asarray([False, True, True])
+    order, keep = nn.nms_mask(boxes, scores, 0.5, valid=valid)
+    got = set(np.asarray(order)[np.asarray(keep)].tolist())
+    assert got == {1, 2}
+
+
+def test_normalize_scale_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.RandomState(1).randn(2, 8, 5, 5).astype(np.float32)
+    m = nn.NormalizeScale(p=2.0, scale=20.0, size=8)
+    out = np.asarray(m.forward(jnp.asarray(x)))
+    tx = torch.tensor(x)
+    want = (torch.nn.functional.normalize(tx, p=2.0, dim=1) * 20.0).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_normalize_scale_weight_trains():
+    m = nn.NormalizeScale(size=4)
+    assert "weight" in m.get_params()
+    assert m.get_params()["weight"].shape == (4,)
+
+
+def test_prior_box_matches_caffe_recipe():
+    # independent replica of the Caffe PriorBox loop for one cell
+    img = 300
+    layer = 3
+    min_s, max_s = 30.0, 60.0
+    m = nn.PriorBox(min_sizes=[min_s], max_sizes=[max_s], aspect_ratios=[2.0],
+                    flip=True, clip=False, img_h=img, img_w=img)
+    fmap = jnp.zeros((1, 4, layer, layer))
+    out = np.asarray(m.forward(fmap))
+    assert out.shape == (1, 2, layer * layer * m.num_priors * 4)
+    priors = out[0, 0].reshape(-1, 4)
+    var = out[0, 1].reshape(-1, 4)
+    np.testing.assert_allclose(var, np.tile([0.1, 0.1, 0.2, 0.2],
+                                            (priors.shape[0], 1)), rtol=1e-6)
+    # first cell center = (0.5, 0.5) * step, step = 100
+    step = img / layer
+    cx = cy = 0.5 * step
+    want = []
+    for bw, bh in [(min_s, min_s),
+                   (math.sqrt(min_s * max_s), math.sqrt(min_s * max_s)),
+                   (min_s * math.sqrt(2), min_s / math.sqrt(2)),
+                   (min_s / math.sqrt(2), min_s * math.sqrt(2))]:
+        want.append([(cx - bw / 2) / img, (cy - bh / 2) / img,
+                     (cx + bw / 2) / img, (cy + bh / 2) / img])
+    np.testing.assert_allclose(priors[:4], np.array(want, np.float32), rtol=1e-5)
+
+
+def test_anchor_matches_py_faster_rcnn_recipe():
+    m = nn.Anchor(ratios=[0.5, 1.0, 2.0], scales=[8.0, 16.0, 32.0], base_size=16)
+    a = m.generate(2, 2, stride=16)
+    assert a.shape == (2 * 2 * 9, 4)
+    # base anchors replicated: anchor at shift (x=16, y=0) is base + [16,0,16,0]
+    np.testing.assert_allclose(a[9] - a[0], [16, 0, 16, 0], atol=1e-5)
+    np.testing.assert_allclose(a[18] - a[0], [0, 16, 0, 16], atol=1e-5)
+    # ratio-1 anchors are square with side scale*base
+    widths = a[:9, 2] - a[:9, 0] + 1
+    heights = a[:9, 3] - a[:9, 1] + 1
+    sq = [i for i in range(9) if abs(widths[i] - heights[i]) < 1e-3]
+    assert sorted(widths[sq].tolist()) == [128.0, 256.0, 512.0]
+    # areas are preserved by the ratio warp (within rounding)
+    for i in range(9):
+        assert widths[i] * heights[i] == pytest.approx(
+            (16 * [8, 16, 32][i % 3]) ** 2, rel=0.08)
+
+
+def test_proposal_static_shape_and_validity():
+    rng = np.random.RandomState(2)
+    a, h, w = 9, 6, 8
+    scores = rng.rand(1, 2 * a, h, w).astype(np.float32)
+    deltas = (rng.randn(1, 4 * a, h, w) * 0.1).astype(np.float32)
+    im_info = np.array([[96.0, 128.0, 1.0]], np.float32)
+    m = nn.Proposal(pre_nms_topn=200, post_nms_topn=50, rpn_min_size=4)
+    out = m.forward(Table(jnp.asarray(scores), jnp.asarray(deltas),
+                          jnp.asarray(im_info)))
+    rois, valid = out.values()
+    rois, valid = np.asarray(rois), np.asarray(valid)
+    assert rois.shape == (50, 5) and valid.shape == (50,)
+    assert valid.any()
+    live = rois[valid]
+    assert (live[:, 1] >= 0).all() and (live[:, 3] <= 127).all()
+    assert (live[:, 2] >= 0).all() and (live[:, 4] <= 95).all()
+    assert (live[:, 0] == 0).all()
+    # survivors pairwise IoU below the NMS threshold
+    boxes = live[:, 1:]
+    ious = np.asarray(nn.pairwise_iou(jnp.asarray(boxes), jnp.asarray(boxes)))
+    off_diag = ious - np.eye(len(boxes))
+    assert (off_diag <= 0.7 + 1e-5).all()
+
+
+def test_proposal_budget_overflow_keeps_top_scored():
+    # more NMS survivors than post_nms_topn: every output row must be valid
+    # and hold the highest-scored survivors (regression: the old scatter
+    # could clobber the last slot nondeterministically)
+    rng = np.random.RandomState(7)
+    a, h, w = 9, 8, 8   # 576 anchors, far more survivors than budget 8
+    scores = rng.rand(1, 2 * a, h, w).astype(np.float32)
+    deltas = np.zeros((1, 4 * a, h, w), np.float32)   # boxes = anchors
+    im_info = np.array([[128.0, 128.0, 1.0]], np.float32)
+    m = nn.Proposal(pre_nms_topn=300, post_nms_topn=8, rpn_min_size=2,
+                    nms_thresh=0.95)  # lenient NMS → plenty of survivors
+    rois, valid = m.forward(Table(jnp.asarray(scores), jnp.asarray(deltas),
+                                  jnp.asarray(im_info))).values()
+    valid = np.asarray(valid)
+    assert valid.all()
+    assert np.isfinite(np.asarray(rois)).all()
+
+
+def test_proposal_nhwc_layout_matches_nchw():
+    from bigdl_tpu.nn import layout
+    rng = np.random.RandomState(8)
+    a, h, w = 9, 5, 6
+    scores = rng.rand(1, 2 * a, h, w).astype(np.float32)
+    deltas = (rng.randn(1, 4 * a, h, w) * 0.1).astype(np.float32)
+    im_info = np.array([[80.0, 96.0, 1.0]], np.float32)
+    m = nn.Proposal(pre_nms_topn=100, post_nms_topn=12, rpn_min_size=2)
+    want = m.forward(Table(jnp.asarray(scores), jnp.asarray(deltas),
+                           jnp.asarray(im_info))).values()
+    layout.set_image_format("NHWC")
+    try:
+        got = m.forward(Table(jnp.asarray(scores.transpose(0, 2, 3, 1)),
+                              jnp.asarray(deltas.transpose(0, 2, 3, 1)),
+                              jnp.asarray(im_info))).values()
+    finally:
+        layout.set_image_format(None)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
+def test_proposal_feeds_roi_pooling():
+    rng = np.random.RandomState(3)
+    a, h, w = 9, 4, 4
+    scores = rng.rand(1, 2 * a, h, w).astype(np.float32)
+    deltas = (rng.randn(1, 4 * a, h, w) * 0.1).astype(np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    prop = nn.Proposal(pre_nms_topn=100, post_nms_topn=10, rpn_min_size=2)
+    rois, valid = prop.forward(Table(jnp.asarray(scores), jnp.asarray(deltas),
+                                     jnp.asarray(im_info))).values()
+    feats = jnp.asarray(rng.randn(1, 3, h, w).astype(np.float32))
+    pool = nn.RoiPooling(pooled_h=2, pooled_w=2, spatial_scale=1.0 / 16)
+    pooled = pool.forward(Table(feats, rois))
+    assert pooled.shape == (10, 3, 2, 2)
+    assert np.isfinite(np.asarray(pooled)).all()
+
+
+def test_detection_output_ssd_decodes_and_ranks():
+    # priors: 4 boxes; zero deltas decode back to the priors themselves
+    priors = np.array([[0.1, 0.1, 0.3, 0.3],
+                       [0.5, 0.5, 0.7, 0.7],
+                       [0.52, 0.52, 0.72, 0.72],   # overlaps prior 1
+                       [0.8, 0.1, 0.95, 0.3]], np.float32)
+    var = np.tile([0.1, 0.1, 0.2, 0.2], (4, 1)).astype(np.float32)
+    wire = np.stack([priors.reshape(-1), var.reshape(-1)])[None]  # (1,2,16)
+    loc = np.zeros((1, 16), np.float32)
+    # 3 classes, bg=0. logits: prior0 → class1 strong; priors 1,2 → class2
+    # (overlapping, NMS keeps one); prior3 → below threshold everywhere
+    conf = np.full((1, 4 * 3), -10.0, np.float32).reshape(1, 4, 3)
+    conf[0, 0, 1] = 5.0
+    conf[0, 1, 2] = 4.0
+    conf[0, 2, 2] = 3.0
+    conf[0, 3, 0] = 5.0
+    m = nn.DetectionOutputSSD(n_classes=3, nms_thresh=0.45, keep_topk=5,
+                              conf_thresh=0.01)
+    out = np.asarray(m.forward(Table(jnp.asarray(loc),
+                                     jnp.asarray(conf.reshape(1, -1)),
+                                     jnp.asarray(wire))))
+    assert out.shape == (1, 5, 6)
+    det = out[0]
+    live = det[det[:, 0] >= 0]
+    assert len(live) == 2
+    # highest score first: class1 @ prior0
+    assert live[0, 0] == 1.0
+    np.testing.assert_allclose(live[0, 2:], priors[0], atol=1e-5)
+    assert live[1, 0] == 2.0
+    np.testing.assert_allclose(live[1, 2:], priors[1], atol=1e-5)
+    # padding rows are sentinel
+    assert (det[len(live):, 0] == -1).all()
+    assert (det[len(live):, 1] == 0).all()
+
+
+def test_detection_output_jits():
+    import jax
+    priors = np.random.RandomState(4).rand(8, 4).astype(np.float32)
+    priors = np.sort(priors.reshape(8, 2, 2), axis=1).reshape(8, 4)
+    var = np.tile([0.1, 0.1, 0.2, 0.2], (8, 1)).astype(np.float32)
+    wire = jnp.asarray(np.stack([priors.reshape(-1), var.reshape(-1)])[None])
+    m = nn.DetectionOutputSSD(n_classes=4, keep_topk=6)
+    fn = jax.jit(lambda loc, conf: m.apply({}, {}, Table(loc, conf, wire))[0])
+    out = fn(jnp.zeros((2, 32)), jnp.zeros((2, 8 * 4)))
+    assert out.shape == (2, 6, 6)
+
+
+def test_serializer_roundtrip_detection():
+    from bigdl_tpu.utils import serializer
+    import tempfile, os
+    for m in [nn.NormalizeScale(size=4),
+              nn.PriorBox([30.], [60.], [2.], img_h=300, img_w=300),
+              nn.Proposal(post_nms_topn=10),
+              nn.DetectionOutputSSD(n_classes=3)]:
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.bigdl")
+            serializer.save_module(m, p)
+            m2 = serializer.load_module(p)
+            assert type(m2) is type(m)

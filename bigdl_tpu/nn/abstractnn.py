@@ -394,6 +394,18 @@ class AbstractModule(metaclass=RecordsInit):
 
     load_module = load  # reference ``Module.loadModule`` alias
 
+    def save_torch(self, path: str) -> "AbstractModule":
+        """Export as a Lua-Torch7 ``.t7`` nn model — reference ``saveTorch``."""
+        from bigdl_tpu.utils import torchfile
+        torchfile.save_torch(self, path)
+        return self
+
+    @staticmethod
+    def load_torch(path: str) -> "AbstractModule":
+        """Import a Lua-Torch7 ``.t7`` nn model — reference ``loadTorch``."""
+        from bigdl_tpu.utils import torchfile
+        return torchfile.load_torch(path)
+
     def __getstate__(self):
         d = dict(self.__dict__)
         d.pop("_cached_fwd_jit", None)  # jitted closures don't pickle

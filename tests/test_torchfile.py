@@ -1,0 +1,250 @@
+"""Torch7 .t7 interop: reader pinned against hand-encoded bytes (independent
+byte-level oracle of the Torch7 File:writeObject binary format), writer pinned
+by round-trip + forward-output equality."""
+
+import struct
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils import torchfile
+from bigdl_tpu.utils.torchfile import (TorchObject, load_torch, read_t7,
+                                       save_torch, write_t7)
+
+
+# ---------------------------------------------------- byte-level t7 encoder
+# Written independently of utils/torchfile.py from the Torch7 format spec:
+# int=int32 LE, long=int64 LE, number=float64 LE; objects are (tag, payload).
+
+class Enc:
+    def __init__(self):
+        self.b = bytearray()
+        self.idx = 0
+
+    def i(self, v): self.b += struct.pack("<i", v)
+    def l(self, v): self.b += struct.pack("<q", v)
+    def d(self, v): self.b += struct.pack("<d", v)
+
+    def s(self, v):
+        raw = v.encode()
+        self.i(len(raw)); self.b += raw
+
+    def number(self, v): self.i(1); self.d(v)
+    def string(self, v): self.i(2); self.s(v)
+    def boolean(self, v): self.i(5); self.i(1 if v else 0)
+
+    def table_start(self, n):
+        self.idx += 1
+        self.i(3); self.i(self.idx); self.i(n)
+
+    def torch_start(self, cls):
+        self.idx += 1
+        self.i(4); self.i(self.idx); self.s("V 1"); self.s(cls)
+
+    def float_tensor(self, arr):
+        arr = np.ascontiguousarray(arr, np.float32)
+        self.torch_start("torch.FloatTensor")
+        self.i(arr.ndim)   # Torch7 writes nDimension as int32
+        for sz in arr.shape: self.l(sz)
+        strides, acc = [], 1
+        for sz in reversed(arr.shape):
+            strides.append(acc); acc *= sz
+        for st in reversed(strides): self.l(st)
+        self.l(1)
+        self.torch_start("torch.FloatStorage")
+        self.l(arr.size); self.b += arr.tobytes()
+
+
+def test_reader_parses_handcrafted_linear(tmp_path):
+    w = np.arange(6, dtype=np.float32).reshape(2, 3)
+    bias = np.array([0.5, -0.5], np.float32)
+    e = Enc()
+    e.torch_start("nn.Linear")
+    e.table_start(3)
+    e.string("weight"); e.float_tensor(w)
+    e.string("bias"); e.float_tensor(bias)
+    e.string("train"); e.boolean(False)
+    p = tmp_path / "lin.t7"
+    p.write_bytes(bytes(e.b))
+    m = load_torch(str(p))
+    assert isinstance(m, nn.Linear)
+    np.testing.assert_allclose(np.asarray(m.get_params()["weight"]), w)
+    np.testing.assert_allclose(np.asarray(m.get_params()["bias"]), bias)
+    x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(m.forward(jnp.asarray(x))),
+                               x @ w.T + bias, rtol=1e-5)
+
+
+def test_reader_parses_handcrafted_sequential(tmp_path):
+    w = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+    e = Enc()
+    e.torch_start("nn.Sequential")
+    e.table_start(1)
+    e.string("modules")
+    e.table_start(2)
+    e.number(1.0)
+    e.torch_start("nn.Linear")
+    e.table_start(1)
+    e.string("weight"); e.float_tensor(w)
+    e.number(2.0)
+    e.torch_start("nn.ReLU")
+    e.table_start(0)
+    p = tmp_path / "seq.t7"
+    p.write_bytes(bytes(e.b))
+    m = load_torch(str(p))
+    assert isinstance(m, nn.Sequential) and len(m.modules) == 2
+    x = np.random.RandomState(2).randn(2, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(m.forward(jnp.asarray(x))),
+                               np.maximum(x @ w.T, 0), rtol=1e-5)
+
+
+def test_reader_strided_noncontiguous_tensor(tmp_path):
+    # a transposed view: sizes (2,3), strides (1,2) over a 6-element storage
+    e = Enc()
+    e.torch_start("torch.FloatTensor")
+    e.i(2); e.l(2); e.l(3); e.l(1); e.l(2); e.l(1)
+    e.torch_start("torch.FloatStorage")
+    data = np.arange(6, dtype=np.float32)
+    e.l(6); e.b += data.tobytes()
+    p = tmp_path / "t.t7"
+    p.write_bytes(bytes(e.b))
+    arr = read_t7(str(p))
+    np.testing.assert_allclose(arr, data.reshape(3, 2).T)
+
+
+def test_reader_shared_storage_memoization(tmp_path):
+    # the same storage object referenced twice must parse once and share
+    e = Enc()
+    e.table_start(2)
+    e.string("a")
+    e.torch_start("torch.FloatStorage")
+    storage_idx = e.idx
+    e.l(3); e.b += np.array([1, 2, 3], np.float32).tobytes()
+    e.string("b")
+    e.i(4); e.i(storage_idx)          # memo reference to the same storage
+    p = tmp_path / "sh.t7"
+    p.write_bytes(bytes(e.b))
+    out = read_t7(str(p))
+    assert out["a"] is out["b"]
+
+
+def test_roundtrip_conv_net_forward_equal(tmp_path):
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1))
+    m.add(nn.SpatialBatchNormalization(8))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(2, 2))
+    m.add(nn.Reshape([8 * 4 * 4]))
+    m.add(nn.Linear(128, 10))
+    m.add(nn.LogSoftMax())
+    m.evaluate()
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 3, 8, 8).astype(np.float32))
+    want = np.asarray(m.forward(x))
+    p = tmp_path / "net.t7"
+    save_torch(m, str(p))
+    m2 = load_torch(str(p))
+    m2.evaluate()
+    np.testing.assert_allclose(np.asarray(m2.forward(x)), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_roundtrip_bn_running_stats(tmp_path):
+    m = nn.SpatialBatchNormalization(4)
+    st = m.get_state()
+    st["running_mean"] = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    st["running_var"] = jnp.asarray([0.5, 1.5, 2.5, 3.5])
+    m.set_state(st)
+    p = tmp_path / "bn.t7"
+    save_torch(m, str(p))
+    m2 = load_torch(str(p))
+    np.testing.assert_allclose(np.asarray(m2.get_state()["running_mean"]),
+                               [1, 2, 3, 4])
+    np.testing.assert_allclose(np.asarray(m2.get_state()["running_var"]),
+                               [0.5, 1.5, 2.5, 3.5])
+    assert m2.eps == pytest.approx(m.eps)
+
+
+def test_roundtrip_table_containers(tmp_path):
+    m = nn.Sequential()
+    branch = nn.Concat(2)
+    branch.add(nn.Linear(6, 4))
+    branch.add(nn.Linear(6, 3))
+    m.add(branch)
+    m.add(nn.ReLU())
+    x = jnp.asarray(np.random.RandomState(4).randn(5, 6).astype(np.float32))
+    want = np.asarray(m.forward(x))
+    p = tmp_path / "cc.t7"
+    save_torch(m, str(p))
+    got = np.asarray(load_torch(str(p)).forward(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_roundtrip_lookup_table(tmp_path):
+    m = nn.LookupTable(10, 4)
+    ids = jnp.asarray(np.array([[1, 2], [3, 10]], np.int32))
+    want = np.asarray(m.forward(ids))
+    p = tmp_path / "lut.t7"
+    save_torch(m, str(p))
+    got = np.asarray(load_torch(str(p)).forward(ids))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_generic_value_roundtrip(tmp_path):
+    obj = {"num": 3.5, "int": 7, "str": "hello", "flag": True,
+           "arr": np.arange(4, dtype=np.float32),
+           "nested": {"x": 1.0}}
+    p = tmp_path / "v.t7"
+    write_t7(str(p), obj)
+    out = read_t7(str(p))
+    assert out["num"] == 3.5 and out["int"] == 7
+    assert out["str"] == "hello" and out["flag"] is True
+    np.testing.assert_allclose(out["arr"], [0, 1, 2, 3])
+    assert out["nested"]["x"] == 1.0
+
+
+def test_integer_dtypes_preserved(tmp_path):
+    obj = {"i32": np.array([2**31 - 1, -5], np.int32),
+           "u8": np.arange(4, dtype=np.uint8),
+           "i64": np.array([2**40], np.int64)}
+    p = tmp_path / "ints.t7"
+    write_t7(str(p), obj)
+    out = read_t7(str(p))
+    assert out["i32"].dtype == np.int32 and out["i32"][0] == 2**31 - 1
+    assert out["u8"].dtype == np.uint8
+    assert out["i64"].dtype == np.int64 and out["i64"][0] == 2**40
+
+
+def test_shared_tensor_roundtrips_shared(tmp_path):
+    a = np.arange(3, dtype=np.float32)
+    p = tmp_path / "sh.t7"
+    write_t7(str(p), {"x": a, "y": a})
+    out = read_t7(str(p))
+    assert out["x"] is out["y"]
+
+
+def test_corrupt_tensor_bounds_rejected(tmp_path):
+    # tensor header claims 1000 elements over a 2-element storage
+    e = Enc()
+    e.torch_start("torch.FloatTensor")
+    e.i(1); e.l(1000); e.l(1); e.l(1)
+    e.torch_start("torch.FloatStorage")
+    e.l(2); e.b += np.zeros(2, np.float32).tobytes()
+    p = tmp_path / "bad.t7"
+    p.write_bytes(bytes(e.b))
+    with pytest.raises(ValueError, match="corrupt"):
+        read_t7(str(p))
+
+
+def test_grouped_conv_export_refused(tmp_path):
+    m = nn.SpatialConvolution(4, 4, 3, 3, n_group=2)
+    with pytest.raises(ValueError, match="group"):
+        save_torch(m, str(tmp_path / "g.t7"))
+
+
+def test_unknown_class_raises(tmp_path):
+    p = tmp_path / "u.t7"
+    write_t7(str(p), TorchObject("nn.TotallyUnknownLayer", {}))
+    with pytest.raises(ValueError, match="no converter"):
+        load_torch(str(p))

@@ -71,6 +71,25 @@ def _peak_flops(device_kind: str):
     return None
 
 
+# HBM bandwidth by chip (roofline denominator for the ablation leg);
+# alias list mirrors _PEAK_FLOPS — first substring match wins
+_PEAK_HBM_BW = (("v6", 1640e9),
+                ("v5p", 2765e9),
+                ("v5 lite", 819e9), ("v5e", 819e9), ("v5litepod", 819e9),
+                ("v5", 2765e9),
+                ("v4", 1228e9),
+                ("v3", 900e9),
+                ("v2", 700e9))
+
+
+def _peak_hbm(device_kind: str):
+    kind = device_kind.lower()
+    for sub, bw in _PEAK_HBM_BW:
+        if sub in kind:
+            return bw
+    return None
+
+
 # Models the bench runs channels-last (the TPU-native fast path; numerics
 # pinned equal to NCHW by tests/test_layout_nhwc.py). LeNet stays NCHW — its
 # front Reshape([1,28,28]) hard-codes the reference layout, and it's a
@@ -256,6 +275,26 @@ def _measure(model_name: str, batch: int, iters: int, warmup: int,
     }
 
 
+def _placed_step_inputs(opt):
+    """Device-place everything the compiled step consumes: params, module
+    state, optimizer state (post-run if available), one fixed batch, rng."""
+    import jax
+
+    from bigdl_tpu.utils.random_generator import RandomGenerator
+
+    model, method = opt.model, opt.optim_method
+    params = jax.device_put(model.get_params())
+    mstate = jax.device_put(model.get_state())
+    ostate = jax.device_put(getattr(opt, "_final_ostate", None)
+                            or method.init_state(params))
+    inp = target = None
+    for b in opt.dataset.data(train=True):
+        inp = jax.device_put(b.input)
+        target = jax.device_put(b.target)
+        break
+    return params, mstate, ostate, inp, target, RandomGenerator.next_key()
+
+
 def _measure_direct_step(opt, batch: int, iters: int) -> float:
     """Drive the optimizer's own compiled train step in a bare loop: warm steps,
     then `iters` timed dispatches with ONE terminal loss fetch as the sync point.
@@ -265,17 +304,7 @@ def _measure_direct_step(opt, batch: int, iters: int) -> float:
     import numpy as np
 
     step_fn = opt._step_cache
-    model, method = opt.model, opt.optim_method
-    params = jax.device_put(model.get_params())
-    mstate = jax.device_put(model.get_state())
-    ostate = jax.device_put(getattr(opt, "_final_ostate", None)
-                            or method.init_state(params))
-    for b in opt.dataset.data(train=True):
-        inp = jax.device_put(b.input)
-        target = jax.device_put(b.target)
-        break
-    from bigdl_tpu.utils.random_generator import RandomGenerator
-    base_rng = RandomGenerator.next_key()
+    params, mstate, ostate, inp, target, base_rng = _placed_step_inputs(opt)
 
     def run(n, start):
         nonlocal params, mstate, ostate
@@ -428,6 +457,127 @@ def _measure_serving(model_name: str, batch: int, iters: int) -> dict:
             "batch": batch, "dtype": "bf16"}
 
 
+def _measure_ablation(model_name: str, batch: int, iters: int) -> dict:
+    """Step-time attribution (the committed profile analysis): time the full
+    compiled train step and its sub-programs — forward-only, forward+backward,
+    optimizer-update-only — on the same placed batch, and read XLA's compiled
+    cost analysis (flops / bytes accessed) to place the step on the chip's
+    compute/HBM roofline. Answers "where does the non-MXU time go" without a
+    trace viewer: bwd = fwdbwd − fwd, optimizer = step − fwdbwd, and the
+    roofline ratio says how much of the remaining gap is memory-bound."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.precision import cast_floating
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    Engine.init(compute_dtype=jnp.bfloat16)
+    dev = Engine.devices()[0]
+
+    model, dataset, criterion = _build(model_name, batch, n_batches=2,
+                                       dtype="bf16")
+    opt = LocalOptimizer(model, dataset, criterion)
+    opt.set_optim_method(SGD(learningrate=0.01, momentum=0.9, dampening=0.0))
+    opt.log_every = 10 ** 9
+    opt.set_end_when(Trigger.max_iteration(3))
+    opt.optimize()   # builds + warms the real compiled step
+
+    method = opt.optim_method
+    params, mstate, ostate, inp, target, rng = _placed_step_inputs(opt)
+    compute_dtype = Engine.compute_dtype()
+
+    def loss_fn(p, x, t):
+        pc = cast_floating(p, compute_dtype)
+        xc = cast_floating(x, compute_dtype)
+        out, new_ms = model.apply(pc, mstate, xc, training=True, rng=rng)
+        return criterion.apply(cast_floating(out, jnp.float32), t)
+
+    # no donation: every program re-runs on the SAME placed buffers
+    step_fn = jax.jit(opt._make_step_fn())
+    fwd_fn = jax.jit(loss_fn)
+    bwd_fn = jax.jit(jax.value_and_grad(loss_fn))
+    zero_i = jnp.asarray(0, jnp.int32)
+    _, grads0 = bwd_fn(params, inp, target)
+    grads0 = jax.device_put(jax.device_get(grads0))
+    upd_fn = jax.jit(lambda p, g, os_: method.update(p, g, os_, zero_i))
+
+    def timed(run, sync):
+        sync(run())                      # warm + sync
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = run()
+        sync(out)
+        return (time.perf_counter() - t0) / iters * 1e3   # ms/iter
+
+    leaf0 = lambda t: jax.tree_util.tree_leaves(t)[0].block_until_ready()
+    step_ms = timed(lambda: step_fn(params, mstate, ostate, zero_i, inp,
+                                    target, rng),
+                    lambda o: float(jax.device_get(o[3])))
+    fwd_ms = timed(lambda: fwd_fn(params, inp, target),
+                   lambda o: float(jax.device_get(o)))
+    bwd_ms = timed(lambda: bwd_fn(params, inp, target),
+                   lambda o: float(jax.device_get(o[0])))
+    upd_ms = timed(lambda: upd_fn(params, grads0, ostate), leaf0)
+
+    # XLA's own cost model for the compiled step: flops + HBM traffic
+    # (lower() on the ALREADY-jitted step_fn reuses its trace/compile cache)
+    cost = {}
+    try:
+        lowered = step_fn.lower(params, mstate, ostate, zero_i, inp,
+                                target, rng)
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        cost = {"xla_flops": ca.get("flops"),
+                "xla_bytes_accessed": ca.get("bytes accessed")}
+    except Exception as e:  # cost analysis is best-effort diagnostics
+        cost = {"cost_analysis_error": f"{type(e).__name__}: {e}"[:200]}
+
+    peak, bw = _peak_flops(dev.device_kind), _peak_hbm(dev.device_kind)
+    roofline = {}
+    if cost.get("xla_flops") and peak:
+        roofline["compute_bound_ms"] = 1e3 * cost["xla_flops"] / peak
+    if cost.get("xla_bytes_accessed") and bw:
+        roofline["memory_bound_ms"] = 1e3 * cost["xla_bytes_accessed"] / bw
+    if roofline:
+        floor = max(roofline.values())
+        roofline["roofline_floor_ms"] = round(floor, 3)
+        roofline["step_vs_roofline"] = round(step_ms / floor, 2)
+        roofline["bound"] = ("memory"
+                             if roofline.get("memory_bound_ms", 0)
+                             >= roofline.get("compute_bound_ms", 0)
+                             else "compute")
+
+    per_unit = _ANALYTIC_STEP_FLOPS_PER_UNIT.get(model_name)
+    unit, per_sample = _MODEL_UNITS.get(model_name, ("records", 1))
+    units_per_sec = batch * per_sample / (step_ms / 1e3)
+    out = {
+        "value": round(step_ms, 3),
+        "unit": "ms/step",
+        "batch": batch,
+        "step_ms": round(step_ms, 3),
+        "fwd_ms": round(fwd_ms, 3),
+        "fwdbwd_ms": round(bwd_ms, 3),
+        "update_only_ms": round(upd_ms, 3),
+        "bwd_delta_ms": round(bwd_ms - fwd_ms, 3),
+        "optimizer_delta_ms": round(step_ms - bwd_ms, 3),
+        f"{unit}_per_sec_step": round(units_per_sec, 1),
+        "mfu_step": (round(per_unit * units_per_sec / peak, 4)
+                     if per_unit and peak else None),
+        "device_kind": dev.device_kind,
+        "platform": dev.platform,
+        **{k: round(v, 3) if isinstance(v, float) else v
+           for k, v in roofline.items()},
+        **cost,
+    }
+    return out
+
+
 def run_worker(args) -> None:
     """The measured child process: ONE dtype, one JSON line, exit.
 
@@ -517,6 +667,8 @@ def run_orchestrator(args) -> None:
         worker_argv.append("--serving")
     if args.decode_infer:
         worker_argv.append("--decode-infer")
+    if args.ablate:
+        worker_argv.append("--ablate")
     env = dict(os.environ)
     # TPU attach in this environment swings from ~20 s to outright hangs; give a
     # real attempt generous headroom (the subprocess timeout still bounds it)
@@ -531,7 +683,7 @@ def run_orchestrator(args) -> None:
             # discard the good primary number above
             if args.compare_dtypes and args.dtype == "bf16" \
                     and not args.int8_infer and not args.serving \
-                    and not args.decode_infer:
+                    and not args.decode_infer and not args.ablate:
                 # the comparison leg only feeds the ratio — skip its streamed
                 # measurement (it would be discarded)
                 cmp_argv = ["--run", "--model", args.model,
@@ -564,11 +716,12 @@ def run_orchestrator(args) -> None:
         attempts.append(f"attempt{attempt}: {err}")
         print(f"bench: {err}", file=sys.stderr)
 
-    if args.int8_infer or args.serving or args.decode_infer:
+    if args.int8_infer or args.serving or args.decode_infer or args.ablate:
         # a LeNet training number would not answer an inference-path request:
         # fail loudly with the metric the caller asked for
         kind = ("int8_vs_bf16_infer" if args.int8_infer
-                else "serving" if args.serving else "decode_infer")
+                else "serving" if args.serving
+                else "decode_infer" if args.decode_infer else "step_ablation")
         print(json.dumps({
             "metric": f"{args.model}_{kind}",
             "value": None,
@@ -630,6 +783,9 @@ def main(argv=None):
     p.add_argument("--decode-infer", action="store_true",
                    help="LM decode micro-bench: KV-cached greedy_generate "
                         "tokens/sec vs the uncached static-block search")
+    p.add_argument("--ablate", action="store_true",
+                   help="step-time attribution: fwd / fwd+bwd / update "
+                        "sub-program timings + XLA cost-analysis roofline")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
     args = p.parse_args(argv)
@@ -651,6 +807,12 @@ def main(argv=None):
         elif args.decode_infer:
             res = _measure_decode_infer(min(args.batch, 16))
             res["metric"] = "transformerlm_decode_infer"
+            res["vs_baseline"] = None
+            print(json.dumps(res))
+        elif args.ablate:
+            res = _measure_ablation(args.model, args.batch,
+                                    max(args.iters // 2, 8))
+            res["metric"] = f"{args.model}_step_ablation"
             res["vs_baseline"] = None
             print(json.dumps(res))
         else:

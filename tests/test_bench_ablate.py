@@ -1,0 +1,31 @@
+"""bench --ablate: step-time attribution leg prints one JSON line whose
+sub-program timings are mutually consistent."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ablate_leg_json_contract():
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"),
+         "--model", "lenet", "--batch", "32", "--iters", "8",
+         "--ablate", "--timeout", "500"],
+        cwd="/tmp", capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "lenet_step_ablation"
+    assert line["unit"] == "ms/step"
+    # attribution identities: fwd <= fwd+bwd; all components positive
+    assert 0 < line["fwd_ms"] <= line["fwdbwd_ms"]
+    assert line["update_only_ms"] > 0
+    assert line["bwd_delta_ms"] >= 0
+    # the full step covers at least the fwd+bwd work (tolerance for timer noise)
+    assert line["step_ms"] >= 0.5 * line["fwdbwd_ms"]
+    # XLA cost analysis present on CPU too (flops always reported)
+    assert line.get("xla_flops") or line.get("cost_analysis_error")

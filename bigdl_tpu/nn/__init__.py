@@ -68,6 +68,9 @@ from bigdl_tpu.nn.sparse import (
     SparseLinear,
 )
 from bigdl_tpu.nn.roi import RoiPooling
+from bigdl_tpu.nn.fused_loss import (
+    ChunkedSoftmaxCrossEntropy, FusedLMHead, chunked_softmax_xent,
+)
 from bigdl_tpu.nn.detection import (
     Anchor, DetectionOutputSSD, NormalizeScale, PriorBox, Proposal,
     decode_rcnn, decode_ssd, nms_mask, pairwise_iou,

@@ -1,0 +1,235 @@
+"""Chunked-vocabulary softmax cross-entropy — the fused LM-head loss.
+
+Reference parity: none — the reference caps at PTB-scale vocabularies where
+materializing (tokens, vocab) logits is harmless (SURVEY.md §5.7 notes the
+reference has no long-context machinery at all). This is a TPU-first addition
+in the same spirit as ring attention: on TPU the HBM cost of the LM head
+dominates large-vocab training — logits for a (B=8, T=2048) batch over a 256k
+vocab are 16 GB in fp32, more than the chip has — so the projection and the
+loss must be fused and streamed.
+
+Design: ``chunked_softmax_xent`` computes per-token NLL with an ONLINE
+logsumexp over vocabulary chunks (``lax.scan`` over ``(V/C, C, d)`` weight
+slices; running max/sum-exp carry — the flash-attention recurrence applied to
+the vocab axis). A ``jax.custom_vjp`` recomputes each chunk's probabilities in
+the backward from the saved per-token logsumexp, so neither pass ever holds
+more than ``(N, C)`` logits. Peak activation memory O(N·C + N·d), not O(N·V).
+
+Wiring: criterions in this framework hold no trainable parameters, so
+``FusedLMHead`` (the module that owns the projection weight) emits
+``Table(hidden, weight[, bias])`` in training mode — the weight rides the
+output pytree, so ``value_and_grad`` over the model parameters sees the loss
+as a function of it — and ``ChunkedSoftmaxCrossEntropy`` consumes that table
+with the labels. In eval mode ``FusedLMHead`` is an ordinary logits head, so
+``predict``/``evaluate``/beam search work unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.nn.criterion import AbstractCriterion
+from bigdl_tpu.nn.initialization import InitializationMethod, Xavier, Zeros
+from bigdl_tpu.utils.table import Table
+
+_NEG = -1e30   # "-inf" for padded vocab rows that survives exp() as exactly 0
+
+
+def _pad_vocab(weight, bias, chunk):
+    """Pad (V, d) / (V,) up to a chunk multiple; padded rows get bias ~ -inf
+    so they contribute exp(-inf)=0 to the logsumexp."""
+    v, d = weight.shape
+    k = -(-v // chunk)
+    pad = k * chunk - v
+    if bias is None:
+        bias = jnp.zeros((v,), weight.dtype)
+    if pad:
+        weight = jnp.concatenate(
+            [weight, jnp.zeros((pad, d), weight.dtype)], axis=0)
+        bias = jnp.concatenate(
+            [bias, jnp.full((pad,), _NEG, bias.dtype)], axis=0)
+    return weight.reshape(k, chunk, d), bias.reshape(k, chunk)
+
+
+def chunked_softmax_xent(hidden, weight, bias, labels, chunk_size=8192):
+    """Per-row softmax cross-entropy ``-log softmax(hidden @ weight.T + bias)[label]``
+    computed in vocabulary chunks. ``hidden (N, d)``, ``weight (V, d)``,
+    ``bias (V,) | None``, ``labels (N,)`` int (negative = ignored, loss 0).
+    Returns ``(N,)`` losses. Never materializes an (N, V) array."""
+    chunk = min(int(chunk_size), weight.shape[0])
+    return _xent_for_chunk(chunk)(hidden, weight, bias, labels)
+
+
+_XENT_CACHE: dict = {}
+
+
+def _xent_for_chunk(chunk: int):
+    """custom_vjp instance per chunk size (chunk is trace-static; a closure
+    avoids version-dependent nondiff_argnums calling conventions)."""
+    fn = _XENT_CACHE.get(chunk)
+    if fn is None:
+        @jax.custom_vjp
+        def fn(hidden, weight, bias, labels):
+            return _xent_fwd_impl(hidden, weight, bias, labels, chunk)[0]
+
+        fn.defvjp(partial(_xent_fwd, chunk), partial(_xent_bwd, chunk))
+        _XENT_CACHE[chunk] = fn
+    return fn
+
+
+def _xent_fwd_impl(hidden, weight, bias, labels, chunk):
+    f32 = jnp.float32
+    h = hidden.astype(f32)
+    wr, br = _pad_vocab(weight, bias, chunk)   # original dtype; cast per chunk
+    n = h.shape[0]
+
+    def body(carry, wc_bc):
+        m, s = carry
+        wc, bc = wc_bc
+        # cast THIS chunk only: a (C, d) fp32 slice, never the full (V, d)
+        logits = h @ wc.T.astype(f32) + bc.astype(f32)   # (N, C)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]).sum(axis=-1)
+        return (m_new, s), None
+
+    (m, s), _ = jax.lax.scan(
+        body, (jnp.full((n,), _NEG, f32), jnp.zeros((n,), f32)), (wr, br))
+    lse = m + jnp.log(s)
+
+    valid = labels >= 0
+    lc = jnp.clip(labels, 0, weight.shape[0] - 1)
+    tgt = (h * weight[lc].astype(f32)).sum(axis=-1)
+    if bias is not None:
+        tgt = tgt + bias[lc].astype(f32)
+    loss = jnp.where(valid, lse - tgt, 0.0)
+    return loss, lse
+
+
+def _xent_fwd(chunk, hidden, weight, bias, labels):
+    loss, lse = _xent_fwd_impl(hidden, weight, bias, labels, chunk)
+    return loss, (hidden, weight, bias, labels, lse)
+
+
+def _xent_bwd(chunk, res, g):
+    hidden, weight, bias, labels, lse = res
+    f32 = jnp.float32
+    h = hidden.astype(f32)
+    v, d = weight.shape
+    wr, br = _pad_vocab(weight, bias, chunk)   # original dtype; cast per chunk
+    valid = labels >= 0
+    geff = (g.astype(f32) * valid)                  # (N,)
+    lc = jnp.clip(labels, 0, v - 1)
+
+    def body(dh, wc_bc):
+        wc = wc_bc[0].astype(f32)
+        bc = wc_bc[1].astype(f32)
+        p = jnp.exp(h @ wc.T + bc - lse[:, None])    # (N, C) recomputed
+        pg = p * geff[:, None]
+        dh = dh + pg @ wc                            # (N, d)
+        dwc = pg.T @ h                               # (C, d)
+        dbc = pg.sum(axis=0)                         # (C,)
+        return dh, (dwc, dbc)
+
+    dh, (dw_chunks, db_chunks) = jax.lax.scan(body, jnp.zeros_like(h), (wr, br))
+    dw = dw_chunks.reshape(-1, d)[:v]
+    db = db_chunks.reshape(-1)[:v]
+
+    # subtract the target one-hot term
+    dh = dh - geff[:, None] * weight[lc].astype(f32)
+    dw = dw.at[lc].add(-geff[:, None] * h)   # geff already zeroes invalid rows
+    dweight = dw.astype(weight.dtype)
+    if bias is None:
+        dbias = None
+    else:
+        dbias = db.at[lc].add(-geff).astype(bias.dtype)
+    return (dh.astype(hidden.dtype), dweight, dbias, None)
+
+
+class FusedLMHead(TensorModule):
+    """LM projection head fused with its loss (see module docstring).
+
+    Training mode: input ``hidden (..., d)`` → output
+    ``Table(hidden, weight[, bias])`` for :class:`ChunkedSoftmaxCrossEntropy`.
+    Eval mode: ordinary logits head ``(..., vocab)``.
+
+    Weight tying: a parameter pytree cannot alias leaves across modules, so
+    tying the head to an embedding is done by REUSING one module instance —
+    the same ``FusedLMHead`` can serve as the embedding via
+    :meth:`embed` (a gather of its rows), giving one ``weight`` leaf that
+    receives both gradient contributions."""
+
+    def __init__(self, hidden_size: int, vocab_size: int,
+                 with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.hidden_size, self.vocab_size = int(hidden_size), int(vocab_size)
+        self.with_bias = with_bias
+        self.w_init = w_init or Xavier()
+        self.b_init = b_init or Zeros()
+        self.reset()
+
+    def reset(self):
+        p = {"weight": jnp.asarray(self.w_init.init(
+            (self.vocab_size, self.hidden_size),
+            fan_in=self.hidden_size, fan_out=self.vocab_size))}
+        if self.with_bias:
+            p["bias"] = jnp.asarray(self.b_init.init(
+                (self.vocab_size,), fan_in=self.hidden_size,
+                fan_out=self.vocab_size))
+        self._params = p
+        self.zero_grad_parameters()
+
+    def embed(self, params, ids):
+        """Tied-embedding lookup over this head's weight: ``ids (...)`` int →
+        ``(..., d)``. Use inside a Graph/custom module that reuses this head
+        instance so embedding and head share one weight leaf."""
+        return params["weight"][ids]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        w, b = params["weight"], params.get("bias")
+        if training:
+            out = [input, w] + ([b] if b is not None else [])
+            return Table(*out), state
+        logits = input @ w.T
+        if b is not None:
+            logits = logits + b
+        return logits, state
+
+    def __repr__(self):
+        return f"FusedLMHead({self.hidden_size}->{self.vocab_size})"
+
+
+class ChunkedSoftmaxCrossEntropy(AbstractCriterion):
+    """Consumes :class:`FusedLMHead`'s training output
+    ``Table(hidden, weight[, bias])`` and integer ``target`` of matching
+    leading shape (negative labels are ignored). Mean NLL over valid tokens.
+    ``chunk_size`` bounds live logits memory to ``tokens × chunk_size``."""
+
+    def __init__(self, chunk_size: int = 8192, zero_based: bool = True):
+        super().__init__()
+        self.chunk_size = int(chunk_size)
+        self.zero_based = zero_based
+
+    def apply(self, input, target):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        hidden, weight = xs[0], xs[1]
+        bias = xs[2] if len(xs) > 2 else None
+        d = hidden.shape[-1]
+        h2 = hidden.reshape(-1, d)
+        t = target.reshape(-1).astype(jnp.int32)
+        if not self.zero_based:
+            t = t - 1
+        chunk = min(self.chunk_size, weight.shape[0])
+        losses = chunked_softmax_xent(h2, weight, bias, t, chunk)
+        n_valid = jnp.maximum((t >= 0).sum(), 1)
+        return losses.sum() / n_valid
+
+    def __repr__(self):
+        return f"ChunkedSoftmaxCrossEntropy(chunk={self.chunk_size})"

@@ -87,13 +87,14 @@ def dryrun_multichip(n_devices: int) -> None:
         "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
     losses = {}
 
-    # 1) pure data parallel, both parameter-sync modes (allreduce / ZeRO-1)
+    # 1) pure data parallel, every parameter-sync mode
+    #    (allreduce / ZeRO-1 slots / ZeRO-3 fsdp weights)
     Engine.reset()
     Engine.init(mesh_shape=(n_devices,), mesh_axes=(Engine.DATA_AXIS,))
     imgs, labels = load_mnist(None, "train", synthetic_size=4 * n_devices)
     data = DataSet.array(to_samples(imgs, labels),
                          distributed=True) >> SampleToMiniBatch(4 * n_devices)
-    for sync in ("allreduce", "zero1"):
+    for sync in ("allreduce", "zero1", "fsdp"):
         model = LeNet5(10)
         opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion(),
                                parameter_sync=sync)

@@ -1,0 +1,105 @@
+"""FSDP (ZeRO-3) parameter sync: weights stored sharded over the data axis.
+Pins (1) training equivalence with plain allreduce DP, (2) sharded parameter
+residency in the compiled program's outputs, (3) the gather/scatter structure
+in the optimized HLO."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.dataset import DataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.optim import SGD, Trigger
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+
+def _model(seed=3):
+    from bigdl_tpu.utils.random_generator import RandomGenerator
+    RandomGenerator.set_seed(seed)
+    m = nn.Sequential()
+    m.add(nn.Linear(12, 32))
+    m.add(nn.ReLU())
+    m.add(nn.Linear(32, 4))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def _data(batch=16, n_batches=4):
+    rng = np.random.default_rng(0)
+    return DataSet.array([
+        MiniBatch(rng.normal(size=(batch, 12)).astype(np.float32),
+                  rng.integers(0, 4, size=(batch,)).astype(np.int32))
+        for _ in range(n_batches)])
+
+
+@pytest.fixture
+def mesh_engine():
+    Engine.reset()
+    Engine.init()
+    yield
+    Engine.reset()
+
+
+class TestFSDP:
+    def test_fsdp_matches_allreduce_training(self, mesh_engine):
+        losses = {}
+        for sync in ("allreduce", "fsdp"):
+            opt = (DistriOptimizer(_model(seed=3), _data(),
+                                   nn.ClassNLLCriterion(),
+                                   parameter_sync=sync)
+                   .set_optim_method(SGD(learningrate=0.1))
+                   .set_end_when(Trigger.max_iteration(6)))
+            opt.optimize()
+            losses[sync] = float(opt.state["loss"])
+        assert np.isfinite(losses["fsdp"])
+        assert losses["fsdp"] == pytest.approx(losses["allreduce"], rel=1e-4)
+
+    def test_params_stored_sharded(self, mesh_engine):
+        opt = (DistriOptimizer(_model(), _data(), nn.ClassNLLCriterion(),
+                               parameter_sync="fsdp")
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_end_when(Trigger.max_iteration(2)))
+        opt.optimize()
+        param_sh, _, _ = opt._shardings
+        n_dev = len(jax.devices())
+        flat = jax.tree_util.tree_leaves_with_path(param_sh)
+        sharded = [jax.tree_util.keystr(k) for k, s in flat
+                   if s.spec and s.spec[0] is not None]
+        # every divisible leading-axis leaf is sharded (32-row weight etc.)
+        assert any("weight" in k for k in sharded), (
+            f"no weight leaf sharded over the {n_dev}-device mesh: {flat}")
+
+    def test_hlo_has_gather_and_scatter_structure(self, mesh_engine):
+        opt = (DistriOptimizer(_model(), _data(), nn.ClassNLLCriterion(),
+                               parameter_sync="fsdp")
+               .set_optim_method(SGD(learningrate=0.1)))
+        step = opt._compile_step()
+        params = opt.model.get_params()
+        mstate = opt.model.get_state()
+        ostate = opt.optim_method.init_state(params)
+        x = jnp.zeros((16, 12), jnp.float32)
+        y = jnp.zeros((16,), jnp.int32)
+        hlo = step.lower(params, mstate, ostate, jnp.zeros((), jnp.int32),
+                         x, y, None).compile().as_text()
+        has_gather = "all-gather" in hlo
+        # GSPMD may express the sharded-grad reduction as reduce-scatter or as
+        # all-reduce + dynamic-slice; accept either spelling of the structure
+        has_scatter = ("reduce-scatter" in hlo
+                       or ("all-reduce" in hlo and "dynamic-slice" in hlo))
+        assert has_gather, "no all-gather in FSDP step (params not gathered)"
+        assert has_scatter, "no sharded-gradient reduction in FSDP step"
+
+    def test_bad_sync_mode_rejected(self, mesh_engine):
+        with pytest.raises(ValueError, match="parameter_sync"):
+            DistriOptimizer(_model(), _data(), nn.ClassNLLCriterion(),
+                            parameter_sync="zero9")
+
+    def test_fsdp_with_tp_rejected(self, mesh_engine):
+        from bigdl_tpu.parallel import TPRules
+        opt = (DistriOptimizer(_model(), _data(), nn.ClassNLLCriterion(),
+                               parameter_sync="fsdp")
+               .set_tensor_parallel(TPRules({})))
+        with pytest.raises(ValueError, match="fsdp"):
+            opt._compile_step()

@@ -75,6 +75,7 @@ from bigdl_tpu.nn.detection import (
     Anchor, DetectionOutputSSD, NormalizeScale, PriorBox, Proposal,
     decode_rcnn, decode_ssd, nms_mask, pairwise_iou,
 )
+from bigdl_tpu.nn.multibox import MultiBoxCriterion, encode_ssd, match_priors
 from bigdl_tpu.nn.tree import BinaryTreeLSTM
 from bigdl_tpu.nn.beam_search import SequenceBeamSearch, greedy_decode
 from bigdl_tpu.nn.incremental import (

@@ -1,0 +1,196 @@
+"""MultiBoxCriterion: encode/decode inverse, matching semantics, mining, and
+an end-to-end tiny-SSD must-actually-learn localization task."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.detection import decode_ssd
+from bigdl_tpu.nn.multibox import encode_ssd, match_priors
+from bigdl_tpu.utils.table import Table
+
+
+def _priors(p=8, seed=0):
+    rng = np.random.RandomState(seed)
+    c = rng.uniform(0.2, 0.8, (p, 2))
+    s = rng.uniform(0.1, 0.25, (p, 2))
+    boxes = np.concatenate([c - s / 2, c + s / 2], axis=1).astype(np.float32)
+    var = np.tile([0.1, 0.1, 0.2, 0.2], (p, 1)).astype(np.float32)
+    return jnp.asarray(boxes), jnp.asarray(var)
+
+
+def test_encode_decode_roundtrip():
+    pb, var = _priors()
+    rng = np.random.RandomState(1)
+    c = rng.uniform(0.3, 0.7, (8, 2))
+    s = rng.uniform(0.05, 0.2, (8, 2))
+    boxes = jnp.asarray(np.concatenate([c - s / 2, c + s / 2], 1).astype(np.float32))
+    enc = encode_ssd(pb, var, boxes)
+    dec = decode_ssd(pb, var, enc)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(boxes), atol=1e-5)
+
+
+def test_match_priors_forces_best_prior_per_gt():
+    pb = jnp.asarray([[0.0, 0.0, 0.2, 0.2],
+                      [0.4, 0.4, 0.6, 0.6],
+                      [0.7, 0.7, 0.9, 0.9]], jnp.float32)
+    # one gt overlapping prior 1 weakly (below threshold) — must still match
+    gt = jnp.asarray([[0.45, 0.45, 0.8, 0.8]], jnp.float32)
+    matched, is_pos = match_priors(pb, gt, jnp.asarray([True]), 0.99)
+    assert bool(is_pos.any())
+    assert int(matched[np.argmax(np.asarray(is_pos))]) == 0
+
+
+def test_match_priors_threshold():
+    pb = jnp.asarray([[0.0, 0.0, 0.5, 0.5],
+                      [0.5, 0.5, 1.0, 1.0]], jnp.float32)
+    gt = jnp.asarray([[0.0, 0.0, 0.5, 0.5],
+                      [0.5, 0.5, 1.0, 1.0]], jnp.float32)
+    matched, is_pos = match_priors(pb, gt, jnp.asarray([True, True]), 0.5)
+    assert bool(is_pos.all())
+    assert matched.tolist() == [0, 1]
+    # invalid gt never matches
+    _, is_pos2 = match_priors(pb, gt, jnp.asarray([True, False]), 0.5)
+    assert is_pos2.tolist() == [True, False]
+
+
+def test_padding_gt_does_not_clobber_force_match():
+    # regression: a padding row's scatter must not erase a valid gt's
+    # force-match on prior 0 (the padded-(N,G,5) normal case)
+    pb = jnp.asarray([[0.0, 0.0, 0.4, 0.4],
+                      [0.6, 0.6, 0.9, 0.9]], jnp.float32)
+    gt = jnp.asarray([[0.0, 0.0, 0.2, 0.2],        # best prior 0, IoU 0.25
+                      [0.0, 0.0, 0.0, 0.0]], jnp.float32)   # padding row
+    matched, is_pos = match_priors(pb, gt, jnp.asarray([True, False]), 0.5)
+    assert bool(is_pos[0]), "padding gt clobbered the valid force-match"
+    assert int(matched[0]) == 0
+
+
+def test_loss_zero_when_predictions_perfect():
+    pb, var = _priors(4, seed=2)
+    wire = jnp.concatenate([pb.reshape(1, 1, -1), var.reshape(1, 1, -1)], 1)
+    gt = np.full((1, 2, 5), -1, np.float32)
+    gt[0, 0] = [1, *np.asarray(pb[0])]          # gt exactly on prior 0
+    crit = nn.MultiBoxCriterion(n_classes=3, neg_pos_ratio=0.0)
+    # loc prediction = exact encoding (zeros), conf strongly right everywhere
+    loc = jnp.zeros((1, 4 * 4))
+    conf = np.full((1, 4, 3), 0.0, np.float32)
+    conf[0, :, 0] = 20.0                         # background everywhere...
+    conf[0, 0, 0] = 0.0
+    conf[0, 0, 1] = 20.0                         # ...except the matched prior
+    loss = float(crit.apply(Table(loc, jnp.asarray(conf.reshape(1, -1)), wire),
+                            jnp.asarray(gt)))
+    assert loss < 1e-3
+
+
+def test_hard_negative_mining_bounds_negatives():
+    pb, var = _priors(8, seed=3)
+    wire = jnp.concatenate([pb.reshape(1, 1, -1), var.reshape(1, 1, -1)], 1)
+    gt = np.full((1, 1, 5), -1, np.float32)
+    gt[0, 0] = [1, *np.asarray(pb[0])]
+    loc = jnp.zeros((1, 8 * 4))
+    conf = jnp.zeros((1, 8 * 3))                 # uniform: CE = log(3) each
+    full = nn.MultiBoxCriterion(n_classes=3, neg_pos_ratio=100.0)
+    mined = nn.MultiBoxCriterion(n_classes=3, neg_pos_ratio=1.0)
+    l_full = float(full.apply(Table(loc, conf, wire), jnp.asarray(gt)))
+    l_mined = float(mined.apply(Table(loc, conf, wire), jnp.asarray(gt)))
+    # 1 positive: mined keeps 1 neg (2*log3), full keeps all 7 (8*log3)
+    assert l_mined == pytest.approx(2 * np.log(3), rel=1e-4)
+    assert l_full == pytest.approx(8 * np.log(3), rel=1e-4)
+
+
+def test_tiny_ssd_learns_localization():
+    """End-to-end: conv trunk + PriorBox + MultiBox training localizes a
+    bright square; DetectionOutputSSD serves the trained head."""
+    from bigdl_tpu import Engine
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.optim import Adam, LocalOptimizer, Trigger
+
+    Engine.init(seed=0)
+    rng = np.random.RandomState(4)
+    img, cells = 32, 4
+    n_cls = 2                                     # bg + "square"
+
+    def make_sample():
+        x = rng.rand(1, img, img).astype(np.float32) * 0.1
+        cy, cx = rng.randint(0, cells), rng.randint(0, cells)
+        y0, x0 = cy * 8, cx * 8
+        x[0, y0 + 1:y0 + 7, x0 + 1:x0 + 7] = 1.0
+        gt = np.full((1, 5), -1, np.float32)
+        gt[0] = [1, (x0 + 1) / img, (y0 + 1) / img,
+                 (x0 + 7) / img, (y0 + 7) / img]
+        return Sample(x, gt)
+
+    prior_gen = nn.PriorBox([6.0], aspect_ratios=[], flip=False,
+                            img_h=img, img_w=img)   # 1 prior/cell
+    fmap = jnp.zeros((1, 1, cells, cells))
+    wire = prior_gen.forward(fmap)
+    n_priors = wire.shape[2] // 4
+
+    class SSDHead(nn.AbstractModule):
+        def __init__(self):
+            super().__init__()
+            self.trunk = nn.Sequential()
+            self.trunk.add(nn.SpatialConvolution(1, 8, 3, 3, pad_w=1, pad_h=1))
+            self.trunk.add(nn.ReLU())
+            self.trunk.add(nn.SpatialMaxPooling(8, 8))   # (8, cells, cells)
+            self.loc = nn.SpatialConvolution(8, 4, 1, 1)
+            self.conf = nn.SpatialConvolution(8, n_cls, 1, 1)
+            self._kids = {"trunk": self.trunk, "loc": self.loc,
+                          "conf": self.conf}
+
+        def get_params(self):
+            return {k: m.get_params() for k, m in self._kids.items()}
+
+        def set_params(self, p):
+            for k, m in self._kids.items():
+                m.set_params(p[k])
+
+        def get_state(self):
+            return {k: m.get_state() for k, m in self._kids.items()}
+
+        def set_state(self, s):
+            for k, m in self._kids.items():
+                m.set_state(s[k])
+
+        def apply(self, params, state, input, *, training=False, rng=None):
+            f, st = self.trunk.apply(params["trunk"], state["trunk"], input,
+                                     training=training, rng=rng)
+            loc, _ = self.loc.apply(params["loc"], state["loc"], f)
+            conf, _ = self.conf.apply(params["conf"], state["conf"], f)
+            n = loc.shape[0]
+            loc = loc.transpose(0, 2, 3, 1).reshape(n, -1)
+            conf = conf.transpose(0, 2, 3, 1).reshape(n, -1)
+            pw = jnp.broadcast_to(wire, (1,) + wire.shape[1:])
+            return Table(loc, conf, pw), {"trunk": st, "loc": state["loc"],
+                                          "conf": state["conf"]}
+
+    model = SSDHead()
+    data = DataSet.array([make_sample() for _ in range(64)]) \
+        >> SampleToMiniBatch(16)
+    opt = (LocalOptimizer(model, data, nn.MultiBoxCriterion(n_classes=n_cls))
+           .set_optim_method(Adam(learningrate=0.01))
+           .set_end_when(Trigger.max_epoch(30)))
+    opt.optimize()
+
+    # serve through DetectionOutputSSD: detection must land on the square
+    model.evaluate()
+    hits = 0
+    for _ in range(16):
+        s = make_sample()
+        out = model.forward(jnp.asarray(s.feature[0][None]))
+        det_head = nn.DetectionOutputSSD(n_classes=n_cls, keep_topk=1,
+                                         conf_thresh=0.01)
+        det = np.asarray(det_head.forward(out))[0, 0]
+        gt = s.label[0][0, 1:]
+        inter_x = max(0, min(det[4], gt[2]) - max(det[2], gt[0]))
+        inter_y = max(0, min(det[5], gt[3]) - max(det[3], gt[1]))
+        inter = inter_x * inter_y
+        a = (det[4] - det[2]) * (det[5] - det[3])
+        b = (gt[2] - gt[0]) * (gt[3] - gt[1])
+        iou = inter / max(a + b - inter, 1e-9)
+        hits += iou > 0.5
+    assert hits >= 13, f"trained SSD localized only {hits}/16 squares"

@@ -1,0 +1,1 @@
+from bigdl_tpu.models.ssd.ssd import SSD, PermuteFlatten, detector

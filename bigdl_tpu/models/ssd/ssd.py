@@ -1,0 +1,120 @@
+"""SSD — single-shot multibox detector built from the detection layer family.
+
+Reference parity: the reference ships the SSD *layers* (PriorBox /
+NormalizeScale / DetectionOutputSSD — SURVEY.md §2.1 layer zoo) but no SSD
+zoo model; this builder completes the family into a trainable/servable model
+the way the reference zoo wraps its other topologies. The graph follows the
+SSD paper's shape: shared conv trunk, per-scale loc/conf 3×3 heads, priors
+generated per scale, concatenated into the Caffe wire format
+``Table(loc (N, P*4), conf (N, P*C), priors (1, 2, P*4))`` — exactly what
+:class:`~bigdl_tpu.nn.MultiBoxCriterion` trains against and
+:class:`~bigdl_tpu.nn.DetectionOutputSSD` serves from.
+
+TPU shape notes: every scale contributes a static number of priors, so the
+concatenated wire tensors are fixed-shape; the priors are trace-time
+constants (PriorBox); the whole model jits as one program in either image
+layout (the permute-flatten respects ``nn.layout``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from bigdl_tpu import nn
+
+
+class PermuteFlatten(nn.TensorModule):
+    """(N, C, H, W) → (N, H*W*C) in Caffe head order (y, x, anchor, coord):
+    channels move innermost so the flattened vector interleaves per-location
+    blocks in the same order PriorBox emits priors. Under the NHWC layout
+    flag the conv output is already channel-last — flatten directly."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.nn import layout
+        x = input
+        if not layout.is_nhwc():
+            x = x.transpose(0, 2, 3, 1)
+        return x.reshape(x.shape[0], -1), state
+
+
+def _conv_block(c_in: int, c_out: int, stride_pool: bool = True) -> nn.Sequential:
+    b = nn.Sequential()
+    b.add(nn.SpatialConvolution(c_in, c_out, 3, 3, pad_w=1, pad_h=1))
+    b.add(nn.ReLU())
+    b.add(nn.SpatialConvolution(c_out, c_out, 3, 3, pad_w=1, pad_h=1))
+    b.add(nn.ReLU())
+    if stride_pool:
+        b.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+    return b
+
+
+def SSD(n_classes: int, img_size: int = 64,
+        base_channels: Sequence[int] = (16, 32, 64),
+        min_sizes: Optional[Sequence[float]] = None,
+        aspect_ratios: Sequence[float] = ()) -> nn.Graph:
+    """Two-scale SSD graph. ``n_classes`` INCLUDES background (label 0).
+
+    Scales: stride-8 (``img_size/8`` cells) and stride-16 features. With the
+    default empty ``aspect_ratios`` each cell carries one prior per
+    ``min_size`` entry; pass ratios for the paper's multi-anchor heads.
+    Output: ``Table(loc, conf, priors)`` wire format.
+    """
+    if img_size % 16 != 0:
+        raise ValueError("img_size must be divisible by 16 (two stride scales)")
+    if min_sizes is None:
+        min_sizes = [img_size * 0.15, img_size * 0.4]
+    if len(min_sizes) != 2:
+        raise ValueError("min_sizes must give one size per scale (2)")
+    c1, c2, c3 = base_channels
+
+    inp = nn.Input()
+    # trunk: three stride-2 stages → stride-8 feature map
+    s8 = nn.Sequential()
+    s8.add(_conv_block(3, c1))
+    s8.add(_conv_block(c1, c2))
+    s8.add(_conv_block(c2, c3))
+    feat8 = s8.set_name("trunk_s8").inputs(inp)
+    norm8 = nn.NormalizeScale(p=2.0, scale=20.0, size=c3) \
+        .set_name("norm_s8").inputs(feat8)
+    # extra stage → stride-16
+    feat16 = _conv_block(c3, c3).set_name("trunk_s16").inputs(feat8)
+
+    locs, confs, priors = [], [], []
+    for tag, node, ms in (("s8", norm8, min_sizes[0]),
+                          ("s16", feat16, min_sizes[1])):
+        pb = nn.PriorBox([ms], aspect_ratios=list(aspect_ratios), flip=True,
+                         img_h=img_size, img_w=img_size)
+        a = pb.num_priors
+        loc = nn.SpatialConvolution(c3, a * 4, 3, 3, pad_w=1, pad_h=1) \
+            .set_name(f"loc_{tag}").inputs(node)
+        conf = nn.SpatialConvolution(c3, a * n_classes, 3, 3, pad_w=1, pad_h=1) \
+            .set_name(f"conf_{tag}").inputs(node)
+        locs.append(PermuteFlatten().inputs(loc))
+        confs.append(PermuteFlatten().inputs(conf))
+        priors.append(pb.set_name(f"priors_{tag}").inputs(node))
+
+    loc_all = nn.JoinTable(2).set_name("loc_cat").inputs(*locs)
+    conf_all = nn.JoinTable(2).set_name("conf_cat").inputs(*confs)
+    prior_all = nn.JoinTable(3).set_name("prior_cat").inputs(*priors)
+    return nn.Graph([inp], [loc_all, conf_all, prior_all])
+
+
+# portable serialization: the head-order flatten is model-private but must
+# round-trip inside saved SSD archives like any other module
+from bigdl_tpu.utils.serializer import register as _register_serializable  # noqa: E402
+
+_register_serializable(PermuteFlatten)
+
+
+def detector(model: nn.Graph, n_classes: int, keep_topk: int = 20,
+             conf_thresh: float = 0.3, nms_thresh: float = 0.45):
+    """Wrap a trained SSD graph with DetectionOutputSSD for serving: returns
+    a callable image-batch → (N, keep_topk, 6) detections."""
+    head = nn.DetectionOutputSSD(n_classes=n_classes, keep_topk=keep_topk,
+                                 conf_thresh=conf_thresh, nms_thresh=nms_thresh)
+
+    def run(images):
+        model.evaluate()
+        return head.forward(model.forward(images))
+
+    return run

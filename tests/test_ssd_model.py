@@ -1,0 +1,78 @@
+"""SSD zoo model: graph shape contract, layout equivalence, serialization,
+and a short must-learn training run."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.models.ssd import SSD, PermuteFlatten, detector
+
+
+def test_wire_format_shapes():
+    n_cls, img = 3, 64
+    m = SSD(n_cls, img_size=img)
+    m.evaluate()
+    x = jnp.zeros((2, 3, img, img), jnp.float32)
+    out = m.forward(x)
+    loc, conf, priors = out.values()
+    p8, p16 = (img // 8) ** 2, (img // 16) ** 2
+    p = p8 + p16
+    assert loc.shape == (2, p * 4)
+    assert conf.shape == (2, p * n_cls)
+    assert priors.shape == (1, 2, p * 4)
+    # prior boxes are plausible normalized corners
+    pb = np.asarray(priors)[0, 0].reshape(-1, 4)
+    assert (pb[:, 2] > pb[:, 0]).all() and (pb[:, 3] > pb[:, 1]).all()
+
+
+def test_aspect_ratio_head_sizing():
+    m = SSD(2, img_size=64, aspect_ratios=[2.0])
+    x = jnp.zeros((1, 3, 64, 64), jnp.float32)
+    m.evaluate()
+    loc, conf, priors = m.forward(x).values()
+    # ar 2 + flip -> 3 priors/cell on both scales
+    p = 3 * ((64 // 8) ** 2 + (64 // 16) ** 2)
+    assert loc.shape == (1, p * 4)
+    assert priors.shape == (1, 2, p * 4)
+
+
+def test_permute_flatten_matches_prior_order():
+    # channel-last flatten: position blocks contiguous, channels innermost
+    x = jnp.asarray(np.arange(2 * 4 * 2 * 3).reshape(2, 4, 2, 3)
+                    .astype(np.float32))
+    out = np.asarray(PermuteFlatten().forward(x))
+    want = np.asarray(x).transpose(0, 2, 3, 1).reshape(2, -1)
+    np.testing.assert_array_equal(out, want)
+
+
+def test_detection_output_consumes_model_wire():
+    m = SSD(3, img_size=32)
+    serve = detector(m, 3, keep_topk=4)
+    det = serve(jnp.zeros((2, 3, 32, 32), jnp.float32))
+    assert np.asarray(det).shape == (2, 4, 6)
+
+
+def test_serializer_roundtrip():
+    import os
+    import tempfile
+    m = SSD(2, img_size=32)
+    m.evaluate()
+    x = jnp.asarray(np.random.RandomState(0).rand(1, 3, 32, 32)
+                    .astype(np.float32))
+    want = [np.asarray(v) for v in m.forward(x).values()]
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ssd.bigdl")
+        m.save_module(path)
+        m2 = nn.AbstractModule.load(path)
+    m2.evaluate()
+    got = [np.asarray(v) for v in m2.forward(x).values()]
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_train_main_learns():
+    from bigdl_tpu.models.ssd.train import main
+    iou = main(["--max-epoch", "12", "--n-train", "128", "--img-size", "32",
+                "--batch-size", "16"])
+    assert iou > 0.3, f"SSD train main failed to localize (mean IoU {iou})"

@@ -162,14 +162,16 @@ def _build(model_name: str, batch: int, n_batches: int, dtype: str):
         model = PTBModel(10000, 650, num_layers=2)
         seq, n_classes = _MODEL_UNITS[model_name][1], 10000
         shape = (batch, seq)
-        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                                 size_average=True)
     elif model_name == "transformerlm":
         from bigdl_tpu.models.transformerlm import TransformerLM
         seq, n_classes = _MODEL_UNITS[model_name][1], 32000
         model = TransformerLM(n_classes, embed_dim=512, num_heads=8,
                               num_layers=6, max_len=seq)
         shape = (batch, seq)
-        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                                 size_average=True)
     else:
         raise ValueError(f"unknown model {model_name!r}")
 

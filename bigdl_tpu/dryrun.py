@@ -178,7 +178,8 @@ def dryrun_multichip(n_devices: int) -> None:
                    for _ in range(4 * n_devices)]
         data = DataSet.array(samples, distributed=True) \
             >> SampleToMiniBatch(2 * n_devices)
-        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                                 size_average=True)
         opt = (DistriOptimizer(model, data, crit)
                .set_optim_method(SGD(learningrate=0.05, momentum=0.9,
                                      dampening=0.0))

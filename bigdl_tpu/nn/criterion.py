@@ -103,6 +103,12 @@ class CrossEntropyCriterion(AbstractCriterion):
         super().__init__()
         self.inner = ClassNLLCriterion(weights, size_average, one_based=one_based)
 
+    @property
+    def size_average(self) -> bool:
+        # averaging lives on the wrapped ClassNLL; expose it so wrappers
+        # (TimeDistributedCriterion) classify this criterion correctly
+        return self.inner.size_average
+
     def apply(self, input, target):
         return self.inner.apply(jax.nn.log_softmax(input, axis=-1), target)
 
@@ -467,13 +473,19 @@ class TimeDistributedCriterion(AbstractCriterion):
         self.size_average = size_average
 
     def apply(self, input, target):
+        # Reference semantics: loss = Σ_t inner(input[:, t], target[:, t]),
+        # divided by T when size_average. Flattening time into batch computes
+        # the same thing in ONE inner call, but the rescale depends on
+        # whether the inner criterion itself averages: an averaging inner on
+        # the flat (N*T, ...) batch already IS the size_average result (the
+        # old code divided by T a second time, shrinking LM losses T-fold).
         t_steps = input.shape[1]
         flat_in = input.reshape((-1,) + input.shape[2:])
         flat_t = target.reshape((-1,) + target.shape[2:])
         loss = self.criterion.apply(flat_in, flat_t)
-        if not self.size_average:
-            return loss
-        return loss / t_steps
+        if bool(getattr(self.criterion, "size_average", False)):
+            return loss if self.size_average else loss * t_steps
+        return loss / t_steps if self.size_average else loss
 
 
 class MultiCriterion(AbstractCriterion):

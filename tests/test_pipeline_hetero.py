@@ -113,7 +113,8 @@ class TestHeteroTraining:
         Engine.init(mesh_shape=(2, 4), mesh_axes=("data", "pipe"), seed=0)
         RandomGenerator.set_seed(0)
         g = GPipe(stages=_lm_stages(), n_microbatches=2)
-        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
         rng = np.random.default_rng(3)
         x = jnp.asarray(rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32))
         y = jnp.asarray(rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32))

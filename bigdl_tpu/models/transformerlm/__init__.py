@@ -1,3 +1,3 @@
 from bigdl_tpu.models.transformerlm.transformerlm import (
-    PositionEmbedding, TransformerBlock, TransformerLM,
+    PositionEmbedding, TransformerBlock, TransformerLM, lm_criterion,
 )

@@ -24,6 +24,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-layers", type=int, default=2)
     p.add_argument("--vocab-size", type=int, default=256)
     p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--fused-head", action="store_true",
+                   help="FusedLMHead + chunked softmax CE: the large-vocab "
+                        "memory path (logits never materialized in training)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block (fit deeper/longer in HBM)")
     p.add_argument("--max-iteration", type=int, default=8)
@@ -42,7 +45,7 @@ def main(argv=None):
     from bigdl_tpu import nn
     from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
     from bigdl_tpu.dataset.text import ptb_windows, synthetic_ptb
-    from bigdl_tpu.models.transformerlm import TransformerLM
+    from bigdl_tpu.models.transformerlm import TransformerLM, lm_criterion
     from bigdl_tpu.optim import Adam, DistriOptimizer, LocalOptimizer, Trigger
     from bigdl_tpu.utils.engine import Engine
     from bigdl_tpu.utils.random_generator import RandomGenerator
@@ -66,9 +69,9 @@ def main(argv=None):
 
     model = TransformerLM(args.vocab_size, args.embed_dim, args.num_heads,
                           args.num_layers, max_len=args.seq_len,
-                          dropout=args.dropout, remat=args.remat)
-    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
-                                            size_average=True)
+                          dropout=args.dropout, remat=args.remat,
+                          fused_head=args.fused_head)
+    criterion = lm_criterion(fused_head=args.fused_head)
     cls = DistriOptimizer if args.distributed else LocalOptimizer
     opt = (cls(model, data, criterion)
            .set_optim_method(Adam(learningrate=args.learning_rate))

@@ -84,8 +84,15 @@ def TransformerLM(vocab_size: int, embed_dim: int = 256, num_heads: int = 4,
                   num_layers: int = 4, max_len: int = 1024,
                   mlp_ratio: int = 4, dropout: float = 0.0,
                   remat: bool = False,
-                  attention_impl: str = "auto") -> nn.Sequential:
-    """Token ids (N, T) int32 → per-position log-probs (N, T, vocab)."""
+                  attention_impl: str = "auto",
+                  fused_head: bool = False) -> nn.Sequential:
+    """Token ids (N, T) int32 → per-position log-probs (N, T, vocab).
+
+    ``fused_head=True`` swaps the ``Linear >> LogSoftMax`` decoder for
+    :class:`~bigdl_tpu.nn.FusedLMHead`: training streams the loss over vocab
+    chunks (pair with :func:`lm_criterion`) so the (N, T, vocab) logits
+    tensor is never materialized — the large-vocab memory path; eval output
+    stays per-position log-probs either way."""
     model = (nn.Sequential()
              .add(nn.LookupTable(vocab_size, embed_dim, zero_based=True)
                   .set_name("embedding"))
@@ -97,7 +104,19 @@ def TransformerLM(vocab_size: int, embed_dim: int = 256, num_heads: int = 4,
             block = nn.Remat(block)
         model.add(block.set_name(f"block{i + 1}"))
     model.add(nn.LayerNorm(embed_dim).set_name("final_norm"))
-    model.add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size))
-              .set_name("decoder"))
-    model.add(nn.TimeDistributed(nn.LogSoftMax()))
+    if fused_head:
+        model.add(nn.FusedLMHead(embed_dim, vocab_size, eval_log_probs=True)
+                  .set_name("decoder"))
+    else:
+        model.add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size))
+                  .set_name("decoder"))
+        model.add(nn.TimeDistributed(nn.LogSoftMax()))
     return model
+
+
+def lm_criterion(fused_head: bool = False, chunk_size: int = 8192):
+    """The training criterion matching :func:`TransformerLM`'s head choice."""
+    if fused_head:
+        return nn.ChunkedSoftmaxCrossEntropy(chunk_size=chunk_size)
+    return nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                       size_average=True)

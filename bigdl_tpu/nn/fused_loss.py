@@ -167,12 +167,17 @@ class FusedLMHead(TensorModule):
     def __init__(self, hidden_size: int, vocab_size: int,
                  with_bias: bool = True,
                  w_init: Optional[InitializationMethod] = None,
-                 b_init: Optional[InitializationMethod] = None):
+                 b_init: Optional[InitializationMethod] = None,
+                 eval_log_probs: bool = False):
         super().__init__()
         self.hidden_size, self.vocab_size = int(hidden_size), int(vocab_size)
         self.with_bias = with_bias
         self.w_init = w_init or Xavier()
         self.b_init = b_init or Zeros()
+        # eval_log_probs=True makes the eval head a drop-in for the
+        # Linear >> LogSoftMax pair (beam-search score sums need log-probs,
+        # not raw logits)
+        self.eval_log_probs = bool(eval_log_probs)
         self.reset()
 
     def reset(self):
@@ -200,6 +205,8 @@ class FusedLMHead(TensorModule):
         logits = input @ w.T
         if b is not None:
             logits = logits + b
+        if self.eval_log_probs:
+            logits = jax.nn.log_softmax(logits, axis=-1)
         return logits, state
 
     def __repr__(self):

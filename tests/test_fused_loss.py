@@ -178,6 +178,45 @@ def test_fused_loss_value_equals_unfused():
     assert got == pytest.approx(want, rel=1e-5)
 
 
+def test_transformerlm_fused_head_matches_standard():
+    """fused_head=True must produce the same training loss and the same
+    eval log-probs as the Linear>>LogSoftMax head given equal weights."""
+    from bigdl_tpu.models.transformerlm import TransformerLM, lm_criterion
+
+    rng = np.random.RandomState(9)
+    v, e, t = 23, 16, 8
+    std = TransformerLM(v, embed_dim=e, num_heads=2, num_layers=1, max_len=t)
+    fused = TransformerLM(v, embed_dim=e, num_heads=2, num_layers=1,
+                          max_len=t, fused_head=True)
+    # copy the standard model's weights into the fused one, child by child
+    # (the head weight is the same (V, E) matrix in both layouts; std nests
+    # it inside TimeDistributed(Linear))
+    std_by_name = {m.name: m for m in std.modules}
+    for m in fused.modules:
+        if m.name == "decoder":
+            leaves = jax.tree_util.tree_leaves_with_path(
+                std_by_name["decoder"].get_params())
+            flat = {jax.tree_util.keystr(k): v_ for k, v_ in leaves}
+            m.set_params({
+                "weight": [v_ for k, v_ in flat.items() if "weight" in k][0],
+                "bias": [v_ for k, v_ in flat.items() if "bias" in k][0]})
+        elif m.name in std_by_name:
+            m.set_params(std_by_name[m.name].get_params())
+
+    x = jnp.asarray(rng.randint(0, v, (2, t)).astype(np.int32))
+    y = jnp.asarray(rng.randint(0, v, (2, t)).astype(np.int32))
+
+    std.training(); fused.training()
+    l_std = float(lm_criterion(False).apply(std.forward(x), y))
+    l_fused = float(lm_criterion(True, chunk_size=7).apply(fused.forward(x), y))
+    assert l_fused == pytest.approx(l_std, rel=1e-5)
+
+    std.evaluate(); fused.evaluate()
+    np.testing.assert_allclose(np.asarray(fused.forward(x)),
+                               np.asarray(std.forward(x)), rtol=1e-4,
+                               atol=1e-5)
+
+
 def test_tied_embed_shares_one_weight_leaf():
     """Tying = reusing the head instance: embed() and the head read the same
     params leaf, so one gradient leaf receives both contributions."""

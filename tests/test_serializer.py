@@ -32,6 +32,23 @@ def _seq(*layers):
 # class name → (factory, sample_input). Factories are thunks so each test run
 # builds fresh instances under a fixed seed.
 EXAMPLES = {
+    # round-4 detection family + fused LM head
+    "NormalizeScale": (lambda: nn.NormalizeScale(size=3), _x(1, 3, 4, 4)),
+    "PriorBox": (lambda: nn.PriorBox([30.0], [60.0], [2.0],
+                                     img_h=300, img_w=300), _x(1, 3, 4, 4)),
+    "Anchor": (lambda: nn.Anchor(), _x(1, 3, 4, 4)),
+    "Proposal": (
+        lambda: nn.Proposal(pre_nms_topn=50, post_nms_topn=8, rpn_min_size=2),
+        Table(jnp.abs(_x(1, 18, 4, 4)), 0.1 * _x(1, 36, 4, 4),
+              jnp.asarray([[64.0, 64.0, 1.0]]))),
+    "DetectionOutputSSD": (
+        lambda: nn.DetectionOutputSSD(n_classes=3, keep_topk=4),
+        Table(jnp.zeros((1, 8)),
+              _x(1, 6),
+              jnp.asarray(np.stack([
+                  np.array([0.1, 0.1, 0.4, 0.4, 0.5, 0.5, 0.8, 0.8], np.float32),
+                  np.tile([0.1, 0.1, 0.2, 0.2], 2).astype(np.float32)])[None]))),
+    "FusedLMHead": (lambda: nn.FusedLMHead(6, 11).evaluate(), _x(2, 6)),
     # round-4 sparse family tail
     "DenseToSparse": (lambda: nn.DenseToSparse(k=2), _x(2, 6)),
     "SparseJoinTable": (

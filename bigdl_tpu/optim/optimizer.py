@@ -73,6 +73,7 @@ class Optimizer:
         self.summary_trigger: Optional[Trigger] = None
         self.grad_clip_const: Optional[tuple[float, float]] = None
         self.grad_clip_norm: Optional[float] = None
+        self.grad_accum: int = 1       # set_gradient_accumulation(n)
         # Auxiliary-loss convention: modules that declare an ``aux_loss`` leaf
         # in their state (MoE load balancing, parallel/moe.py) get it added to
         # the training objective scaled by this weight. 0.01 is the Switch
@@ -264,6 +265,26 @@ class Optimizer:
         self._step_cache = None
         return self
 
+    def set_gradient_accumulation(self, n_micro: int) -> "Optimizer":
+        """Split every mini-batch into ``n_micro`` microbatches inside the
+        compiled step (``lax.scan``), averaging gradients before the single
+        optimizer update — ~1/n the activation memory, the TPU lever for
+        large effective batches; no reference analog (the reference's
+        effective batch grows with Spark partitions instead).
+
+        Numerically the same update as the full batch for unweighted mean-
+        or sum-reduced losses; criteria that normalize by a PER-BATCH
+        quantity (class-weighted ClassNLL's weight-sum denominator, masked
+        criteria's valid-count) divide per microbatch instead, so their
+        accumulated update can differ under imbalance. Batch size must be
+        divisible by ``n_micro``. BN batch statistics see each microbatch
+        separately (the standard grad-accumulation semantics)."""
+        if n_micro < 1:
+            raise ValueError("n_micro must be >= 1")
+        self.grad_accum = int(n_micro)
+        self._step_cache = None
+        return self
+
     # ------------------------------------------------------------- compile
     def _clip_grads(self, grads):
         if self.grad_clip_const is not None:
@@ -317,19 +338,20 @@ class Optimizer:
         compute_dtype = Engine.compute_dtype()
         mixed = compute_dtype != jnp.float32
 
-        def step(params, mstate, ostate, step_idx, inp, target, base_rng):
-            rng = jax.random.fold_in(base_rng, step_idx) if needs_rng else None
+        accum = self.grad_accum
 
-            def loss_fn(p):
-                x = inp
+        def step(params, mstate, ostate, step_idx, inp, target, base_rng):
+            rng0 = jax.random.fold_in(base_rng, step_idx) if needs_rng else None
+
+            def loss_fn(p, ms, x, t, rng):
                 if mixed:
                     p = cast_floating(p, compute_dtype)
                     x = cast_floating(x, compute_dtype)
-                out, new_ms = model.apply(p, mstate, x, training=True, rng=rng)
+                out, new_ms = model.apply(p, ms, x, training=True, rng=rng)
                 if mixed:
                     out = cast_floating(out, jnp.float32)
                     new_ms = cast_floating(new_ms, jnp.float32)
-                loss = criterion.apply(out, target)
+                loss = criterion.apply(out, t)
                 aux, pen = collect_state_losses(new_ms)
                 if aux is not None and aux_w:
                     loss = loss + aux_w * aux
@@ -339,7 +361,43 @@ class Optimizer:
                     loss = loss + model.regularizer_penalty(p)
                 return loss, new_ms
 
-            (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            vg = jax.value_and_grad(loss_fn, has_aux=True)
+            if accum == 1:
+                (loss, new_ms), grads = vg(params, mstate, inp, target, rng0)
+            else:
+                # gradient accumulation: scan microbatches, averaging grads —
+                # one optimizer update, ~1/accum the activation memory
+                def micro_split(t):
+                    def split(a):
+                        if a.shape[0] % accum:
+                            raise ValueError(
+                                f"batch size {a.shape[0]} is not divisible "
+                                f"by set_gradient_accumulation({accum})")
+                        return a.reshape((accum, a.shape[0] // accum)
+                                         + a.shape[1:])
+                    return jax.tree_util.tree_map(split, t)
+
+                def body(carry, xt):
+                    ms, gsum, lsum = carry
+                    x_mb, t_mb, i = xt
+                    rng = (jax.random.fold_in(rng0, i) if needs_rng else None)
+                    (l, ms2), g = vg(params, ms, x_mb, t_mb, rng)
+                    gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                    return (ms2, gsum, lsum + l), None
+
+                xs = (micro_split(inp), micro_split(target),
+                      jnp.arange(accum, dtype=jnp.int32))
+                # microbatch 0 unrolled: some modules materialize state
+                # structure on first apply, which a scan carry cannot morph
+                first = jax.tree_util.tree_map(lambda a: a[0], xs)
+                (l0, ms1), g0 = vg(params, mstate, first[0], first[1],
+                                   (jax.random.fold_in(rng0, 0)
+                                    if needs_rng else None))
+                rest = jax.tree_util.tree_map(lambda a: a[1:], xs)
+                (new_ms, gsum, lsum), _ = jax.lax.scan(
+                    body, (ms1, g0, l0), rest)
+                grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
+                loss = lsum / accum
             if scale_tree is not None:
                 grads = jax.tree_util.tree_map(
                     lambda g, s: g * s, grads, scale_tree)

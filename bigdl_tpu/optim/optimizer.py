@@ -279,8 +279,8 @@ class Optimizer:
         accumulated update can differ under imbalance. Batch size must be
         divisible by ``n_micro``. BN batch statistics see each microbatch
         separately (the standard grad-accumulation semantics)."""
-        if n_micro < 1:
-            raise ValueError("n_micro must be >= 1")
+        if n_micro != int(n_micro) or int(n_micro) < 1:
+            raise ValueError(f"n_micro must be a positive integer, got {n_micro!r}")
         self.grad_accum = int(n_micro)
         self._step_cache = None
         return self
@@ -373,8 +373,14 @@ class Optimizer:
                             raise ValueError(
                                 f"batch size {a.shape[0]} is not divisible "
                                 f"by set_gradient_accumulation({accum})")
-                        return a.reshape((accum, a.shape[0] // accum)
-                                         + a.shape[1:])
+                        # STRIDED split (microbatch i = rows i::accum): under
+                        # DistriOptimizer's data-sharded batch each micro
+                        # keeps rows on their original devices (a contiguous
+                        # reshape would force a per-step all-to-all); the
+                        # assignment is numerically irrelevant to the
+                        # averaged gradient
+                        return a.reshape((a.shape[0] // accum, accum)
+                                         + a.shape[1:]).swapaxes(0, 1)
                     return jax.tree_util.tree_map(split, t)
 
                 def body(carry, xt):
@@ -396,8 +402,15 @@ class Optimizer:
                 rest = jax.tree_util.tree_map(lambda a: a[1:], xs)
                 (new_ms, gsum, lsum), _ = jax.lax.scan(
                     body, (ms1, g0, l0), rest)
-                grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
-                loss = lsum / accum
+                # averaging criteria: mean of micro means == full-batch mean;
+                # summing criteria: the micro sums already ARE the full-batch
+                # sum — dividing again would shrink the update accum-fold
+                crit_averages = bool(getattr(criterion, "size_average", True))
+                if crit_averages:
+                    grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
+                    loss = lsum / accum
+                else:
+                    grads, loss = gsum, lsum
             if scale_tree is not None:
                 grads = jax.tree_util.tree_map(
                     lambda g, s: g * s, grads, scale_tree)

@@ -58,6 +58,30 @@ def test_local_matches_full_batch(accum):
                                    atol=1e-6, err_msg=str(k1))
 
 
+def test_sum_reduced_criterion_matches_full_batch():
+    """size_average=False (summing) criteria: micro sums already total the
+    full-batch sum — the update must not shrink accum-fold."""
+    def train(accum):
+        Engine.reset()
+        Engine.init(seed=0)
+        opt = (LocalOptimizer(_model(), _data(),
+                              nn.ClassNLLCriterion(size_average=False))
+               .set_optim_method(SGD(learningrate=0.005))
+               .set_gradient_accumulation(accum)
+               .set_end_when(Trigger.max_iteration(3)))
+        opt.optimize()
+        return float(opt.state["loss"]), opt.model.get_params()
+
+    l1, p1 = train(1)
+    l4, p4 = train(4)
+    assert l4 == pytest.approx(l1, rel=1e-4)
+    import jax
+    for (k, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(p1),
+                              jax.tree_util.tree_leaves_with_path(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-6, err_msg=str(k))
+
+
 def test_distri_matches_full_batch():
     loss1, _ = _train(DistriOptimizer, 1)
     loss4, _ = _train(DistriOptimizer, 4)
@@ -71,7 +95,7 @@ def test_indivisible_batch_raises():
            .set_optim_method(SGD(learningrate=0.1))
            .set_gradient_accumulation(4)
            .set_end_when(Trigger.max_iteration(1)))
-    with pytest.raises(Exception):
+    with pytest.raises(ValueError, match="not divisible"):
         opt.optimize()
 
 

@@ -1,0 +1,106 @@
+"""The migration journey, chained: a Torch7 model file → load_torch →
+distributed fine-tune (FSDP + gradient accumulation) → int8 quantize →
+Predictor serving → portable archive round trip. Each feature is tested
+alone elsewhere; this pins that the seams between them hold."""
+
+import os
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.optim import SGD, Trigger
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _make_t7_model(path):
+    """A 'legacy Torch' conv net, written as .t7 by our exporter (the byte
+    format itself is pinned against a hand-encoder in test_torchfile)."""
+    RandomGenerator.set_seed(42)
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(1, 8, 3, 3, pad_w=1, pad_h=1))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(2, 2))
+    m.add(nn.Reshape([8 * 7 * 7]))
+    m.add(nn.Linear(8 * 7 * 7, 4))
+    m.add(nn.LogSoftMax())
+    m.save_torch(path)
+    return m
+
+
+def _task_data(n=128, batch=32, seed=0):
+    """4-class task: quadrant of the bright blob in a 14x14 image."""
+    rng = np.random.RandomState(seed)
+    samples = []
+    for _ in range(n):
+        cls = rng.randint(0, 4)
+        x = rng.rand(1, 14, 14).astype(np.float32) * 0.2
+        y0, x0 = (cls // 2) * 7, (cls % 2) * 7
+        x[0, y0 + 1:y0 + 6, x0 + 1:x0 + 6] += 1.0
+        samples.append(Sample(x, np.int32(cls)))
+    return samples
+
+
+def test_journey_torch7_finetune_quantize_serve_archive():
+    Engine.reset()
+    Engine.init(seed=0)
+
+    with tempfile.TemporaryDirectory() as d:
+        t7 = os.path.join(d, "legacy.t7")
+        _make_t7_model(t7)
+
+        # 1) import the legacy Torch file
+        model = nn.AbstractModule.load_torch(t7)
+
+        # 2) distributed fine-tune: FSDP weights + gradient accumulation
+        data = (DataSet.array(_task_data(), distributed=True)
+                >> SampleToMiniBatch(32))
+        opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion(),
+                               parameter_sync="fsdp")
+               .set_optim_method(SGD(learningrate=0.3, momentum=0.9,
+                                     dampening=0.0))
+               .set_gradient_accumulation(2)
+               .set_end_when(Trigger.max_epoch(10)))
+        opt.optimize()
+
+        # the fine-tune must actually learn the task
+        model.evaluate()
+        test = _task_data(n=64, seed=7)
+        x = jnp.asarray(np.stack([s.feature[0] for s in test]))
+        y = np.asarray([int(s.label[0]) for s in test])
+        acc = (np.asarray(model.forward(x)).argmax(-1) == y).mean()
+        assert acc > 0.9, f"fine-tune failed (acc={acc})"
+
+        # 3) int8 weight quantization keeps the accuracy
+        q = model.quantize(mode="weight_only")
+        q.evaluate()
+        qacc = (np.asarray(q.forward(x)).argmax(-1) == y).mean()
+        assert qacc > 0.85, f"quantized accuracy collapsed (acc={qacc})"
+
+        # 4) serve through the Predictor path
+        pred = q.predict_class(DataSet.array(test) >> SampleToMiniBatch(16))
+        pred = np.asarray(list(pred)).reshape(-1)[:len(y)]
+        assert (pred == y).mean() > 0.85
+
+        # 5) portable archive round trip of the QUANTIZED model
+        arc = os.path.join(d, "served.bigdl")
+        q.save_module(arc)
+        q2 = nn.AbstractModule.load(arc)
+        q2.evaluate()
+        np.testing.assert_allclose(np.asarray(q2.forward(x)),
+                                   np.asarray(q.forward(x)),
+                                   rtol=1e-5, atol=1e-6)
+
+        # 6) and the fine-tuned model exports BACK to Torch7
+        back = os.path.join(d, "back.t7")
+        model.save_torch(back)
+        m3 = nn.AbstractModule.load_torch(back)
+        m3.evaluate()
+        np.testing.assert_allclose(np.asarray(m3.forward(x)),
+                                   np.asarray(model.forward(x)),
+                                   rtol=1e-4, atol=1e-5)

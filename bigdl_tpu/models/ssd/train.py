@@ -77,12 +77,9 @@ def main(argv=None):
     for s in test:
         det = np.asarray(serve(jnp.asarray(s.feature[0][None])))[0, 0]
         gt = s.label[0][0]
-        ix = max(0.0, min(det[4], gt[3]) - max(det[2], gt[1]))
-        iy = max(0.0, min(det[5], gt[4]) - max(det[3], gt[2]))
-        inter = ix * iy
-        a = max(det[4] - det[2], 0) * max(det[5] - det[3], 0)
-        b = (gt[3] - gt[1]) * (gt[4] - gt[2])
-        ious.append(inter / max(a + b - inter, 1e-9))
+        iou = float(nn.pairwise_iou(jnp.asarray(det[None, 2:]),
+                                    jnp.asarray(gt[None, 1:]))[0, 0])
+        ious.append(iou)
         cls_ok += int(det[0] == gt[0])
     print(f"held-out mean IoU: {np.mean(ious):.3f}  "
           f"class acc: {cls_ok / len(test):.3f}")

@@ -96,6 +96,26 @@ def decode_ssd(priors: jnp.ndarray, variances: jnp.ndarray,
     return jnp.stack([cx - w * 0.5, cy - h * 0.5, cx + w * 0.5, cy + h * 0.5], axis=1)
 
 
+def encode_ssd(priors: jnp.ndarray, variances: jnp.ndarray,
+               boxes: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`decode_ssd` (pinned by test): corner-form ``boxes``
+    (P, 4) → variance-scaled center-size deltas against the priors. Training
+    targets for MultiBoxCriterion."""
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    pcx = (priors[:, 0] + priors[:, 2]) * 0.5
+    pcy = (priors[:, 1] + priors[:, 3]) * 0.5
+    bw = jnp.maximum(boxes[:, 2] - boxes[:, 0], 1e-8)
+    bh = jnp.maximum(boxes[:, 3] - boxes[:, 1], 1e-8)
+    bcx = (boxes[:, 0] + boxes[:, 2]) * 0.5
+    bcy = (boxes[:, 1] + boxes[:, 3]) * 0.5
+    dx = (bcx - pcx) / pw / variances[:, 0]
+    dy = (bcy - pcy) / ph / variances[:, 1]
+    dw = jnp.log(bw / pw) / variances[:, 2]
+    dh = jnp.log(bh / ph) / variances[:, 3]
+    return jnp.stack([dx, dy, dw, dh], axis=1)
+
+
 def decode_rcnn(anchors: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
     """Faster-R-CNN box decode (unit variances, +1 width convention)."""
     aw = anchors[:, 2] - anchors[:, 0] + 1.0

@@ -22,27 +22,8 @@ import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.nn.criterion import AbstractCriterion
-from bigdl_tpu.nn.detection import pairwise_iou
+from bigdl_tpu.nn.detection import encode_ssd, pairwise_iou
 from bigdl_tpu.utils.table import Table
-
-
-def encode_ssd(priors: jnp.ndarray, variances: jnp.ndarray,
-               boxes: jnp.ndarray) -> jnp.ndarray:
-    """Inverse of detection.decode_ssd: corner-form ``boxes`` (P, 4) →
-    variance-scaled center-size deltas against corner-form ``priors``."""
-    pw = priors[:, 2] - priors[:, 0]
-    ph = priors[:, 3] - priors[:, 1]
-    pcx = (priors[:, 0] + priors[:, 2]) * 0.5
-    pcy = (priors[:, 1] + priors[:, 3]) * 0.5
-    bw = jnp.maximum(boxes[:, 2] - boxes[:, 0], 1e-8)
-    bh = jnp.maximum(boxes[:, 3] - boxes[:, 1], 1e-8)
-    bcx = (boxes[:, 0] + boxes[:, 2]) * 0.5
-    bcy = (boxes[:, 1] + boxes[:, 3]) * 0.5
-    dx = (bcx - pcx) / pw / variances[:, 0]
-    dy = (bcy - pcy) / ph / variances[:, 1]
-    dw = jnp.log(bw / pw) / variances[:, 2]
-    dh = jnp.log(bh / ph) / variances[:, 3]
-    return jnp.stack([dx, dy, dw, dh], axis=1)
 
 
 def match_priors(priors: jnp.ndarray, gt_boxes: jnp.ndarray,

@@ -165,13 +165,15 @@ def _build(model_name: str, batch: int, n_batches: int, dtype: str):
         criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
                                                  size_average=True)
     elif model_name == "transformerlm":
-        from bigdl_tpu.models.transformerlm import TransformerLM
+        from bigdl_tpu.models.transformerlm import TransformerLM, lm_criterion
         seq, n_classes = _MODEL_UNITS[model_name][1], 32000
+        # BIGDL_BENCH_FUSED_HEAD=1: A/B the chunked-vocab loss head (the
+        # (B*T, 32k) logits tensor never materializes in training)
+        fused = os.environ.get("BIGDL_BENCH_FUSED_HEAD", "0") == "1"
         model = TransformerLM(n_classes, embed_dim=512, num_heads=8,
-                              num_layers=6, max_len=seq)
+                              num_layers=6, max_len=seq, fused_head=fused)
         shape = (batch, seq)
-        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
-                                                 size_average=True)
+        criterion = lm_criterion(fused_head=fused)
     else:
         raise ValueError(f"unknown model {model_name!r}")
 

@@ -16,7 +16,8 @@ from bigdl_tpu.optim.regularizer import (
 )
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
-    AccuracyResult, HitRatio, Loss, LossResult, MAE, NDCG, Top1Accuracy, Top5Accuracy,
+    AccuracyResult, HitRatio, Loss, LossResult, MAE, MeanAveragePrecision,
+    NDCG, Top1Accuracy, Top5Accuracy,
     TreeNNAccuracy,
     TopKAccuracy, ValidationMethod, ValidationResult,
 )

@@ -137,6 +137,106 @@ class Loss(ValidationMethod):
         return LossResult(loss * n, n)
 
 
+class MAPResult(ValidationResult):
+    """Accumulates raw per-image detections/ground-truth across batches (AP is
+    a global ranking metric — per-batch fractions cannot be summed) and
+    computes VOC2010-style all-points mAP at ``result()`` time."""
+
+    def __init__(self, dets: list, gts: list, iou_threshold: float):
+        self.dets = list(dets)     # per image: (K, 6) [label, score, 4 box]
+        self.gts = list(gts)       # per image: (G, 5) [label, 4 box]
+        self.iou_threshold = iou_threshold
+
+    def __add__(self, other):
+        return MAPResult(self.dets + other.dets, self.gts + other.gts,
+                         self.iou_threshold)
+
+    @staticmethod
+    def _iou(a, b):
+        # numpy one-vs-many mirror of nn.detection.pairwise_iou (same
+        # degenerate-box clipping; host-side because AP ranking is host work)
+        ix = np.maximum(0.0, np.minimum(a[2], b[:, 2]) - np.maximum(a[0], b[:, 0]))
+        iy = np.maximum(0.0, np.minimum(a[3], b[:, 3]) - np.maximum(a[1], b[:, 1]))
+        inter = ix * iy
+        area_a = max(a[2] - a[0], 0.0) * max(a[3] - a[1], 0.0)
+        area_b = (np.clip(b[:, 2] - b[:, 0], 0, None)
+                  * np.clip(b[:, 3] - b[:, 1], 0, None))
+        return inter / np.maximum(area_a + area_b - inter, 1e-12)
+
+    def result(self):
+        # group rows by class ONCE per image, then one pass per class
+        def by_class(rows):
+            out: dict[int, np.ndarray] = {}
+            for c in np.unique(rows[:, 0]).astype(int):
+                out[c] = rows[rows[:, 0] == c]
+            return out
+
+        gt_grp = [by_class(g) for g in self.gts]
+        det_grp = [by_class(d) for d in self.dets]
+        classes = sorted({c for g in gt_grp for c in g})
+        aps = []
+        for c in classes:
+            gt_by_img = [g.get(c, np.zeros((0, 5)))[:, 1:] for g in gt_grp]
+            n_gt = sum(len(b) for b in gt_by_img)
+            if n_gt == 0:
+                continue
+            records = [(float(row[1]), i, row[2:])
+                       for i, d in enumerate(det_grp)
+                       for row in d.get(c, np.zeros((0, 6)))]
+            records.sort(key=lambda r: -r[0])
+            matched = [np.zeros(len(b), bool) for b in gt_by_img]
+            tp = np.zeros(len(records))
+            for k, (_, i, box) in enumerate(records):
+                boxes = gt_by_img[i]
+                if len(boxes):
+                    ious = self._iou(box, boxes)
+                    j = int(np.argmax(ious))
+                    if ious[j] >= self.iou_threshold and not matched[i][j]:
+                        matched[i][j] = True
+                        tp[k] = 1.0
+            cum_tp = np.cumsum(tp)
+            recall = cum_tp / n_gt
+            precision = cum_tp / (np.arange(len(records)) + 1)
+            # monotone precision envelope, integrated over recall
+            for k in range(len(precision) - 2, -1, -1):
+                precision[k] = max(precision[k], precision[k + 1])
+            ap = 0.0
+            prev_r = 0.0
+            for k in range(len(recall)):
+                ap += (recall[k] - prev_r) * precision[k]
+                prev_r = recall[k]
+            aps.append(ap)
+        mean_ap = float(np.mean(aps)) if aps else 0.0
+        return (mean_ap, len(self.dets))
+
+    def __repr__(self):
+        v, c = self.result()
+        return f"MeanAveragePrecision({v:.4f}, images={c})"
+
+
+class MeanAveragePrecision(ValidationMethod):
+    """Detection mAP (reference ``MeanAveragePrecision`` validation method for
+    object-detection models). ``output``: (N, K, 6) DetectionOutputSSD rows
+    ``[label, score, xmin, ymin, xmax, ymax]`` (label < 0 = padding);
+    ``target``: (N, G, 5) padded ground truth ``[label, x1, y1, x2, y2]``
+    (label <= 0 = padding/background). VOC2010 all-points AP per class,
+    averaged over classes with ground truth."""
+
+    def __init__(self, iou_threshold: float = 0.5):
+        self.iou_threshold = float(iou_threshold)
+        self.name = "MeanAveragePrecision"
+
+    def apply(self, output, target, valid=None):
+        out = np.asarray(output)
+        gt = np.asarray(target)
+        n = out.shape[0]
+        if valid is not None and valid < n:
+            out, gt = out[:valid], gt[:valid]
+        dets = [img[img[:, 0] >= 0] for img in out]
+        gts = [g[g[:, 0] > 0] for g in gt]
+        return MAPResult(dets, gts, self.iou_threshold)
+
+
 class MAE(ValidationMethod):
     name = "MAE"
 

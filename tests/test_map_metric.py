@@ -1,0 +1,133 @@
+"""MeanAveragePrecision validation method: hand-computable AP cases, batch
+merge associativity, and the SSD-output wire format."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.optim import MeanAveragePrecision
+
+
+def det(label, score, x1, y1, x2, y2):
+    return [label, score, x1, y1, x2, y2]
+
+
+def gt(label, x1, y1, x2, y2):
+    return [label, x1, y1, x2, y2]
+
+
+PAD_DET = [-1, 0, 0, 0, 0, 0]
+PAD_GT = [-1, 0, 0, 0, 0]
+
+
+def test_perfect_detections_map_1():
+    out = np.asarray([[det(1, 0.9, 0, 0, 10, 10), det(2, 0.8, 20, 20, 30, 30)]],
+                     np.float32)
+    target = np.asarray([[gt(1, 0, 0, 10, 10), gt(2, 20, 20, 30, 30)]],
+                        np.float32)
+    m, n = MeanAveragePrecision().apply(out, target).result()
+    assert m == pytest.approx(1.0)
+    assert n == 1
+
+
+def test_miss_halves_ap():
+    # class 1: two gts, one detected perfectly, one missed -> AP = 0.5
+    out = np.asarray([[det(1, 0.9, 0, 0, 10, 10), PAD_DET]], np.float32)
+    target = np.asarray([[gt(1, 0, 0, 10, 10), gt(1, 50, 50, 60, 60)]],
+                        np.float32)
+    m, _ = MeanAveragePrecision().apply(out, target).result()
+    assert m == pytest.approx(0.5)
+
+
+def test_false_positive_before_tp_lowers_ap():
+    # high-scored FP then a TP: precision at the TP is 1/2 -> AP = 0.5
+    out = np.asarray([[det(1, 0.95, 70, 70, 80, 80),
+                       det(1, 0.90, 0, 0, 10, 10)]], np.float32)
+    target = np.asarray([[gt(1, 0, 0, 10, 10), PAD_GT]], np.float32)
+    m, _ = MeanAveragePrecision().apply(out, target).result()
+    assert m == pytest.approx(0.5)
+
+
+def test_duplicate_detection_counts_once():
+    # two detections on the same gt: second is a FP
+    out = np.asarray([[det(1, 0.9, 0, 0, 10, 10),
+                       det(1, 0.8, 1, 1, 10, 10)]], np.float32)
+    target = np.asarray([[gt(1, 0, 0, 10, 10), PAD_GT]], np.float32)
+    m, _ = MeanAveragePrecision().apply(out, target).result()
+    assert m == pytest.approx(1.0)  # TP found at rank 1; dup FP after full recall
+
+
+def test_iou_threshold_gates_match():
+    out = np.asarray([[det(1, 0.9, 0, 0, 10, 5), PAD_DET]], np.float32)
+    target = np.asarray([[gt(1, 0, 0, 10, 10), PAD_GT]], np.float32)
+    loose, _ = MeanAveragePrecision(iou_threshold=0.45).apply(out, target).result()
+    strict, _ = MeanAveragePrecision(iou_threshold=0.75).apply(out, target).result()
+    assert loose == pytest.approx(1.0)
+    assert strict == pytest.approx(0.0)
+
+
+def test_batch_merge_equals_single_batch():
+    rng = np.random.RandomState(0)
+
+    def rand_img():
+        boxes = rng.rand(3, 4) * 50
+        boxes[:, 2:] = boxes[:, :2] + 5 + rng.rand(3, 2) * 20
+        labels = rng.randint(1, 3, 3)
+        g = np.concatenate([labels[:, None], boxes], axis=1).astype(np.float32)
+        # detections: jittered gt + one random FP
+        d = []
+        for row in g:
+            d.append([row[0], rng.rand() * 0.5 + 0.5,
+                      row[1] + 1, row[2] + 1, row[3] + 1, row[4] + 1])
+        d.append([1, rng.rand() * 0.5, 200, 200, 210, 210])
+        return np.asarray(d, np.float32), g
+
+    imgs = [rand_img() for _ in range(6)]
+    method = MeanAveragePrecision()
+    full = method.apply(np.stack([d for d, _ in imgs]),
+                        np.stack([g for _, g in imgs]))
+    merged = None
+    for d, g in imgs:
+        r = method.apply(d[None], g[None])
+        merged = r if merged is None else merged + r
+    assert full.result() == merged.result()
+
+
+def test_padding_rows_ignored():
+    out = np.asarray([[det(1, 0.9, 0, 0, 10, 10), PAD_DET, PAD_DET]],
+                     np.float32)
+    target = np.asarray([[gt(1, 0, 0, 10, 10), PAD_GT, [0, 1, 1, 2, 2]]],
+                        np.float32)
+    m, _ = MeanAveragePrecision().apply(out, target).result()
+    assert m == pytest.approx(1.0)
+
+
+def test_trained_ssd_scores_high_map():
+    """The SSD zoo model's held-out detections through DetectionOutputSSD
+    score well on the real metric."""
+    import jax.numpy as jnp
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.models.ssd import SSD, detector
+    from bigdl_tpu.models.ssd.train import make_dataset
+    from bigdl_tpu.optim import Adam, LocalOptimizer, Trigger
+
+    Engine.reset()
+    Engine.init(seed=0)
+    rng = np.random.RandomState(1)
+    img, n_cls = 32, 3
+    model = SSD(n_cls, img_size=img)
+    data = (DataSet.array(make_dataset(128, img, rng))
+            >> SampleToMiniBatch(16))
+    opt = (LocalOptimizer(model, data, nn.MultiBoxCriterion(n_classes=n_cls))
+           .set_optim_method(Adam(learningrate=0.01))
+           .set_end_when(Trigger.max_epoch(12)))
+    opt.optimize()
+
+    serve = detector(model, n_cls, keep_topk=4, conf_thresh=0.05)
+    test = make_dataset(24, img, rng)
+    dets = np.stack([np.asarray(serve(jnp.asarray(s.feature[0][None])))[0]
+                     for s in test])
+    gts = np.stack([s.label[0] for s in test])
+    m, n = MeanAveragePrecision().apply(dets, gts).result()
+    assert n == 24
+    assert m > 0.5, f"trained SSD mAP too low: {m}"

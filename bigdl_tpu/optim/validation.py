@@ -148,6 +148,10 @@ class MAPResult(ValidationResult):
         self.iou_threshold = iou_threshold
 
     def __add__(self, other):
+        if self.iou_threshold != other.iou_threshold:
+            raise ValueError(
+                f"cannot merge MAPResults with different IoU thresholds "
+                f"({self.iou_threshold} vs {other.iou_threshold})")
         return MAPResult(self.dets + other.dets, self.gts + other.gts,
                          self.iou_threshold)
 
@@ -232,7 +236,7 @@ class MeanAveragePrecision(ValidationMethod):
         n = out.shape[0]
         if valid is not None and valid < n:
             out, gt = out[:valid], gt[:valid]
-        dets = [img[img[:, 0] >= 0] for img in out]
+        dets = [img[img[:, 0] > 0] for img in out]   # drop padding AND bg rows
         gts = [g[g[:, 0] > 0] for g in gt]
         return MAPResult(dets, gts, self.iou_threshold)
 

@@ -534,11 +534,23 @@ def _measure_ablation(model_name: str, batch: int, iters: int) -> dict:
     try:
         lowered = step_fn.lower(params, mstate, ostate, zero_i, inp,
                                 target, rng)
-        ca = lowered.compile().cost_analysis()
+        compiled = lowered.compile()
+        ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
         cost = {"xla_flops": ca.get("flops"),
                 "xla_bytes_accessed": ca.get("bytes accessed")}
+        try:   # memory telemetry separately: its failure must not discard
+            ma = compiled.memory_analysis()    # the flops numbers above
+            if ma is not None:
+                for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                          "output_size_in_bytes",
+                          "generated_code_size_in_bytes"):
+                    v = getattr(ma, k, None)
+                    if v is not None:
+                        cost[k.replace("_in_bytes", "_bytes")] = int(v)
+        except Exception as e:
+            cost["memory_analysis_error"] = f"{type(e).__name__}: {e}"[:200]
     except Exception as e:  # cost analysis is best-effort diagnostics
         cost = {"cost_analysis_error": f"{type(e).__name__}: {e}"[:200]}
 
@@ -796,33 +808,37 @@ def main(argv=None):
     if args.batch is None:
         args.batch = _DEFAULT_BATCH.get(args.model, 256)
     if args.run:
-        # worker mode: --int8-infer rides the same resilient spawn path as the
-        # training metric (a TPU attach hang must not break the JSON contract)
-        if args.int8_infer:
-            res = _measure_int8_infer(args.model, args.batch,
-                                      max(args.iters, 10))
-            res["metric"] = f"{args.model}_int8_vs_bf16_infer"
-            print(json.dumps(res))
-        elif args.serving:
-            res = _measure_serving(args.model, args.batch,
-                                   max(args.iters // 4, 3))
-            res["metric"] = f"{args.model}_serving"
-            print(json.dumps(res))
-        elif args.decode_infer:
-            res = _measure_decode_infer(min(args.batch, 16))
-            res["metric"] = "transformerlm_decode_infer"
-            res["vs_baseline"] = None
-            print(json.dumps(res))
-        elif args.ablate:
-            res = _measure_ablation(args.model, args.batch,
-                                    max(args.iters // 2, 8))
-            res["metric"] = f"{args.model}_step_ablation"
-            res["vs_baseline"] = None
-            print(json.dumps(res))
-        else:
-            run_worker(args)
+        return _run_worker_modes(args)
+    run_orchestrator(args)
+    return 0
+
+
+def _run_worker_modes(args) -> int:
+    # worker mode: every leg rides the same resilient spawn path as the
+    # training metric (a TPU attach hang must not break the JSON contract)
+    if args.int8_infer:
+        res = _measure_int8_infer(args.model, args.batch,
+                                  max(args.iters, 10))
+        res["metric"] = f"{args.model}_int8_vs_bf16_infer"
+        print(json.dumps(res))
+    elif args.serving:
+        res = _measure_serving(args.model, args.batch,
+                               max(args.iters // 4, 3))
+        res["metric"] = f"{args.model}_serving"
+        print(json.dumps(res))
+    elif args.decode_infer:
+        res = _measure_decode_infer(min(args.batch, 16))
+        res["metric"] = "transformerlm_decode_infer"
+        res["vs_baseline"] = None
+        print(json.dumps(res))
+    elif args.ablate:
+        res = _measure_ablation(args.model, args.batch,
+                                max(args.iters // 2, 8))
+        res["metric"] = f"{args.model}_step_ablation"
+        res["vs_baseline"] = None
+        print(json.dumps(res))
     else:
-        run_orchestrator(args)
+        run_worker(args)
     return 0
 
 

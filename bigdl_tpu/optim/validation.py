@@ -221,10 +221,11 @@ class MAPResult(ValidationResult):
 class MeanAveragePrecision(ValidationMethod):
     """Detection mAP (reference ``MeanAveragePrecision`` validation method for
     object-detection models). ``output``: (N, K, 6) DetectionOutputSSD rows
-    ``[label, score, xmin, ymin, xmax, ymax]`` (label < 0 = padding);
-    ``target``: (N, G, 5) padded ground truth ``[label, x1, y1, x2, y2]``
-    (label <= 0 = padding/background). VOC2010 all-points AP per class,
-    averaged over classes with ground truth."""
+    ``[label, score, xmin, ymin, xmax, ymax]``; ``target``: (N, G, 5) padded
+    ground truth ``[label, x1, y1, x2, y2]``. On BOTH sides rows with
+    label <= 0 are dropped (padding/background — labels are 1-based with 0
+    reserved for background, the DetectionOutputSSD convention). VOC2010
+    all-points AP per class, averaged over classes with ground truth."""
 
     def __init__(self, iou_threshold: float = 0.5):
         self.iou_threshold = float(iou_threshold)

@@ -3,10 +3,8 @@ SSD → DetectionOutputSSD via predict_image (the reference's SSD
 predictImage story), and Evaluator.test with MeanAveragePrecision."""
 
 import os
-import tempfile
 
 import numpy as np
-import jax.numpy as jnp
 import pytest
 
 from bigdl_tpu import Engine, nn

@@ -65,10 +65,12 @@ def _residual(inner: nn.AbstractModule) -> nn.Sequential:
 def TransformerBlock(embed_dim: int, num_heads: int, mlp_ratio: int = 4,
                      dropout: float = 0.0,
                      attention_impl: str = "auto",
-                     causal: bool = True) -> nn.Sequential:
+                     causal: bool = True,
+                     num_kv_heads=None) -> nn.Sequential:
     attn = nn.Sequential().add(nn.LayerNorm(embed_dim)).add(
         nn.MultiHeadAttention(embed_dim, num_heads, causal=causal,
-                              attention_impl=attention_impl))
+                              attention_impl=attention_impl,
+                              num_kv_heads=num_kv_heads))
     mlp = (nn.Sequential()
            .add(nn.LayerNorm(embed_dim))
            .add(nn.TimeDistributed(nn.Linear(embed_dim, mlp_ratio * embed_dim)))
@@ -85,7 +87,8 @@ def TransformerLM(vocab_size: int, embed_dim: int = 256, num_heads: int = 4,
                   mlp_ratio: int = 4, dropout: float = 0.0,
                   remat: bool = False,
                   attention_impl: str = "auto",
-                  fused_head: bool = False) -> nn.Sequential:
+                  fused_head: bool = False,
+                  num_kv_heads=None) -> nn.Sequential:
     """Token ids (N, T) int32 → per-position log-probs (N, T, vocab).
 
     ``fused_head=True`` swaps the ``Linear >> LogSoftMax`` decoder for
@@ -99,7 +102,7 @@ def TransformerLM(vocab_size: int, embed_dim: int = 256, num_heads: int = 4,
              .add(PositionEmbedding(max_len, embed_dim).set_name("pos")))
     for i in range(num_layers):
         block = TransformerBlock(embed_dim, num_heads, mlp_ratio, dropout,
-                                 attention_impl)
+                                 attention_impl, num_kv_heads=num_kv_heads)
         if remat:
             block = nn.Remat(block)
         model.add(block.set_name(f"block{i + 1}"))

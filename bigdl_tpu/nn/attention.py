@@ -27,9 +27,16 @@ class MultiHeadAttention(TensorModule):
     "full" (plain fused attention, the numerical oracle).
     """
 
+    @property
+    def kv_heads(self) -> int:
+        # pre-GQA pickles lack _kv_heads; they are plain MHA by construction
+        kv = self.__dict__.get("_kv_heads")
+        return kv if kv is not None else self.num_heads
+
     def __init__(self, embed_dim: int, num_heads: int, causal: bool = False,
                  with_bias: bool = True, attention_impl: str = "auto",
-                 w_init: Optional[InitializationMethod] = None):
+                 w_init: Optional[InitializationMethod] = None,
+                 num_kv_heads: Optional[int] = None):
         super().__init__()
         if embed_dim % num_heads != 0:
             raise ValueError(f"embed_dim {embed_dim} % num_heads {num_heads} != 0")
@@ -39,6 +46,18 @@ class MultiHeadAttention(TensorModule):
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
+        # grouped-query attention (beyond reference): kv_heads < num_heads
+        # shares each K/V head across a GROUP of query heads — the decode
+        # KV cache (and its HBM bandwidth) shrinks by num_heads/kv_heads;
+        # kv_heads=1 is multi-query attention
+        if num_kv_heads is None:
+            self._kv_heads = num_heads
+        else:
+            self._kv_heads = int(num_kv_heads)
+            if self._kv_heads < 1 or num_heads % self._kv_heads != 0:
+                raise ValueError(
+                    f"num_kv_heads must be a positive divisor of num_heads "
+                    f"{num_heads}, got {num_kv_heads!r}")
         self.causal = causal
         self.with_bias = with_bias
         self.attention_impl = attention_impl
@@ -47,16 +66,40 @@ class MultiHeadAttention(TensorModule):
 
     def reset(self) -> None:
         e = self.embed_dim
-        self._params = {
-            "qkv_weight": jnp.asarray(
-                self.w_init.init((3 * e, e), fan_in=e, fan_out=3 * e)),
-            "out_weight": jnp.asarray(
-                self.w_init.init((e, e), fan_in=e, fan_out=e)),
-        }
-        if self.with_bias:
-            self._params["qkv_bias"] = jnp.zeros((3 * e,), jnp.float32)
-            self._params["out_bias"] = jnp.zeros((e,), jnp.float32)
+        if self.kv_heads == self.num_heads:
+            # plain MHA keeps the fused-QKV parameter layout (existing
+            # checkpoints/archives stay loadable)
+            self._params = {
+                "qkv_weight": jnp.asarray(
+                    self.w_init.init((3 * e, e), fan_in=e, fan_out=3 * e)),
+                "out_weight": jnp.asarray(
+                    self.w_init.init((e, e), fan_in=e, fan_out=e)),
+            }
+            if self.with_bias:
+                self._params["qkv_bias"] = jnp.zeros((3 * e,), jnp.float32)
+                self._params["out_bias"] = jnp.zeros((e,), jnp.float32)
+        else:
+            kv = 2 * self.kv_heads * self.head_dim
+            self._params = {
+                "q_weight": jnp.asarray(
+                    self.w_init.init((e, e), fan_in=e, fan_out=e)),
+                "kv_weight": jnp.asarray(
+                    self.w_init.init((kv, e), fan_in=e, fan_out=kv)),
+                "out_weight": jnp.asarray(
+                    self.w_init.init((e, e), fan_in=e, fan_out=e)),
+            }
+            if self.with_bias:
+                self._params["q_bias"] = jnp.zeros((e,), jnp.float32)
+                self._params["kv_bias"] = jnp.zeros((kv,), jnp.float32)
+                self._params["out_bias"] = jnp.zeros((e,), jnp.float32)
         self.zero_grad_parameters()
+
+    def _expand_kv(self, x):
+        """(b, kv_heads, t, d) → (b, num_heads, t, d): broadcast each KV head
+        over its query group (XLA fuses the broadcast into the consumer)."""
+        if self.kv_heads == self.num_heads:
+            return x
+        return jnp.repeat(x, self.num_heads // self.kv_heads, axis=1)
 
     def _attend(self, q, k, v):
         from bigdl_tpu.parallel.ring_attention import full_attention, ring_attention
@@ -79,16 +122,30 @@ class MultiHeadAttention(TensorModule):
         return ring_attention(q, k, v, mesh=mesh, seq_axis=Engine.SEQ_AXIS,
                               causal=self.causal)
 
+    def _project_qkv(self, params, input, b, t):
+        if self.kv_heads == self.num_heads:
+            qkv = input @ params["qkv_weight"].T
+            if self.with_bias:
+                qkv = qkv + params["qkv_bias"]
+            qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
+            q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+            return q, k, v                                     # all (b,h,t,d)
+        q = input @ params["q_weight"].T
+        kv = input @ params["kv_weight"].T
+        if self.with_bias:
+            q = q + params["q_bias"]
+            kv = kv + params["kv_bias"]
+        q = q.reshape(b, t, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        kv = kv.reshape(b, t, 2, self.kv_heads, self.head_dim)
+        k, v = (kv[:, :, i].transpose(0, 2, 1, 3) for i in range(2))
+        return q, k, v                       # q (b,h,t,d); k,v (b,kv_h,t,d)
+
     def apply(self, params, state, input, *, training=False, rng=None):
         b, t, e = input.shape
-        qkv = input @ params["qkv_weight"].T
-        if self.with_bias:
-            qkv = qkv + params["qkv_bias"]
-        qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
-        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))  # b,h,t,d
+        q, k, v = self._project_qkv(params, input, b, t)
         if isinstance(state, dict) and "cache_k" in state:
             return self._decode_step(params, state, q, k, v, b, t, e)
-        o = self._attend(q, k, v)
+        o = self._attend(q, self._expand_kv(k), self._expand_kv(v))
         o = o.transpose(0, 2, 1, 3).reshape(b, t, e)
         out = o @ params["out_weight"].T
         if self.with_bias:
@@ -112,10 +169,13 @@ class MultiHeadAttention(TensorModule):
             raise ValueError(
                 f"cached decode feeds one position at a time, got t={t}")
         pos = state["pos"]
+        # cache persists at kv_heads width — the GQA memory win; heads are
+        # broadcast per step only inside the fused attend
         ck = lax.dynamic_update_slice(state["cache_k"], k, (0, 0, pos, 0))
         cv = lax.dynamic_update_slice(state["cache_v"], v, (0, 0, pos, 0))
         lmax = ck.shape[2]
-        o = full_attention(q, ck, cv, causal=False,
+        o = full_attention(q, self._expand_kv(ck), self._expand_kv(cv),
+                           causal=False,
                            kv_mask=(jnp.arange(lmax) <= pos)[None, None, None])
         o = o.transpose(0, 2, 1, 3).reshape(b, 1, e)
         out = o @ params["out_weight"].T
@@ -124,8 +184,10 @@ class MultiHeadAttention(TensorModule):
         return out, {"cache_k": ck, "cache_v": cv, "pos": pos + 1}
 
     def __repr__(self):
-        return (f"MultiHeadAttention(embed={self.embed_dim}, heads={self.num_heads}, "
-                f"causal={self.causal}, impl={self.attention_impl})")
+        gqa = (f", kv_heads={self.kv_heads}"
+               if self.kv_heads != self.num_heads else "")
+        return (f"MultiHeadAttention(embed={self.embed_dim}, heads={self.num_heads}"
+                f"{gqa}, causal={self.causal}, impl={self.attention_impl})")
 
 
 class CrossAttention(TensorModule):

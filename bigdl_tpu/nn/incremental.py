@@ -62,10 +62,12 @@ def install_decode_cache(model: AbstractModule, batch_size: int,
                 f"silently clamp positions the uncached path rejects")
 
     for mod in attns:
+        # GQA caches store kv_heads (<= num_heads) — the cache-memory win
+        kv_h = getattr(mod, "kv_heads", mod.num_heads)
         mod.set_state({
-            "cache_k": jnp.zeros((batch_size, mod.num_heads, max_len,
+            "cache_k": jnp.zeros((batch_size, kv_h, max_len,
                                   mod.head_dim), dtype),
-            "cache_v": jnp.zeros((batch_size, mod.num_heads, max_len,
+            "cache_v": jnp.zeros((batch_size, kv_h, max_len,
                                   mod.head_dim), dtype),
             "pos": jnp.asarray(0, jnp.int32),
         })

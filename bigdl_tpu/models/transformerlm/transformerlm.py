@@ -66,11 +66,11 @@ def TransformerBlock(embed_dim: int, num_heads: int, mlp_ratio: int = 4,
                      dropout: float = 0.0,
                      attention_impl: str = "auto",
                      causal: bool = True,
-                     num_kv_heads=None) -> nn.Sequential:
+                     num_kv_heads=None, rope: bool = False) -> nn.Sequential:
     attn = nn.Sequential().add(nn.LayerNorm(embed_dim)).add(
         nn.MultiHeadAttention(embed_dim, num_heads, causal=causal,
                               attention_impl=attention_impl,
-                              num_kv_heads=num_kv_heads))
+                              num_kv_heads=num_kv_heads, rope=rope))
     mlp = (nn.Sequential()
            .add(nn.LayerNorm(embed_dim))
            .add(nn.TimeDistributed(nn.Linear(embed_dim, mlp_ratio * embed_dim)))
@@ -88,21 +88,28 @@ def TransformerLM(vocab_size: int, embed_dim: int = 256, num_heads: int = 4,
                   remat: bool = False,
                   attention_impl: str = "auto",
                   fused_head: bool = False,
-                  num_kv_heads=None) -> nn.Sequential:
+                  num_kv_heads=None,
+                  position: str = "learned") -> nn.Sequential:
     """Token ids (N, T) int32 → per-position log-probs (N, T, vocab).
 
     ``fused_head=True`` swaps the ``Linear >> LogSoftMax`` decoder for
     :class:`~bigdl_tpu.nn.FusedLMHead`: training streams the loss over vocab
     chunks (pair with :func:`lm_criterion`) so the (N, T, vocab) logits
     tensor is never materialized — the large-vocab memory path; eval output
-    stays per-position log-probs either way."""
+    stays per-position log-probs either way. ``position="rope"`` replaces the
+    learned absolute table with rotary embeddings applied inside every
+    attention (relative positions; no max_len table to outgrow)."""
+    if position not in ("learned", "rope"):
+        raise ValueError(f"position must be learned|rope, got {position!r}")
     model = (nn.Sequential()
              .add(nn.LookupTable(vocab_size, embed_dim, zero_based=True)
-                  .set_name("embedding"))
-             .add(PositionEmbedding(max_len, embed_dim).set_name("pos")))
+                  .set_name("embedding")))
+    if position == "learned":
+        model.add(PositionEmbedding(max_len, embed_dim).set_name("pos"))
     for i in range(num_layers):
         block = TransformerBlock(embed_dim, num_heads, mlp_ratio, dropout,
-                                 attention_impl, num_kv_heads=num_kv_heads)
+                                 attention_impl, num_kv_heads=num_kv_heads,
+                                 rope=(position == "rope"))
         if remat:
             block = nn.Remat(block)
         model.add(block.set_name(f"block{i + 1}"))

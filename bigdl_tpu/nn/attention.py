@@ -19,6 +19,24 @@ from bigdl_tpu.nn.abstractnn import TensorModule
 from bigdl_tpu.nn.initialization import InitializationMethod, Xavier
 
 
+def rope_rotate(x: jnp.ndarray, positions: jnp.ndarray,
+                base: float = 10000.0) -> jnp.ndarray:
+    """Rotary position embedding (split-half convention): ``x (..., t, d)``
+    rotated by per-position angles ``positions (t,)``. Each (x[i], x[i+d/2])
+    pair turns by ``pos / base^(2i/d)`` — attention scores then depend only
+    on RELATIVE distance, which is what lets RoPE models extrapolate and
+    makes the rotation cache-free (the decode path rotates the single new
+    position by its absolute index; nothing else changes)."""
+    d = x.shape[-1]
+    half = d // 2
+    inv_freq = 1.0 / (base ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (t, half)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
 class MultiHeadAttention(TensorModule):
     """Self-attention over (batch, seq, embed) inputs.
 
@@ -36,10 +54,13 @@ class MultiHeadAttention(TensorModule):
     def __init__(self, embed_dim: int, num_heads: int, causal: bool = False,
                  with_bias: bool = True, attention_impl: str = "auto",
                  w_init: Optional[InitializationMethod] = None,
-                 num_kv_heads: Optional[int] = None):
+                 num_kv_heads: Optional[int] = None,
+                 rope: bool = False, rope_base: float = 10000.0):
         super().__init__()
         if embed_dim % num_heads != 0:
             raise ValueError(f"embed_dim {embed_dim} % num_heads {num_heads} != 0")
+        if rope and (embed_dim // num_heads) % 2 != 0:
+            raise ValueError("rope needs an even head_dim")
         if attention_impl not in ("auto", "ring", "full", "flash"):
             raise ValueError(f"attention_impl must be auto|ring|full|flash, "
                              f"got {attention_impl!r}")
@@ -61,6 +82,8 @@ class MultiHeadAttention(TensorModule):
         self.causal = causal
         self.with_bias = with_bias
         self.attention_impl = attention_impl
+        self.rope = bool(rope)
+        self.rope_base = float(rope_base)
         self.w_init = w_init or Xavier()
         self.reset()
 
@@ -145,6 +168,10 @@ class MultiHeadAttention(TensorModule):
         q, k, v = self._project_qkv(params, input, b, t)
         if isinstance(state, dict) and "cache_k" in state:
             return self._decode_step(params, state, q, k, v, b, t, e)
+        if getattr(self, "rope", False):
+            pos = jnp.arange(t)
+            q = rope_rotate(q, pos, self.rope_base)
+            k = rope_rotate(k, pos, self.rope_base)
         o = self._attend(q, self._expand_kv(k), self._expand_kv(v))
         o = o.transpose(0, 2, 1, 3).reshape(b, t, e)
         out = o @ params["out_weight"].T
@@ -169,6 +196,12 @@ class MultiHeadAttention(TensorModule):
             raise ValueError(
                 f"cached decode feeds one position at a time, got t={t}")
         pos = state["pos"]
+        if getattr(self, "rope", False):
+            # rotate the single new position by its ABSOLUTE index; cached
+            # keys were already rotated when they were written
+            ppos = jnp.full((1,), pos)
+            q = rope_rotate(q, ppos, self.rope_base)
+            k = rope_rotate(k, ppos, self.rope_base)
         # cache persists at kv_heads width — the GQA memory win; heads are
         # broadcast per step only inside the fused attend
         ck = lax.dynamic_update_slice(state["cache_k"], k, (0, 0, pos, 0))

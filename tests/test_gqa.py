@@ -69,19 +69,13 @@ def test_decode_cache_stores_kv_heads_only():
     from bigdl_tpu.nn.incremental import install_decode_cache
     from bigdl_tpu.models.transformerlm import TransformerLM
 
+    from bigdl_tpu.nn.incremental import _iter_modules
+
     model = TransformerLM(32, embed_dim=16, num_heads=4, num_layers=1,
                           max_len=16, num_kv_heads=2)
     install_decode_cache(model, batch_size=2, max_len=16)
-    attn = [m for m in model.modules_recursive()
-            if isinstance(m, nn.MultiHeadAttention)][0] \
-        if hasattr(model, "modules_recursive") else None
-    if attn is None:
-        def walk(mod):
-            yield mod
-            for c in getattr(mod, "modules", []):
-                yield from walk(c)
-        attn = [m for m in walk(model)
-                if isinstance(m, nn.MultiHeadAttention)][0]
+    attn = [m for m in _iter_modules(model)
+            if isinstance(m, nn.MultiHeadAttention)][0]
     assert attn.get_state()["cache_k"].shape == (2, 2, 16, 4)
 
 

@@ -1,0 +1,129 @@
+"""Rotary position embeddings: numpy oracle, the relative-shift invariance
+that defines RoPE, cached decode equality, and a rope TransformerLM
+must-learn run."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.nn.attention import rope_rotate
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def np_rope(x, positions, base=10000.0):
+    d = x.shape[-1]
+    half = d // 2
+    inv = 1.0 / (base ** (np.arange(half) / half))
+    ang = positions[:, None] * inv[None, :]
+    cos, sin = np.cos(ang), np.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    return np.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+def test_rope_rotate_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 8).astype(np.float32)
+    pos = np.arange(5).astype(np.float32)
+    got = np.asarray(rope_rotate(jnp.asarray(x), jnp.asarray(pos)))
+    np.testing.assert_allclose(got, np_rope(x, pos), rtol=1e-5, atol=1e-6)
+
+
+def test_rope_preserves_norm():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 7, 16).astype(np.float32)
+    r = np.asarray(rope_rotate(jnp.asarray(x), jnp.arange(7)))
+    np.testing.assert_allclose(np.linalg.norm(r, axis=-1),
+                               np.linalg.norm(x, axis=-1), rtol=1e-5)
+
+
+def test_rope_scores_depend_on_relative_distance():
+    """<rope(q, i), rope(k, j)> must equal <rope(q, i+s), rope(k, j+s)>."""
+    rng = np.random.RandomState(2)
+    q = rng.randn(8).astype(np.float32)
+    k = rng.randn(8).astype(np.float32)
+
+    def score(i, j):
+        qi = np.asarray(rope_rotate(jnp.asarray(q[None, None]),
+                                    jnp.asarray([float(i)])))[0, 0]
+        kj = np.asarray(rope_rotate(jnp.asarray(k[None, None]),
+                                    jnp.asarray([float(j)])))[0, 0]
+        return float(qi @ kj)
+
+    assert score(3, 1) == pytest.approx(score(10, 8), rel=1e-4)
+    assert score(0, 0) == pytest.approx(score(25, 25), rel=1e-4)
+    assert abs(score(3, 1) - score(3, 2)) > 1e-6   # but NOT position-blind
+
+
+def test_odd_head_dim_rejected():
+    with pytest.raises(ValueError, match="even head_dim"):
+        nn.MultiHeadAttention(6, 2, rope=True)   # head_dim 3
+
+
+def test_rope_attention_differs_from_plain_and_is_causal():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(1, 6, 16).astype(np.float32))
+    RandomGenerator.set_seed(9)
+    plain = nn.MultiHeadAttention(16, 2, causal=True, attention_impl="full")
+    RandomGenerator.set_seed(9)
+    roped = nn.MultiHeadAttention(16, 2, causal=True, attention_impl="full",
+                                  rope=True)
+    plain.evaluate(); roped.evaluate()
+    a = np.asarray(plain.forward(x))
+    b = np.asarray(roped.forward(x))
+    assert not np.allclose(a, b)
+    # causality: position 0's output ignores later positions
+    x2 = x.at[:, 3:].set(0.0)
+    b2 = np.asarray(roped.forward(x2))
+    np.testing.assert_allclose(b[:, :3], b2[:, :3], rtol=1e-4, atol=1e-5)
+
+
+def test_rope_cached_decode_matches_uncached():
+    from bigdl_tpu.nn.incremental import greedy_generate
+    from bigdl_tpu.models.transformerlm import TransformerLM
+
+    Engine.reset()
+    Engine.init(seed=0)
+    RandomGenerator.set_seed(11)
+    v = 31
+    model = TransformerLM(v, embed_dim=16, num_heads=4, num_layers=2,
+                          max_len=24, position="rope", num_kv_heads=2)
+    model.evaluate()
+    rng = np.random.RandomState(12)
+    prompt = jnp.asarray(rng.randint(0, v, (2, 5)).astype(np.int32))
+    cached = np.asarray(greedy_generate(model, prompt, decode_length=7))
+    seq = np.asarray(prompt)
+    for _ in range(7):
+        logits = np.asarray(model.forward(jnp.asarray(seq)))
+        seq = np.concatenate(
+            [seq, logits[:, -1].argmax(-1).astype(np.int32)[:, None]], axis=1)
+    np.testing.assert_array_equal(cached, seq)
+
+
+def test_rope_transformerlm_learns():
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.models.transformerlm import TransformerLM, lm_criterion
+    from bigdl_tpu.optim import Adam, LocalOptimizer, Trigger
+
+    Engine.reset()
+    Engine.init(seed=0)
+    rng = np.random.RandomState(13)
+    v, t = 17, 8
+    seqs = np.zeros((64, t + 1), np.int64)
+    seqs[:, 0] = rng.randint(0, v, 64)
+    for i in range(t):
+        seqs[:, i + 1] = (seqs[:, i] * 3 + 1) % v
+    model = TransformerLM(v, embed_dim=32, num_heads=4, num_layers=1,
+                          max_len=t, position="rope")
+    data = DataSet.array([Sample(s[:-1].astype(np.int32),
+                                 s[1:].astype(np.int32)) for s in seqs]) \
+        >> SampleToMiniBatch(16)
+    opt = (LocalOptimizer(model, data, lm_criterion())
+           .set_optim_method(Adam(learningrate=0.01))
+           .set_end_when(Trigger.max_epoch(40)))
+    opt.optimize()
+    model.evaluate()
+    x = jnp.asarray(seqs[:16, :-1].astype(np.int32))
+    acc = (np.asarray(model.forward(x)).argmax(-1) == seqs[:16, 1:]).mean()
+    assert acc > 0.9, f"rope transformer failed to learn (acc={acc})"

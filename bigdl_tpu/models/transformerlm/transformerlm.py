@@ -66,16 +66,34 @@ def TransformerBlock(embed_dim: int, num_heads: int, mlp_ratio: int = 4,
                      dropout: float = 0.0,
                      attention_impl: str = "auto",
                      causal: bool = True,
-                     num_kv_heads=None, rope: bool = False) -> nn.Sequential:
-    attn = nn.Sequential().add(nn.LayerNorm(embed_dim)).add(
+                     num_kv_heads=None, rope: bool = False,
+                     norm: str = "layer", mlp_kind: str = "gelu") -> nn.Sequential:
+    if norm not in ("layer", "rms"):
+        raise ValueError(f"norm must be layer|rms, got {norm!r}")
+    if mlp_kind not in ("gelu", "swiglu"):
+        raise ValueError(f"mlp_kind must be gelu|swiglu, got {mlp_kind!r}")
+    norm_layer = nn.RMSNorm if norm == "rms" else nn.LayerNorm
+    attn = nn.Sequential().add(norm_layer(embed_dim)).add(
         nn.MultiHeadAttention(embed_dim, num_heads, causal=causal,
                               attention_impl=attention_impl,
                               num_kv_heads=num_kv_heads, rope=rope))
-    mlp = (nn.Sequential()
-           .add(nn.LayerNorm(embed_dim))
-           .add(nn.TimeDistributed(nn.Linear(embed_dim, mlp_ratio * embed_dim)))
-           .add(nn.GELU())
-           .add(nn.TimeDistributed(nn.Linear(mlp_ratio * embed_dim, embed_dim))))
+    hidden = mlp_ratio * embed_dim
+    mlp = nn.Sequential().add(norm_layer(embed_dim))
+    if mlp_kind == "swiglu":
+        # llama-style gated MLP from the stock table algebra:
+        # (silu(x W_gate) * (x W_up)) W_down — the branch product is the
+        # ConcatTable >> CMulTable idiom
+        mlp.add(nn.ConcatTable()
+                .add(nn.Sequential()
+                     .add(nn.TimeDistributed(nn.Linear(embed_dim, hidden)))
+                     .add(nn.Swish()))
+                .add(nn.TimeDistributed(nn.Linear(embed_dim, hidden))))
+        mlp.add(nn.CMulTable())
+        mlp.add(nn.TimeDistributed(nn.Linear(hidden, embed_dim)))
+    else:
+        mlp.add(nn.TimeDistributed(nn.Linear(embed_dim, hidden)))
+        mlp.add(nn.GELU())
+        mlp.add(nn.TimeDistributed(nn.Linear(hidden, embed_dim)))
     if dropout > 0:
         attn.add(nn.Dropout(dropout))
         mlp.add(nn.Dropout(dropout))
@@ -89,7 +107,8 @@ def TransformerLM(vocab_size: int, embed_dim: int = 256, num_heads: int = 4,
                   attention_impl: str = "auto",
                   fused_head: bool = False,
                   num_kv_heads=None,
-                  position: str = "learned") -> nn.Sequential:
+                  position: str = "learned",
+                  norm: str = "layer", mlp_kind: str = "gelu") -> nn.Sequential:
     """Token ids (N, T) int32 → per-position log-probs (N, T, vocab).
 
     ``fused_head=True`` swaps the ``Linear >> LogSoftMax`` decoder for
@@ -109,11 +128,13 @@ def TransformerLM(vocab_size: int, embed_dim: int = 256, num_heads: int = 4,
     for i in range(num_layers):
         block = TransformerBlock(embed_dim, num_heads, mlp_ratio, dropout,
                                  attention_impl, num_kv_heads=num_kv_heads,
-                                 rope=(position == "rope"))
+                                 rope=(position == "rope"),
+                                 norm=norm, mlp_kind=mlp_kind)
         if remat:
             block = nn.Remat(block)
         model.add(block.set_name(f"block{i + 1}"))
-    model.add(nn.LayerNorm(embed_dim).set_name("final_norm"))
+    final_norm = nn.RMSNorm if norm == "rms" else nn.LayerNorm
+    model.add(final_norm(embed_dim).set_name("final_norm"))
     if fused_head:
         model.add(nn.FusedLMHead(embed_dim, vocab_size, eval_log_probs=True)
                   .set_name("decoder"))

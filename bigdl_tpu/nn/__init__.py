@@ -32,7 +32,7 @@ from bigdl_tpu.nn.embedding import HashBucketEmbedding, LookupTable
 from bigdl_tpu.nn.graph import Graph, Input, ModuleNode, StaticGraph
 from bigdl_tpu.nn.normalization import (
     Add, BatchNormalization, CAdd, CMul, Dropout, GaussianDropout, GaussianNoise,
-    LayerNorm, Mul, Normalize, SpatialBatchNormalization,
+    LayerNorm, Mul, Normalize, RMSNorm, SpatialBatchNormalization,
     SpatialContrastiveNormalization, SpatialCrossMapLRN,
     SpatialDivisiveNormalization, SpatialDropout1D, SpatialDropout2D,
     SpatialDropout3D, SpatialSubtractiveNormalization, SpatialWithinChannelLRN,

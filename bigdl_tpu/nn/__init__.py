@@ -79,7 +79,8 @@ from bigdl_tpu.nn.multibox import MultiBoxCriterion, encode_ssd, match_priors
 from bigdl_tpu.nn.tree import BinaryTreeLSTM
 from bigdl_tpu.nn.beam_search import SequenceBeamSearch, greedy_decode
 from bigdl_tpu.nn.incremental import (
-    clear_decode_cache, generate, greedy_generate, install_decode_cache)
+    beam_generate, clear_decode_cache, generate, greedy_generate,
+    install_decode_cache)
 from bigdl_tpu.nn.volumetric import (
     VolumetricAveragePooling, VolumetricConvolution, VolumetricFullConvolution,
     VolumetricMaxPooling,

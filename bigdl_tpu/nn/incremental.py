@@ -98,6 +98,147 @@ def greedy_generate(model: AbstractModule, prompt, decode_length: int,
     return generate(model, prompt, decode_length, dtype=dtype)
 
 
+def beam_generate(model: AbstractModule, prompt, decode_length: int,
+                  beam_size: int, eos_id: int = -1, alpha: float = 0.0,
+                  pad_id: int = 0, dtype=jnp.float32):
+    """KV-cached BEAM search: the O(L)-per-token serving form of
+    :class:`~bigdl_tpu.nn.SequenceBeamSearch` (which re-runs the full prefix
+    every step — O(L²) — because the reference's static-block formulation
+    has no cache). Beams ride the batch axis (``n*beam`` cache rows); when a
+    step reselects beams, the cache rows are GATHERED to follow their parent
+    hypotheses — the cache-reorder that the reference's SequenceBeamSearch
+    cache arguments exist for, done here as one ``take`` on the state pytree.
+
+    Returns ``(sequences (N, beam, T0+decode_length), scores (N, beam))``,
+    best beam first — the same contract (and, tie-breaks aside, the same
+    result) as SequenceBeamSearch, pinned by test."""
+    from jax import lax as _lax
+
+    if beam_size < 1 or decode_length < 1:
+        raise ValueError("beam_size and decode_length must be >= 1")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    n, t0 = prompt.shape
+    B = int(beam_size)
+    total = t0 + decode_length
+    neg = -1e30
+
+    params = model.get_params()
+    state0 = install_decode_cache(model, n * B, total, dtype=dtype)
+    try:
+        key = ("beam_generate", n, t0, decode_length, B, eos_id,
+               float(alpha), pad_id, jnp.dtype(dtype).name)
+        fn = model._apply_cache.get(key)
+        if fn is None:
+
+            def reorder(state, flat_parent):
+                """Gather KV-cache rows to follow their parent beams.
+                Keyed on the decode-cache leaf names (cache_k/cache_v) so
+                unrelated state whose leading dim happens to equal n*B is
+                never permuted."""
+                def g(path, leaf):
+                    key = path and getattr(path[-1], "key", None)
+                    if key in ("cache_k", "cache_v"):
+                        return jnp.take(leaf, flat_parent, axis=0)
+                    return leaf
+                return jax.tree_util.tree_map_with_path(g, state)
+
+            def penalty(length):
+                if alpha == 0.0:
+                    return 1.0
+                return ((5.0 + length) / 6.0) ** alpha
+
+            def run(params, state0, prompt):
+                pb = jnp.repeat(prompt, B, axis=0)       # (n*B, t0)
+
+                def step(carry, i):
+                    state, tok, seqs, alive_lp, fin_seqs, fin_scores, \
+                        fin_flags = carry
+                    logits, new_state = model.apply(
+                        params, state, tok[:, None], training=False, rng=None)
+                    in_prompt = i + 1 < t0
+
+                    # ---- prompt phase: feed the next prompt token, no beam math
+                    p_tok = pb[:, jnp.minimum(i + 1, t0 - 1)]
+
+                    # ---- decode phase: expand beams
+                    lp = jax.nn.log_softmax(logits[:, 0, :], axis=-1)
+                    V = lp.shape[-1]
+                    cand = (alive_lp[:, :, None]
+                            + lp.reshape(n, B, V)).reshape(n, B * V)
+                    vals, idx = _lax.top_k(cand, 2 * B)
+                    beam_idx, cand_tok = idx // V, (idx % V).astype(jnp.int32)
+                    cand_seqs = jnp.take_along_axis(
+                        seqs, beam_idx[:, :, None], axis=1)   # (n, 2B, L)
+                    onehot = (jnp.arange(total) == (i + 1))[None, None, :]
+                    cand_seqs = jnp.where(onehot, cand_tok[:, :, None],
+                                          cand_seqs)
+                    is_eos = cand_tok == eos_id
+
+                    alive_vals, alive_sel = _lax.top_k(
+                        jnp.where(is_eos, neg, vals), B)
+                    new_seqs = jnp.take_along_axis(
+                        cand_seqs, alive_sel[:, :, None], axis=1)
+                    new_tok = jnp.take_along_axis(cand_tok, alive_sel, axis=1)
+                    parent = jnp.take_along_axis(beam_idx, alive_sel, axis=1)
+
+                    # finished pool
+                    dec_len = (i + 2 - t0).astype(jnp.float32)
+                    cand_fin = jnp.where(is_eos, vals / penalty(dec_len), neg)
+                    all_scores = jnp.concatenate([fin_scores, cand_fin], 1)
+                    all_seqs = jnp.concatenate([fin_seqs, cand_seqs], 1)
+                    all_flags = jnp.concatenate([fin_flags, is_eos], 1)
+                    top_scores, sel = _lax.top_k(all_scores, B)
+                    nf_seqs = jnp.take_along_axis(all_seqs, sel[:, :, None], 1)
+                    nf_flags = jnp.take_along_axis(all_flags, sel, 1)
+
+                    # ---- select phase by position
+                    flat_parent = (jnp.arange(n)[:, None] * B
+                                   + parent).reshape(-1)
+                    identity = jnp.arange(n * B)
+                    state_out = reorder(
+                        new_state,
+                        jnp.where(in_prompt, identity, flat_parent))
+                    tok_out = jnp.where(in_prompt, p_tok,
+                                        new_tok.reshape(-1))
+                    prompt_seqs = jnp.where(onehot, p_tok.reshape(n, B)
+                                            [:, :, None], seqs)
+                    seqs_out = jnp.where(in_prompt, prompt_seqs, new_seqs)
+                    alive_out = jnp.where(in_prompt, alive_lp, alive_vals)
+                    fs_out = jnp.where(in_prompt, fin_seqs, nf_seqs)
+                    fsc_out = jnp.where(in_prompt, fin_scores, top_scores)
+                    ff_out = jnp.where(in_prompt, fin_flags, nf_flags)
+                    return (state_out, tok_out, seqs_out, alive_out,
+                            fs_out, fsc_out, ff_out), None
+
+                seqs0 = jnp.full((n, B, total), pad_id, jnp.int32)
+                seqs0 = seqs0.at[:, :, :t0].set(prompt[:, None, :])
+                alive0 = jnp.full((n, B), neg, jnp.float32).at[:, 0].set(0.0)
+                fin_seqs0 = jnp.full((n, B, total), pad_id, jnp.int32)
+                fin_scores0 = jnp.full((n, B), neg, jnp.float32)
+                fin_flags0 = jnp.zeros((n, B), bool)
+                carry0 = (state0, pb[:, 0], seqs0, alive0, fin_seqs0,
+                          fin_scores0, fin_flags0)
+                (state, _, seqs, alive_lp, fin_seqs, fin_scores,
+                 fin_flags), _ = _lax.scan(step, carry0,
+                                           jnp.arange(total - 1))
+
+                alive_scores = alive_lp / penalty(float(decode_length))
+                merged_scores = jnp.concatenate(
+                    [jnp.where(fin_flags, fin_scores, neg), alive_scores], 1)
+                merged_seqs = jnp.concatenate([fin_seqs, seqs], 1)
+                out_scores, sel = _lax.top_k(merged_scores, B)
+                out_seqs = jnp.take_along_axis(merged_seqs,
+                                               sel[:, :, None], 1)
+                return out_seqs, out_scores
+
+            fn = jax.jit(run)
+            model._apply_cache[key] = fn
+        out = fn(params, state0, prompt)
+    finally:
+        clear_decode_cache(model)
+    return out
+
+
 def generate(model: AbstractModule, prompt, decode_length: int,
              dtype=jnp.float32, *, sample: bool = False,
              temperature: float = 1.0, top_k: int | None = None,

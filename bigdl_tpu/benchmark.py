@@ -417,11 +417,14 @@ def _measure_decode_infer(batch: int, prompt_len: int = 32,
     bs = nn.SequenceBeamSearch(lm, 1, eos_id=-1,
                                decode_length=decode_length).evaluate()
     uncached_tps = timed(lambda: bs.forward(prompt)[1])
+    beam_tps = timed(lambda: nn.beam_generate(
+        lm, prompt, decode_length, beam_size=4, eos_id=-1)[0])
     return {"batch": batch, "prompt_len": prompt_len,
             "decode_length": decode_length,
             "cached_decode_tokens_per_sec": round(cached_tps, 1),
             "uncached_decode_tokens_per_sec": round(uncached_tps, 1),
-            "cached_uncached_ratio": round(cached_tps / uncached_tps, 2)}
+            "cached_uncached_ratio": round(cached_tps / uncached_tps, 2),
+            "cached_beam4_tokens_per_sec": round(beam_tps, 1)}
 
 
 def _measure_serving(model_name: str, batch: int, iters: int) -> dict:

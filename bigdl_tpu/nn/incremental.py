@@ -112,7 +112,7 @@ def beam_generate(model: AbstractModule, prompt, decode_length: int,
     Returns ``(sequences (N, beam, T0+decode_length), scores (N, beam))``,
     best beam first — the same contract (and, tie-breaks aside, the same
     result) as SequenceBeamSearch, pinned by test."""
-    from jax import lax as _lax
+    from bigdl_tpu.nn.beam_search import _length_penalty
 
     if beam_size < 1 or decode_length < 1:
         raise ValueError("beam_size and decode_length must be >= 1")
@@ -142,11 +142,6 @@ def beam_generate(model: AbstractModule, prompt, decode_length: int,
                     return leaf
                 return jax.tree_util.tree_map_with_path(g, state)
 
-            def penalty(length):
-                if alpha == 0.0:
-                    return 1.0
-                return ((5.0 + length) / 6.0) ** alpha
-
             def run(params, state0, prompt):
                 pb = jnp.repeat(prompt, B, axis=0)       # (n*B, t0)
 
@@ -165,7 +160,7 @@ def beam_generate(model: AbstractModule, prompt, decode_length: int,
                     V = lp.shape[-1]
                     cand = (alive_lp[:, :, None]
                             + lp.reshape(n, B, V)).reshape(n, B * V)
-                    vals, idx = _lax.top_k(cand, 2 * B)
+                    vals, idx = lax.top_k(cand, 2 * B)
                     beam_idx, cand_tok = idx // V, (idx % V).astype(jnp.int32)
                     cand_seqs = jnp.take_along_axis(
                         seqs, beam_idx[:, :, None], axis=1)   # (n, 2B, L)
@@ -174,7 +169,7 @@ def beam_generate(model: AbstractModule, prompt, decode_length: int,
                                           cand_seqs)
                     is_eos = cand_tok == eos_id
 
-                    alive_vals, alive_sel = _lax.top_k(
+                    alive_vals, alive_sel = lax.top_k(
                         jnp.where(is_eos, neg, vals), B)
                     new_seqs = jnp.take_along_axis(
                         cand_seqs, alive_sel[:, :, None], axis=1)
@@ -183,11 +178,11 @@ def beam_generate(model: AbstractModule, prompt, decode_length: int,
 
                     # finished pool
                     dec_len = (i + 2 - t0).astype(jnp.float32)
-                    cand_fin = jnp.where(is_eos, vals / penalty(dec_len), neg)
+                    cand_fin = jnp.where(is_eos, vals / _length_penalty(dec_len, alpha), neg)
                     all_scores = jnp.concatenate([fin_scores, cand_fin], 1)
                     all_seqs = jnp.concatenate([fin_seqs, cand_seqs], 1)
                     all_flags = jnp.concatenate([fin_flags, is_eos], 1)
-                    top_scores, sel = _lax.top_k(all_scores, B)
+                    top_scores, sel = lax.top_k(all_scores, B)
                     nf_seqs = jnp.take_along_axis(all_seqs, sel[:, :, None], 1)
                     nf_flags = jnp.take_along_axis(all_flags, sel, 1)
 
@@ -200,9 +195,9 @@ def beam_generate(model: AbstractModule, prompt, decode_length: int,
                         jnp.where(in_prompt, identity, flat_parent))
                     tok_out = jnp.where(in_prompt, p_tok,
                                         new_tok.reshape(-1))
-                    prompt_seqs = jnp.where(onehot, p_tok.reshape(n, B)
-                                            [:, :, None], seqs)
-                    seqs_out = jnp.where(in_prompt, prompt_seqs, new_seqs)
+                    # prompt phase never modifies seqs: position i+1 already
+                    # holds the prompt token from the seqs0 init
+                    seqs_out = jnp.where(in_prompt, seqs, new_seqs)
                     alive_out = jnp.where(in_prompt, alive_lp, alive_vals)
                     fs_out = jnp.where(in_prompt, fin_seqs, nf_seqs)
                     fsc_out = jnp.where(in_prompt, fin_scores, top_scores)
@@ -219,14 +214,14 @@ def beam_generate(model: AbstractModule, prompt, decode_length: int,
                 carry0 = (state0, pb[:, 0], seqs0, alive0, fin_seqs0,
                           fin_scores0, fin_flags0)
                 (state, _, seqs, alive_lp, fin_seqs, fin_scores,
-                 fin_flags), _ = _lax.scan(step, carry0,
+                 fin_flags), _ = lax.scan(step, carry0,
                                            jnp.arange(total - 1))
 
-                alive_scores = alive_lp / penalty(float(decode_length))
+                alive_scores = alive_lp / _length_penalty(float(decode_length), alpha)
                 merged_scores = jnp.concatenate(
                     [jnp.where(fin_flags, fin_scores, neg), alive_scores], 1)
                 merged_seqs = jnp.concatenate([fin_seqs, seqs], 1)
-                out_scores, sel = _lax.top_k(merged_scores, B)
+                out_scores, sel = lax.top_k(merged_scores, B)
                 out_seqs = jnp.take_along_axis(merged_seqs,
                                                sel[:, :, None], 1)
                 return out_seqs, out_scores

@@ -56,6 +56,7 @@ def test_matches_static_block_with_eos():
         seqs_s, scores_s = (np.asarray(x) for x in out.values())
         if (seqs_s == eos).any():
             break
+    assert (seqs_s == eos).any(), "no eos fired: finished-pool untested"
     seqs_c, scores_c = beam_generate(model, prompt, dec, beam_size=B,
                                      eos_id=eos, alpha=0.6)
     np.testing.assert_array_equal(np.asarray(seqs_c), seqs_s)

@@ -55,12 +55,24 @@ class MultiHeadAttention(TensorModule):
                  with_bias: bool = True, attention_impl: str = "auto",
                  w_init: Optional[InitializationMethod] = None,
                  num_kv_heads: Optional[int] = None,
-                 rope: bool = False, rope_base: float = 10000.0):
+                 rope: bool = False, rope_base: float = 10000.0,
+                 window: Optional[int] = None):
         super().__init__()
         if embed_dim % num_heads != 0:
             raise ValueError(f"embed_dim {embed_dim} % num_heads {num_heads} != 0")
         if rope and (embed_dim // num_heads) % 2 != 0:
             raise ValueError("rope needs an even head_dim")
+        if window is not None:
+            if not causal:
+                raise ValueError("window (sliding-window attention) requires "
+                                 "causal=True")
+            if int(window) < 1:
+                raise ValueError(f"window must be >= 1, got {window!r}")
+            if attention_impl == "ring":
+                raise ValueError(
+                    "window is served by the masked single-device path; "
+                    "it cannot honor attention_impl='ring' (sequence-"
+                    "parallel banded attention is not implemented)")
         if attention_impl not in ("auto", "ring", "full", "flash"):
             raise ValueError(f"attention_impl must be auto|ring|full|flash, "
                              f"got {attention_impl!r}")
@@ -84,6 +96,12 @@ class MultiHeadAttention(TensorModule):
         self.attention_impl = attention_impl
         self.rope = bool(rope)
         self.rope_base = float(rope_base)
+        # sliding-window attention (Mistral-style): each position attends to
+        # the last `window` positions only — O(T·W) scores and a W-bounded
+        # decode cache REACH (the cache itself stays max_len; the mask bounds
+        # what the softmax sees). Served by the masked fused path; the flash
+        # kernel's banded tile-skip is a future fast path.
+        self.window = None if window is None else int(window)
         self.w_init = w_init or Xavier()
         self.reset()
 
@@ -172,7 +190,16 @@ class MultiHeadAttention(TensorModule):
             pos = jnp.arange(t)
             q = rope_rotate(q, pos, self.rope_base)
             k = rope_rotate(k, pos, self.rope_base)
-        o = self._attend(q, self._expand_kv(k), self._expand_kv(v))
+        if getattr(self, "window", None) is not None:
+            # masked single-device path (constructor rejects 'ring'+window);
+            # one fused band mask, mirroring _decode_step's composition
+            from bigdl_tpu.parallel.ring_attention import full_attention
+            diff = jnp.arange(t)[:, None] - jnp.arange(t)[None, :]
+            band = (diff >= 0) & (diff < self.window)
+            o = full_attention(q, self._expand_kv(k), self._expand_kv(v),
+                               causal=False, kv_mask=band[None, None])
+        else:
+            o = self._attend(q, self._expand_kv(k), self._expand_kv(v))
         o = o.transpose(0, 2, 1, 3).reshape(b, t, e)
         out = o @ params["out_weight"].T
         if self.with_bias:
@@ -207,9 +234,12 @@ class MultiHeadAttention(TensorModule):
         ck = lax.dynamic_update_slice(state["cache_k"], k, (0, 0, pos, 0))
         cv = lax.dynamic_update_slice(state["cache_v"], v, (0, 0, pos, 0))
         lmax = ck.shape[2]
+        kv_mask = jnp.arange(lmax) <= pos
+        if getattr(self, "window", None) is not None:
+            kv_mask &= jnp.arange(lmax) > pos - self.window
         o = full_attention(q, self._expand_kv(ck), self._expand_kv(cv),
                            causal=False,
-                           kv_mask=(jnp.arange(lmax) <= pos)[None, None, None])
+                           kv_mask=kv_mask[None, None, None])
         o = o.transpose(0, 2, 1, 3).reshape(b, 1, e)
         out = o @ params["out_weight"].T
         if self.with_bias:

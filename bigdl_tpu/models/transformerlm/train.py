@@ -24,6 +24,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-layers", type=int, default=2)
     p.add_argument("--vocab-size", type=int, default=256)
     p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings instead of the learned table")
+    p.add_argument("--num-kv-heads", type=int, default=None,
+                   help="grouped-query attention: KV heads shared across "
+                        "query-head groups (1 = multi-query)")
+    p.add_argument("--norm", default="layer", choices=["layer", "rms"])
+    p.add_argument("--mlp", default="gelu", choices=["gelu", "swiglu"])
     p.add_argument("--fused-head", action="store_true",
                    help="FusedLMHead + chunked softmax CE: the large-vocab "
                         "memory path (logits never materialized in training)")
@@ -70,7 +77,10 @@ def main(argv=None):
     model = TransformerLM(args.vocab_size, args.embed_dim, args.num_heads,
                           args.num_layers, max_len=args.seq_len,
                           dropout=args.dropout, remat=args.remat,
-                          fused_head=args.fused_head)
+                          fused_head=args.fused_head,
+                          num_kv_heads=args.num_kv_heads,
+                          position="rope" if args.rope else "learned",
+                          norm=args.norm, mlp_kind=args.mlp)
     criterion = lm_criterion(fused_head=args.fused_head)
     cls = DistriOptimizer if args.distributed else LocalOptimizer
     opt = (cls(model, data, criterion)
@@ -79,7 +89,9 @@ def main(argv=None):
     opt.optimize()
     print(f"final loss: {opt.state['loss']:.4f}")
     if args.generate:
-        if args.generate + args.seq_len // 4 > args.seq_len:
+        # rope models have no position table to outgrow; only the learned
+        # table bounds total length
+        if not args.rope and args.generate + args.seq_len // 4 > args.seq_len:
             raise SystemExit("--generate must fit in --seq-len (the model's "
                              "max_len) together with the seed prefix")
         seed = np.asarray(xs[0][: args.seq_len // 4])[None].astype(np.int32)

@@ -49,6 +49,7 @@ EXAMPLES = {
                   np.array([0.1, 0.1, 0.4, 0.4, 0.5, 0.5, 0.8, 0.8], np.float32),
                   np.tile([0.1, 0.1, 0.2, 0.2], 2).astype(np.float32)])[None]))),
     "FusedLMHead": (lambda: nn.FusedLMHead(6, 11).evaluate(), _x(2, 6)),
+    "RMSNorm": (lambda: nn.RMSNorm(5), _x(2, 5)),
     # round-4 sparse family tail
     "DenseToSparse": (lambda: nn.DenseToSparse(k=2), _x(2, 6)),
     "SparseJoinTable": (

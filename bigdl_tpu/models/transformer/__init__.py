@@ -1,4 +1,5 @@
 from bigdl_tpu.models.transformer.transformer import (
-    Transformer, TransformerDecoderBlock, beam_translate)
+    Transformer, TransformerDecoderBlock, beam_translate, translate_generate)
 
-__all__ = ["Transformer", "TransformerDecoderBlock", "beam_translate"]
+__all__ = ["Transformer", "TransformerDecoderBlock", "beam_translate",
+           "translate_generate"]

@@ -139,11 +139,12 @@ class _MemoryDecoder(Container):
         super().__init__(transformer)
         self._memory = jnp.asarray(memory)
 
+    def _tile(self, memory, rows: int):
+        return jnp.repeat(memory, rows // memory.shape[0], axis=0)
+
     def apply(self, params, state, input, *, training=False, rng=None):
         model = self.modules[0]
-        m, L = input.shape
-        reps = m // self._memory.shape[0]
-        memory = jnp.repeat(self._memory, reps, axis=0)
+        memory = self._tile(self._memory, input.shape[0])
         _, emb, dec, head = model.modules
         p, s = params["0"], state["0"]
         x, _ = emb.apply(p["1"], s["1"], input, training=False, rng=None)
@@ -168,3 +169,55 @@ def beam_translate(model: Transformer, src, *, beam_size: int = 4,
     prompt = jnp.full((src.shape[0], 1), bos_id, jnp.int32)
     out = bs.forward(prompt)
     return np.asarray(out[1]), np.asarray(out[2])
+
+
+class _CachedMemoryDecoder(_MemoryDecoder):
+    """Like :class:`_MemoryDecoder` but threads MODULE STATE through, so the
+    decoder stack's KV caches (``nn.install_decode_cache``) survive between
+    steps — the O(L)-per-token cached translate path.
+
+    The memory travels as a PARAMS leaf (not a closure constant) and the jit
+    cache is shared with the underlying transformer, so repeat translates of
+    the same shape reuse the compiled beam scan instead of retracing."""
+
+    def __init__(self, transformer: Transformer, memory):
+        super().__init__(transformer, memory)
+        self._apply_cache = transformer._apply_cache
+
+    def get_params(self):
+        return {**super().get_params(), "memory": self._memory}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        model = self.modules[0]
+        memory = self._tile(params["memory"], input.shape[0])
+        p, s = params["0"], state["0"]
+        x, s1 = model.modules[1].apply(p["1"], s["1"], input,
+                                       training=False, rng=None)
+        out, s2 = model.modules[2].apply(p["2"], s["2"], T(x, memory),
+                                         training=False, rng=None)
+        logp, s3 = model.modules[3].apply(p["3"], s["3"], out[1],
+                                          training=False, rng=None)
+        return logp, {"0": {"0": s["0"], "1": s1, "2": s2, "3": s3}}
+
+
+def translate_generate(model: Transformer, src, *, beam_size: int = 4,
+                       eos_id: int, bos_id: int, decode_length: int,
+                       alpha: float = 0.6, pad_id: int = 0):
+    """KV-cached beam translate — same contract (and, tie-breaks aside, the
+    same result — pinned by test) as :func:`beam_translate`, but the decoder
+    self-attention runs O(L) per generated token through the decode cache
+    instead of re-running the full target prefix every step. The cache scope
+    excludes the bidirectional encoder (it runs once, here, up front)."""
+    from bigdl_tpu.nn.incremental import beam_generate
+
+    src = jnp.asarray(src, jnp.int32)
+    enc = model.modules[0]
+    memory, _ = enc.apply(model.get_params()["0"], model.get_state()["0"],
+                          src, training=False, rng=None)
+    wrapped = _CachedMemoryDecoder(model, memory)
+    prompt = jnp.full((src.shape[0], 1), bos_id, jnp.int32)
+    seqs, scores = beam_generate(
+        wrapped, prompt, decode_length, beam_size=beam_size, eos_id=eos_id,
+        alpha=alpha, pad_id=pad_id,
+        cache_roots=[model.modules[1], model.modules[2]])
+    return np.asarray(seqs), np.asarray(scores)

@@ -34,18 +34,24 @@ def _iter_modules(m: AbstractModule):
 
 
 def install_decode_cache(model: AbstractModule, batch_size: int,
-                         max_len: int, dtype=jnp.float32) -> dict:
+                         max_len: int, dtype=jnp.float32,
+                         roots=None) -> dict:
     """Install zeroed decode caches into ``model``'s attention/position
     modules and return the full state pytree to carry through decode steps.
+
+    ``roots`` limits the cache scope to the given submodules (seq2seq: the
+    target embedding + decoder stack — the bidirectional encoder is never
+    stepped incrementally and must stay cache-free). Default: the whole
+    model.
 
     The model's regular (training/eval) path is restored by
     :func:`clear_decode_cache` — cached state and full-sequence apply are
     mutually exclusive."""
     from bigdl_tpu.models.transformerlm.transformerlm import PositionEmbedding
 
-    # validate the WHOLE tree before touching any state, so a raise never
+    # validate the WHOLE scope before touching any state, so a raise never
     # leaves the model half-cached
-    mods = list(_iter_modules(model))
+    mods = [m for r in (roots or [model]) for m in _iter_modules(r)]
     attns = [m for m in mods if isinstance(m, MultiHeadAttention)]
     if not attns:
         raise ValueError("model has no MultiHeadAttention modules to cache")
@@ -100,7 +106,7 @@ def greedy_generate(model: AbstractModule, prompt, decode_length: int,
 
 def beam_generate(model: AbstractModule, prompt, decode_length: int,
                   beam_size: int, eos_id: int = -1, alpha: float = 0.0,
-                  pad_id: int = 0, dtype=jnp.float32):
+                  pad_id: int = 0, dtype=jnp.float32, cache_roots=None):
     """KV-cached BEAM search: the O(L)-per-token serving form of
     :class:`~bigdl_tpu.nn.SequenceBeamSearch` (which re-runs the full prefix
     every step — O(L²) — because the reference's static-block formulation
@@ -123,7 +129,8 @@ def beam_generate(model: AbstractModule, prompt, decode_length: int,
     neg = -1e30
 
     params = model.get_params()
-    state0 = install_decode_cache(model, n * B, total, dtype=dtype)
+    state0 = install_decode_cache(model, n * B, total, dtype=dtype,
+                                  roots=cache_roots)
     try:
         key = ("beam_generate", n, t0, decode_length, B, eos_id,
                float(alpha), pad_id, jnp.dtype(dtype).name)

@@ -40,7 +40,7 @@ def main(argv=None):
 
     from bigdl_tpu import nn
     from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
-    from bigdl_tpu.models.transformer import Transformer, beam_translate
+    from bigdl_tpu.models.transformer import Transformer, translate_generate
     from bigdl_tpu.optim import Adam, DistriOptimizer, LocalOptimizer, Trigger
     from bigdl_tpu.utils.engine import Engine
 
@@ -108,7 +108,8 @@ def main(argv=None):
             hsrc = rng.integers(
                 0, payload, (args.translate, args.seq_len)).astype(np.int32)
             origin = "held-out"
-        seqs, scores = beam_translate(
+        # the KV-cached search (O(L)/token); result-equal to beam_translate
+        seqs, scores = translate_generate(
             model, hsrc, beam_size=args.beam, eos_id=eos, bos_id=bos,
             decode_length=hsrc.shape[1] + 1)
         for n in range(len(hsrc)):

@@ -47,6 +47,22 @@ def _same_pad(in_size: int, k: int, s: int):
     return lo, total - lo
 
 
+def _set_ceil(module, value: bool):
+    """Shared fluent ceil/floor mutator. Must also update the RECORDED
+    constructor args — the portable serializer rebuilds from those, and a
+    .ceil() lost in round-trip silently shrinks every downstream spatial
+    dim. Bind the recorded positionals to parameter NAMES first, else a
+    positionally passed ceil_mode would collide with (or silently override)
+    the kwarg at rebuild time."""
+    import inspect
+    module.ceil_mode = value
+    args, kwargs = module._init_args
+    names = list(inspect.signature(type(module).__init__).parameters)[1:]
+    module._init_args = ((), {**dict(zip(names, args)), **kwargs,
+                              "ceil_mode": value})
+    return module
+
+
 class SpatialMaxPooling(TensorModule):
     def __init__(self, kw: int, kh: int, dw: int | None = None, dh: int | None = None,
                  pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,
@@ -61,26 +77,11 @@ class SpatialMaxPooling(TensorModule):
             raise ValueError(f"pad_mode must be torch|same, got {pad_mode!r}")
         self.pad_mode = pad_mode
 
-    def _set_ceil(self, value: bool):
-        self.ceil_mode = value
-        # fluent mutators must also update the RECORDED constructor args —
-        # the portable serializer rebuilds from those, and a .ceil() lost in
-        # round-trip silently shrinks every downstream spatial dim. Bind the
-        # recorded positionals to parameter NAMES first, else a positionally
-        # passed ceil_mode would collide with (or silently override) the
-        # kwarg at rebuild time.
-        import inspect
-        args, kwargs = self._init_args
-        names = list(inspect.signature(type(self).__init__).parameters)[1:]
-        merged = {**dict(zip(names, args)), **kwargs, "ceil_mode": value}
-        self._init_args = ((), merged)
-        return self
-
     def ceil(self) -> "SpatialMaxPooling":
-        return self._set_ceil(True)
+        return _set_ceil(self, True)
 
     def floor(self) -> "SpatialMaxPooling":
-        return self._set_ceil(False)
+        return _set_ceil(self, False)
 
     def apply(self, params, state, input, *, training=False, rng=None):
         from bigdl_tpu.nn import layout
@@ -133,13 +134,10 @@ class SpatialAveragePooling(TensorModule):
         self.pad_mode = pad_mode
 
     def ceil(self) -> "SpatialAveragePooling":
-        self.ceil_mode = True
-        import inspect
-        args, kwargs = self._init_args
-        names = list(inspect.signature(type(self).__init__).parameters)[1:]
-        self._init_args = ((), {**dict(zip(names, args)), **kwargs,
-                                "ceil_mode": True})
-        return self
+        return _set_ceil(self, True)
+
+    def floor(self) -> "SpatialAveragePooling":
+        return _set_ceil(self, False)
 
     def apply(self, params, state, input, *, training=False, rng=None):
         from bigdl_tpu.nn import layout

@@ -68,6 +68,7 @@ from bigdl_tpu.nn.sparse import (
     SparseLinear,
 )
 from bigdl_tpu.nn.roi import RoiPooling
+from bigdl_tpu.nn.lora import LoRALinear, apply_lora, merge_lora
 from bigdl_tpu.nn.fused_loss import (
     ChunkedSoftmaxCrossEntropy, FusedLMHead, chunked_softmax_xent,
 )

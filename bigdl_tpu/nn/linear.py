@@ -16,6 +16,17 @@ from bigdl_tpu.nn.abstractnn import TensorModule
 from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform, Zeros
 
 
+def normalize_linear_input(input):
+    """Reference Linear shape rule, shared with LoRALinear so the two can't
+    drift: >2-D flattens to (batch, -1); 1-D promotes to a single row (and
+    the returned ``restore`` demotes the output back)."""
+    if input.ndim > 2:
+        return input.reshape(input.shape[0], -1), (lambda out: out)
+    if input.ndim == 1:
+        return input[None, :], (lambda out: out[0])
+    return input, (lambda out: out)
+
+
 class Linear(TensorModule):
     def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
                  w_init: Optional[InitializationMethod] = None,
@@ -42,19 +53,11 @@ class Linear(TensorModule):
         self.zero_grad_parameters()
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        x = input
-        flattened = False
-        if x.ndim > 2:
-            x = x.reshape(x.shape[0], -1)
-            flattened = True
-        elif x.ndim == 1:
-            x = x[None, :]
+        x, restore = normalize_linear_input(input)
         out = x @ params["weight"].T
         if self.with_bias:
             out = out + params["bias"]
-        if input.ndim == 1 and not flattened:
-            out = out[0]
-        return out, state
+        return restore(out), state
 
     def __repr__(self):
         return f"Linear({self.input_size} -> {self.output_size})"

@@ -1,0 +1,186 @@
+"""LoRA — low-rank adaptation for fine-tuning.
+
+No reference counterpart (pre-dates it); this is the modern fine-tuning
+companion to ``freeze()``: instead of updating a pretrained ``W`` (out, in),
+train only a rank-``r`` residual ``B @ A`` (``A`` (r, in), ``B`` (out, r)) —
+``out = x Wᵀ + (x Aᵀ) Bᵀ · α/r``. Parameter count and optimizer-state
+memory drop from ``out·in`` to ``r·(out+in)`` per adapted layer, and the
+frozen base rides the existing gradient-scale machinery (its grad leaves get
+scale 0 inside the jitted step — byte-identical through training, pinned by
+test).
+
+``apply_lora(model, rank)`` swaps every ``nn.Linear`` in the module tree
+(containers and Graph nodes) for a :class:`LoRALinear` carrying the original
+weights; ``merge_lora(model)`` bakes ``W + BA·α/r`` back into plain Linears
+for serving (merged forward == adapted forward, pinned by test).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, Container, TensorModule
+from bigdl_tpu.nn.graph import Graph
+from bigdl_tpu.nn.initialization import RandomNormal
+from bigdl_tpu.nn.linear import Linear
+
+
+class LoRALinear(TensorModule):
+    """A Linear whose base weights are frozen and whose update lives in a
+    trainable rank-``rank`` residual. Construct via :meth:`from_linear`."""
+
+    def __init__(self, input_size: int, output_size: int, rank: int,
+                 alpha: Optional[float] = None, with_bias: bool = True):
+        super().__init__()
+        if rank < 1:
+            raise ValueError(f"rank must be >= 1, got {rank!r}")
+        self.input_size, self.output_size = int(input_size), int(output_size)
+        self.rank = int(rank)
+        self.alpha = float(alpha) if alpha is not None else float(rank)
+        self.with_bias = with_bias
+        self.reset()
+
+    def reset(self) -> None:
+        # base starts zero (from_linear overwrites with the pretrained
+        # weights); A gaussian / B zero is the standard init — the adapter
+        # starts as an exact identity of the base
+        p = {"weight": jnp.zeros((self.output_size, self.input_size),
+                                 jnp.float32),
+             "lora_a": jnp.asarray(RandomNormal(0.0, 0.02).init(
+                 (self.rank, self.input_size),
+                 fan_in=self.input_size, fan_out=self.rank)),
+             "lora_b": jnp.zeros((self.output_size, self.rank), jnp.float32)}
+        if self.with_bias:
+            p["bias"] = jnp.zeros((self.output_size,), jnp.float32)
+        self._params = p
+        self.zero_grad_parameters()
+
+    @classmethod
+    def from_linear(cls, lin: Linear, rank: int,
+                    alpha: Optional[float] = None) -> "LoRALinear":
+        m = cls(lin.input_size, lin.output_size, rank, alpha,
+                with_bias=lin.with_bias)
+        base = lin.get_params()
+        p = m.get_params()
+        p["weight"] = base["weight"]
+        if "bias" in base:
+            p["bias"] = base["bias"]
+        m.set_params(p)
+        m.set_name(lin.name)
+        return m
+
+    def grad_scales(self) -> dict:
+        # base weight/bias frozen; only the adapter trains (whole-module
+        # freeze() still wins if requested)
+        if self.is_frozen():
+            return {k: 0.0 for k in self._params}
+        return {k: (self.scale_w if k.startswith("lora") else 0.0)
+                for k in self._params}
+
+    def merged_weight(self, params) -> jnp.ndarray:
+        return params["weight"] + (params["lora_b"] @ params["lora_a"]
+                                   * (self.alpha / self.rank))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.nn.linear import normalize_linear_input
+        x, restore = normalize_linear_input(input)
+        out = (x @ params["weight"].T
+               + (x @ params["lora_a"].T) @ params["lora_b"].T
+               * (self.alpha / self.rank))
+        if self.with_bias:
+            out = out + params["bias"]
+        return restore(out), state
+
+    def to_linear(self) -> Linear:
+        """Bake the adapter into a plain Linear (serving form)."""
+        lin = Linear(self.input_size, self.output_size,
+                     with_bias=self.with_bias)
+        p = self.get_params()
+        merged = {"weight": self.merged_weight(p)}
+        if self.with_bias:
+            merged["bias"] = p["bias"]
+        lin.set_params(merged)
+        lin.set_name(self.name)
+        return lin
+
+    def __repr__(self):
+        return (f"LoRALinear({self.input_size} -> {self.output_size}, "
+                f"rank={self.rank}, alpha={self.alpha})")
+
+
+def _swap_modules(root: AbstractModule, replace) -> int:
+    """Walk the container/Graph tree, calling ``replace(m)`` on every module;
+    a non-None return swaps the module in place. Returns the swap count."""
+    count = 0
+
+    def walk(m):
+        nonlocal count
+        if isinstance(m, Graph):
+            for node in m.exec_nodes:
+                new = replace(node.module)
+                if new is not None:
+                    node.module = new
+                    count += 1
+                else:
+                    walk(node.module)
+            m.modules = [n.module for n in m.exec_nodes]
+        elif isinstance(m, Container):
+            for i, c in enumerate(m.modules):
+                new = replace(c)
+                if new is not None:
+                    m.modules[i] = new
+                    count += 1
+                else:
+                    walk(c)
+
+    walk(root)
+    return count
+
+
+def apply_lora(model: AbstractModule, rank: int,
+               alpha: Optional[float] = None,
+               freeze_rest: bool = True) -> int:
+    """Swap every ``nn.Linear`` under ``model`` for a LoRA adapter carrying
+    the original (now frozen) weights. Returns the number of adapted layers.
+
+    ``freeze_rest=True`` (the LoRA convention) additionally freezes every
+    OTHER module — convs, norms, embeddings — so ONLY the adapters train;
+    ``freeze_rest=False`` leaves non-Linear layers trainable (partial
+    fine-tuning). Set the model on the Optimizer AFTER adapting so the
+    compiled step sees the new structure."""
+    if type(model) is Linear:
+        raise ValueError(
+            "apply_lora cannot swap a bare nn.Linear root in place — use "
+            "LoRALinear.from_linear(model, rank) directly")
+    # validate BEFORE freezing so a raise leaves the model untouched
+    found = []
+
+    def probe(m):
+        if type(m) is Linear:
+            found.append(m)
+        return None   # never swaps — count only
+
+    _swap_modules(model, probe)
+    if not found:
+        raise ValueError("apply_lora found no nn.Linear layers to adapt")
+    if freeze_rest:
+        model.freeze()
+    return _swap_modules(
+        model,
+        lambda m: (LoRALinear.from_linear(m, rank, alpha)
+                   if type(m) is Linear else None))
+
+
+def merge_lora(model: AbstractModule) -> int:
+    """Bake every LoRA adapter under ``model`` back into a plain Linear
+    (merged forward == adapted forward). Returns the merge count."""
+    if isinstance(model, LoRALinear):
+        raise ValueError(
+            "merge_lora cannot swap a bare LoRALinear root in place — use "
+            "model.to_linear() directly")
+    return _swap_modules(
+        model,
+        lambda m: m.to_linear() if isinstance(m, LoRALinear) else None)

@@ -187,6 +187,8 @@ def _module_spec(m, ctx: _Arrays) -> dict:
                             "iid": iid}
     if m.scale_w != 1.0 or m.scale_b != 1.0:
         spec["scale_w"], spec["scale_b"] = m.scale_w, m.scale_b
+    if getattr(m, "_frozen", False):
+        spec["frozen"] = True
     args, kwargs = getattr(m, "_init_args", ((), {}))
 
     if isinstance(m, Container):
@@ -329,6 +331,8 @@ def _build_module(spec: dict, arrays: list[np.ndarray],
     m.name = spec.get("name", m.name)
     m.scale_w = spec.get("scale_w", 1.0)
     m.scale_b = spec.get("scale_b", 1.0)
+    if spec.get("frozen"):
+        m._frozen = True
     if "iid" in spec:
         cache[spec["iid"]] = m
     return m

@@ -1,0 +1,164 @@
+"""LoRA: identity-at-init, frozen base (byte-identical through training),
+adapter-only gradients, merge equality, Graph surgery, serializer round
+trip, and a fine-tune that actually learns."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _mlp(seed=31):
+    RandomGenerator.set_seed(seed)
+    m = nn.Sequential()
+    m.add(nn.Linear(8, 16))
+    m.add(nn.ReLU())
+    m.add(nn.Linear(16, 4))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def test_adapter_is_identity_at_init():
+    m = _mlp()
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 8).astype(np.float32))
+    m.evaluate()
+    want = np.asarray(m.forward(x))
+    n = nn.apply_lora(m, rank=2)
+    assert n == 2
+    m.evaluate()
+    np.testing.assert_allclose(np.asarray(m.forward(x)), want, rtol=1e-6)
+
+
+def test_only_adapter_gets_gradients_and_base_stays_frozen():
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+    Engine.reset()
+    Engine.init(seed=0)
+    m = _mlp()
+    nn.apply_lora(m, rank=2)
+    flat = jax.tree_util.tree_leaves_with_path(m.get_params())
+    before = {jax.tree_util.keystr(k): np.asarray(v).copy() for k, v in flat}
+
+    rng = np.random.default_rng(1)
+    data = DataSet.array([
+        MiniBatch(rng.normal(size=(16, 8)).astype(np.float32),
+                  rng.integers(0, 4, size=(16,)).astype(np.int32))
+        for _ in range(2)])
+    opt = (LocalOptimizer(m, data, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(learningrate=0.5))
+           .set_end_when(Trigger.max_iteration(4)))
+    opt.optimize()
+    after = {jax.tree_util.keystr(k): np.asarray(v)
+             for k, v in jax.tree_util.tree_leaves_with_path(m.get_params())}
+    for k in before:
+        if "lora" not in k:   # base weight/bias: byte-identical through training
+            np.testing.assert_array_equal(before[k], after[k], err_msg=k)
+    moved = [k for k in before
+             if "lora" in k and not np.array_equal(before[k], after[k])]
+    assert moved, "no adapter leaf changed during training"
+
+
+def test_lora_finetune_learns_then_merges():
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.optim import Adam, LocalOptimizer, Trigger
+
+    Engine.reset()
+    Engine.init(seed=0)
+    m = _mlp(seed=33)
+    nn.apply_lora(m, rank=4)
+    rng = np.random.default_rng(2)
+    xs = rng.normal(size=(128, 8)).astype(np.float32)
+    ys = (xs[:, 0] > 0).astype(np.int32) + 2 * (xs[:, 1] > 0).astype(np.int32)
+    data = DataSet.array([MiniBatch(xs[i:i + 16], ys[i:i + 16])
+                          for i in range(0, 128, 16)])
+    opt = (LocalOptimizer(m, data, nn.ClassNLLCriterion())
+           .set_optim_method(Adam(learningrate=0.05))
+           .set_end_when(Trigger.max_epoch(30)))
+    opt.optimize()
+    m.evaluate()
+    acc = (np.asarray(m.forward(jnp.asarray(xs))).argmax(-1) == ys).mean()
+    assert acc > 0.9, f"LoRA fine-tune failed to learn (acc={acc})"
+
+    # merge: plain Linears, same outputs
+    want = np.asarray(m.forward(jnp.asarray(xs[:8])))
+    n = nn.merge_lora(m)
+    assert n == 2
+    assert all(type(c) is not nn.LoRALinear for c in m.modules)
+    m.evaluate()
+    np.testing.assert_allclose(np.asarray(m.forward(jnp.asarray(xs[:8]))),
+                               want, rtol=1e-4, atol=1e-5)
+
+
+def test_apply_lora_reaches_graph_nodes():
+    inp = nn.Input()
+    h = nn.Linear(6, 5).inputs(inp)
+    r = nn.ReLU().inputs(h)
+    out = nn.Linear(5, 3).inputs(r)
+    g = nn.Graph([inp], [out])
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 6).astype(np.float32))
+    g.evaluate()
+    want = np.asarray(g.forward(x))
+    assert nn.apply_lora(g, rank=2) == 2
+    g.evaluate()
+    np.testing.assert_allclose(np.asarray(g.forward(x)), want, rtol=1e-6)
+
+
+def test_timedistributed_linear_adapted():
+    m = nn.Sequential().add(nn.TimeDistributed(nn.Linear(4, 4)))
+    assert nn.apply_lora(m, rank=2) == 1
+    x = jnp.asarray(np.random.RandomState(4).randn(2, 3, 4).astype(np.float32))
+    assert m.forward(x).shape == (2, 3, 4)
+
+
+def test_no_linear_raises_without_mutating():
+    m = nn.Sequential().add(nn.SpatialConvolution(1, 2, 3, 3))
+    with pytest.raises(ValueError, match="no nn.Linear"):
+        nn.apply_lora(m, rank=2)
+    assert not m.modules[0].is_frozen(), "failed apply_lora mutated the model"
+
+
+def test_bare_roots_rejected_loudly():
+    with pytest.raises(ValueError, match="from_linear"):
+        nn.apply_lora(nn.Linear(4, 4), rank=2)
+    lora = nn.LoRALinear.from_linear(nn.Linear(4, 4), rank=2)
+    with pytest.raises(ValueError, match="to_linear"):
+        nn.merge_lora(lora)
+
+
+def test_frozen_flag_survives_archive_roundtrip():
+    import os
+    import tempfile
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(1, 2, 3, 3))
+    m.add(nn.Flatten() if hasattr(nn, "Flatten") else nn.Identity())
+    m.add(nn.Linear(2 * 6 * 6, 3))
+    nn.apply_lora(m, rank=2)          # freezes the conv too
+    assert m.modules[0].is_frozen()
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "f.bigdl")
+        m.save_module(p)
+        m2 = nn.AbstractModule.load(p)
+    assert m2.modules[0].is_frozen(), \
+        "frozen-trunk contract lost in the portable archive round trip"
+
+
+def test_serializer_roundtrip_lora():
+    import os
+    import tempfile
+    m = _mlp(seed=35)
+    nn.apply_lora(m, rank=2)
+    m.evaluate()
+    x = jnp.asarray(np.random.RandomState(5).randn(2, 8).astype(np.float32))
+    want = np.asarray(m.forward(x))
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "lora.bigdl")
+        m.save_module(p)
+        m2 = nn.AbstractModule.load(p)
+    m2.evaluate()
+    np.testing.assert_allclose(np.asarray(m2.forward(x)), want, rtol=1e-5)

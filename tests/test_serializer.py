@@ -50,6 +50,7 @@ EXAMPLES = {
                   np.tile([0.1, 0.1, 0.2, 0.2], 2).astype(np.float32)])[None]))),
     "FusedLMHead": (lambda: nn.FusedLMHead(6, 11).evaluate(), _x(2, 6)),
     "RMSNorm": (lambda: nn.RMSNorm(5), _x(2, 5)),
+    "LoRALinear": (lambda: nn.LoRALinear(4, 3, rank=2), _x(2, 4)),
     # round-4 sparse family tail
     "DenseToSparse": (lambda: nn.DenseToSparse(k=2), _x(2, 6)),
     "SparseJoinTable": (

@@ -3,11 +3,13 @@
 No reference counterpart (pre-dates it); this is the modern fine-tuning
 companion to ``freeze()``: instead of updating a pretrained ``W`` (out, in),
 train only a rank-``r`` residual ``B @ A`` (``A`` (r, in), ``B`` (out, r)) —
-``out = x Wᵀ + (x Aᵀ) Bᵀ · α/r``. Parameter count and optimizer-state
-memory drop from ``out·in`` to ``r·(out+in)`` per adapted layer, and the
-frozen base rides the existing gradient-scale machinery (its grad leaves get
-scale 0 inside the jitted step — byte-identical through training, pinned by
-test).
+``out = x Wᵀ + (x Aᵀ) Bᵀ · α/r``. Trainable parameters drop from ``out·in``
+to ``r·(out+in)`` per adapted layer; the frozen base rides the gradient-
+scale machinery (scale 0 → ``stop_gradient`` before the forward, so XLA
+dead-codes the frozen backward entirely — byte-identical through training
+AND no frozen backward compute, both pinned by test). Optimizer slots are
+still allocated for frozen leaves (they hold zeros); trimming them is a
+known follow-up, not claimed.
 
 ``apply_lora(model, rank)`` swaps every ``nn.Linear`` in the module tree
 (containers and Graph nodes) for a :class:`LoRALinear` carrying the original

@@ -308,6 +308,19 @@ class Optimizer:
         scale_tree = model.grad_scales()
         if all(s == 1.0 for s in jax.tree_util.tree_leaves(scale_tree)):
             scale_tree = None
+        # frozen (scale==0) leaves: stop_gradient BEFORE the forward so XLA
+        # dead-codes their whole backward — freeze()/LoRA then actually SKIP
+        # the frozen backward compute instead of computing grads and zeroing
+        # them. Numerically identical (stopped grads are exact zeros).
+        has_frozen = scale_tree is not None and any(
+            s == 0.0 for s in jax.tree_util.tree_leaves(scale_tree))
+
+        def stop_frozen(p):
+            if not has_frozen:
+                return p
+            return jax.tree_util.tree_map(
+                lambda leaf, s: jax.lax.stop_gradient(leaf) if s == 0.0
+                else leaf, p, scale_tree)
         # static: models without attached regularizers trace unchanged
         has_reg = model.has_regularizers()
 
@@ -344,6 +357,7 @@ class Optimizer:
             rng0 = jax.random.fold_in(base_rng, step_idx) if needs_rng else None
 
             def loss_fn(p, ms, x, t, rng):
+                p = stop_frozen(p)
                 if mixed:
                     p = cast_floating(p, compute_dtype)
                     x = cast_floating(x, compute_dtype)

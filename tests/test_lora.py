@@ -148,6 +148,54 @@ def test_frozen_flag_survives_archive_roundtrip():
         "frozen-trunk contract lost in the portable archive round trip"
 
 
+def test_frozen_backward_is_dead_coded():
+    """freeze()/LoRA must SKIP the frozen backward, not compute-and-zero it:
+    the compiled step of a frozen-trunk model has measurably fewer XLA flops
+    than the fully-trainable step."""
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.optim import LocalOptimizer, SGD
+
+    def step_flops(freeze_trunk):
+        Engine.reset()
+        Engine.init(seed=0)
+        RandomGenerator.set_seed(40)
+        m = nn.Sequential()
+        m.add(nn.SpatialConvolution(3, 16, 3, 3, pad_w=1, pad_h=1))
+        m.add(nn.ReLU())
+        m.add(nn.SpatialConvolution(16, 16, 3, 3, pad_w=1, pad_h=1))
+        m.add(nn.ReLU())
+        m.add(nn.Reshape([16 * 16 * 16]))
+        m.add(nn.Linear(16 * 16 * 16, 5))
+        m.add(nn.LogSoftMax())
+        if freeze_trunk:
+            for c in m.modules[:4]:
+                c.freeze()
+        rng = np.random.default_rng(0)
+        data = DataSet.array([MiniBatch(
+            rng.normal(size=(8, 3, 16, 16)).astype(np.float32),
+            rng.integers(0, 5, size=(8,)).astype(np.int32))])
+        opt = LocalOptimizer(m, data, nn.ClassNLLCriterion()) \
+            .set_optim_method(SGD(learningrate=0.1))
+        step = opt._compile_step()
+        p = m.get_params()
+        lowered = step.lower(p, m.get_state(),
+                             opt.optim_method.init_state(p),
+                             jnp.asarray(0, jnp.int32),
+                             jnp.zeros((8, 3, 16, 16), jnp.float32),
+                             jnp.zeros((8,), jnp.int32), None)
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca["flops"])
+
+    full = step_flops(False)
+    frozen = step_flops(True)
+    assert frozen < 0.8 * full, (
+        f"frozen-trunk step flops {frozen} not meaningfully below full "
+        f"{full} — the frozen backward is still being computed")
+
+
 def test_serializer_roundtrip_lora():
     import os
     import tempfile

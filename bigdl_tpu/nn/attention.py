@@ -56,7 +56,9 @@ class MultiHeadAttention(TensorModule):
                  w_init: Optional[InitializationMethod] = None,
                  num_kv_heads: Optional[int] = None,
                  rope: bool = False, rope_base: float = 10000.0,
-                 window: Optional[int] = None):
+                 window: Optional[int] = None,
+                 lora_rank: Optional[int] = None,
+                 lora_alpha: Optional[float] = None):
         super().__init__()
         if embed_dim % num_heads != 0:
             raise ValueError(f"embed_dim {embed_dim} % num_heads {num_heads} != 0")
@@ -102,6 +104,9 @@ class MultiHeadAttention(TensorModule):
         # what the softmax sees). Served by the masked fused path; the flash
         # kernel's banded tile-skip is a future fast path.
         self.window = None if window is None else int(window)
+        self.lora_rank = None if lora_rank is None else int(lora_rank)
+        self.lora_alpha = (float(lora_alpha) if lora_alpha is not None
+                           else (float(lora_rank) if lora_rank else None))
         self.w_init = w_init or Xavier()
         self.reset()
 
@@ -133,6 +138,8 @@ class MultiHeadAttention(TensorModule):
                 self._params["q_bias"] = jnp.zeros((e,), jnp.float32)
                 self._params["kv_bias"] = jnp.zeros((kv,), jnp.float32)
                 self._params["out_bias"] = jnp.zeros((e,), jnp.float32)
+        if getattr(self, "lora_rank", None):
+            self._extend_lora_params()   # adapters survive re-randomise
         self.zero_grad_parameters()
 
     def _expand_kv(self, x):
@@ -141,6 +148,80 @@ class MultiHeadAttention(TensorModule):
         if self.kv_heads == self.num_heads:
             return x
         return jnp.repeat(x, self.num_heads // self.kv_heads, axis=1)
+
+    # ----------------------------------------------------------------- LoRA
+    def _extend_lora_params(self) -> None:
+        from bigdl_tpu.nn.initialization import RandomNormal
+        r = self.lora_rank
+        for name in [k for k in self._params if k.endswith("_weight")]:
+            out_d, in_d = self._params[name].shape
+            self._params[f"lora_{name}_a"] = jnp.asarray(
+                RandomNormal(0.0, 0.02).init((r, in_d), fan_in=in_d,
+                                             fan_out=r))
+            self._params[f"lora_{name}_b"] = jnp.zeros((out_d, r), jnp.float32)
+        self.zero_grad_parameters()
+
+    def _rebuild_init_args(self, set_keys=None, pop_keys=()):
+        """Fluent-mutator bookkeeping: bind recorded positionals to names,
+        apply overrides — the serializer rebuilds from these."""
+        import inspect
+        args, kwargs = self._init_args
+        names = list(inspect.signature(type(self).__init__).parameters)[1:]
+        merged = {**dict(zip(names, args)), **kwargs, **(set_keys or {})}
+        for k in pop_keys:
+            merged.pop(k, None)
+        self._init_args = ((), merged)
+
+    def add_lora(self, rank: int, alpha: Optional[float] = None
+                 ) -> "MultiHeadAttention":
+        """Attach rank-``rank`` LoRA adapters to every projection (qkv/out);
+        base weights freeze (grad-scale 0), only the adapters train. Fluent
+        mutator: also updates the recorded constructor args so the portable
+        serializer rebuilds the adapted structure."""
+        if self.lora_rank:
+            raise ValueError("attention already has LoRA adapters")
+        if int(rank) < 1:
+            raise ValueError(f"rank must be >= 1, got {rank!r}")
+        self.lora_rank = int(rank)
+        self.lora_alpha = float(alpha) if alpha is not None else float(rank)
+        self._extend_lora_params()
+        self._rebuild_init_args({"lora_rank": self.lora_rank,
+                                 "lora_alpha": self.lora_alpha})
+        self._apply_cache = {}
+        return self
+
+    def merge_lora(self) -> "MultiHeadAttention":
+        """Bake the adapters into the base projections and drop them."""
+        if not self.lora_rank:
+            raise ValueError("attention has no LoRA adapters to merge")
+        p = self.get_params()
+        scale = self.lora_alpha / self.lora_rank
+        for name in [k for k in p if k.endswith("_weight")
+                     and not k.startswith("lora_")]:
+            a, b = p.pop(f"lora_{name}_a"), p.pop(f"lora_{name}_b")
+            p[name] = p[name] + b @ a * scale
+        self.set_params(p)
+        self.zero_grad_parameters()   # drop the stale lora grad entries
+        self.lora_rank = self.lora_alpha = None
+        self._rebuild_init_args(pop_keys=("lora_rank", "lora_alpha"))
+        self._apply_cache = {}
+        return self
+
+    def grad_scales(self) -> dict:
+        if self.is_frozen():
+            return {k: 0.0 for k in self._params}
+        if getattr(self, "lora_rank", None):
+            return {k: (self.scale_w if k.startswith("lora_") else 0.0)
+                    for k in self._params}
+        return super().grad_scales()
+
+    def _w(self, params, name):
+        """Effective projection weight: base, or base + BA·α/r under LoRA."""
+        w = params[name]
+        if getattr(self, "lora_rank", None):
+            w = w + (params[f"lora_{name}_b"] @ params[f"lora_{name}_a"]
+                     * (self.lora_alpha / self.lora_rank))
+        return w
 
     def _attend(self, q, k, v):
         from bigdl_tpu.parallel.ring_attention import full_attention, ring_attention
@@ -165,14 +246,14 @@ class MultiHeadAttention(TensorModule):
 
     def _project_qkv(self, params, input, b, t):
         if self.kv_heads == self.num_heads:
-            qkv = input @ params["qkv_weight"].T
+            qkv = input @ self._w(params, "qkv_weight").T
             if self.with_bias:
                 qkv = qkv + params["qkv_bias"]
             qkv = qkv.reshape(b, t, 3, self.num_heads, self.head_dim)
             q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
             return q, k, v                                     # all (b,h,t,d)
-        q = input @ params["q_weight"].T
-        kv = input @ params["kv_weight"].T
+        q = input @ self._w(params, "q_weight").T
+        kv = input @ self._w(params, "kv_weight").T
         if self.with_bias:
             q = q + params["q_bias"]
             kv = kv + params["kv_bias"]
@@ -201,7 +282,7 @@ class MultiHeadAttention(TensorModule):
         else:
             o = self._attend(q, self._expand_kv(k), self._expand_kv(v))
         o = o.transpose(0, 2, 1, 3).reshape(b, t, e)
-        out = o @ params["out_weight"].T
+        out = o @ self._w(params, "out_weight").T
         if self.with_bias:
             out = out + params["out_bias"]
         return out, state
@@ -241,7 +322,7 @@ class MultiHeadAttention(TensorModule):
                            causal=False,
                            kv_mask=kv_mask[None, None, None])
         o = o.transpose(0, 2, 1, 3).reshape(b, 1, e)
-        out = o @ params["out_weight"].T
+        out = o @ self._w(params, "out_weight").T
         if self.with_bias:
             out = out + params["out_bias"]
         return out, {"cache_k": ck, "cache_v": cv, "pos": pos + 1}

@@ -153,6 +153,8 @@ def apply_lora(model: AbstractModule, rank: int,
     ``freeze_rest=False`` leaves non-Linear layers trainable (partial
     fine-tuning). Set the model on the Optimizer AFTER adapting so the
     compiled step sees the new structure."""
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+
     if type(model) is Linear:
         raise ValueError(
             "apply_lora cannot swap a bare nn.Linear root in place — use "
@@ -161,28 +163,61 @@ def apply_lora(model: AbstractModule, rank: int,
     found = []
 
     def probe(m):
-        if type(m) is Linear:
+        if type(m) is Linear or (isinstance(m, MultiHeadAttention)
+                                 and not getattr(m, 'lora_rank', None)):
             found.append(m)
         return None   # never swaps — count only
 
     _swap_modules(model, probe)
+    if isinstance(model, MultiHeadAttention) and not getattr(model, 'lora_rank', None):
+        found.append(model)
     if not found:
-        raise ValueError("apply_lora found no nn.Linear layers to adapt")
+        raise ValueError(
+            "apply_lora found no nn.Linear or MultiHeadAttention to adapt")
     if freeze_rest:
         model.freeze()
-    return _swap_modules(
-        model,
-        lambda m: (LoRALinear.from_linear(m, rank, alpha)
-                   if type(m) is Linear else None))
+
+    n = 0
+
+    def adapt(m):
+        nonlocal n
+        if type(m) is Linear:
+            n += 1
+            return LoRALinear.from_linear(m, rank, alpha)
+        if isinstance(m, MultiHeadAttention) and not getattr(m, 'lora_rank', None):
+            # in place: unfreeze the module (freeze_rest froze it), attach
+            # adapters — grad_scales then freezes the base leaves only
+            m.unfreeze()
+            m.add_lora(rank, alpha)
+            n += 1
+        return None
+
+    adapt(model)            # the root itself may be an adaptable attention
+    _swap_modules(model, adapt)
+    return n
 
 
 def merge_lora(model: AbstractModule) -> int:
     """Bake every LoRA adapter under ``model`` back into a plain Linear
     (merged forward == adapted forward). Returns the merge count."""
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+
     if isinstance(model, LoRALinear):
         raise ValueError(
             "merge_lora cannot swap a bare LoRALinear root in place — use "
             "model.to_linear() directly")
-    return _swap_modules(
-        model,
-        lambda m: m.to_linear() if isinstance(m, LoRALinear) else None)
+    n = 0
+
+    def merge(m):
+        nonlocal n
+        if isinstance(m, LoRALinear):
+            n += 1
+            return m.to_linear()
+        if isinstance(m, MultiHeadAttention) and getattr(m, 'lora_rank', None):
+            m.merge_lora()
+            n += 1
+        return None
+
+    merge(model)            # the root itself may be an adapted attention
+    _swap_modules(model, merge)
+    return n

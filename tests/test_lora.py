@@ -210,3 +210,92 @@ def test_serializer_roundtrip_lora():
         m2 = nn.AbstractModule.load(p)
     m2.evaluate()
     np.testing.assert_allclose(np.asarray(m2.forward(x)), want, rtol=1e-5)
+
+
+def test_attention_lora_transformer_finetune():
+    """LoRA on a TransformerLM: attention projections + MLP Linears adapt,
+    all bases stay byte-frozen, adapters learn, merge == adapted."""
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.models.transformerlm import TransformerLM, lm_criterion
+    from bigdl_tpu.optim import Adam, LocalOptimizer, Trigger
+
+    Engine.reset()
+    Engine.init(seed=0)
+    rng = np.random.RandomState(44)
+    v, t = 17, 8
+    seqs = np.zeros((64, t + 1), np.int64)
+    seqs[:, 0] = rng.randint(0, v, 64)
+    for i in range(t):
+        seqs[:, i + 1] = (seqs[:, i] * 3 + 1) % v
+    model = TransformerLM(v, embed_dim=32, num_heads=4, num_layers=1,
+                          max_len=t)
+    n = nn.apply_lora(model, rank=4)
+    assert n >= 4   # attention + 2 mlp linears + decoder head
+
+    flat = jax.tree_util.tree_leaves_with_path(model.get_params())
+    before = {jax.tree_util.keystr(k): np.asarray(x).copy() for k, x in flat}
+    data = DataSet.array([Sample(s[:-1].astype(np.int32),
+                                 s[1:].astype(np.int32)) for s in seqs]) \
+        >> SampleToMiniBatch(16)
+    opt = (LocalOptimizer(model, data, lm_criterion())
+           .set_optim_method(Adam(learningrate=0.02))
+           .set_end_when(Trigger.max_epoch(40)))
+    opt.optimize()
+    after = {jax.tree_util.keystr(k): np.asarray(x)
+             for k, x in jax.tree_util.tree_leaves_with_path(model.get_params())}
+    for k in before:
+        if "lora" not in k:
+            np.testing.assert_array_equal(before[k], after[k], err_msg=k)
+    model.evaluate()
+    x = jnp.asarray(seqs[:16, :-1].astype(np.int32))
+    acc = (np.asarray(model.forward(x)).argmax(-1) == seqs[:16, 1:]).mean()
+    assert acc > 0.85, f"attention-LoRA fine-tune failed (acc={acc})"
+
+    want = np.asarray(model.forward(x))
+    assert nn.merge_lora(model) == n
+    model.evaluate()
+    np.testing.assert_allclose(np.asarray(model.forward(x)), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_attention_lora_identity_at_init_and_serializes():
+    import os
+    import tempfile
+    RandomGenerator.set_seed(45)
+    m = nn.MultiHeadAttention(16, 4, causal=True)
+    x = jnp.asarray(np.random.RandomState(6).randn(2, 5, 16).astype(np.float32))
+    m.evaluate()
+    want = np.asarray(m.forward(x))
+    m.add_lora(4)
+    m._apply_cache = {}
+    m.evaluate()
+    np.testing.assert_allclose(np.asarray(m.forward(x)), want, rtol=1e-6)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "a.bigdl")
+        m.save_module(p)
+        m2 = nn.AbstractModule.load(p)
+    assert m2.lora_rank == 4
+    m2.evaluate()
+    np.testing.assert_allclose(np.asarray(m2.forward(x)), want, rtol=1e-6)
+    m2.merge_lora()
+    assert not any(k.startswith("lora_") for k in m2.get_params())
+    m2._apply_cache = {}
+    np.testing.assert_allclose(np.asarray(m2.forward(x)), want, rtol=1e-5)
+
+
+def test_attention_lora_survives_reset_and_root_adapt():
+    RandomGenerator.set_seed(46)
+    m = nn.MultiHeadAttention(16, 4, causal=True)
+    assert nn.apply_lora(m, rank=2) == 1        # bare-MHA root adapts in place
+    assert m.lora_rank == 2
+    m.reset()                                   # re-randomise keeps adapters
+    assert any(k.startswith("lora_") for k in m.get_params())
+    x = jnp.asarray(np.random.RandomState(9).randn(1, 4, 16).astype(np.float32))
+    m.evaluate()
+    assert np.isfinite(np.asarray(m.forward(x))).all()
+    # merge refreshes grads: parameters()/grads stay aligned
+    assert nn.merge_lora(m) == 1
+    assert set(m.get_grads()) == set(m.get_params())
+    with pytest.raises(ValueError, match="rank"):
+        nn.MultiHeadAttention(16, 4).add_lora(0)

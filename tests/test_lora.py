@@ -299,3 +299,36 @@ def test_attention_lora_survives_reset_and_root_adapt():
     assert set(m.get_grads()) == set(m.get_params())
     with pytest.raises(ValueError, match="rank"):
         nn.MultiHeadAttention(16, 4).add_lora(0)
+
+
+def test_lora_composes_with_distri_fsdp():
+    """Adapters train under DistriOptimizer fsdp sharding on the mesh; bases
+    stay byte-frozen across the sharded update."""
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+    Engine.reset()
+    Engine.init(seed=0)
+    m = _mlp(seed=37)
+    nn.apply_lora(m, rank=2)
+    flat = jax.tree_util.tree_leaves_with_path(m.get_params())
+    before = {jax.tree_util.keystr(k): np.asarray(v).copy() for k, v in flat}
+    rng = np.random.default_rng(3)
+    data = DataSet.array([
+        MiniBatch(rng.normal(size=(16, 8)).astype(np.float32),
+                  rng.integers(0, 4, size=(16,)).astype(np.int32))
+        for _ in range(3)], distributed=True)
+    opt = (DistriOptimizer(m, data, nn.ClassNLLCriterion(),
+                           parameter_sync="fsdp")
+           .set_optim_method(SGD(learningrate=0.3))
+           .set_end_when(Trigger.max_iteration(5)))
+    opt.optimize()
+    after = {jax.tree_util.keystr(k): np.asarray(v)
+             for k, v in jax.tree_util.tree_leaves_with_path(m.get_params())}
+    for k in before:
+        if "lora" not in k:
+            np.testing.assert_array_equal(before[k], after[k], err_msg=k)
+    assert any("lora" in k and not np.array_equal(before[k], after[k])
+               for k in before)

@@ -24,6 +24,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-layers", type=int, default=2)
     p.add_argument("--vocab-size", type=int, default=256)
     p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--model-snapshot", default=None,
+                   help="start from a saved model archive (fine-tuning); "
+                        "vocab/seq-len/rope/fused-head are read from the "
+                        "model, not these flags")
+    p.add_argument("--save", default=None,
+                   help="save the trained model archive here")
+    p.add_argument("--lora", type=int, default=0, metavar="RANK",
+                   help="LoRA fine-tune: adapt attention+Linear layers at "
+                        "this rank, freeze everything else")
     p.add_argument("--rope", action="store_true",
                    help="rotary position embeddings instead of the learned table")
     p.add_argument("--num-kv-heads", type=int, default=None,
@@ -52,7 +61,8 @@ def main(argv=None):
     from bigdl_tpu import nn
     from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
     from bigdl_tpu.dataset.text import ptb_windows, synthetic_ptb
-    from bigdl_tpu.models.transformerlm import TransformerLM, lm_criterion
+    from bigdl_tpu.models.transformerlm import (
+        PositionEmbedding, TransformerLM, lm_criterion)
     from bigdl_tpu.optim import Adam, DistriOptimizer, LocalOptimizer, Trigger
     from bigdl_tpu.utils.engine import Engine
     from bigdl_tpu.utils.random_generator import RandomGenerator
@@ -60,6 +70,49 @@ def main(argv=None):
     if not Engine.is_initialized():
         Engine.init()
     RandomGenerator.set_seed(0)
+
+    from bigdl_tpu.nn.incremental import iter_modules
+
+    if args.lora and not args.model_snapshot:
+        print("WARNING: --lora without --model-snapshot freezes a RANDOM "
+              "base and trains only the adapters — this is almost never "
+              "what you want (LoRA fine-tunes a pretrained model)")
+    if args.model_snapshot:
+        model = nn.AbstractModule.load(args.model_snapshot)
+        # trust the MODEL, not the flags, for everything structural
+        mods = list(iter_modules(model))
+        args.fused_head = any(isinstance(m, nn.FusedLMHead) for m in mods)
+        args.rope = any(getattr(m, "rope", False) for m in mods
+                        if isinstance(m, nn.MultiHeadAttention))
+        emb = [m for m in mods if isinstance(m, nn.LookupTable)]
+        if emb and emb[0].n_index != args.vocab_size:
+            print(f"snapshot vocab {emb[0].n_index} overrides "
+                  f"--vocab-size {args.vocab_size}")
+            args.vocab_size = emb[0].n_index
+        pos = [m for m in mods if isinstance(m, PositionEmbedding)]
+        if pos and args.seq_len > pos[0].max_len:
+            print(f"snapshot max_len {pos[0].max_len} caps "
+                  f"--seq-len {args.seq_len}")
+            args.seq_len = pos[0].max_len
+    else:
+        model = TransformerLM(args.vocab_size, args.embed_dim, args.num_heads,
+                              args.num_layers, max_len=args.seq_len,
+                              dropout=args.dropout, remat=args.remat,
+                              fused_head=args.fused_head,
+                              num_kv_heads=args.num_kv_heads,
+                              position="rope" if args.rope else "learned",
+                              norm=args.norm, mlp_kind=args.mlp)
+    if args.lora:
+        already = any(isinstance(m, nn.LoRALinear)
+                      or getattr(m, "lora_rank", None)
+                      for m in iter_modules(model))
+        if already:
+            print("snapshot already carries LoRA adapters — resuming "
+                  "fine-tuning with them (bases stay frozen)")
+        else:
+            n = nn.apply_lora(model, rank=args.lora)
+            print(f"LoRA: adapted {n} modules at rank {args.lora} "
+                  f"(base frozen)")
 
     if args.folder is not None:
         from bigdl_tpu.dataset.text import Dictionary, SentenceTokenizer
@@ -74,13 +127,6 @@ def main(argv=None):
     data = (DataSet.array(samples, distributed=args.distributed)
             >> SampleToMiniBatch(args.batch_size))
 
-    model = TransformerLM(args.vocab_size, args.embed_dim, args.num_heads,
-                          args.num_layers, max_len=args.seq_len,
-                          dropout=args.dropout, remat=args.remat,
-                          fused_head=args.fused_head,
-                          num_kv_heads=args.num_kv_heads,
-                          position="rope" if args.rope else "learned",
-                          norm=args.norm, mlp_kind=args.mlp)
     criterion = lm_criterion(fused_head=args.fused_head)
     cls = DistriOptimizer if args.distributed else LocalOptimizer
     opt = (cls(model, data, criterion)
@@ -88,6 +134,9 @@ def main(argv=None):
            .set_end_when(Trigger.max_iteration(args.max_iteration)))
     opt.optimize()
     print(f"final loss: {opt.state['loss']:.4f}")
+    if args.save:
+        model.save_module(args.save)
+        print(f"saved to {args.save}")
     if args.generate:
         # rope models have no position table to outgrow; only the learned
         # table bounds total length

@@ -26,11 +26,16 @@ from bigdl_tpu.nn.abstractnn import AbstractModule, Container
 from bigdl_tpu.nn.attention import MultiHeadAttention
 
 
-def _iter_modules(m: AbstractModule):
+def iter_modules(m: AbstractModule):
+    """Depth-first module-tree iterator (the shared walker — reuse instead of
+    re-implementing per call site)."""
     yield m
     if isinstance(m, Container):
         for c in m.modules:
-            yield from _iter_modules(c)
+            yield from iter_modules(c)
+
+
+_iter_modules = iter_modules   # backward-compatible private alias
 
 
 def install_decode_cache(model: AbstractModule, batch_size: int,

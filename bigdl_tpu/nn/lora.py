@@ -113,6 +113,17 @@ class LoRALinear(TensorModule):
                 f"rank={self.rank}, alpha={self.alpha})")
 
 
+def _patch_init_args(parent: AbstractModule, old, new) -> None:
+    """Wrapper containers (TimeDistributed, Bottle, …) record their child in
+    ``_init_args``; after a swap the recorded reference must follow, or the
+    serializer re-encodes the STALE child (whose arrays the jit may have
+    donated and deleted)."""
+    args, kwargs = parent._init_args
+    parent._init_args = (
+        tuple(new if a is old else a for a in args),
+        {k: (new if v is old else v) for k, v in kwargs.items()})
+
+
 def _swap_modules(root: AbstractModule, replace) -> int:
     """Walk the container/Graph tree, calling ``replace(m)`` on every module;
     a non-None return swaps the module in place. Returns the swap count."""
@@ -134,6 +145,7 @@ def _swap_modules(root: AbstractModule, replace) -> int:
                 new = replace(c)
                 if new is not None:
                     m.modules[i] = new
+                    _patch_init_args(m, c, new)
                     count += 1
                 else:
                     walk(c)

@@ -332,3 +332,38 @@ def test_lora_composes_with_distri_fsdp():
             np.testing.assert_array_equal(before[k], after[k], err_msg=k)
     assert any("lora" in k and not np.array_equal(before[k], after[k])
                for k in before)
+
+
+def test_lora_swapped_wrapper_saves_after_training():
+    """Regression: TimeDistributed records its child in _init_args — after
+    apply_lora swaps it, save_module must encode the NEW child (the stale one
+    holds jit-donated, deleted arrays)."""
+    import os
+    import tempfile
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.models.transformerlm import TransformerLM, lm_criterion
+    from bigdl_tpu.optim import Adam, LocalOptimizer, Trigger
+
+    Engine.reset()
+    Engine.init(seed=0)
+    m = TransformerLM(48, embed_dim=32, num_heads=4, num_layers=1, max_len=16,
+                      position="rope")
+    nn.apply_lora(m, rank=4)
+    rng = np.random.default_rng(0)
+    data = DataSet.array([MiniBatch(
+        rng.integers(0, 48, (8, 16)).astype(np.int32),
+        rng.integers(0, 48, (8, 16)).astype(np.int32))])
+    opt = (LocalOptimizer(m, data, lm_criterion())
+           .set_optim_method(Adam(learningrate=1e-3))
+           .set_end_when(Trigger.max_iteration(3)))
+    opt.optimize()
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "tuned.bigdl")
+        m.save_module(p)          # raised RuntimeError before the fix
+        m2 = nn.AbstractModule.load(p)
+    m2.evaluate()
+    x = jnp.asarray(rng.integers(0, 48, (2, 16)).astype(np.int32))
+    m.evaluate()
+    np.testing.assert_allclose(np.asarray(m2.forward(x)),
+                               np.asarray(m.forward(x)), rtol=1e-5, atol=1e-6)

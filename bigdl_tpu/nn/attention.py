@@ -104,6 +104,8 @@ class MultiHeadAttention(TensorModule):
         # what the softmax sees). Served by the masked fused path; the flash
         # kernel's banded tile-skip is a future fast path.
         self.window = None if window is None else int(window)
+        if lora_rank is not None and int(lora_rank) < 1:
+            raise ValueError(f"lora_rank must be >= 1, got {lora_rank!r}")
         self.lora_rank = None if lora_rank is None else int(lora_rank)
         self.lora_alpha = (float(lora_alpha) if lora_alpha is not None
                            else (float(lora_rank) if lora_rank else None))

@@ -56,7 +56,11 @@ def install_decode_cache(model: AbstractModule, batch_size: int,
 
     # validate the WHOLE scope before touching any state, so a raise never
     # leaves the model half-cached
-    mods = [m for r in (roots or [model]) for m in _iter_modules(r)]
+    scope = roots if roots is not None else [model]
+    if not scope:
+        raise ValueError("roots=[] would cache nothing — pass None "
+                         "for whole-model scope")
+    mods = [m for r in scope for m in _iter_modules(r)]
     attns = [m for m in mods if isinstance(m, MultiHeadAttention)]
     if not attns:
         raise ValueError("model has no MultiHeadAttention modules to cache")
@@ -122,8 +126,14 @@ def beam_generate(model: AbstractModule, prompt, decode_length: int,
 
     Returns ``(sequences (N, beam, T0+decode_length), scores (N, beam))``,
     best beam first — the same contract (and, tie-breaks aside, the same
-    result) as SequenceBeamSearch, pinned by test."""
-    from bigdl_tpu.nn.beam_search import _length_penalty
+    result) as SequenceBeamSearch, pinned by test.
+
+    Known costs, accepted for one-scan simplicity: the prompt prefill runs at
+    ``n*beam`` batch with the beam algebra masked out (wasted prefill FLOPs
+    grow with beam_size; prefill-at-n then tile is the optimization if long
+    prompts dominate), and the step algebra mirrors SequenceBeamSearch.body
+    (the result-equality test keeps the two in lock-step)."""
+    from bigdl_tpu.nn.beam_search import _NEG, _length_penalty
 
     if beam_size < 1 or decode_length < 1:
         raise ValueError("beam_size and decode_length must be >= 1")
@@ -131,7 +141,7 @@ def beam_generate(model: AbstractModule, prompt, decode_length: int,
     n, t0 = prompt.shape
     B = int(beam_size)
     total = t0 + decode_length
-    neg = -1e30
+    neg = _NEG   # shared sentinel: result parity with SequenceBeamSearch
 
     params = model.get_params()
     state0 = install_decode_cache(model, n * B, total, dtype=dtype,
@@ -146,7 +156,10 @@ def beam_generate(model: AbstractModule, prompt, decode_length: int,
                 """Gather KV-cache rows to follow their parent beams.
                 Keyed on the decode-cache leaf names (cache_k/cache_v) so
                 unrelated state whose leading dim happens to equal n*B is
-                never permuted."""
+                never permuted. CONTRACT: any future module carrying other
+                per-batch-row decode state must either use these names or
+                extend this key set — unlisted per-row state would silently
+                keep the pre-reselection beam layout."""
                 def g(path, leaf):
                     key = path and getattr(path[-1], "key", None)
                     if key in ("cache_k", "cache_v"):
@@ -258,6 +271,8 @@ def generate(model: AbstractModule, prompt, decode_length: int,
     prompt = jnp.asarray(prompt, jnp.int32)
     n, t0 = prompt.shape
     total = t0 + decode_length
+    if sample and top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k!r}")
     if sample and rng is None:
         from bigdl_tpu.utils.random_generator import RandomGenerator
         rng = RandomGenerator.next_key()

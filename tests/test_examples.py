@@ -50,3 +50,15 @@ class TestImageClassificationGuards:
         from bigdl_tpu.examples.imageclassification.main import main
         with pytest.raises(SystemExit, match="--folder requires --model"):
             main(["--folder", "/tmp/nonexistent"])
+
+
+class TestFinetuneExample:
+    def test_lora_mode_learns_and_merges(self):
+        from bigdl_tpu.examples.finetune.main import main
+        acc = main(["--mode", "lora", "--merge", "--max-epoch", "25"])
+        assert acc > 0.8, f"lora fine-tune example failed (acc={acc})"
+
+    def test_head_mode_learns(self):
+        from bigdl_tpu.examples.finetune.main import main
+        acc = main(["--mode", "head", "--max-epoch", "25"])
+        assert acc > 0.8, f"head fine-tune example failed (acc={acc})"

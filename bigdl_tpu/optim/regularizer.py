@@ -14,8 +14,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from bigdl_tpu.nn.abstractnn import RecordsInit
 
-class Regularizer:
+
+class Regularizer(metaclass=RecordsInit):
     def penalty(self, w) -> jnp.ndarray:
         raise NotImplementedError
 
@@ -48,3 +50,12 @@ class L1L2Regularizer(Regularizer):
         w = w.astype(jnp.float32)
         return (self.l1 * jnp.sum(jnp.abs(w))
                 + 0.5 * self.l2 * jnp.sum(jnp.square(w)))
+
+
+# portable serialization: regularized layers record their regularizer as a
+# constructor arg — it must rebuild from the archive like any module
+from bigdl_tpu.utils.serializer import register as _register_serializable  # noqa: E402
+
+for _cls in (L1Regularizer, L2Regularizer, L1L2Regularizer):
+    _register_serializable(_cls)
+del _cls

@@ -93,13 +93,24 @@ def _registry() -> dict[str, type]:
 def register(cls: type, name: str | None = None) -> type:
     """Register an out-of-tree class for portable serialization."""
     _registry()[name or cls.__name__] = cls
+    _rev_registry()[cls] = name or cls.__name__
     return cls
 
 
+_REV: dict | None = None
+
+
+def _rev_registry() -> dict:
+    global _REV
+    if _REV is None:
+        _REV = {c: n for n, c in _registry().items()}
+    return _REV
+
+
 def _reg_name(cls: type) -> str:
-    for name, c in _registry().items():
-        if c is cls:
-            return name
+    name = _rev_registry().get(cls)
+    if name is not None:
+        return name
     raise SerializationError(
         f"{cls.__module__}.{cls.__name__} is not in the serialization registry; "
         f"export it from bigdl_tpu.nn or call serializer.register()")
@@ -134,6 +145,8 @@ def _encode_value(v: Any, ctx: _Arrays, child_ids: dict[int, int] | None) -> Any
 
     if v is None or isinstance(v, (bool, int, float, str)):
         return v
+    if isinstance(v, np.bool_):
+        return bool(v)
     if isinstance(v, (np.integer,)):
         return int(v)
     if isinstance(v, (np.floating,)):
@@ -155,17 +168,19 @@ def _encode_value(v: Any, ctx: _Arrays, child_ids: dict[int, int] | None) -> Any
         return {"__module__": _module_spec(v, ctx)}
     if hasattr(v, "shape") and hasattr(v, "dtype"):  # jnp / np array
         return {"__array__": ctx.add(v)}
+    if hasattr(v, "_init_args"):
+        # before callable(): RecordsInit objects (criterions, regularizers)
+        # may define __call__ but must rebuild from their recorded args
+        args, kwargs = v._init_args
+        return {"__obj__": _reg_name(type(v)),
+                "args": [_encode_value(a, ctx, None) for a in args],
+                "kwargs": {k: _encode_value(a, ctx, None) for k, a in kwargs.items()}}
     if callable(v):
         name = _fn_name(v)
         if name is not None:
             return {"__fn__": name}
         raise SerializationError(
             f"cannot serialize callable {v!r}; whitelist it in serializer._FN_WHITELIST")
-    if hasattr(v, "_init_args"):
-        args, kwargs = v._init_args
-        return {"__obj__": _reg_name(type(v)),
-                "args": [_encode_value(a, ctx, None) for a in args],
-                "kwargs": {k: _encode_value(a, ctx, None) for k, a in kwargs.items()}}
     raise SerializationError(f"cannot serialize constructor arg {v!r} ({type(v)})")
 
 
@@ -181,6 +196,10 @@ def _module_spec(m, ctx: _Arrays) -> dict:
     if isinstance(m, Graph):
         spec = _graph_spec(m, ctx)
         spec["iid"] = iid
+        if m.scale_w != 1.0 or m.scale_b != 1.0:
+            spec["scale_w"], spec["scale_b"] = m.scale_w, m.scale_b
+        if getattr(m, "_frozen", False):
+            spec["frozen"] = True
         return spec
 
     spec: dict[str, Any] = {"type": _reg_name(type(m)), "name": m.name,
@@ -193,7 +212,9 @@ def _module_spec(m, ctx: _Arrays) -> dict:
 
     if isinstance(m, Container):
         children = m.modules
-        child_ids = {id(c): i for i, c in enumerate(children)}
+        child_ids: dict[int, int] = {}
+        for i, c in enumerate(children):   # FIRST occurrence wins: later
+            child_ids.setdefault(id(c), i)  # duplicates decode as shared_refs
         spec["children"] = [_module_spec(c, ctx) for c in children]
         enc_args = [_encode_value(a, ctx, child_ids) for a in args]
         enc_kwargs = {k: _encode_value(a, ctx, child_ids) for k, a in kwargs.items()}
@@ -280,8 +301,9 @@ def _decode_value(v: Any, arrays: list[np.ndarray], children: list | None,
         cls = _registry().get(v["__obj__"])
         if cls is None:
             raise SerializationError(f"unknown registered type {v['__obj__']!r}")
-        args = [_decode_value(a, arrays, None) for a in v.get("args", [])]
-        kwargs = {k: _decode_value(a, arrays, None)
+        args = [_decode_value(a, arrays, None, cache)
+                for a in v.get("args", [])]
+        kwargs = {k: _decode_value(a, arrays, None, cache)
                   for k, a in v.get("kwargs", {}).items()}
         return cls(*args, **kwargs)
     return {k: _decode_value(x, arrays, children, cache) for k, x in v.items()}
@@ -304,6 +326,10 @@ def _build_module(spec: dict, arrays: list[np.ndarray],
 
     if "graph" in spec:
         g = _build_graph(cls, spec, arrays, cache)
+        g.scale_w = spec.get("scale_w", 1.0)
+        g.scale_b = spec.get("scale_b", 1.0)
+        if spec.get("frozen"):
+            g._frozen = True
         if "iid" in spec:
             cache[spec["iid"]] = g
         return g
@@ -364,14 +390,18 @@ def save_module(module, path: str, overwrite: bool = True) -> None:
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    tmp = path + ".tmp"
-    with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("manifest.json", json.dumps(manifest))
-        for i, arr in enumerate(ctx.arrays):
-            buf = io.BytesIO()
-            np.lib.format.write_array(buf, np.ascontiguousarray(arr))
-            zf.writestr(f"arrays/{i}.npy", buf.getvalue())
-    os.replace(tmp, path)
+    tmp = f"{path}.{os.getpid()}.tmp"   # unique per process; cleaned on error
+    try:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("manifest.json", json.dumps(manifest))
+            for i, arr in enumerate(ctx.arrays):
+                buf = io.BytesIO()
+                np.lib.format.write_array(buf, np.ascontiguousarray(arr))
+                zf.writestr(f"arrays/{i}.npy", buf.getvalue())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def is_portable_file(path: str) -> bool:
@@ -388,7 +418,9 @@ def load_module(path: str):
             raise SerializationError(
                 f"{path}: written by a newer format version "
                 f"({manifest['version']} > {FORMAT_VERSION})")
-        n = len([e for e in zf.namelist() if e.startswith("arrays/")])
+        import re
+        n = len([e for e in zf.namelist()
+                 if re.fullmatch(r"arrays/\d+\.npy", e)])
         arrays = [np.lib.format.read_array(io.BytesIO(zf.read(f"arrays/{i}.npy")))
                   for i in range(n)]
     return _build_module(manifest["root"], arrays)

@@ -470,3 +470,80 @@ class TestFormatTolerance:
         _assert_same_forward(model, loaded, x)
         assert loaded.modules[0] is loaded.modules[2], \
             "shared instance decoded as independent copies"
+
+
+class TestSession3Fixes:
+    def test_regularized_model_roundtrips(self, tmp_path):
+        from bigdl_tpu.optim.regularizer import L1L2Regularizer, L2Regularizer
+        m = nn.Linear(4, 3, w_regularizer=L2Regularizer(5e-4),
+                      b_regularizer=L1L2Regularizer(1e-4, 1e-4))
+        p = str(tmp_path / "reg.bigdl")
+        m.save_module(p)
+        m2 = serializer.load_module(p)
+        assert m2.w_regularizer.l2 == pytest.approx(5e-4)
+        assert m2.b_regularizer.l1 == pytest.approx(1e-4)
+        x = _x(2, 4)
+        np.testing.assert_allclose(np.asarray(m2.forward(x)),
+                                   np.asarray(m.forward(x)), rtol=1e-6)
+
+    def test_shared_child_as_ctor_arg_and_added_keeps_order(self, tmp_path):
+        shared = nn.Linear(5, 5)
+        m = nn.Sequential(shared)
+        m.add(nn.ReLU())
+        m.add(shared)                     # same INSTANCE again
+        x = _x(2, 5)
+        want = np.asarray(m.forward(x))
+        p = str(tmp_path / "sh.bigdl")
+        m.save_module(p)
+        m2 = serializer.load_module(p)
+        assert len(m2.modules) == 3
+        assert m2.modules[0] is m2.modules[2], "shared identity lost"
+        assert type(m2.modules[1]).__name__ == "ReLU"
+        np.testing.assert_allclose(np.asarray(m2.forward(x)), want, rtol=1e-6)
+
+    def test_graph_frozen_and_scales_roundtrip(self, tmp_path):
+        inp = nn.Input()
+        out = nn.Linear(3, 2).inputs(inp)
+        g = nn.Graph([inp], [out])
+        g.freeze()
+        g.set_scale_w(0.5)
+        p = str(tmp_path / "g.bigdl")
+        g.save_module(p)
+        g2 = serializer.load_module(p)
+        assert g2.is_frozen()
+        assert g2.scale_w == pytest.approx(0.5)
+
+    def test_numpy_bool_arg_normalizes(self, tmp_path):
+        m = nn.SpatialMaxPooling(2, 2, ceil_mode=np.bool_(True))
+        p = str(tmp_path / "b.bigdl")
+        m.save_module(p)
+        m2 = serializer.load_module(p)
+        assert m2.ceil_mode is True
+
+    def test_rezipped_archive_with_dir_entry_loads(self, tmp_path):
+        m = nn.Linear(3, 2)
+        p = str(tmp_path / "m.bigdl")
+        m.save_module(p)
+        # simulate a re-zip that adds a directory entry under arrays/
+        import zipfile as zf_mod
+        p2 = str(tmp_path / "rezip.bigdl")
+        with zf_mod.ZipFile(p) as src, zf_mod.ZipFile(p2, "w") as dst:
+            dst.writestr("arrays/", "")
+            for e in src.namelist():
+                dst.writestr(e, src.read(e))
+        m2 = serializer.load_module(p2)
+        x = _x(2, 3)
+        np.testing.assert_allclose(np.asarray(m2.forward(x)),
+                                   np.asarray(m.forward(x)), rtol=1e-6)
+
+    def test_failed_save_leaves_no_tmp(self, tmp_path):
+        class Unserializable:
+            pass
+        m = nn.Sequential()
+        m.add(nn.Identity())
+        m.modules[0].__dict__["_init_args"] = ((Unserializable(),), {})
+        p = str(tmp_path / "bad.bigdl")
+        with pytest.raises(serializer.SerializationError):
+            m.save_module(p)
+        leftovers = [f for f in os.listdir(tmp_path) if "tmp" in f]
+        assert not leftovers, leftovers

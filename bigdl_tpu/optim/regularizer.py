@@ -51,11 +51,3 @@ class L1L2Regularizer(Regularizer):
         return (self.l1 * jnp.sum(jnp.abs(w))
                 + 0.5 * self.l2 * jnp.sum(jnp.square(w)))
 
-
-# portable serialization: regularized layers record their regularizer as a
-# constructor arg — it must rebuild from the archive like any module
-from bigdl_tpu.utils.serializer import register as _register_serializable  # noqa: E402
-
-for _cls in (L1Regularizer, L2Regularizer, L1L2Regularizer):
-    _register_serializable(_cls)
-del _cls

@@ -80,24 +80,51 @@ def _build_registry() -> dict[str, type]:
     _scan(tfops, prefix="tf.")
     import bigdl_tpu.utils.caffe.ops as caffeops
     _scan(caffeops, prefix="caffe.")
+    # regularizers: recorded-args objects that ride layer constructor args
+    # (registered HERE, lazily — a module-level register() call inside
+    # optim.regularizer would build this registry mid-import and freeze it
+    # incomplete)
+    import bigdl_tpu.optim.regularizer as regmod
+    from bigdl_tpu.optim.regularizer import Regularizer
+    for attr in dir(regmod):
+        obj = getattr(regmod, attr)
+        if isinstance(obj, type) and issubclass(obj, Regularizer) \
+                and obj is not Regularizer:
+            reg[obj.__name__] = obj
     return reg
 
 
+# registrations arriving while the registry is still building (module-level
+# register() calls inside modules that _build_registry itself imports — e.g.
+# utils/tf/ops) are buffered and applied to the FINAL registry; triggering a
+# nested build here used to leave a stale reverse map whose names the final
+# registry didn't contain (order-dependent "unknown module type" on load)
+_PENDING: list[tuple[str, type]] = []
+_REV: dict | None = None
+
+
 def _registry() -> dict[str, type]:
-    global _REGISTRY
+    global _REGISTRY, _REV
     if _REGISTRY is None:
-        _REGISTRY = _build_registry()
+        reg = _build_registry()
+        for n, c in _PENDING:
+            reg[n] = c
+        _REGISTRY = reg
+        _REV = None   # derive strictly from the final registry
     return _REGISTRY
 
 
 def register(cls: type, name: str | None = None) -> type:
     """Register an out-of-tree class for portable serialization."""
-    _registry()[name or cls.__name__] = cls
-    _rev_registry()[cls] = name or cls.__name__
+    global _REV
+    n = name or cls.__name__
+    if _REGISTRY is None:
+        _PENDING.append((n, cls))
+        return cls
+    _REGISTRY[n] = cls
+    if _REV is not None:
+        _REV[cls] = n
     return cls
-
-
-_REV: dict | None = None
 
 
 def _rev_registry() -> dict:

@@ -349,6 +349,8 @@ class L1HingeEmbeddingCriterion(AbstractCriterion):
     """L1 distance hinge over a pair: ``d = |x1 - x2|_1``; loss ``d`` if y=1 else
     ``max(0, margin - d)`` (reference ``L1HingeEmbeddingCriterion`` — unverified)."""
 
+    size_average = True   # batch-mean reduced (gradient-accumulation contract)
+
     def __init__(self, margin: float = 1.0):
         super().__init__()
         self.margin = margin
@@ -365,6 +367,8 @@ class PoissonCriterion(AbstractCriterion):
     """Poisson NLL over positive rates: ``mean(pred - target * log(pred))``
     (keras-style; reference keras loss set — unverified)."""
 
+    size_average = True
+
     def apply(self, input, target):
         return jnp.mean(input - target * jnp.log(jnp.clip(input, 1e-12)))
 
@@ -372,6 +376,8 @@ class PoissonCriterion(AbstractCriterion):
 class CosineProximityCriterion(AbstractCriterion):
     """Negative mean cosine proximity of l2-normalised tensors (keras
     ``cosine_proximity``; reference keras loss set — unverified)."""
+
+    size_average = True
 
     def apply(self, input, target):
         from bigdl_tpu.nn.cosine import cosine_similarity
@@ -381,6 +387,8 @@ class CosineProximityCriterion(AbstractCriterion):
 class MeanAbsolutePercentageCriterion(AbstractCriterion):
     """MAPE: ``100 * mean(|t - x| / clip(|t|))`` (keras-style)."""
 
+    size_average = True
+
     def apply(self, input, target):
         return 100.0 * jnp.mean(
             jnp.abs(target - input) / jnp.clip(jnp.abs(target), 1e-7))
@@ -388,6 +396,8 @@ class MeanAbsolutePercentageCriterion(AbstractCriterion):
 
 class MeanSquaredLogarithmicCriterion(AbstractCriterion):
     """MSLE: ``mean((log(1+t) - log(1+x))^2)`` (keras-style)."""
+
+    size_average = True
 
     def apply(self, input, target):
         return jnp.mean(jnp.square(
@@ -397,6 +407,8 @@ class MeanSquaredLogarithmicCriterion(AbstractCriterion):
 class KullbackLeiblerDivergenceCriterion(AbstractCriterion):
     """KL(target ‖ input) over probability distributions (keras ``kld``; the
     log-prob-input variant is :class:`DistKLDivCriterion`)."""
+
+    size_average = True
 
     def apply(self, input, target):
         t = jnp.clip(target, 1e-7, 1.0)
@@ -451,6 +463,13 @@ class ParallelCriterion(AbstractCriterion):
         self.criterions.append((criterion, weight))
         return self
 
+    @property
+    def size_average(self) -> bool:
+        # a weighted sum of means is itself mean-like under gradient
+        # accumulation; only an all-sum composite accumulates by summing
+        return all(bool(getattr(c, "size_average", True))
+                   for c, _ in self.criterions)
+
     def apply(self, input, target):
         xs = input.values() if isinstance(input, Table) else list(input)
         if self.repeat_target:
@@ -470,22 +489,32 @@ class TimeDistributedCriterion(AbstractCriterion):
                  dimension: int = 2):
         super().__init__()
         self.criterion = criterion
-        self.size_average = size_average
+        # the reference arg name means "divide by T" — NOT batch reduction;
+        # stored under its real meaning so the gradient-accumulation contract
+        # (the size_average property below) can answer the batch question
+        self.time_average = size_average
+
+    @property
+    def size_average(self) -> bool:
+        # batch-reduction semantics for gradient accumulation: the T division
+        # is a constant factor, so whether micro-losses average or sum over
+        # the batch is decided by the inner criterion's reduction
+        return bool(getattr(self.criterion, "size_average", True))
 
     def apply(self, input, target):
         # Reference semantics: loss = Σ_t inner(input[:, t], target[:, t]),
-        # divided by T when size_average. Flattening time into batch computes
-        # the same thing in ONE inner call, but the rescale depends on
-        # whether the inner criterion itself averages: an averaging inner on
-        # the flat (N*T, ...) batch already IS the size_average result (the
-        # old code divided by T a second time, shrinking LM losses T-fold).
+        # divided by T when time-averaging. Flattening time into batch
+        # computes the same thing in ONE inner call, but the rescale depends
+        # on whether the inner criterion itself averages: an averaging inner
+        # on the flat (N*T, ...) batch already IS the time-averaged result
+        # (the old code divided by T a second time, shrinking LM losses T-fold).
         t_steps = input.shape[1]
         flat_in = input.reshape((-1,) + input.shape[2:])
         flat_t = target.reshape((-1,) + target.shape[2:])
         loss = self.criterion.apply(flat_in, flat_t)
         if bool(getattr(self.criterion, "size_average", False)):
-            return loss if self.size_average else loss * t_steps
-        return loss / t_steps if self.size_average else loss
+            return loss if self.time_average else loss * t_steps
+        return loss / t_steps if self.time_average else loss
 
 
 class MultiCriterion(AbstractCriterion):
@@ -499,6 +528,11 @@ class MultiCriterion(AbstractCriterion):
         self.criterions.append((criterion, weight))
         return self
 
+    @property
+    def size_average(self) -> bool:
+        return all(bool(getattr(c, "size_average", True))
+                   for c, _ in self.criterions)
+
     def apply(self, input, target):
         total = 0.0
         for crit, w in self.criterions:
@@ -507,6 +541,8 @@ class MultiCriterion(AbstractCriterion):
 
 
 class L1Cost(AbstractCriterion):
+    size_average = False   # sum-reduced: micro-losses add up to the batch loss
+
     def apply(self, input, target):
         return jnp.sum(jnp.abs(input))
 
@@ -576,6 +612,9 @@ class SoftmaxWithCriterion(AbstractCriterion):
         self.ignore_label = ignore_label
         self.normalize_mode = normalize_mode
         self.one_based = one_based
+        # valid/full/batch_size all divide by a per-batch count (mean-like
+        # under gradient accumulation); only "none" is a raw sum
+        self.size_average = normalize_mode != "none"
 
     def apply(self, input, target):
         logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=1) \
@@ -626,6 +665,8 @@ class TimeDistributedMaskCriterion(AbstractCriterion):
     the non-padded count only. The inner criterion must be class-index based
     (ClassNLL / CrossEntropy — the padded-label use case)."""
 
+    size_average = True   # normalized by the non-padded count (mean-like)
+
     def __init__(self, criterion: AbstractCriterion, padding_value: int = 0):
         super().__init__()
         if isinstance(criterion, CrossEntropyCriterion):
@@ -658,6 +699,10 @@ class SmoothL1CriterionWithWeights(AbstractCriterion):
     ``SmoothL1CriterionWithWeights(sigma, num)``): target is a Table
     (t, inside_w, outside_w); ``sum(outside_w * smoothL1(inside_w*(x-t)))/num``
     with the sigma-scaled Huber transition at ``1/sigma^2``."""
+
+    # sum-reduced for accumulation purposes even when num > 0: the divisor is
+    # a CONSTANT, so micro-losses add up to the full-batch loss
+    size_average = False
 
     def __init__(self, sigma: float = 1.0, num: int = 0):
         super().__init__()
@@ -696,6 +741,10 @@ class TransformerCriterion(AbstractCriterion):
         self.criterion = criterion
         self.input_transformer = input_transformer
         self.target_transformer = target_transformer
+
+    @property
+    def size_average(self) -> bool:
+        return bool(getattr(self.criterion, "size_average", True))
 
     def _run(self, module, x):
         if module is None:

@@ -351,6 +351,10 @@ class Proposal(AbstractModule):
             # NHWC conv outputs the layout flag produces by transposing once.
             scores = scores.transpose(0, 3, 1, 2)
             deltas = deltas.transpose(0, 3, 1, 2)
+        if scores.shape[0] != 1:
+            raise ValueError(
+                f"Proposal is single-image (reference contract): got batch "
+                f"{scores.shape[0]}; vmap/loop over images instead")
         a = self.anchor.num_anchors
         h, w = int(scores.shape[2]), int(scores.shape[3])
         anchors = jnp.asarray(self.anchor.generate(h, w, self.feat_stride))  # (H*W*A,4)

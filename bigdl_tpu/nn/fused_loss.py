@@ -102,7 +102,10 @@ def _xent_fwd_impl(hidden, weight, bias, labels, chunk):
         body, (jnp.full((n,), _NEG, f32), jnp.zeros((n,), f32)), (wr, br))
     lse = m + jnp.log(s)
 
-    valid = labels >= 0
+    # out-of-range labels (>= V) are masked exactly like ignore labels (< 0):
+    # the unfused ClassNLL path errors on them; silently training against
+    # class V-1 would hide a vocab/label mismatch behind a plausible loss
+    valid = (labels >= 0) & (labels < weight.shape[0])
     lc = jnp.clip(labels, 0, weight.shape[0] - 1)
     tgt = (h * weight[lc].astype(f32)).sum(axis=-1)
     if bias is not None:
@@ -122,7 +125,7 @@ def _xent_bwd(chunk, res, g):
     h = hidden.astype(f32)
     v, d = weight.shape
     wr, br = _pad_vocab(weight, bias, chunk)   # original dtype; cast per chunk
-    valid = labels >= 0
+    valid = (labels >= 0) & (labels < v)            # mirror forward masking
     geff = (g.astype(f32) * valid)                  # (N,)
     lc = jnp.clip(labels, 0, v - 1)
 
@@ -219,6 +222,8 @@ class ChunkedSoftmaxCrossEntropy(AbstractCriterion):
     leading shape (negative labels are ignored). Mean NLL over valid tokens.
     ``chunk_size`` bounds live logits memory to ``tokens × chunk_size``."""
 
+    size_average = True   # mean over valid tokens (gradient-accumulation contract)
+
     def __init__(self, chunk_size: int = 8192, zero_based: bool = True):
         super().__init__()
         self.chunk_size = int(chunk_size)
@@ -235,7 +240,7 @@ class ChunkedSoftmaxCrossEntropy(AbstractCriterion):
             t = t - 1
         chunk = min(self.chunk_size, weight.shape[0])
         losses = chunked_softmax_xent(h2, weight, bias, t, chunk)
-        n_valid = jnp.maximum((t >= 0).sum(), 1)
+        n_valid = jnp.maximum(((t >= 0) & (t < weight.shape[0])).sum(), 1)
         return losses.sum() / n_valid
 
     def __repr__(self):

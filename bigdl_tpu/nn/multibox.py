@@ -63,6 +63,11 @@ class MultiBoxCriterion(AbstractCriterion):
     priors) mined per image.
     """
 
+    # normalized by the per-batch positive count: mean-like under gradient
+    # accumulation (same caveat as weighted ClassNLL — per-batch denominators
+    # can differ micro vs full under imbalance)
+    size_average = True
+
     def __init__(self, n_classes: int, iou_threshold: float = 0.5,
                  neg_pos_ratio: float = 3.0, loc_weight: float = 1.0):
         super().__init__()

@@ -142,6 +142,15 @@ class _Reader:
             if storage is None:
                 arr = np.zeros(sizes, _STORAGE_DTYPES[_TENSOR_STORAGE[cls]])
             else:
+                # A negative stride shrinks the span below storage.size yet
+                # makes as_strided read BEFORE the view start (out-of-bounds
+                # process memory) — reject. Stride 0 is legitimate: Torch7
+                # serializes expand()ed tensors with their 0 strides, and a
+                # 0-stride view aliases within bounds.
+                if any(st < 0 for st, sz in zip(strides, sizes) if sz > 1):
+                    raise ValueError(
+                        f"corrupt .t7: negative stride in {strides} "
+                        f"for tensor of size {sizes}")
                 span = offset + sum(st * (sz - 1) for st, sz in zip(strides, sizes)
                                     if sz > 0) + 1
                 if offset < 0 or (sizes and span > storage.size):

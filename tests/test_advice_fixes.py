@@ -152,3 +152,80 @@ class TestEvaluatorSharding:
         placed = _put_eval_batch((np.ones((16, 4), np.float32),
                                   np.ones((16, 2, 3), np.float32)))
         assert all(len(p.sharding.device_set) == 8 for p in placed)
+
+
+class TestProposalBatchContract:
+    """Round-4 advisor: Proposal hardcodes batch index 0; a multi-image batch
+    silently dropped every image after the first. Must refuse loudly."""
+
+    def test_multi_image_batch_rejected(self):
+        import jax.numpy as jnp
+        from bigdl_tpu.utils.table import Table
+
+        rng = np.random.RandomState(0)
+        a, h, w = 9, 4, 4
+        scores = rng.rand(2, 2 * a, h, w).astype(np.float32)
+        deltas = np.zeros((2, 4 * a, h, w), np.float32)
+        im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+        m = N.Proposal(pre_nms_topn=50, post_nms_topn=10, rpn_min_size=2)
+        with pytest.raises(ValueError, match="single-image"):
+            m.forward(Table(jnp.asarray(scores), jnp.asarray(deltas),
+                            jnp.asarray(im_info)))
+
+    def test_single_image_still_works(self):
+        import jax.numpy as jnp
+        from bigdl_tpu.utils.table import Table
+
+        rng = np.random.RandomState(1)
+        a, h, w = 9, 4, 4
+        scores = rng.rand(1, 2 * a, h, w).astype(np.float32)
+        deltas = np.zeros((1, 4 * a, h, w), np.float32)
+        im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+        m = N.Proposal(pre_nms_topn=50, post_nms_topn=10, rpn_min_size=2)
+        rois, valid = m.forward(Table(jnp.asarray(scores), jnp.asarray(deltas),
+                                      jnp.asarray(im_info))).values()
+        assert rois.shape == (10, 5)
+
+
+class TestGradAccumSizeAverageWarning:
+    """Round-4 advisor: a criterion without a size_average attribute is
+    assumed mean-reduced under accumulation; that assumption must be loud."""
+
+    def _train(self, criterion, caplog):
+        import logging
+
+        from bigdl_tpu import Engine, nn as bnn
+        from bigdl_tpu.dataset import DataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim import SGD, Trigger
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+        Engine.reset()
+        Engine.init(seed=0)
+        rng = np.random.default_rng(0)
+        data = DataSet.array([MiniBatch(
+            rng.normal(size=(8, 4)).astype(np.float32),
+            rng.normal(size=(8, 2)).astype(np.float32))])
+        m = bnn.Sequential().add(bnn.Linear(4, 2))
+        opt = (LocalOptimizer(m, data, criterion)
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_gradient_accumulation(2)
+               .set_end_when(Trigger.max_iteration(1)))
+        with caplog.at_level(logging.WARNING, logger="bigdl_tpu.optim"):
+            opt.optimize()
+        return caplog
+
+    def test_warns_when_attribute_absent(self, caplog):
+        from bigdl_tpu.nn.criterion import AbstractCriterion
+        import jax.numpy as jnp
+
+        class SumCrit(AbstractCriterion):
+            def apply(self, input, target):
+                return jnp.sum((input - target) ** 2)
+
+        log = self._train(SumCrit(), caplog)
+        assert any("size_average" in r.message for r in log.records)
+
+    def test_silent_when_attribute_present(self, caplog):
+        log = self._train(N.MSECriterion(), caplog)
+        assert not any("size_average" in r.message for r in log.records)

@@ -62,6 +62,31 @@ def test_ignored_labels_zero_loss_and_grads():
     assert np.any(g[0] != 0)
 
 
+def test_out_of_range_labels_masked_like_ignored():
+    # round-4 advisor: labels >= V must be masked (loss 0, grad 0) like
+    # negative labels — NOT silently clipped to class V-1, which would hide
+    # a vocab/label mismatch behind a plausible-looking loss
+    rng = np.random.RandomState(3)
+    n, d, v = 6, 4, 9
+    h = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    w = jnp.asarray(rng.randn(v, d).astype(np.float32))
+    labels = jnp.asarray(np.array([0, 9, 3, 500, 8, 2], np.int32))  # 9, 500 >= V
+    losses = chunked_softmax_xent(h, w, None, labels, 4)
+    assert np.asarray(losses)[1] == 0 and np.asarray(losses)[3] == 0
+    assert np.asarray(losses)[0] > 0
+
+    g = jax.grad(lambda h: chunked_softmax_xent(h, w, None, labels, 4).sum())(h)
+    g = np.asarray(g)
+    assert np.all(g[1] == 0) and np.all(g[3] == 0)
+    assert np.any(g[0] != 0)
+
+    # the criterion's mean must normalize by in-range tokens only
+    crit = nn.ChunkedSoftmaxCrossEntropy(chunk_size=4)
+    mean_loss = crit.apply(Table(h, w), labels)
+    np.testing.assert_allclose(float(mean_loss),
+                               float(np.asarray(losses).sum() / 4), rtol=1e-6)
+
+
 def test_matches_torch_cross_entropy():
     torch = pytest.importorskip("torch")
     rng = np.random.RandomState(2)

@@ -82,6 +82,40 @@ def test_sum_reduced_criterion_matches_full_batch():
                                    atol=1e-6, err_msg=str(k))
 
 
+def test_declared_sum_criterion_matches_full_batch():
+    """Round-4 advisor follow-up: built-in sum-reducers that take no
+    size_average arg (SmoothL1CriterionWithWeights — constant-divisor, so
+    sum-like) must DECLARE size_average=False, or accumulation silently
+    shrinks their update accum-fold."""
+    assert nn.SmoothL1CriterionWithWeights.size_average is False
+    assert nn.L1Cost.size_average is False
+
+    def train(accum):
+        Engine.reset()
+        Engine.init(seed=0)
+        rng = np.random.default_rng(3)
+        data = DataSet.array([MiniBatch(
+            rng.normal(size=(16, 10)).astype(np.float32),
+            rng.normal(size=(16, 5)).astype(np.float32))])
+        RandomGenerator.set_seed(7)
+        m = nn.Sequential().add(nn.Linear(10, 5))
+        opt = (LocalOptimizer(m, data, nn.SmoothL1CriterionWithWeights(num=16))
+               .set_optim_method(SGD(learningrate=0.05))
+               .set_gradient_accumulation(accum)
+               .set_end_when(Trigger.max_iteration(3)))
+        opt.optimize()
+        return float(opt.state["loss"]), opt.model.get_params()
+
+    l1, p1 = train(1)
+    l4, p4 = train(4)
+    assert l4 == pytest.approx(l1, rel=1e-4)
+    import jax
+    for (k, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(p1),
+                              jax.tree_util.tree_leaves_with_path(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-6, err_msg=str(k))
+
+
 def test_distri_matches_full_batch():
     loss1, _ = _train(DistriOptimizer, 1)
     loss4, _ = _train(DistriOptimizer, 4)

@@ -237,6 +237,37 @@ def test_corrupt_tensor_bounds_rejected(tmp_path):
         read_t7(str(p))
 
 
+def test_negative_stride_rejected(tmp_path):
+    # round-4 advisor (medium): a negative stride shrinks the span below
+    # storage.size, passes the bounds check, and as_strided then reads
+    # out-of-bounds process memory. Torch7 never writes non-positive strides.
+    e = Enc()
+    e.torch_start("torch.FloatTensor")
+    e.i(1); e.l(4); e.l(-1000); e.l(1)   # size 4, stride -1000
+    e.torch_start("torch.FloatStorage")
+    e.l(8); e.b += np.zeros(8, np.float32).tobytes()
+    p = tmp_path / "negstride.t7"
+    p.write_bytes(bytes(e.b))
+    with pytest.raises(ValueError, match="stride"):
+        read_t7(str(p))
+
+
+def test_zero_stride_expand_tensor_loads(tmp_path):
+    # Torch7 serializes expand()ed tensors with their 0 strides verbatim; a
+    # 0-stride view aliases WITHIN bounds, so it must load (as a broadcast),
+    # not be refused along with the genuinely-dangerous negative strides
+    e = Enc()
+    e.torch_start("torch.FloatTensor")
+    e.i(1); e.l(4); e.l(0); e.l(1)   # size 4, stride 0: 4 aliases of slot 0
+    e.torch_start("torch.FloatStorage")
+    data = np.array([7.5, 1, 2, 3], np.float32)
+    e.l(4); e.b += data.tobytes()
+    p = tmp_path / "expand.t7"
+    p.write_bytes(bytes(e.b))
+    arr = read_t7(str(p))
+    np.testing.assert_allclose(arr, np.full(4, 7.5, np.float32))
+
+
 def test_grouped_conv_export_refused(tmp_path):
     m = nn.SpatialConvolution(4, 4, 3, 3, n_group=2)
     with pytest.raises(ValueError, match="group"):

@@ -20,6 +20,8 @@ there is no citable denominator (BASELINE.md).
 from __future__ import annotations
 
 import argparse
+import datetime
+import glob
 import json
 import os
 import subprocess
@@ -57,6 +59,60 @@ _MODEL_UNITS = {
     "inception": ("images", 1), "vgg16": ("images", 1),
     "ptb-lstm": ("words", 35), "transformerlm": ("tokens", 512),
 }
+
+# committed measurement history (tunnel-wedge insurance; see bench_results/)
+_RESULTS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "bench_results")
+
+
+def _provenance() -> dict:
+    """timestamp + commit stamped onto every emitted line so committed sweep
+    records carry their own provenance (the r04 lines had none)."""
+    out = {"timestamp": datetime.datetime.now(datetime.timezone.utc)
+           .strftime("%Y-%m-%dT%H:%M:%SZ")}
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(_RESULTS_DIR))
+        if rev.returncode == 0:
+            out["git_commit"] = rev.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        # a hung git (TimeoutExpired) must never cost us a measured number
+        pass
+    return out
+
+
+def last_known_good_tpu(model: str, results_dir: str = None) -> dict | None:
+    """Newest clean TPU-provenance record for ``model`` (else any model) from
+    the committed sweep JSONLs, so a degraded CPU fallback never presents
+    itself as the round's only number (round-4 verdict weak #1)."""
+    best_model, best_any = None, None
+    for path in sorted(glob.glob(
+            os.path.join(results_dir or _RESULTS_DIR, "*.jsonl"))):
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        for ln in lines:
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if (rec.get("degraded") or rec.get("suspect")
+                    or rec.get("platform") != "tpu" or rec.get("value") is None):
+                continue
+            entry = {k: rec[k] for k in
+                     ("metric", "value", "unit", "dtype", "batch", "mfu",
+                      "device_kind", "timestamp", "git_commit")
+                     if rec.get(k) is not None}
+            entry["source"] = os.path.basename(path)
+            if str(rec.get("metric", "")).startswith(model):
+                best_model = entry      # later same-model lines win
+            best_any = entry
+    return best_model or best_any
 
 # per-model default batch (samples/step) when --batch is not given
 _DEFAULT_BATCH = {"resnet50": 256, "lenet": 256, "inception": 256,
@@ -672,6 +728,16 @@ def _spawn(argv, env, timeout):
     return None, f"rc={p.returncode}: " + " | ".join(tail)[-600:]
 
 
+def _emit(record: dict, model: str) -> None:
+    """The one emission path for degraded/failed results: stamp provenance
+    and the newest committed TPU number, then print the JSON line."""
+    record.update(_provenance())
+    lkg = last_known_good_tpu(model)
+    if lkg is not None:
+        record["last_known_good_tpu"] = lkg
+    print(json.dumps(record))
+
+
 def run_orchestrator(args) -> None:
     """Always prints one JSON line and exits 0 — degraded runs carry a reason."""
     worker_argv = ["--run", "--model", args.model, "--batch", str(args.batch),
@@ -730,6 +796,7 @@ def run_orchestrator(args) -> None:
                 elif cmp_err:
                     print(f"bench: fp32 comparison leg failed: {cmp_err}",
                           file=sys.stderr)
+            result.update(_provenance())
             print(json.dumps(result))
             return
         attempts.append(f"attempt{attempt}: {err}")
@@ -741,13 +808,13 @@ def run_orchestrator(args) -> None:
         kind = ("int8_vs_bf16_infer" if args.int8_infer
                 else "serving" if args.serving
                 else "decode_infer" if args.decode_infer else "step_ablation")
-        print(json.dumps({
+        _emit({
             "metric": f"{args.model}_{kind}",
             "value": None,
             "unit": "samples/sec",
             "vs_baseline": None,
             "error": "; ".join(attempts)[-1200:],
-        }))
+        }, model=args.model)
         return
 
     # degraded CPU fallback: a number with a reason beats a traceback
@@ -757,19 +824,21 @@ def run_orchestrator(args) -> None:
     fb_argv = ["--run", "--model", "lenet", "--batch", "256",
                "--iters", "20", "--warmup", "5", "--dtype", "fp32"]
     result, err = _spawn(fb_argv, env, args.timeout)
+    # whatever the fallback yields, carry the newest committed TPU number so
+    # the driver-facing artifact never silently demotes to a CPU-only result
     if result is not None:
         result["degraded"] = True
         result["degraded_reason"] = "; ".join(attempts)
-        print(json.dumps(result))
+        _emit(result, model=args.model)
         return
     attempts.append(f"cpu-fallback: {err}")
-    print(json.dumps({
+    _emit({
         "metric": f"{args.model}_train_images_per_sec_per_chip",
         "value": None,
         "unit": "images/sec",
         "vs_baseline": None,
         "error": "; ".join(attempts)[-1200:],
-    }))
+    }, model=args.model)
 
 
 def main(argv=None):

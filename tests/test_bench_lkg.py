@@ -1,0 +1,115 @@
+"""Bench artifact hardening (round-4 verdict weak #1): a degraded CPU
+fallback must carry a last_known_good_tpu block read from the committed
+sweep JSONLs, so the driver-facing BENCH_r*.json never presents a CPU
+number as the round's only result."""
+
+import argparse
+import json
+
+import pytest
+
+import bigdl_tpu.benchmark as bm
+
+
+def _write(path, recs):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+TPU_RESNET = {"metric": "resnet50_train_images_per_sec_per_chip",
+              "value": 2223.7, "unit": "images/sec", "dtype": "bf16",
+              "batch": 256, "mfu": 0.277, "suspect": False,
+              "device_kind": "TPU v5 lite", "platform": "tpu"}
+TPU_INCEPTION = {"metric": "inception_train_images_per_sec_per_chip",
+                 "value": 4312.1, "unit": "images/sec", "suspect": False,
+                 "device_kind": "TPU v5 lite", "platform": "tpu"}
+CPU_DEGRADED = {"metric": "lenet_train_images_per_sec_per_chip",
+                "value": 4192.0, "unit": "images/sec", "suspect": False,
+                "device_kind": "cpu", "platform": "cpu", "degraded": True}
+TPU_SUSPECT = {"metric": "resnet50_train_images_per_sec_per_chip",
+               "value": 99999.0, "unit": "images/sec", "suspect": True,
+               "device_kind": "TPU v5 lite", "platform": "tpu"}
+
+
+class TestLastKnownGood:
+    def test_prefers_same_model_newest(self, tmp_path):
+        _write(tmp_path / "a.jsonl",
+               [dict(TPU_RESNET, value=1000.0), TPU_INCEPTION, TPU_RESNET])
+        got = bm.last_known_good_tpu("resnet50", str(tmp_path))
+        assert got["value"] == 2223.7 and got["source"] == "a.jsonl"
+
+    def test_falls_back_to_any_model(self, tmp_path):
+        _write(tmp_path / "a.jsonl", [TPU_INCEPTION])
+        got = bm.last_known_good_tpu("vgg16", str(tmp_path))
+        assert got["metric"].startswith("inception")
+
+    def test_skips_degraded_suspect_and_cpu(self, tmp_path):
+        _write(tmp_path / "a.jsonl", [CPU_DEGRADED, TPU_SUSPECT])
+        assert bm.last_known_good_tpu("resnet50", str(tmp_path)) is None
+
+    def test_empty_dir_is_none(self, tmp_path):
+        assert bm.last_known_good_tpu("resnet50", str(tmp_path)) is None
+
+    def test_committed_sweep_has_no_degraded_lines(self):
+        # the TPU sweep file must never carry CPU/degraded provenance
+        # (degraded records live in their own r*_degraded.jsonl)
+        import glob
+        import os
+        files = glob.glob(os.path.join(bm._RESULTS_DIR, "*_sweep.jsonl"))
+        assert files, "committed sweep files should exist"
+        for path in files:
+            for ln in open(path).read().splitlines():
+                rec = json.loads(ln)
+                assert not rec.get("degraded"), f"degraded line in {path}"
+                assert rec.get("platform") == "tpu", f"non-TPU line in {path}"
+
+
+def _args(**over):
+    base = dict(model="resnet50", batch=256, iters=24, warmup=12,
+                dtype="bf16", compare_dtypes=False, streamed=False,
+                timeout=5, int8_infer=False, serving=False,
+                decode_infer=False, ablate=False)
+    base.update(over)
+    return argparse.Namespace(**base)
+
+
+class TestDegradedFallbackCarriesLKG:
+    def _run(self, monkeypatch, capsys, spawn):
+        monkeypatch.setattr(bm, "_spawn", spawn)
+        bm.run_orchestrator(_args())
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        return json.loads(out)
+
+    def test_degraded_cpu_result_carries_lkg(self, monkeypatch, capsys):
+        # TPU attempts dead; CPU fallback succeeds → degraded + LKG block
+        def spawn(argv, env, timeout):
+            if "lenet" in argv:   # the CPU-fallback leg
+                return {"metric": "lenet_train_images_per_sec_per_chip",
+                        "value": 4192.0, "unit": "images/sec",
+                        "device_kind": "cpu", "platform": "cpu"}, None
+            return None, "backend hang (simulated)"
+
+        rec = self._run(monkeypatch, capsys, spawn)
+        assert rec["degraded"] is True
+        lkg = rec["last_known_good_tpu"]
+        assert lkg["value"] == 2223.7          # the committed r04 number
+        assert lkg["device_kind"].startswith("TPU")
+        assert rec["timestamp"] and "degraded_reason" in rec
+
+    def test_total_failure_still_carries_lkg(self, monkeypatch, capsys):
+        rec = self._run(monkeypatch, capsys,
+                        lambda argv, env, timeout: (None, "dead (simulated)"))
+        assert rec["value"] is None and "error" in rec
+        assert rec["last_known_good_tpu"]["value"] == 2223.7
+
+    def test_healthy_result_has_provenance_no_lkg(self, monkeypatch, capsys):
+        def spawn(argv, env, timeout):
+            return {"metric": "resnet50_train_images_per_sec_per_chip",
+                    "value": 2300.0, "unit": "images/sec",
+                    "suspect": False, "platform": "tpu"}, None
+
+        rec = self._run(monkeypatch, capsys, spawn)
+        assert rec["value"] == 2300.0
+        assert "last_known_good_tpu" not in rec
+        assert rec["timestamp"]  # provenance stamped on every line

@@ -7,9 +7,10 @@ train only a rank-``r`` residual ``B @ A`` (``A`` (r, in), ``B`` (out, r)) —
 to ``r·(out+in)`` per adapted layer; the frozen base rides the gradient-
 scale machinery (scale 0 → ``stop_gradient`` before the forward, so XLA
 dead-codes the frozen backward entirely — byte-identical through training
-AND no frozen backward compute, both pinned by test). Optimizer slots are
-still allocated for frozen leaves (they hold zeros); trimming them is a
-known follow-up, not claimed.
+AND no frozen backward compute, both pinned by test). Optimizer slots for
+frozen leaves are trimmed to 0-size arrays (``OptimMethod.init_state_trimmed``
+/ ``update_trimmed``), so slot memory is ~adapter-only — Adam on a LoRA'd
+model no longer pays 2x base-param memory for moments that never move.
 
 ``apply_lora(model, rank)`` swaps every ``nn.Linear`` in the module tree
 (containers and Graph nodes) for a :class:`LoRALinear` carrying the original

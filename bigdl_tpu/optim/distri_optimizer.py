@@ -79,7 +79,9 @@ class DistriOptimizer(Optimizer):
 
         params = self.model.get_params()
         # shapes only — no device allocation for the throwaway state
-        ostate_shapes = jax.eval_shape(self.optim_method.init_state, params)
+        ostate_shapes = jax.eval_shape(
+            lambda p: self.optim_method.init_state_trimmed(
+                p, self._trainable_mask()), params)
         if self.parameter_sync == "fsdp" and self.tp_rules is not None:
             raise ValueError(
                 "parameter_sync='fsdp' cannot combine with tensor "

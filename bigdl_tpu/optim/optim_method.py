@@ -36,6 +36,42 @@ class OptimMethod:
         """Return (new_params, new_state). ``step`` is a 0-based traced int scalar."""
         raise NotImplementedError
 
+    # ---------------------------------------------- frozen-leaf slot trimming
+    # Frozen leaves (grad scale 0 — freeze()/LoRA) need no optimizer slots;
+    # allocating full Adam moments for a frozen base model wastes 2x base-param
+    # memory, which defeats LoRA's point. The generic mechanism: present the
+    # method with params whose frozen leaves are 0-size arrays — every
+    # ``zeros_like`` slot then costs nothing, the pytree STRUCTURE is
+    # unchanged (donation/sharding/serialization all keep working), and on
+    # update the frozen originals are spliced back around the method's output.
+
+    @staticmethod
+    def _mask_frozen(tree, trainable):
+        return tree_map(
+            lambda x, t: x if t else jnp.zeros((0,), jnp.asarray(x).dtype),
+            tree, trainable)
+
+    def init_state_trimmed(self, params, trainable=None) -> dict:
+        """``init_state`` with frozen (non-trainable) leaves trimmed to 0-size
+        slot arrays. ``trainable`` is a params-structured pytree of static
+        bools (None = everything trains → plain init_state)."""
+        if trainable is None:
+            return self.init_state(params)
+        return self.init_state(self._mask_frozen(params, trainable))
+
+    def update_trimmed(self, params, grads, state, step, trainable=None):
+        """``update`` against a trimmed slot tree: the method sees 0-size
+        frozen leaves (its elementwise slot math costs nothing there; XLA
+        dead-codes the empties) and frozen params pass through untouched."""
+        if trainable is None:
+            return self.update(params, grads, state, step)
+        mp = self._mask_frozen(params, trainable)
+        mg = self._mask_frozen(grads, trainable)
+        new_mp, new_state = self.update(mp, mg, state, step)
+        new_params = tree_map(lambda p, q, t: q if t else p,
+                              params, new_mp, trainable)
+        return new_params, new_state
+
     def get_learning_rate(self, step: int) -> float:
         return 0.0
 

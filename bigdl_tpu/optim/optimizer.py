@@ -43,6 +43,13 @@ logger = logging.getLogger("bigdl_tpu.optim")
 class Optimizer:
     """Front-end factory + shared trainer implementation."""
 
+    # Module-state leaf names auto-logged as training scalars (TB tag =
+    # "State/<path>"). Routing health for MoE (round-4 verdict weak #5: the
+    # aux loss trained blind — capacity drops were invisible in logs), and
+    # any future layer exposing a same-named scalar rides for free.
+    OBSERVABLE_STATE_LEAVES = ("aux_loss", "router_z_loss",
+                               "dropped_fraction", "expert_load_max")
+
     def __new__(cls, model: AbstractModule = None, dataset: AbstractDataSet = None,
                 criterion: AbstractCriterion = None, **kw):
         if cls is Optimizer and dataset is not None and is_distributed(dataset):
@@ -286,6 +293,30 @@ class Optimizer:
         return self
 
     # ------------------------------------------------------------- compile
+    def _trainable_mask(self):
+        """Params-structured pytree of static bools (False = frozen, grad
+        scale 0) driving frozen-leaf optimizer-slot trimming — or None when
+        everything trains. LoRA's memory story: no Adam moments on the
+        frozen base."""
+        scales = self.model.grad_scales()
+        if not any(s == 0.0 for s in jax.tree_util.tree_leaves(scales)):
+            return None
+        return jax.tree_util.tree_map(lambda s: s != 0.0, scales)
+
+    def _ostate_compatible(self, ostate, params, mask) -> bool:
+        """Do carried/resumed slots structurally fit what the current
+        freeze configuration would allocate?"""
+        try:
+            expected = jax.eval_shape(
+                lambda p: self.optim_method.init_state_trimmed(p, mask), params)
+        except Exception:
+            return True   # can't predict (exotic method): let it ride
+        exp_flat, exp_def = jax.tree_util.tree_flatten(expected)
+        got_flat, got_def = jax.tree_util.tree_flatten(ostate)
+        if exp_def != got_def:
+            return False
+        return all(np.shape(g) == e.shape for g, e in zip(got_flat, exp_flat))
+
     def _clip_grads(self, grads):
         if self.grad_clip_const is not None:
             lo, hi = self.grad_clip_const
@@ -314,6 +345,9 @@ class Optimizer:
         # them. Numerically identical (stopped grads are exact zeros).
         has_frozen = scale_tree is not None and any(
             s == 0.0 for s in jax.tree_util.tree_leaves(scale_tree))
+        # frozen leaves carry 0-size optimizer slots (see OptimMethod
+        # .update_trimmed) — static, so unfrozen models trace unchanged
+        trainable_mask = self._trainable_mask()
 
         def stop_frozen(p):
             if not has_frozen:
@@ -353,6 +387,32 @@ class Optimizer:
 
         accum = self.grad_accum
 
+        # 1F1B pipeline: when the ROOT model is a GPipe(schedule="1f1b") on a
+        # live pipe mesh, the pipeline owns the whole train step (loss inside
+        # the schedule — the only way to interleave backwards with forwards);
+        # grads/loss feed the same clip+update tail as the generic path.
+        pipe_fn = None
+        if getattr(model, "schedule", None) == "1f1b" \
+                and hasattr(model, "pipeline_train_step"):
+            mesh = Engine.mesh() if Engine.is_initialized() else None
+            axes = dict(mesh.shape) if mesh is not None else {}
+            if axes.get(model.axis_name, 1) == model.n_stages \
+                    and model.n_stages > 1:
+                if accum != 1:
+                    raise ValueError(
+                        "schedule='1f1b' already microbatches inside the "
+                        "pipeline; combine via n_microbatches, not "
+                        "set_gradient_accumulation")
+                if needs_rng:
+                    raise ValueError(
+                        "1f1b stages must not need RNG (GPipe contract)")
+                dax = Engine.DATA_AXIS \
+                    if axes.get(Engine.DATA_AXIS, 1) > 1 else None
+
+                def pipe_fn(p, x, t):
+                    return model.pipeline_train_step(p, x, t, criterion,
+                                                     mesh, dax)
+
         def step(params, mstate, ostate, step_idx, inp, target, base_rng):
             rng0 = jax.random.fold_in(base_rng, step_idx) if needs_rng else None
 
@@ -376,7 +436,17 @@ class Optimizer:
                 return loss, new_ms
 
             vg = jax.value_and_grad(loss_fn, has_aux=True)
-            if accum == 1:
+            if pipe_fn is not None:
+                # stages are stateless (GPipe contract) → mstate passes
+                # through; frozen leaves stop-gradient through the flat rows
+                loss, grads = pipe_fn(stop_frozen(params), inp, target)
+                new_ms = mstate
+                if has_reg:  # data-independent: differentiate it separately
+                    pen, pgrads = jax.value_and_grad(
+                        model.regularizer_penalty)(params)
+                    loss = loss + pen
+                    grads = jax.tree_util.tree_map(jnp.add, grads, pgrads)
+            elif accum == 1:
                 (loss, new_ms), grads = vg(params, mstate, inp, target, rng0)
             else:
                 # gradient accumulation: scan microbatches, averaging grads —
@@ -419,6 +489,15 @@ class Optimizer:
                 # averaging criteria: mean of micro means == full-batch mean;
                 # summing criteria: the micro sums already ARE the full-batch
                 # sum — dividing again would shrink the update accum-fold
+                # criteria opt into sum semantics by exposing size_average=False;
+                # a sum-reducing criterion without the attribute would silently
+                # get its accumulated gradient divided by accum — say so once
+                if not hasattr(criterion, "size_average"):
+                    logger.warning(
+                        "gradient accumulation: criterion %s does not expose "
+                        "size_average; assuming mean reduction (micro-grads "
+                        "averaged). Sum-reducing criteria must set "
+                        "size_average=False.", type(criterion).__name__)
                 crit_averages = bool(getattr(criterion, "size_average", True))
                 if crit_averages:
                     grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
@@ -429,7 +508,8 @@ class Optimizer:
                 grads = jax.tree_util.tree_map(
                     lambda g, s: g * s, grads, scale_tree)
             grads = self._clip_grads(grads)
-            new_p, new_os = method.update(params, grads, ostate, step_idx)
+            new_p, new_os = method.update_trimmed(params, grads, ostate,
+                                                  step_idx, trainable_mask)
             return new_p, new_ms, new_os, loss
 
         return step
@@ -598,8 +678,19 @@ class Optimizer:
         ostate = getattr(self, "_resume_ostate", None)
         if ostate is None and self.state.get("neval", 1) > 1:
             ostate = getattr(self, "_final_ostate", None)
+        mask = self._trainable_mask()
+        if ostate is not None and not self._ostate_compatible(ostate, params,
+                                                              mask):
+            # freeze/LoRA config changed since these slots were created (or an
+            # untrimmed-era checkpoint meets a trimmed config): the slot shapes
+            # no longer fit the compiled step. Restart moments — loudly.
+            logger.warning(
+                "optimizer-state shapes do not match the current freeze/scale "
+                "configuration; resetting optimizer slots (momentum/Adam "
+                "moments start fresh)")
+            ostate = None
         if ostate is None:
-            ostate = self.optim_method.init_state(params)
+            ostate = self.optim_method.init_state_trimmed(params, mask)
         self._resume_ostate = None
         # step cache is keyed on the Engine compute dtype (the casts are baked
         # into the trace) AND the model's gradient-scale fingerprint — freeze/
@@ -680,6 +771,7 @@ class Optimizer:
                         self.profile_dir = None  # one window per optimize()
                         logger.info("profiler trace captured")
 
+                    smetrics = self._collect_state_metrics(mstate)
                     if run_iters == 1:
                         # First step of this optimize() call absorbs compile, param
                         # re-placement, and feed spin-up. Wait for it, then start the
@@ -689,11 +781,17 @@ class Optimizer:
                         if err is not None:
                             jax.device_get(err).throw()
                         state["loss"] = val
-                        self._write_iter_summary(state["neval"], val, state)
+                        fetched = {t: float(jax.device_get(v))
+                                   for t, v in smetrics}
+                        if fetched:
+                            state["state_metrics"] = fetched
+                        self._write_iter_summary(state["neval"], val, state,
+                                                 fetched)
                         records = 0
                         window_t0 = time.perf_counter()
                     else:
-                        pending.append((state["neval"], loss, batch.valid, err))
+                        pending.append((state["neval"], loss, batch.valid, err,
+                                        smetrics))
                     if state["neval"] % self.log_every == 0:
                         # fetch all complete losses in one round trip; the newest
                         # stays pending so the fetch never stalls on the in-flight
@@ -706,9 +804,15 @@ class Optimizer:
                             dt = time.perf_counter() - window_t0
                             thr = records / dt if dt > 0 else 0.0
                             state["throughput"] = thr
+                            drops = [v for t, v in
+                                     (state.get("state_metrics") or {}).items()
+                                     if t.endswith("dropped_fraction")]
                             logger.info(
-                                "Epoch %d iter %d: loss %.6f, %.1f records/s",
-                                state["epoch"], state["neval"], state["loss"], thr)
+                                "Epoch %d iter %d: loss %.6f, %.1f records/s%s",
+                                state["epoch"], state["neval"], state["loss"],
+                                thr,
+                                (", moe drop %.1f%%" % (100 * max(drops))
+                                 if drops else ""))
                             records = 0
                             window_t0 = time.perf_counter()
                         elif "loss" in state:
@@ -746,6 +850,20 @@ class Optimizer:
         return self.model
 
     # ---------------------------------------------------------- loss flush
+    def _collect_state_metrics(self, mstate) -> list:
+        """(tag, device_scalar) pairs for observable module-state leaves
+        (OBSERVABLE_STATE_LEAVES — MoE routing health). The walk is cheap
+        host work on a static structure; the values ride the batched loss
+        fetch, so observability adds no extra device round trips."""
+        from jax.tree_util import tree_flatten_with_path
+        out = []
+        for path, leaf in tree_flatten_with_path(mstate)[0]:
+            keys = [str(getattr(p, "key", p)) for p in path]
+            if keys and keys[-1] in self.OBSERVABLE_STATE_LEAVES \
+                    and getattr(leaf, "shape", None) == ():
+                out.append(("State/" + "/".join(keys), leaf))
+        return out
+
     def _flush_pending(self, pending: list, state: dict, keep_last: bool) -> int:
         """Fetch queued device losses in ONE host round trip, write their exact
         per-iteration summary scalars, and update ``state['loss']``. With
@@ -755,19 +873,26 @@ class Optimizer:
         if not to_fetch:
             return 0
         with self.metrics.timer("loss_fetch"):
-            vals, errs = jax.device_get(
-                ([l for _, l, _, _ in to_fetch], [e for _, _, _, e in to_fetch]))
+            vals, errs, mvals = jax.device_get(
+                ([l for _, l, _, _, _ in to_fetch],
+                 [e for _, _, _, e, _ in to_fetch],
+                 [[v for _, v in m] for _, _, _, _, m in to_fetch]))
         records = 0
-        for (it, _, valid, _), v, err in zip(to_fetch, vals, errs):
+        for (it, _, valid, _, sm), v, err, mv in zip(to_fetch, vals, errs,
+                                                     mvals):
             if err is not None:
                 err.throw()  # checkify sanitizer: NaN/inf with op location
             state["loss"] = float(v)
             records += valid
-            self._write_iter_summary(it, float(v), state)
+            metrics = {tag: float(x) for (tag, _), x in zip(sm, mv)}
+            if metrics:
+                state["state_metrics"] = metrics
+            self._write_iter_summary(it, float(v), state, metrics)
         del pending[: len(to_fetch)]
         return records
 
-    def _write_iter_summary(self, it: int, loss_val: float, state: dict) -> None:
+    def _write_iter_summary(self, it: int, loss_val: float, state: dict,
+                            metrics: Optional[dict] = None) -> None:
         """Per-iteration scalar summaries (Loss / LearningRate / Throughput), written
         at flush time with the iteration they belong to — lazy loss fetching must not
         change what lands in the event file."""
@@ -790,6 +915,9 @@ class Optimizer:
                 "LearningRate", self.optim_method.get_learning_rate(it - 1), it)
         if "throughput" in state and _tag_fires("Throughput"):
             self.train_summary.add_scalar("Throughput", state["throughput"], it)
+        for tag, val in (metrics or {}).items():
+            if _tag_fires(tag):
+                self.train_summary.add_scalar(tag, val, it)
 
     # ------------------------------------------------------------ triggers
     @staticmethod

@@ -215,3 +215,150 @@ class TestAuxLossTraining:
             opt.optimize()
             losses[w] = opt.state["loss"]
         assert losses[10.0] > losses[0.0] + 1.0, losses
+
+
+class TestTop2Routing:
+    """GShard top-2: two experts per token with renormalized gates; second
+    choices queue behind first choices; full drop only when BOTH overflow."""
+
+    def test_matches_top2_loop_oracle(self):
+        RandomGenerator.set_seed(0)
+        m = MoE(8, 16, n_experts=4, capacity_factor=8.0,
+                router="top2").evaluate()    # no drops at cf=8
+        x = _x(12, 8, seed=3)
+        out = np.asarray(m.forward(x))
+        p = {k: np.asarray(v) for k, v in m.get_params().items()}
+        logits = np.asarray(x) @ p["w_gate"]
+        probs = np.exp(logits - logits.max(1, keepdims=True))
+        probs /= probs.sum(1, keepdims=True)
+        ref = np.zeros_like(np.asarray(x))
+        for t in range(12):
+            order = np.argsort(-probs[t])
+            e1, e2 = int(order[0]), int(order[1])
+            g1, g2 = probs[t, e1], probs[t, e2]
+            denom = g1 + g2 + 1e-9
+            for e, g in ((e1, g1 / denom), (e2, g2 / denom)):
+                h = np.maximum(np.asarray(x)[t] @ p["w1"][e] + p["b1"][e], 0.0)
+                ref[t] += (h @ p["w2"][e] + p["b2"][e]) * g
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_top2_degrades_instead_of_zeroing(self):
+        """Under a capacity squeeze, top-2 keeps more tokens alive than
+        top-1 (the second choice catches first-choice overflow)."""
+        RandomGenerator.set_seed(1)
+        x = _x(64, 8, seed=5)
+        m1 = MoE(8, 16, n_experts=4, capacity_factor=0.5).evaluate()
+        m2 = MoE(8, 16, n_experts=4, capacity_factor=0.5,
+                 router="top2").evaluate()
+        m2.set_params({k: v for k, v in m1.get_params().items()})
+        _, st1 = m1.apply(m1.get_params(), m1.get_state(), x)
+        _, st2 = m2.apply(m2.get_params(), m2.get_state(), x)
+        assert float(st2["dropped_fraction"]) < float(st1["dropped_fraction"])
+
+    def test_gradients_flow_through_both_gates(self):
+        RandomGenerator.set_seed(2)
+        m = MoE(8, 16, n_experts=4, capacity_factor=8.0, router="top2")
+        x = _x(10, 8, seed=7)
+
+        def loss(p):
+            y, _ = m.apply(p, m.get_state(), x, training=True)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(loss)(m.get_params())
+        assert float(jnp.abs(g["w_gate"]).sum()) > 0
+        assert float(jnp.abs(g["w1"]).sum()) > 0
+
+    def test_bad_router_rejected(self):
+        with pytest.raises(ValueError, match="router"):
+            MoE(8, 16, n_experts=4, router="top3")
+
+
+class TestObservability:
+    """Round-4 verdict weak #5: silent capacity drops must be visible — in
+    module state, in TB scalars, and in the training log."""
+
+    def test_state_reports_drop_fraction_and_load(self):
+        RandomGenerator.set_seed(0)
+        m = MoE(8, 16, n_experts=2, capacity_factor=0.1).evaluate()  # cap ~1
+        x = _x(32, 8, seed=1)
+        _, st = m.apply(m.get_params(), m.get_state(), x)
+        drop = float(st["dropped_fraction"])
+        assert 0.8 <= drop < 1.0           # 32 tokens, cap 2/expert → ≥28 drop
+        load = np.asarray(st["expert_load"])
+        assert load.shape == (2,) and load.sum() == pytest.approx(1.0, abs=1e-6)
+        assert float(st["expert_load_max"]) == pytest.approx(load.max())
+
+    def test_zero_drop_when_capacity_ample(self):
+        RandomGenerator.set_seed(0)
+        m = MoE(8, 16, n_experts=4, capacity_factor=8.0).evaluate()
+        _, st = m.apply(m.get_params(), m.get_state(), _x(16, 8))
+        assert float(st["dropped_fraction"]) == 0.0
+
+    def test_z_loss_trains_via_penalty_convention(self):
+        RandomGenerator.set_seed(0)
+        m = MoE(8, 16, n_experts=4, capacity_factor=8.0, z_loss_weight=0.01)
+        assert "penalty" in m.get_state()
+        x = _x(16, 8)
+        _, st = m.apply(m.get_params(), m.get_state(), x, training=True)
+        assert float(st["router_z_loss"]) > 0
+        np.testing.assert_allclose(float(st["penalty"]),
+                                   0.01 * float(st["router_z_loss"]),
+                                   rtol=1e-6)
+        # weight 0: no penalty leaf → no dead weight in the objective
+        m0 = MoE(8, 16, n_experts=4)
+        assert "penalty" not in m0.get_state()
+
+    def test_scalars_reach_train_summary(self, tmp_path):
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+        from bigdl_tpu.visualization import TrainSummary
+
+        Engine.reset()
+        Engine.init(seed=0)
+        RandomGenerator.set_seed(0)
+        rng = np.random.default_rng(0)
+        batches = [MiniBatch(rng.normal(size=(16, 8)).astype(np.float32),
+                             rng.integers(0, 3, size=(16,)).astype(np.int32))
+                   for _ in range(2)]
+        model = (nn.Sequential()
+                 .add(MoE(8, 16, n_experts=2, router="top2",
+                          z_loss_weight=1e-3))
+                 .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+        summary = TrainSummary(str(tmp_path), "moe-obs")
+        opt = (LocalOptimizer(model, DataSet.array(batches),
+                              nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_train_summary(summary)
+               .set_end_when(Trigger.max_iteration(4)))
+        opt.log_every = 2
+        opt.optimize()
+        tags = {t for t, _, _ in summary.read_scalar_all()} \
+            if hasattr(summary, "read_scalar_all") else None
+        if tags is None:
+            tags = set()
+            for tag in ("State/0/dropped_fraction", "State/0/aux_loss",
+                        "State/0/router_z_loss", "State/0/expert_load_max"):
+                if summary.read_scalar(tag):
+                    tags.add(tag)
+        assert any("dropped_fraction" in t for t in tags), tags
+        assert any("aux_loss" in t for t in tags), tags
+        assert any("router_z_loss" in t for t in tags), tags
+        # the state_metrics dict is also on the optimizer state (log line)
+        sm = opt.state.get("state_metrics") or {}
+        assert any(t.endswith("dropped_fraction") for t in sm), sm
+
+    def test_serializer_roundtrip_top2(self, tmp_path):
+        from bigdl_tpu.utils.serializer import load_module, save_module
+
+        RandomGenerator.set_seed(3)
+        m = MoE(8, 16, n_experts=4, router="top2", z_loss_weight=1e-3)
+        x = _x(6, 8, seed=9)
+        want = np.asarray(m.evaluate().forward(x))
+        save_module(m, str(tmp_path / "moe.bin"))
+        m2 = load_module(str(tmp_path / "moe.bin"))
+        assert m2.router == "top2" and m2.z_loss_weight == pytest.approx(1e-3)
+        np.testing.assert_allclose(np.asarray(m2.evaluate().forward(x)), want,
+                                   rtol=1e-5)

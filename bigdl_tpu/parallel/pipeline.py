@@ -27,9 +27,22 @@ right; after ``M + S - 1`` ticks every microbatch crossed all stages. The
 backward pipeline needs no hand-written schedule: jax reverse-mode
 differentiates the ``scan`` + ``switch`` + ``ppermute`` chain, yielding the
 reversed-communication schedule automatically — the train step stays ONE
-jitted program. (A manual 1F1B interleave would need a hand-scheduled VJP; the
-GPipe-style all-forward-then-all-backward memory profile is what autodiff
-gives, softened by ``nn.Remat`` on stages when activations dominate.)
+jitted program, at the GPipe all-forward-then-all-backward memory profile
+(activation residuals for all M microbatches live between the forward and
+backward halves), softened by ``remat=True``.
+
+``schedule="1f1b"`` (round-4 verdict #4) replaces that profile with a
+hand-scheduled **one-forward-one-backward** interleave for TRAINING: the
+loss moves INSIDE the pipelined program (``pipeline_train_step``, picked up
+automatically by the Optimizer when a 1f1b GPipe is the root model), each
+backward is an explicit per-stage ``jax.vjp`` with recompute (only the
+stage's INPUT is stashed, the standard remat trade), and a statically
+simulated PipeDream-flush schedule drives forwards and backwards through
+one ``lax.scan``. In-flight microbatches per rank are bounded by
+``min(S - rank, M)`` instead of ``M``, so the activation stash is
+``O(S × microbatch)`` instead of ``O(M × microbatch)`` — the thing 1F1B
+exists to fix — while producing bit-identical gradients (pinned by test
+against the autodiff GPipe schedule).
 
 Stages must be stateless — BatchNorm running stats would silently diverge per
 rank; use ``BatchNormalization(sync=True)`` inside ``shard_map`` data-parallel
@@ -51,6 +64,86 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from bigdl_tpu.nn.abstractnn import AbstractModule, Container
+
+
+def _simulate_1f1b(s: int, m: int):
+    """Statically simulate the PipeDream-flush (non-interleaved 1F1B)
+    schedule for ``s`` stages × ``m`` microbatches under a one-op-per-tick,
+    one-hop-per-tick wire model. Returns int32 numpy tables of shape (T, S):
+
+    - ``f_tab[t, r]``  — microbatch whose FORWARD rank r runs at tick t (-1 none)
+    - ``b_tab[t, r]``  — microbatch whose BACKWARD rank r runs at tick t
+    - ``rf_tab[t, r]`` — microbatch whose forward activation ARRIVES at rank r
+      at tick t (sent by r-1 at t-1)
+    - ``rb_tab[t, r]`` — microbatch whose output-gradient arrives at rank r
+      (sent by r+1 at t-1)
+
+    Policy: backward-when-ready, else forward, with at most
+    ``min(s - r, m)`` microbatches in flight per rank — exactly the classic
+    1F1B steady state. The simulation also validates ring-buffer safety:
+    in-flight microbatch indices are distinct mod s, so stashes keyed
+    ``micro % s`` can never collide."""
+    next_f = [0] * s
+    next_b = [0] * s
+    f_done = [[None] * m for _ in range(s)]
+    b_done = [[None] * m for _ in range(s)]
+    rows = []
+    t = 0
+    while any(next_b[r] < m for r in range(s)):
+        row = []
+        for r in range(s):
+            f_i = b_i = -1
+            can_f = (next_f[r] < m
+                     and (next_f[r] - next_b[r]) < min(s - r, m))
+            if can_f and r > 0:
+                up = f_done[r - 1][next_f[r]]
+                can_f = up is not None and up + 1 <= t
+            can_b = next_b[r] < next_f[r]
+            if can_b:
+                i = next_b[r]
+                if r == s - 1:
+                    can_b = f_done[r][i] is not None and f_done[r][i] < t
+                else:
+                    dn = b_done[r + 1][i]
+                    can_b = dn is not None and dn + 1 <= t
+            if can_b:
+                b_i = next_b[r]
+            elif can_f:
+                f_i = next_f[r]
+            row.append((f_i, b_i))
+        for r, (f_i, b_i) in enumerate(row):
+            if f_i >= 0:
+                # ring-slot safety: no other in-flight micro shares f_i mod s
+                assert all((j - f_i) % s != 0
+                           for j in range(next_b[r], next_f[r])), \
+                    "1F1B stash ring collision"
+                f_done[r][f_i] = t
+                next_f[r] += 1
+            if b_i >= 0:
+                b_done[r][b_i] = t
+                next_b[r] += 1
+        rows.append(row)
+        t += 1
+        if t > 6 * (m + s) + 32:
+            raise RuntimeError("1F1B schedule simulation did not converge")
+    T = len(rows)
+    f_tab = np.full((T, s), -1, np.int32)
+    b_tab = np.full((T, s), -1, np.int32)
+    rf_tab = np.full((T, s), -1, np.int32)
+    rb_tab = np.full((T, s), -1, np.int32)
+    for tt, row in enumerate(rows):
+        for r, (f_i, b_i) in enumerate(row):
+            f_tab[tt, r] = f_i
+            b_tab[tt, r] = b_i
+            if f_i >= 0 and r + 1 < s and tt + 1 < T:
+                rf_tab[tt + 1, r + 1] = f_i
+            if b_i >= 0 and r - 1 >= 0 and tt + 1 < T:
+                rb_tab[tt + 1, r - 1] = b_i
+    # every rank must complete m forwards and m backwards, in order
+    for r in range(s):
+        assert sorted(i for i in f_tab[:, r] if i >= 0) == list(range(m))
+        assert sorted(i for i in b_tab[:, r] if i >= 0) == list(range(m))
+    return f_tab, b_tab, rf_tab, rb_tab
 
 
 def _check_stage(stage: AbstractModule) -> AbstractModule:
@@ -76,9 +169,16 @@ class GPipe(Container):
                  n_stages: int = 1, n_microbatches: int = 2,
                  axis_name: str = "pipe",
                  stages: Optional[Sequence[AbstractModule]] = None,
-                 remat: bool = False):
+                 remat: bool = False, schedule: str = "gpipe"):
         if (stage is None) == (stages is None):
             raise ValueError("pass exactly one of `stage` or `stages`")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or '1f1b', got {schedule!r}")
+        # "1f1b" changes the TRAINING step only (pipeline_train_step, picked
+        # up by the Optimizer when this GPipe is the root model); forward/
+        # inference always uses the GPipe tick loop — identical math
+        self.schedule = schedule
         # remat: recompute each stage's internals in backward instead of
         # stashing them across the whole GPipe schedule — the standard relief
         # for the all-forward-then-all-backward activation profile autodiff
@@ -191,15 +291,10 @@ class GPipe(Container):
         return fn(stacked, x)
 
     # ------------------------------------------ heterogeneous (switch) path
-    def _apply_sharded_hetero(self, params, x, training, mesh, data_axis=None):
-        s, m = self.n_stages, self.n_microbatches
-        axis = self.axis_name
-        x_spec = P(data_axis) if data_axis else P()
-        d = dict(mesh.shape).get(data_axis, 1) if data_axis else 1
-        bm = (x.shape[0] // d) // m  # per-rank microbatch size
-
-        # --- static boundary shapes: chain eval_shape through the stages
-        stage_params = [params[str(i)] for i in range(s)]
+    def _boundary_shapes(self, stage_params, x, bm, training):
+        """Chain eval_shape through the stages: (in_shapes, out_shapes,
+        buf_len) for the zero-padded flat activation wire."""
+        s = self.n_stages
         in_shapes = []   # stage i input aval
         out_shapes = []  # stage i output aval
         cur = jax.ShapeDtypeStruct((bm,) + x.shape[1:], x.dtype)
@@ -215,9 +310,13 @@ class GPipe(Container):
         # into the feed shape on late ticks), so include the input extent too
         buf_len = max([int(np.prod(o.shape)) for o in out_shapes]
                       + [int(np.prod(in_shapes[0].shape))])
+        return in_shapes, out_shapes, buf_len
 
-        # --- flatten+pad+stack per-stage params: (S, P) sharded over `pipe`,
-        # so each rank materialises only its own stage's weights
+    def _flat_param_machinery(self, stage_params):
+        """Flatten+pad+stack per-stage params: (S, P) sharded over ``pipe``,
+        so each rank materialises only its own stage's weights. Returns
+        (p_stk, unflatten, offsets) where ``unflatten(i, row)`` rebuilds
+        stage i's pytree from its padded row."""
         flat, offsets = [], []
         for sp in stage_params:
             leaves = jax.tree_util.tree_leaves(sp)
@@ -239,6 +338,20 @@ class GPipe(Container):
                       .reshape(shape).astype(dtype)
                       for off, shape, dtype in offsets[i]]
             return jax.tree_util.tree_unflatten(treedefs[i], leaves)
+
+        return p_stk, unflatten, offsets
+
+    def _apply_sharded_hetero(self, params, x, training, mesh, data_axis=None):
+        s, m = self.n_stages, self.n_microbatches
+        axis = self.axis_name
+        x_spec = P(data_axis) if data_axis else P()
+        d = dict(mesh.shape).get(data_axis, 1) if data_axis else 1
+        bm = (x.shape[0] // d) // m  # per-rank microbatch size
+
+        stage_params = [params[str(i)] for i in range(s)]
+        in_shapes, out_shapes, buf_len = self._boundary_shapes(
+            stage_params, x, bm, training)
+        p_stk, unflatten, _ = self._flat_param_machinery(stage_params)
 
         def body(p_stk, xs):
             rank = lax.axis_index(axis)
@@ -303,10 +416,207 @@ class GPipe(Container):
                            in_specs=(P(axis), x_spec), out_specs=x_spec)
         return fn(p_stk, x)
 
+    # --------------------------------------------- 1F1B training schedule
+    def pipeline_train_step(self, params, x, y, criterion, mesh,
+                            data_axis=None):
+        """Hand-scheduled 1F1B training step: returns ``(loss, grads)`` with
+        the criterion INSIDE the pipelined program (the only way to interleave
+        backwards with forwards — autodiff of ``apply`` is structurally
+        all-forward-then-all-backward). Each backward is an explicit
+        per-stage ``jax.vjp`` with forward recompute, so the per-rank stash
+        holds only stage INPUTS for in-flight microbatches:
+        ``min(S - rank, M)`` buffers instead of GPipe's ``M``. Gradients are
+        bit-compatible with the autodiff schedule (pinned by test)."""
+        s, m = self.n_stages, self.n_microbatches
+        axis = self.axis_name
+        x_spec = P(data_axis) if data_axis else P()
+        d = dict(mesh.shape).get(data_axis, 1) if data_axis else 1
+        bm = (x.shape[0] // d) // m
+
+        stage_params = [params[str(i)] for i in range(s)]
+        in_shapes, out_shapes, buf_len = self._boundary_shapes(
+            stage_params, x, bm, True)
+        p_stk, unflatten, offsets = self._flat_param_machinery(stage_params)
+        p_len = p_stk.shape[1]
+        f_tab, b_tab, rf_tab, rb_tab = _simulate_1f1b(s, m)
+        n_ticks = f_tab.shape[0]
+        crit_averages = bool(getattr(criterion, "size_average", True))
+        # mean criteria: full-batch mean == mean of equal-size micro means
+        scale = 1.0 / m if crit_averages else 1.0
+
+        # mixed precision mirrors the generic step: fp32 master params/wires,
+        # stage compute in the Engine dtype (bf16 → MXU double rate); the
+        # cast's transpose returns fp32 gradients through the per-stage vjp
+        from bigdl_tpu.nn.precision import cast_floating
+        from bigdl_tpu.utils.engine import Engine
+        compute_dtype = Engine.compute_dtype()
+        mixed = compute_dtype != jnp.float32
+
+        def stage_flat(i, row, buf):
+            av = in_shapes[i]
+            inp = buf[:int(np.prod(av.shape))].reshape(av.shape) \
+                .astype(av.dtype)
+            p = unflatten(i, row)
+            if mixed:
+                p = cast_floating(p, compute_dtype)
+                inp = cast_floating(inp, compute_dtype)
+            out = self._stage_apply(i, p, inp, True)
+            vec = jnp.ravel(out).astype(jnp.float32)
+            return jnp.pad(vec, (0, buf_len - vec.shape[0]))
+
+        def body(p_stk_l, xs, ys):
+            rank = lax.axis_index(axis)
+            row = p_stk_l[0]          # my stage's flattened params
+            micro_x = xs.reshape((m, bm) + xs.shape[1:])
+            micro_y = ys.reshape((m, ys.shape[0] // m) + ys.shape[1:])
+            vaxes = (axis,) if data_axis is None else (axis, data_axis)
+            micro_x = lax.pcast(micro_x, (axis,), to="varying")
+            micro_y = lax.pcast(micro_y, (axis,), to="varying")
+
+            def zeros(shape):
+                return lax.pcast(jnp.zeros(shape, jnp.float32), vaxes,
+                                 to="varying")
+
+            fwd_branches = [
+                (lambda i: lambda row_, buf: stage_flat(i, row_, buf))(i)
+                for i in range(s)]
+
+            def bwd_branch(i):
+                def run(row_, x_buf, g_buf, y_mb):
+                    if i == s - 1:
+                        def f(rw, xb):
+                            out_flat = stage_flat(i, rw, xb)
+                            fs = out_shapes[i]
+                            out = out_flat[:int(np.prod(fs.shape))] \
+                                .reshape(fs.shape).astype(fs.dtype)
+                            return criterion.apply(out, y_mb) * scale
+                        loss_i, vjp = jax.vjp(f, row_, x_buf)
+                        # the cotangent must carry the same varying-axes
+                        # typing as the primal loss under shard_map
+                        d_row, dx = vjp(jnp.ones_like(loss_i))
+                        return (d_row.astype(jnp.float32), dx,
+                                loss_i.astype(jnp.float32))
+
+                    def f(rw, xb):
+                        return stage_flat(i, rw, xb)
+                    _, vjp = jax.vjp(f, row_, x_buf)
+                    d_row, dx = vjp(g_buf)
+                    # zero loss must carry the same varying-axes typing as
+                    # the last branch's real loss (switch output contract)
+                    return (d_row.astype(jnp.float32), dx,
+                            lax.pcast(jnp.zeros((), jnp.float32), vaxes,
+                                      to="varying"))
+                return run
+            bwd_branches = [bwd_branch(i) for i in range(s)]
+
+            rankc = jnp.clip(rank, 0, s - 1)
+            f_j = jnp.asarray(f_tab)
+            b_j = jnp.asarray(b_tab)
+            rf_j = jnp.asarray(rf_tab)
+            rb_j = jnp.asarray(rb_tab)
+
+            def tick(carry, t):
+                fwd_in, bwd_in, x_stash, gsum, loss_acc, wire_f, wire_b = carry
+                # 1. bank last tick's arrivals into the micro-keyed rings
+                rfm = rf_j[t, rankc]
+                fwd_in = jnp.where(
+                    rfm >= 0,
+                    lax.dynamic_update_index_in_dim(
+                        fwd_in, wire_f, lax.rem(jnp.maximum(rfm, 0), s), 0),
+                    fwd_in)
+                rbm = rb_j[t, rankc]
+                bwd_in = jnp.where(
+                    rbm >= 0,
+                    lax.dynamic_update_index_in_dim(
+                        bwd_in, wire_b, lax.rem(jnp.maximum(rbm, 0), s), 0),
+                    bwd_in)
+
+                # 2. forward op (scheduled ranks only; cond skips the rest)
+                fi = f_j[t, rankc]
+                fslot = lax.rem(jnp.maximum(fi, 0), s)
+                feed = micro_x[jnp.clip(fi, 0, m - 1)]
+                feed = jnp.pad(jnp.ravel(feed).astype(jnp.float32),
+                               (0, buf_len - feed.size))
+                inp = jnp.where(rank == 0, feed,
+                                lax.dynamic_index_in_dim(fwd_in, fslot, 0,
+                                                         keepdims=False))
+                x_stash = jnp.where(
+                    fi >= 0,
+                    lax.dynamic_update_index_in_dim(x_stash, inp, fslot, 0),
+                    x_stash)
+                send_f = lax.cond(
+                    fi >= 0,
+                    lambda: lax.switch(rankc, fwd_branches, row, inp),
+                    lambda: zeros((buf_len,)))
+
+                # 3. backward op: vjp with recompute off the stashed input
+                bi = b_j[t, rankc]
+                bslot = lax.rem(jnp.maximum(bi, 0), s)
+                x_in = lax.dynamic_index_in_dim(x_stash, bslot, 0,
+                                                keepdims=False)
+                g_in = lax.dynamic_index_in_dim(bwd_in, bslot, 0,
+                                                keepdims=False)
+                y_mb = micro_y[jnp.clip(bi, 0, m - 1)]
+                # NOTE on varying-axes typing: row is data-INVARIANT (pipe-
+                # sharded, data-replicated), so shard_map's vjp psums d_row
+                # over the data axis automatically — d_row/gsum are typed
+                # V:pipe and already hold the cross-data-rank SUM.
+                d_row, dx, loss_i = lax.cond(
+                    bi >= 0,
+                    lambda: lax.switch(rankc, bwd_branches, row, x_in, g_in,
+                                       y_mb),
+                    lambda: (lax.pcast(jnp.zeros((p_len,), jnp.float32),
+                                       (axis,), to="varying"),
+                             zeros((buf_len,)), zeros(())))
+                gsum = gsum + d_row
+                loss_acc = loss_acc + loss_i
+
+                # 4. wires: activations hop right, gradients hop left
+                wire_f = lax.ppermute(send_f, axis,
+                                      [(i, i + 1) for i in range(s - 1)])
+                wire_b = lax.ppermute(dx, axis,
+                                      [(i + 1, i) for i in range(s - 1)])
+                return (fwd_in, bwd_in, x_stash, gsum, loss_acc,
+                        wire_f, wire_b), None
+
+            init = (zeros((s, buf_len)), zeros((s, buf_len)),
+                    zeros((s, buf_len)),
+                    lax.pcast(jnp.zeros((p_len,), jnp.float32), (axis,),
+                              to="varying"),
+                    zeros(()), zeros((buf_len,)), zeros((buf_len,)))
+            (_, _, _, gsum, loss_acc, _, _), _ = lax.scan(
+                tick, init, jnp.arange(n_ticks))
+
+            # loss lives on the last rank only
+            loss = lax.psum(loss_acc, axis)
+            if data_axis is not None:
+                # gsum already holds the cross-data SUM (vjp auto-psum, see
+                # above); mean criteria need the mean of per-shard grads
+                loss = (lax.pmean(loss, data_axis) if crit_averages
+                        else lax.psum(loss, data_axis))
+                if crit_averages:
+                    gsum = gsum / d
+            return gsum[None, :], loss
+
+        fn = jax.shard_map(body, mesh=mesh,
+                           in_specs=(P(axis), x_spec, x_spec),
+                           out_specs=(P(axis), P()))
+        g_stk, loss = fn(p_stk, x, y)
+
+        grads = {}
+        for i in range(s):
+            leaves = [g_stk[i, off:off + int(np.prod(shape))]
+                      .reshape(shape).astype(dtype)
+                      for off, shape, dtype in offsets[i]]
+            grads[str(i)] = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(stage_params[i]), leaves)
+        return loss, grads
+
     def __repr__(self):
         kind = "homogeneous" if self.homogeneous else "heterogeneous"
         return (f"GPipe(stages={self.n_stages} [{kind}], "
-                f"microbatches={self.n_microbatches})")
+                f"microbatches={self.n_microbatches}, "
+                f"schedule={self.schedule})")
 
 
 from bigdl_tpu.utils.serializer import register as _register_serializable  # noqa: E402

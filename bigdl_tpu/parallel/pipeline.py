@@ -544,8 +544,11 @@ class GPipe(Container):
                     fi >= 0,
                     lax.dynamic_update_index_in_dim(x_stash, inp, fslot, 0),
                     x_stash)
+                # the last rank's forward output is never delivered (ppermute
+                # stops at s-2) and its backward recomputes from x_stash —
+                # skip the compute, keep only the stash write above
                 send_f = lax.cond(
-                    fi >= 0,
+                    jnp.logical_and(fi >= 0, rankc < s - 1),
                     lambda: lax.switch(rankc, fwd_branches, row, inp),
                     lambda: zeros((buf_len,)))
 

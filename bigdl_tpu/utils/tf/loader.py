@@ -90,49 +90,195 @@ class _Importer:
             self.consts[name] = val
             return val
         if node.op in ("Identity", "CheckNumerics",
-                       "PlaceholderWithDefault") and node.input:
+                       "PlaceholderWithDefault", "Enter") and node.input:
+            # Enter: loop-invariant values pass through unchanged (the
+            # frozen-graph weight-feeding path into while bodies)
             return self.const_value(node.input[0])
         return None
 
     # ---------------------------------------------------------------- build
+    def _get(self, name):
+        from bigdl_tpu import nn
+
+        get = self._get
+        base, idx = self._parse(name)
+        key = f"{base}:{idx}" if idx else base
+        if key in self.module_nodes:
+            return self.module_nodes[key]
+        node = self.nodes.get(base)
+        if node is None:
+            raise TFImportError(f"unknown node {base!r}")
+        if node.op == "Switch":
+            # Loop-frame Switch (predicate is a LoopCond): part of a v1
+            # while loop — import the WHOLE loop via its Exit machinery
+            pred_node = self.nodes.get(self._clean(node.input[1]))
+            if pred_node is not None and pred_node.op == "LoopCond":
+                raise TFImportError(
+                    f"{base}: loop-internal Switch referenced outside its "
+                    f"while frame")
+            # frozen-graph control flow: the predicate must be static;
+            # output :0 is the false branch, :1 the true branch
+            pred = self.const_value(node.input[1])
+            if pred is None:
+                raise TFImportError(
+                    f"{base}: dynamic Switch predicate (only frozen "
+                    f"statically-resolvable control flow is supported)")
+            if idx != int(bool(pred)):
+                raise TFImportError(f"{base}: dead branch (output {idx}) "
+                                    f"reached")
+            mn = get(node.input[0])
+            self.module_nodes[key] = mn
+            return mn
+        if node.op == "Exit":
+            mn = self._import_while(base)
+            self.module_nodes[base] = mn
+            return mn
+        if node.op == "Enter":
+            # only loop-INVARIANT Enters are referenced outside the
+            # Merge machinery; their value must be static (frozen graph)
+            val = self.const_value(base)
+            if val is None:
+                raise TFImportError(
+                    f"{base}: loop-invariant Enter does not resolve to a "
+                    f"constant (only frozen graphs import)")
+            raise TFImportError(
+                f"{base}: loop-invariant Enter reached outside a const "
+                f"context — unsupported wiring")
+        if node.op in _MULTI_OUTPUT:
+            raw = self.module_nodes.get(base + ":raw")
+            if raw is None:
+                raw = self._convert(node, get)
+                self.module_nodes[base + ":raw"] = raw
+            sel = nn.SelectTable(idx + 1) \
+                .set_name(f"{base}.{idx}").inputs(raw)
+            self.module_nodes[key] = sel
+            return sel
+        if base not in self.module_nodes:
+            self.module_nodes[base] = self._convert(node, get)
+        return self.module_nodes[base]
+
+    # ----------------------------------------------------- v1 while loops
+    def _sub_context(self, seeds):
+        """Swap in a fresh module-node namespace (seeded with Input
+        placeholders for the loop frame's entry points) for a nested build
+        of a loop cond/body subgraph; returns the saved namespace."""
+        saved = self.module_nodes
+        self.module_nodes = dict(seeds)
+        return saved
+
+    def _import_while(self, exit_base: str):
+        """Import a TF v1 raw-form while loop (Enter/Merge/Switch/LoopCond/
+        NextIteration/Exit — the training-era dynamic control flow SURVEY
+        §2.5 flags) reached via one of its Exit nodes. The loop's carried
+        variables become a ``lax.while_loop`` carry inside a
+        :class:`TFWhileLoop` module whose cond/body are nested ``nn.Graph``
+        imports of the frame subgraphs. Loop-invariant Enters must resolve
+        to constants (frozen graphs); TensorArray-backed loops
+        (dynamic_rnn) are rejected with a pointer to the native recurrent
+        stack. Inference-only: ``lax.while_loop`` is not
+        reverse-differentiable."""
+        from bigdl_tpu import nn
+        from bigdl_tpu.utils.tf import ops as O
+
+        exit_node = self.nodes[exit_base]
+        sw0 = self.nodes[self._clean(exit_node.input[0])]
+        lc_name = self._clean(sw0.input[1])
+        cache = self.module_nodes.get(("__while__", lc_name))
+        if cache is None:
+            cache = self._build_while(lc_name)
+            self.module_nodes[("__while__", lc_name)] = cache
+        while_node, exit_index = cache
+        sel = nn.SelectTable(exit_index[exit_base] + 1) \
+            .set_name(exit_base).inputs(while_node)
+        return sel
+
+    def _build_while(self, lc_name: str):
+        from bigdl_tpu import nn
+        from bigdl_tpu.utils.tf import ops as O
+
+        lc = self.nodes[lc_name]
+        # carried variables, in graph order: Switch(Merge, LoopCond)
+        switches = [n for n in self.nodes.values()
+                    if n.op == "Switch" and self._clean(n.input[1]) == lc_name]
+        if not switches:
+            raise TFImportError(f"{lc_name}: LoopCond with no Switch")
+        merges, enters, nextits = [], [], []
+        for swn in switches:
+            mg = self.nodes[self._clean(swn.input[0])]
+            if mg.op != "Merge":
+                raise TFImportError(f"{swn.name}: loop Switch without Merge")
+            ins = [self.nodes[self._clean(i)] for i in mg.input[:2]]
+            enter = next((n for n in ins if n.op == "Enter"), None)
+            nextit = next((n for n in ins if n.op == "NextIteration"), None)
+            if enter is None or nextit is None:
+                raise TFImportError(
+                    f"{mg.name}: loop Merge must join Enter+NextIteration")
+            merges.append(mg)
+            enters.append(enter)
+            nextits.append(nextit)
+
+        # outer init values: constants (counters etc.) bake into the module;
+        # the rest import in the OUTER context and wire as graph inputs
+        const_slots, const_values, init_nodes, init_slots = [], [], [], []
+        for k, e in enumerate(enters):
+            cv = self.const_value(e.input[0])
+            if cv is not None:
+                const_slots.append(k)
+                const_values.append(cv)
+            else:
+                init_slots.append(k)
+                init_nodes.append(self._get(e.input[0]))
+        if not init_nodes:
+            raise TFImportError(
+                f"{lc_name}: every loop init is a constant — the loop is a "
+                f"frozen computation; fold it before freezing the graph")
+
+        def sub_build(seeds, out_names):
+            saved = self._sub_context(seeds)
+            try:
+                outs = [self._get(o) for o in out_names]
+            finally:
+                self.module_nodes = saved
+            # used seeds = those reachable from the outputs
+            seen, stack = set(), list(outs)
+            while stack:
+                n = stack.pop()
+                if id(n) in seen:
+                    continue
+                seen.add(id(n))
+                stack.extend(n.prev_nodes)
+            seed_nodes = list(seeds.values())
+            used = [i for i, sn in enumerate(seed_nodes) if id(sn) in seen]
+            if not used:
+                raise TFImportError(
+                    f"{lc_name}: loop subgraph uses no carried variable")
+            return nn.Graph([seed_nodes[i] for i in used], outs), used
+
+        # cond references the Merges directly
+        cond_seeds = {mg.name: nn.Input() for mg in merges}
+        cond_graph, cond_used = sub_build(cond_seeds, [lc.input[0]])
+        # body references the Switches' true outputs
+        body_seeds = {f"{sw.name}:1": nn.Input() for sw in switches}
+        body_graph, body_used = sub_build(
+            body_seeds, [n.input[0] for n in nextits])
+
+        wl = O.TFWhileLoop(cond_graph, body_graph, cond_used, body_used,
+                           init_slots=init_slots, const_slots=const_slots,
+                           const_values=const_values).set_name(lc_name)
+        while_node = wl.inputs(*init_nodes)
+        exit_index = {}
+        for n in self.nodes.values():
+            if n.op == "Exit":
+                sw = self.nodes[self._clean(n.input[0])]
+                if self._clean(sw.input[1]) == lc_name:
+                    exit_index[n.name] = switches.index(sw)
+        return while_node, exit_index
+
     def build(self, inputs: Optional[Sequence[str]],
               outputs: Sequence[str]):
         from bigdl_tpu import nn
 
-        def get(name):
-            base, idx = self._parse(name)
-            key = f"{base}:{idx}" if idx else base
-            if key in self.module_nodes:
-                return self.module_nodes[key]
-            node = self.nodes.get(base)
-            if node is None:
-                raise TFImportError(f"unknown node {base!r}")
-            if node.op == "Switch":
-                # frozen-graph control flow: the predicate must be static;
-                # output :0 is the false branch, :1 the true branch
-                pred = self.const_value(node.input[1])
-                if pred is None:
-                    raise TFImportError(
-                        f"{base}: dynamic Switch predicate (only frozen "
-                        f"statically-resolvable control flow is supported)")
-                if idx != int(bool(pred)):
-                    raise TFImportError(f"{base}: dead branch (output {idx}) "
-                                        f"reached")
-                mn = get(node.input[0])
-                self.module_nodes[key] = mn
-                return mn
-            if node.op in _MULTI_OUTPUT:
-                raw = self.module_nodes.get(base + ":raw")
-                if raw is None:
-                    raw = self._convert(node, get)
-                    self.module_nodes[base + ":raw"] = raw
-                sel = nn.SelectTable(idx + 1) \
-                    .set_name(f"{base}.{idx}").inputs(raw)
-                self.module_nodes[key] = sel
-                return sel
-            if base not in self.module_nodes:
-                self.module_nodes[base] = self._convert(node, get)
-            return self.module_nodes[base]
+        get = self._get
 
         # placeholders discovered lazily unless pinned by `inputs`
         out_nodes = [get(o) for o in outputs]
@@ -218,6 +364,18 @@ class _Importer:
                 "Conv2D", "DepthwiseConv2dNative", "MatMul"):
             raise TFImportError(f"{node.name}: bias fusion into {op!r}")
 
+        if op in ("While", "StatelessWhile"):
+            raise TFImportError(
+                f"{node.name}: functional (control-flow-v2) While is not "
+                f"supported — freeze with tf.compat.v1.disable_control_flow_"
+                f"v2() so loops serialize in the raw Enter/Exit form "
+                f"TFWhileLoop imports")
+        if op.startswith("TensorArray"):
+            raise TFImportError(
+                f"{node.name}: TensorArray-backed loops (dynamic_rnn) are "
+                f"not supported — rebuild RNNs with the native recurrent "
+                f"stack (nn.Recurrent / lax.scan), the TPU-correct design; "
+                f"counter/accumulator while loops import via TFWhileLoop")
         if op in ("Placeholder", "PlaceholderWithDefault"):
             self.input_names.append(node.name)
             mn = nn.Input()

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from bigdl_tpu.nn.abstractnn import AbstractModule, TensorModule
+from bigdl_tpu.nn.abstractnn import AbstractModule, Container, TensorModule
 from bigdl_tpu.utils.table import Table
 
 
@@ -781,6 +781,76 @@ class QuantizedTFMatMul(_QuantizedBaseTF):
         if "bias" in params:
             out = out + params["bias"]
         return out, state
+
+
+class TFWhileLoop(Container):
+    """``lax.while_loop`` carrier for an imported TF v1 raw-form while loop
+    (SURVEY §2.5 TF import — training-era dynamic control flow; loader
+    ``_build_while``). ``cond_graph``/``body_graph`` are nested ``nn.Graph``
+    imports of the loop-frame subgraphs; ``cond_used``/``body_used`` pick
+    which carried variables each subgraph actually consumes (nn.Graph
+    refuses disconnected inputs). Input: Table of carried inits (graph
+    order); output: Table of final carried values — the loader wires each
+    TF ``Exit`` to a SelectTable over it.
+
+    Inference-only: ``lax.while_loop`` is not reverse-differentiable, so a
+    fine-tune THROUGH the loop fails loudly in jax; frozen graphs (the
+    importer's scope) never need that."""
+
+    def __init__(self, cond_graph, body_graph, cond_used, body_used,
+                 init_slots=None, const_slots=None, const_values=None):
+        super().__init__(cond_graph, body_graph)
+        self.cond_used = list(cond_used)
+        self.body_used = list(body_used)
+        # carried-variable count = the body's output count (body_used is the
+        # subset it READS, which can be smaller)
+        n = len(body_graph.output_nodes) if init_slots is None else \
+            len(init_slots) + len(const_slots or ())
+        # constant inits (loop counters in frozen graphs) bake into the
+        # module; wired inputs land at init_slots of the carry
+        self.init_slots = list(init_slots) if init_slots is not None \
+            else list(range(n))
+        self.const_slots = list(const_slots or ())
+        self.const_values = [np.asarray(v) for v in (const_values or ())]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from jax import lax as _lax
+
+        wired = list(input.values()) if isinstance(input, Table) else [input]
+        n = len(self.init_slots) + len(self.const_slots)
+        xs = [None] * n
+        for slot, v in zip(self.init_slots, wired):
+            xs[slot] = v
+        for slot, v in zip(self.const_slots, self.const_values):
+            xs[slot] = jnp.asarray(v)
+        cond_m, body_m = self.modules
+        cp, bp = params["0"], params["1"]
+        cs, bs = state["0"], state["1"]
+
+        def pick(carry, used):
+            vals = [carry[i] for i in used]
+            return vals[0] if len(vals) == 1 else Table(*vals)
+
+        def cond_fn(carry):
+            out, _ = cond_m.apply(cp, cs, pick(carry, self.cond_used),
+                                  training=False, rng=None)
+            return jnp.reshape(out, ()).astype(bool)
+
+        def body_fn(carry):
+            out, _ = body_m.apply(bp, bs, pick(carry, self.body_used),
+                                  training=False, rng=None)
+            outs = list(out.values()) if isinstance(out, Table) else [out]
+            # carried dtypes are loop-invariant in TF; enforce for jax
+            return tuple(jnp.asarray(o).astype(c.dtype)
+                         for o, c in zip(outs, carry))
+
+        final = _lax.while_loop(cond_fn, body_fn,
+                                tuple(jnp.asarray(x) for x in xs))
+        return Table(*final), state
+
+    def __repr__(self):
+        return (f"TFWhileLoop(carried={len(self.body_used)}, "
+                f"cond={self.modules[0]!r})")
 
 
 # Portable serialization: imported graphs are first-class modules, so every

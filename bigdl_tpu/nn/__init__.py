@@ -38,8 +38,9 @@ from bigdl_tpu.nn.normalization import (
     SpatialDropout3D, SpatialSubtractiveNormalization, SpatialWithinChannelLRN,
 )
 from bigdl_tpu.nn.recurrent import (
-    BiRecurrent, Cell, ConvLSTMPeephole, GRU, LSTM, LSTMPeephole, Masking,
-    Recurrent, RecurrentDecoder, RnnCell, TimeDistributed,
+    BiRecurrent, Cell, ConvLSTMPeephole, ConvLSTMPeephole3D, GRU, LSTM,
+    LSTMPeephole, Masking, MultiRNNCell, Recurrent, RecurrentDecoder, RnnCell,
+    TimeDistributed,
 )
 from bigdl_tpu.nn.criterion import (
     AbsCriterion, AbstractCriterion, BCECriterion, BCECriterionWithLogits,
@@ -61,7 +62,8 @@ from bigdl_tpu.nn.initialization import (
 )
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.quantized import (
-    QuantizedLinear, QuantizedSpatialConvolution, calibrate,
+    QuantizedLinear, QuantizedSpatialConvolution,
+    QuantizedSpatialDilatedConvolution, calibrate,
 )
 from bigdl_tpu.nn.sparse import (
     DenseToSparse, LookupTableSparse, SparseEmbeddingSum, SparseJoinTable,
@@ -89,6 +91,17 @@ from bigdl_tpu.nn.volumetric import (
 from bigdl_tpu.nn.pooling import (
     SpatialAveragePooling, SpatialMaxPooling, TemporalAveragePooling,
     TemporalMaxPooling,
+)
+from bigdl_tpu.nn.transformer_layers import (
+    Attention, ExpandSize, FeedForwardNetwork, LayerNormalization,
+    TableOperation, Transformer,
+)
+from bigdl_tpu.nn.maskrcnn import (
+    BoxHead, DetectionOutputFrcnn, FPN, MaskHead, Pooler, RegionProposal,
+    RoiAlign,
+)
+from bigdl_tpu.nn.tf_utils import (
+    Const, Fill, Shape, SplitAndSelect, StrideSlice,
 )
 from bigdl_tpu.nn.shape_ops import (
     Contiguous, Flatten, Index, InferReshape, Narrow, Padding, Replicate, Reshape,

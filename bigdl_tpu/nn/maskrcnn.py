@@ -1,0 +1,390 @@
+"""Mask-R-CNN module family (SURVEY §2.1 layer zoo tail — expected
+``<dl>/nn/{RoiAlign,FPN,Pooler,RegionProposal,BoxHead,MaskHead,
+DetectionOutputFrcnn}.scala``, unverified, mount empty).
+
+TPU-first shape discipline throughout: every stage runs on FIXED budgets
+(R rois, per-class NMS over static candidate lists) so the whole detector
+traces once — the same redesign :mod:`bigdl_tpu.nn.detection` applies to
+SSD. Heads are Containers over stock conv/linear modules, so params,
+serialization, freeze/LoRA and the optimizer see nothing new."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.abstractnn import AbstractModule, Container
+from bigdl_tpu.nn.convolution import (SpatialConvolution,
+                                      SpatialFullConvolution)
+from bigdl_tpu.nn.detection import decode_rcnn, nms_mask
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.roi import RoiPooling
+from bigdl_tpu.utils.table import Table
+
+
+class RoiAlign(RoiPooling):
+    """Reference-named RoiAlign (``RoiAlign(spatialScale, samplingRatio,
+    pooledH, pooledW)``): the ALIGNED coordinate transform — continuous
+    coordinates shift by -0.5 so sample points sit at pixel centers (the
+    Mask-R-CNN fix to RoiPooling's quantization). The underlying fixed-
+    budget bilinear sampler is shared with :class:`RoiPooling`."""
+
+    def __init__(self, spatial_scale: float, sampling_ratio: int,
+                 pooled_h: int, pooled_w: int, mode: str = "avg"):
+        super().__init__(pooled_h, pooled_w, spatial_scale=spatial_scale,
+                         sampling_ratio=sampling_ratio, mode=mode)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = input.values() if isinstance(input, Table) else list(input)
+        feats, rois = xs[0], xs[1]
+        # aligned=True: image-space box * scale - 0.5 (pixel-center grid)
+        r = rois.astype(jnp.float32)
+        shifted = jnp.concatenate(
+            [r[:, :1], r[:, 1:] - 0.5 / self.spatial_scale], axis=1)
+        return super().apply(params, state, Table(feats, shifted),
+                             training=training, rng=rng)
+
+    def __repr__(self):
+        return (f"RoiAlign(scale={self.spatial_scale}, "
+                f"{self.pooled_h}x{self.pooled_w})")
+
+
+class FPN(Container):
+    """Feature Pyramid Network (reference ``FPN(inChannels, outChannels,
+    topBlocks)``): per-level lateral 1x1 convs, top-down nearest-neighbour
+    upsampling, 3x3 output convs; ``top_blocks=1`` appends a stride-2
+    max-pooled P6. Input: Table(C2..C5) fine→coarse; output Table(P2..P5
+    [, P6]) in the same order."""
+
+    def __init__(self, in_channels: Sequence[int], out_channels: int,
+                 top_blocks: int = 0):
+        in_channels = list(in_channels)
+        laterals = [SpatialConvolution(c, out_channels, 1, 1)
+                    for c in in_channels]
+        outputs = [SpatialConvolution(out_channels, out_channels, 3, 3,
+                                      pad_w=1, pad_h=1)
+                   for _ in in_channels]
+        super().__init__(*(laterals + outputs))
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.top_blocks = int(top_blocks)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = list(input.values()) if isinstance(input, Table) else list(input)
+        n_lvl = len(self.in_channels)
+        if len(xs) != n_lvl:
+            raise ValueError(f"FPN expects {n_lvl} levels, got {len(xs)}")
+        new_state = dict(state)
+
+        def run(i, x):
+            out, s = self.modules[i].apply(params[str(i)], state[str(i)], x,
+                                           training=training, rng=None)
+            new_state[str(i)] = s
+            return out
+
+        lat = [run(i, x) for i, x in enumerate(xs)]
+        # top-down: coarsest lateral is the seed; upsample 2x and add
+        merged = [None] * n_lvl
+        merged[-1] = lat[-1]
+        for i in range(n_lvl - 2, -1, -1):
+            up = merged[i + 1]
+            up = jnp.repeat(jnp.repeat(up, 2, axis=2), 2, axis=3)
+            up = up[:, :, : lat[i].shape[2], : lat[i].shape[3]]
+            merged[i] = lat[i] + up
+        outs = [run(n_lvl + i, m) for i, m in enumerate(merged)]
+        if self.top_blocks:
+            p6 = jax.lax.reduce_window(
+                outs[-1], -jnp.inf, jax.lax.max, (1, 1, 1, 1), (1, 1, 2, 2),
+                "VALID")
+            outs.append(p6)
+        return Table(*outs), new_state
+
+    def __repr__(self):
+        return (f"FPN({self.in_channels} -> {self.out_channels}, "
+                f"top_blocks={self.top_blocks})")
+
+
+class Pooler(AbstractModule):
+    """Multi-level ROI feature extractor (reference ``Pooler(resolution,
+    scales, samplingRatio)``): each ROI maps to a pyramid level by the FPN
+    heuristic ``level = floor(k0 + log2(sqrt(area)/224))``, is RoiAligned
+    there, and the per-level results merge by mask — shape-static (every
+    ROI is sampled at every level; XLA fuses the selects).
+
+    Input: Table(Table(features...), rois (R, 5)); output
+    (R, C, resolution, resolution)."""
+
+    def __init__(self, resolution: int, scales: Sequence[float],
+                 sampling_ratio: int):
+        super().__init__()
+        self.resolution = int(resolution)
+        self.scales = [float(s) for s in scales]
+        self.sampling_ratio = int(sampling_ratio)
+        self._aligners = [RoiAlign(s, sampling_ratio, resolution, resolution)
+                          for s in self.scales]
+        # canonical level assignment (FPN paper): k = floor(4 + log2(√area/224)),
+        # index = k - finest_level, finest_level from the largest scale
+        self.finest_level = int(round(-math.log2(max(self.scales))))
+        self.canonical = 224.0
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = list(input.values()) if isinstance(input, Table) else list(input)
+        feat_t, rois = xs[0], xs[1]
+        feats = (list(feat_t.values()) if isinstance(feat_t, Table)
+                 else list(feat_t))
+        if len(feats) != len(self.scales):
+            raise ValueError(
+                f"Pooler has {len(self.scales)} scales but got "
+                f"{len(feats)} feature levels")
+        r = rois.astype(jnp.float32)
+        area = jnp.maximum(r[:, 3] - r[:, 1], 0) * jnp.maximum(
+            r[:, 4] - r[:, 2], 0)
+        k = jnp.floor(4.0 + jnp.log2(jnp.sqrt(area) / self.canonical + 1e-6))
+        target = jnp.clip(k - self.finest_level,
+                          0, len(feats) - 1).astype(jnp.int32)
+        pooled = []
+        for lvl, (f, al) in enumerate(zip(feats, self._aligners)):
+            out, _ = al.apply({}, {}, Table(f, rois), training=training)
+            pooled.append(out)
+        stacked = jnp.stack(pooled)                     # (L, R, C, res, res)
+        sel = jax.nn.one_hot(target, len(feats),
+                             dtype=stacked.dtype)       # (R, L)
+        return jnp.einsum("lrchw,rl->rchw", stacked, sel), state
+
+    def __repr__(self):
+        return (f"Pooler(res={self.resolution}, scales={self.scales}, "
+                f"sampling={self.sampling_ratio})")
+
+
+class BoxHead(Container):
+    """Fast-R-CNN box head (reference ``BoxHead``): Pooler → two FC layers →
+    class logits + per-class box deltas. Input: Table(Table(features...),
+    rois (R, 5)); output Table(cls_logits (R, n_classes), bbox_deltas
+    (R, 4·n_classes))."""
+
+    def __init__(self, in_channels: int, resolution: int,
+                 scales: Sequence[float], sampling_ratio: int,
+                 n_classes: int, representation: int = 1024):
+        fc1 = Linear(in_channels * resolution * resolution, representation)
+        fc2 = Linear(representation, representation)
+        cls = Linear(representation, n_classes)
+        bbox = Linear(representation, 4 * n_classes)
+        super().__init__(fc1, fc2, cls, bbox)
+        self.in_channels = in_channels
+        self.resolution = resolution
+        self.scales = [float(s) for s in scales]
+        self.sampling_ratio = sampling_ratio
+        self.n_classes = n_classes
+        self.representation = representation
+        self.pooler = Pooler(resolution, scales, sampling_ratio)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        feats_rois = input
+        pooled, _ = self.pooler.apply({}, {}, feats_rois, training=training)
+        x = pooled.reshape(pooled.shape[0], -1)
+        new_state = dict(state)
+
+        def run(i, x, act=False):
+            out, s = self.modules[i].apply(params[str(i)], state[str(i)], x,
+                                           training=training, rng=None)
+            new_state[str(i)] = s
+            return jax.nn.relu(out) if act else out
+
+        x = run(0, x, act=True)
+        x = run(1, x, act=True)
+        return Table(run(2, x), run(3, x)), new_state
+
+    def __repr__(self):
+        return (f"BoxHead(in={self.in_channels}, res={self.resolution}, "
+                f"classes={self.n_classes})")
+
+
+class MaskHead(Container):
+    """Mask-R-CNN mask head (reference ``MaskHead``): Pooler → 4 SAME 3x3
+    convs (ReLU) → 2x deconv (ReLU) → 1x1 conv to per-class masks. Input:
+    Table(Table(features...), rois (R, 5)); output (R, n_classes,
+    2·resolution, 2·resolution) mask logits."""
+
+    def __init__(self, in_channels: int, resolution: int,
+                 scales: Sequence[float], sampling_ratio: int,
+                 n_classes: int, layers: Sequence[int] = (256, 256, 256, 256),
+                 dilation: int = 1):
+        mods = []
+        prev = in_channels
+        for width in layers:
+            mods.append(SpatialConvolution(
+                prev, width, 3, 3, pad_w=dilation, pad_h=dilation))
+            prev = width
+        mods.append(SpatialFullConvolution(prev, prev, 2, 2, dw=2, dh=2))
+        mods.append(SpatialConvolution(prev, n_classes, 1, 1))
+        super().__init__(*mods)
+        self.in_channels = in_channels
+        self.resolution = resolution
+        self.scales = [float(s) for s in scales]
+        self.sampling_ratio = sampling_ratio
+        self.n_classes = n_classes
+        self.layers = list(layers)
+        self.dilation = dilation
+        self.pooler = Pooler(resolution, scales, sampling_ratio)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x, _ = self.pooler.apply({}, {}, input, training=training)
+        new_state = dict(state)
+        for i, m in enumerate(self.modules):
+            x, s = m.apply(params[str(i)], state[str(i)], x,
+                           training=training, rng=None)
+            new_state[str(i)] = s
+            if i < len(self.modules) - 1:   # all but the mask predictor
+                x = jax.nn.relu(x)
+        return x, new_state
+
+    def __repr__(self):
+        return (f"MaskHead(in={self.in_channels}, res={self.resolution}, "
+                f"classes={self.n_classes})")
+
+
+class RegionProposal(Container):
+    """Multi-level RPN (reference ``RegionProposal``): a shared 3x3 conv +
+    objectness/bbox 1x1 heads over every FPN level, per-level Proposal
+    decode (fixed budgets), concatenated. Single-image contract like
+    :class:`~bigdl_tpu.nn.detection.Proposal`. Input:
+    Table(Table(features...), im_info (1, 3)); output Table(rois (K, 5),
+    valid (K,)) with K = per-level post-NMS budget × levels."""
+
+    def __init__(self, in_channels: int,
+                 anchor_sizes: Sequence[float] = (32, 64, 128, 256, 512),
+                 aspect_ratios: Sequence[float] = (0.5, 1.0, 2.0),
+                 feat_strides: Sequence[float] = (4, 8, 16, 32, 64),
+                 pre_nms_topn: int = 2000, post_nms_topn: int = 1000,
+                 nms_thresh: float = 0.7, rpn_min_size: float = 0.0):
+        from bigdl_tpu.nn.detection import Proposal
+
+        if len(anchor_sizes) != len(feat_strides):
+            raise ValueError("one anchor size per pyramid level")
+        a = len(aspect_ratios)
+        conv = SpatialConvolution(in_channels, in_channels, 3, 3,
+                                  pad_w=1, pad_h=1)
+        cls = SpatialConvolution(in_channels, 2 * a, 1, 1)
+        bbox = SpatialConvolution(in_channels, 4 * a, 1, 1)
+        super().__init__(conv, cls, bbox)
+        self.in_channels = in_channels
+        self.anchor_sizes = [float(s) for s in anchor_sizes]
+        self.aspect_ratios = [float(r) for r in aspect_ratios]
+        self.feat_strides = [float(s) for s in feat_strides]
+        self.pre_nms_topn, self.post_nms_topn = pre_nms_topn, post_nms_topn
+        n_lvl = len(feat_strides)
+        self._proposals = [
+            Proposal(pre_nms_topn=pre_nms_topn // n_lvl,
+                     post_nms_topn=post_nms_topn // n_lvl,
+                     ratios=aspect_ratios,
+                     scales=[self.anchor_sizes[i] / self.feat_strides[i]],
+                     rpn_min_size=rpn_min_size, nms_thresh=nms_thresh,
+                     feat_stride=self.feat_strides[i])
+            for i in range(n_lvl)]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = list(input.values()) if isinstance(input, Table) else list(input)
+        feat_t, im_info = xs[0], xs[1]
+        feats = (list(feat_t.values()) if isinstance(feat_t, Table)
+                 else list(feat_t))
+        new_state = dict(state)
+        all_rois, all_valid = [], []
+        for lvl, f in enumerate(feats):
+            h, s = self.modules[0].apply(params["0"], state["0"], f,
+                                         training=training, rng=None)
+            new_state["0"] = s
+            h = jax.nn.relu(h)
+            scores, s = self.modules[1].apply(params["1"], state["1"], h,
+                                              training=training, rng=None)
+            new_state["1"] = s
+            deltas, s = self.modules[2].apply(params["2"], state["2"], h,
+                                              training=training, rng=None)
+            new_state["2"] = s
+            out, _ = self._proposals[lvl].apply(
+                {}, {}, Table(scores, deltas, im_info), training=training)
+            rois, valid = out.values()
+            all_rois.append(rois)
+            all_valid.append(valid)
+        return Table(jnp.concatenate(all_rois),
+                     jnp.concatenate(all_valid)), new_state
+
+    def __repr__(self):
+        return (f"RegionProposal(in={self.in_channels}, "
+                f"levels={len(self.feat_strides)})")
+
+
+class DetectionOutputFrcnn(AbstractModule):
+    """Faster-R-CNN detection decode (reference ``DetectionOutputFrcnn``):
+    softmax class scores + per-class box deltas against the proposal rois,
+    per-class NMS on fixed budgets, global top-``max_per_image``. Input:
+    Table(cls_logits (R, C), bbox_deltas (R, 4C), rois (R, 5),
+    im_info (1, 3)[, roi_valid (R,)]); output Table(dets
+    (max_per_image, 6) ``[label, score, x1, y1, x2, y2]``, valid
+    (max_per_image,)). Class 0 is background."""
+
+    def __init__(self, n_classes: int, score_thresh: float = 0.05,
+                 nms_thresh: float = 0.5, max_per_image: int = 100):
+        super().__init__()
+        self.n_classes = int(n_classes)
+        self.score_thresh = float(score_thresh)
+        self.nms_thresh = float(nms_thresh)
+        self.max_per_image = int(max_per_image)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = list(input.values()) if isinstance(input, Table) else list(input)
+        logits, deltas, rois, im_info = xs[0], xs[1], xs[2], xs[3]
+        roi_valid = xs[4] if len(xs) > 4 else None
+        r = logits.shape[0]
+        c = self.n_classes
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        info = im_info.reshape(-1)
+        img_h, img_w = info[0], info[1]
+        boxes_all = []
+        scores_all = []
+        labels_all = []
+        for cls in range(1, c):   # skip background
+            d = deltas[:, 4 * cls: 4 * cls + 4]
+            boxes = decode_rcnn(rois[:, 1:], d)
+            boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, img_w - 1),
+                               jnp.clip(boxes[:, 1], 0, img_h - 1),
+                               jnp.clip(boxes[:, 2], 0, img_w - 1),
+                               jnp.clip(boxes[:, 3], 0, img_h - 1)], axis=1)
+            sc = probs[:, cls]
+            ok = sc >= self.score_thresh
+            if roi_valid is not None:
+                ok = ok & roi_valid
+            order, keep = nms_mask(boxes, sc, self.nms_thresh, valid=ok)
+            boxes_all.append(boxes[order])
+            scores_all.append(jnp.where(keep, sc[order], -jnp.inf))
+            labels_all.append(jnp.full((r,), cls, jnp.int32))
+        boxes = jnp.concatenate(boxes_all)          # ((C-1)·R, 4)
+        scores = jnp.concatenate(scores_all)
+        labels = jnp.concatenate(labels_all)
+        k = self.max_per_image
+        if scores.shape[0] < k:   # static budget > candidates: pad invalid
+            pad = k - scores.shape[0]
+            boxes = jnp.concatenate([boxes, jnp.zeros((pad, 4))])
+            scores = jnp.concatenate([scores, jnp.full((pad,), -jnp.inf)])
+            labels = jnp.concatenate([labels, jnp.zeros((pad,), jnp.int32)])
+        top = jnp.argsort(-scores)[:k]
+        dets = jnp.concatenate([
+            labels[top][:, None].astype(jnp.float32),
+            scores[top][:, None], boxes[top]], axis=1)
+        valid = jnp.isfinite(scores[top])
+        dets = jnp.where(valid[:, None], dets, 0.0)
+        return Table(dets, valid), state
+
+    def __repr__(self):
+        return (f"DetectionOutputFrcnn(classes={self.n_classes}, "
+                f"nms={self.nms_thresh}, max={self.max_per_image})")
+
+
+from bigdl_tpu.utils.serializer import register as _register  # noqa: E402
+
+for _cls in (RoiAlign, FPN, Pooler, BoxHead, MaskHead, RegionProposal,
+             DetectionOutputFrcnn):
+    _register(_cls)

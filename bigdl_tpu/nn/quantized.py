@@ -255,6 +255,78 @@ class QuantizedSpatialConvolution(_QuantizedBase):
                 f"{self.n_output_plane}, {self.kernel_w}x{self.kernel_h}, int8)")
 
 
+class QuantizedSpatialDilatedConvolution(_QuantizedBase):
+    """Int8 atrous conv (reference ``nn/quantized`` carries a dilated-conv
+    variant alongside Linear/SpatialConvolution): same int8×int8→int32
+    ``conv_general_dilated`` path with ``rhs_dilation``."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 dilation_w: int = 1, dilation_h: int = 1,
+                 with_bias: bool = True, mode: str = "dynamic"):
+        super().__init__()
+        self._init_quantized(mode)
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.dilation_w, self.dilation_h = dilation_w, dilation_h
+        self.with_bias = with_bias
+        self._params = {
+            "weight_q": jnp.zeros((n_output_plane, n_input_plane, kh, kw),
+                                  jnp.int8),
+            "w_scale": jnp.ones((n_output_plane,), jnp.float32),
+        }
+        if with_bias:
+            self._params["bias"] = jnp.zeros((n_output_plane,), jnp.float32)
+
+    @classmethod
+    def from_float(cls, m, mode: str = "dynamic"):
+        q = cls(m.n_input_plane, m.n_output_plane, m.kw, m.kh, m.dw, m.dh,
+                m.pad_w, m.pad_h, m.dilation_w, m.dilation_h,
+                with_bias=m.with_bias, mode=mode)
+        w_q, scale = _quantize_weight(np.asarray(m.get_params()["weight"]))
+        params = {"weight_q": jnp.asarray(w_q), "w_scale": jnp.asarray(scale)}
+        if m.with_bias:
+            params["bias"] = jnp.asarray(m.get_params()["bias"])
+        q._params = params
+        q.name = m.name
+        return q
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        self._check_inference(training)
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        conv_kw = dict(
+            window_strides=(self.dh, self.dw),
+            padding=[(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+            rhs_dilation=(self.dilation_h, self.dilation_w),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.mode == "weight_only":
+            w = params["weight_q"].astype(x.dtype) \
+                * params["w_scale"][:, None, None, None].astype(x.dtype)
+            out = lax.conv_general_dilated(x, w, **conv_kw).astype(jnp.float32)
+        else:
+            x_q, s_x, state = self._quantize_input(x, state)
+            acc = lax.conv_general_dilated(
+                x_q, params["weight_q"], preferred_element_type=jnp.int32,
+                **conv_kw)
+            out = acc.astype(jnp.float32) \
+                * (s_x * params["w_scale"][None, :, None, None])
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None]
+        if squeeze:
+            out = out[0]
+        return out, state
+
+    def __repr__(self):
+        return (f"QuantizedSpatialDilatedConvolution({self.n_input_plane} -> "
+                f"{self.n_output_plane}, {self.kw}x{self.kh}, "
+                f"dilation={self.dilation_w}x{self.dilation_h}, int8)")
+
+
 def quantize_module(m: AbstractModule, mode: str = "dynamic") -> AbstractModule:
     """Deep-convert: Linear/SpatialConvolution leaves → int8 modules; everything
     else is cloned unchanged. The original module is not modified (reference
@@ -271,6 +343,9 @@ def quantize_module(m: AbstractModule, mode: str = "dynamic") -> AbstractModule:
         return QuantizedLinear.from_float(m, mode)
     if type(m) is SpatialConvolution:
         return QuantizedSpatialConvolution.from_float(m, mode)
+    from bigdl_tpu.nn.convolution import SpatialDilatedConvolution
+    if type(m) is SpatialDilatedConvolution:
+        return QuantizedSpatialDilatedConvolution.from_float(m, mode)
     # TF-imported graphs: their conv/matmul adapters quantize too (lazy import
     # keeps nn free of the utils.tf layer unless an imported graph is present)
     if type(m).__name__ in ("TFConv2D", "TFMatMul"):

@@ -487,3 +487,148 @@ class ConvLSTMPeephole(Cell):
     def __repr__(self):
         return (f"ConvLSTMPeephole({self.input_size}, {self.output_size}, "
                 f"{self.kernel_i}, {self.kernel_c})")
+
+
+class ConvLSTMPeephole3D(ConvLSTMPeephole):
+    """Volumetric convolutional LSTM cell (reference ``ConvLSTMPeephole3D``):
+    hidden/cell state are NCDHW feature volumes; the four gates come from two
+    SAME-padded 3-D convolutions — same structure as the 2-D cell with one
+    more spatial dim (the conv GEMMs still land on the MXU)."""
+
+    def reset(self) -> None:
+        ci, co = self.input_size, self.output_size
+        ki, kc = self.kernel_i, self.kernel_c
+        init = self.w_init
+        fan_i, fan_c = ci * ki ** 3, co * kc ** 3
+        self._params = {
+            "w_ih": jnp.asarray(init.init((4 * co, ci, ki, ki, ki),
+                                          fan_in=fan_i, fan_out=4 * co)),
+            "w_hh": jnp.asarray(init.init((4 * co, co, kc, kc, kc),
+                                          fan_in=fan_c, fan_out=4 * co)),
+            "bias": jnp.zeros((4 * co,), jnp.float32),
+        }
+        if self.with_peephole:
+            for k in ("w_ci", "w_cf", "w_co"):
+                self._params[k] = jnp.asarray(
+                    init.init((co,), fan_in=co, fan_out=co))
+        self.zero_grad_parameters()
+
+    def init_hidden_from(self, x0):
+        n, _, d, h, w = x0.shape
+        z = jnp.zeros((n, self.output_size, d, h, w), x0.dtype)
+        return (z, z)
+
+    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+        h, c = hidden
+        dn = ("NCDHW", "OIDHW", "NCDHW")
+        gates = (
+            jax.lax.conv_general_dilated(x, params["w_ih"], (1, 1, 1),
+                                         "SAME", dimension_numbers=dn)
+            + jax.lax.conv_general_dilated(h, params["w_hh"], (1, 1, 1),
+                                           "SAME", dimension_numbers=dn)
+            + params["bias"][None, :, None, None, None])
+        i_g, f_g, g_g, o_g = jnp.split(gates, 4, axis=1)
+        if self.with_peephole:
+            peep = lambda k: params[k][None, :, None, None, None]
+            i_g = jax.nn.sigmoid(i_g + c * peep("w_ci"))
+            f_g = jax.nn.sigmoid(f_g + c * peep("w_cf"))
+        else:
+            i_g, f_g = jax.nn.sigmoid(i_g), jax.nn.sigmoid(f_g)
+        g_g = jnp.tanh(g_g)
+        new_c = f_g * c + i_g * g_g
+        if self.with_peephole:
+            o_g = jax.nn.sigmoid(
+                o_g + new_c * params["w_co"][None, :, None, None, None])
+        else:
+            o_g = jax.nn.sigmoid(o_g)
+        new_h = o_g * jnp.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+    def __repr__(self):
+        return (f"ConvLSTMPeephole3D({self.input_size}, {self.output_size}, "
+                f"{self.kernel_i}, {self.kernel_c})")
+
+
+class MultiRNNCell(Cell):
+    """Stack of cells run as ONE cell per timestep (reference
+    ``MultiRNNCell(cells)``): cell i's output feeds cell i+1; the stacked
+    hidden state is the tuple of per-cell hiddens. The deep-decoder
+    companion to :class:`RecurrentDecoder`."""
+
+    def __init__(self, cells):
+        super().__init__()
+        cells = list(cells)
+        if not cells:
+            raise ValueError("MultiRNNCell needs at least one cell")
+        for c in cells:
+            if not isinstance(c, Cell):
+                raise TypeError(f"MultiRNNCell stacks Cells, got {type(c).__name__}")
+        self.cells = cells
+        self.input_size = cells[0].input_size
+        self.hidden_size = cells[-1].hidden_size
+        self.output_size = getattr(cells[-1], "output_size",
+                                   cells[-1].hidden_size)
+
+    # params/state nest per sub-cell, container-style
+    def get_params(self):
+        return {str(i): c.get_params() for i, c in enumerate(self.cells)}
+
+    def set_params(self, params) -> None:
+        for i, c in enumerate(self.cells):
+            c.set_params(params[str(i)])
+
+    def get_state(self):
+        return {str(i): c.get_state() for i, c in enumerate(self.cells)}
+
+    def set_state(self, state) -> None:
+        for i, c in enumerate(self.cells):
+            c.set_state(state[str(i)])
+
+    def init_hidden(self, batch_size: int):
+        return tuple(c.init_hidden(batch_size) for c in self.cells)
+
+    def init_hidden_from(self, x0):
+        hiddens, cur = [], x0
+        for c in self.cells:
+            hiddens.append(c.init_hidden_from(cur))
+            # output shape of a cell step == its hidden h; approximate with
+            # the first element of the hidden tuple for shape chaining
+            h0 = hiddens[-1][0] if isinstance(hiddens[-1], tuple) else hiddens[-1]
+            cur = h0
+        return tuple(hiddens)
+
+    def cell_apply(self, params, x, hidden, *, training=False, rng=None):
+        new_hiddens = []
+        out = x
+        for i, c in enumerate(self.cells):
+            out, nh = c.cell_apply(params[str(i)], out, hidden[i],
+                                   training=training, rng=rng)
+            new_hiddens.append(nh)
+        return out, tuple(new_hiddens)
+
+    # the Cell Table API flattens hidden; the stacked hidden is a tuple of
+    # per-cell tuples, so apply() regroups by each cell's hidden arity
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = list(input.values()) if isinstance(input, Table) else [input]
+        x, flat = xs[0], xs[1:]
+        if flat:
+            hidden, i = [], 0
+            for c in self.cells:
+                n = len(c.init_hidden_from(x if not hidden else hidden[-1][0]))
+                hidden.append(tuple(flat[i:i + n]))
+                i += n
+            if i != len(flat):
+                raise ValueError(
+                    f"MultiRNNCell expected {i} hidden tensors, got {len(flat)}")
+            hidden = tuple(hidden)
+        else:
+            hidden = self.init_hidden_from(x)
+        out, new_h = self.cell_apply(params, x, hidden, training=training,
+                                     rng=rng)
+        flat_h = [a for h in new_h
+                  for a in (h if isinstance(h, tuple) else (h,))]
+        return T(out, *flat_h), state
+
+    def __repr__(self):
+        inner = ", ".join(repr(c) for c in self.cells)
+        return f"MultiRNNCell([{inner}])"

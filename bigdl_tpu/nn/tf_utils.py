@@ -1,0 +1,124 @@
+"""Graph-utility modules from the reference's ``<dl>/nn/tf/`` package
+(SURVEY §2.1 layer zoo tail — expected ``Const.scala``, ``Fill.scala``,
+``Shape.scala``, ``StrideSlice.scala``, ``SplitAndSelect.scala``,
+unverified, mount empty): small plumbing layers the reference ships for
+wiring TF-style graphs out of native modules. All are shape/metadata ops —
+free under XLA once fused."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.abstractnn import TensorModule
+from bigdl_tpu.utils.table import Table
+
+
+class Const(TensorModule):
+    """Emit a stored constant, ignoring the input activity (the input exists
+    only to give the node a place in the graph — reference ``Const``)."""
+
+    def __init__(self, value):
+        super().__init__()
+        self.value = np.asarray(value)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.asarray(self.value), state
+
+    def __repr__(self):
+        return f"Const(shape={tuple(self.value.shape)})"
+
+
+class Fill(TensorModule):
+    """Fill a static shape with a (possibly traced) scalar: input is
+    ``Table(shape, value)`` where ``shape`` must be concrete at trace time
+    (XLA needs static shapes — a Const/host array; reference ``Fill``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = list(input.values()) if isinstance(input, Table) else list(input)
+        if len(xs) != 2:
+            raise ValueError("Fill expects Table(shape, value)")
+        shape, value = xs
+        try:
+            shape = tuple(int(s) for s in np.asarray(shape))
+        except Exception:
+            raise ValueError(
+                "Fill needs a STATIC shape (traced shape tensors cannot size "
+                "an XLA buffer) — feed it from a Const") from None
+        return jnp.full(shape, jnp.asarray(value)), state
+
+    def __repr__(self):
+        return "Fill()"
+
+
+class Shape(TensorModule):
+    """The input's shape as an int32 vector (static under jit, so this
+    compiles to a constant — reference ``Shape``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.asarray(input.shape, jnp.int32), state
+
+    def __repr__(self):
+        return "Shape()"
+
+
+class StrideSlice(TensorModule):
+    """Strided slicing by per-dim ``(dim, start, stop, step)`` specs
+    (reference ``StrideSlice(specs)``). Dims are 0-BASED over the full
+    input (dim 0 = batch — slice it only on purpose); unspecified dims
+    pass through whole."""
+
+    def __init__(self, specs: Sequence[Sequence[int]]):
+        super().__init__()
+        self.specs = [tuple(int(v) for v in s) for s in specs]
+        for s in self.specs:
+            if len(s) != 4:
+                raise ValueError(
+                    f"each spec is (dim, start, stop, step), got {s}")
+            if s[3] == 0:
+                raise ValueError("slice step must be nonzero")
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        idx = [slice(None)] * input.ndim
+        for dim, start, stop, step in self.specs:
+            if not 0 <= dim < input.ndim:
+                raise ValueError(
+                    f"StrideSlice dim {dim} out of range for rank {input.ndim}")
+            idx[dim] = slice(start, stop, step)
+        return input[tuple(idx)], state
+
+    def __repr__(self):
+        return f"StrideSlice({self.specs})"
+
+
+class SplitAndSelect(TensorModule):
+    """Split the input into ``num_split`` equal chunks along ``dim`` and
+    output chunk ``index`` (reference ``SplitAndSelect(dim, index,
+    numSplit)``, 0-based here)."""
+
+    def __init__(self, dim: int, index: int, num_split: int):
+        super().__init__()
+        self.dim, self.index, self.num_split = int(dim), int(index), int(num_split)
+        if not 0 <= self.index < self.num_split:
+            raise ValueError(
+                f"index {index} out of range for {num_split} splits")
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if input.shape[self.dim] % self.num_split:
+            raise ValueError(
+                f"dim {self.dim} size {input.shape[self.dim]} not divisible "
+                f"by {self.num_split}")
+        return jnp.split(input, self.num_split, axis=self.dim)[self.index], \
+            state
+
+    def __repr__(self):
+        return (f"SplitAndSelect(dim={self.dim}, index={self.index}, "
+                f"splits={self.num_split})")
+
+
+from bigdl_tpu.utils.serializer import register as _register  # noqa: E402
+
+for _cls in (Const, Fill, Shape, StrideSlice, SplitAndSelect):
+    _register(_cls)

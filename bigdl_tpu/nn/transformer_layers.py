@@ -1,0 +1,308 @@
+"""Transformer layer family (reference parity: SURVEY §2.1 layer zoo tail —
+expected ``<dl>/nn/{Attention,FeedForwardNetwork,LayerNormalization,
+ExpandSize,TableOperation,Transformer}.scala``, unverified, mount empty).
+
+These are the reference's building-block API for its transformer LM; the
+flagship :mod:`bigdl_tpu.models.transformerlm` family is the TPU-first
+redesign (flash/ring attention, GQA/RoPE, fused LM head) — this module keeps
+the reference's layer-level surface so imported/ported models wire up
+unchanged. All matmuls are (B·T, H)-shaped GEMMs on the MXU; dropout rides
+the module RNG plumbing."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.abstractnn import Container, TensorModule
+from bigdl_tpu.nn.initialization import InitializationMethod, Xavier
+from bigdl_tpu.nn.normalization import LayerNorm
+from bigdl_tpu.utils.table import Table
+
+
+def _inverted_dropout(x, p, rng):
+    """Shared inverted-dropout: one implementation for every site in this
+    family (review finding: three hand-rolled copies can drift)."""
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x, 0.0) / keep
+
+
+class LayerNormalization(LayerNorm):
+    """Reference name for last-axis LayerNorm with learned gain/bias
+    (expected ``LayerNormalization(hiddenSize)``)."""
+
+    def __repr__(self):
+        return f"LayerNormalization({self.n_output})"
+
+
+class ExpandSize(TensorModule):
+    """Broadcast the input to ``sizes`` (-1 = keep that dim; expected
+    ``ExpandSize(sizes)``). Pure view semantics — XLA fuses the broadcast
+    into consumers, no copy."""
+
+    def __init__(self, sizes: Sequence[int]):
+        super().__init__()
+        self.sizes = [int(s) for s in sizes]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if len(self.sizes) != input.ndim:
+            raise ValueError(
+                f"ExpandSize{tuple(self.sizes)} rank does not match input "
+                f"rank {input.ndim}")
+        target = [d if s == -1 else s for s, d in zip(self.sizes, input.shape)]
+        for s, d in zip(target, input.shape):
+            if d != s and d != 1:
+                raise ValueError(
+                    f"cannot expand dim of size {d} to {s} (only size-1 "
+                    f"dims broadcast)")
+        return jnp.broadcast_to(input, tuple(target)), state
+
+    def __repr__(self):
+        return f"ExpandSize({self.sizes})"
+
+
+class TableOperation(Container):
+    """Run a binary table layer after broadcasting the lower-rank operand to
+    the higher-rank one (expected ``TableOperation(operationLayer)`` — the
+    reference's tensor-with-scalar table arithmetic wrapper, e.g.
+    ``TableOperation(CMulTable())`` multiplying (B, T, H) by (B, 1, 1))."""
+
+    def __init__(self, operation_layer):
+        super().__init__(operation_layer)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xs = list(input.values()) if isinstance(input, Table) else list(input)
+        if len(xs) != 2:
+            raise ValueError("TableOperation expects a 2-element Table")
+        a, b = xs
+        if a.ndim < b.ndim:
+            a = a.reshape((1,) * (b.ndim - a.ndim) + a.shape)
+        elif b.ndim < a.ndim:
+            b = b.reshape((1,) * (a.ndim - b.ndim) + b.shape)
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        a = jnp.broadcast_to(a, shape)
+        b = jnp.broadcast_to(b, shape)
+        out, s = self.modules[0].apply(params["0"], state["0"], Table(a, b),
+                                       training=training, rng=rng)
+        return out, {"0": s}
+
+    def __repr__(self):
+        return f"TableOperation({self.modules[0]!r})"
+
+
+class Attention(TensorModule):
+    """Multi-head scaled-dot attention over a ``Table(query, source, bias)``
+    (expected ``Attention(hiddenSize, numHeads, attentionDropout)``): query
+    attends to source (self-attention when they are the same tensor), with an
+    ADDITIVE bias broadcast onto the (B, heads, Tq, Tk) logits — the
+    reference's mask/relative-bias hook. Projections are bias-free dense
+    layers; the query scales by head_dim**-0.5."""
+
+    def __init__(self, hidden_size: int, num_heads: int,
+                 attention_dropout: float = 0.0,
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        if hidden_size % num_heads:
+            raise ValueError(
+                f"hidden_size {hidden_size} not divisible by heads {num_heads}")
+        self.hidden_size, self.num_heads = hidden_size, num_heads
+        self.head_dim = hidden_size // num_heads
+        self.dropout_p = float(attention_dropout)
+        self.w_init = w_init or Xavier()
+        self.reset()
+
+    def reset(self) -> None:
+        h = self.hidden_size
+
+        def mk():
+            return jnp.asarray(self.w_init.init((h, h), fan_in=h, fan_out=h))
+
+        self._params = {"w_q": mk(), "w_k": mk(), "w_v": mk(), "w_o": mk()}
+        self.zero_grad_parameters()
+
+    def needs_rng(self) -> bool:
+        return self.dropout_p > 0
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if isinstance(input, Table):
+            xs = list(input.values())
+        elif isinstance(input, (tuple, list)):
+            xs = list(input)
+        else:
+            xs = [input]   # bare tensor: self-attention
+        if len(xs) == 1:
+            q_in = kv_in = xs[0]
+            bias = None
+        elif len(xs) == 2:
+            q_in, kv_in = xs
+            bias = None
+        else:
+            q_in, kv_in, bias = xs[:3]
+        n, tq, h = q_in.shape
+        tk = kv_in.shape[1]
+        nh, hd = self.num_heads, self.head_dim
+
+        def split(x, w, t):
+            return (x @ w).reshape(n, t, nh, hd).transpose(0, 2, 1, 3)
+
+        q = split(q_in, params["w_q"], tq) * (hd ** -0.5)
+        k = split(kv_in, params["w_k"], tk)
+        v = split(kv_in, params["w_v"], tk)
+        logits = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        weights = jax.nn.softmax(logits, axis=-1).astype(q_in.dtype)
+        if training and self.dropout_p > 0:
+            weights = _inverted_dropout(weights, self.dropout_p, rng)
+        ctx = jnp.einsum("nhqk,nhkd->nhqd", weights, v)
+        out = ctx.transpose(0, 2, 1, 3).reshape(n, tq, h) @ params["w_o"]
+        return out, state
+
+    def __repr__(self):
+        return (f"Attention({self.hidden_size}, heads={self.num_heads}, "
+                f"dropout={self.dropout_p})")
+
+
+class FeedForwardNetwork(TensorModule):
+    """Position-wise two-layer MLP (expected ``FeedForwardNetwork(hiddenSize,
+    filterSize, reluDropout)``): H → filter (ReLU, dropout) → H."""
+
+    def __init__(self, hidden_size: int, filter_size: int,
+                 relu_dropout: float = 0.0,
+                 w_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.hidden_size, self.filter_size = hidden_size, filter_size
+        self.dropout_p = float(relu_dropout)
+        self.w_init = w_init or Xavier()
+        self.reset()
+
+    def reset(self) -> None:
+        h, f = self.hidden_size, self.filter_size
+        self._params = {
+            "w1": jnp.asarray(self.w_init.init((h, f), fan_in=h, fan_out=f)),
+            "b1": jnp.zeros((f,), jnp.float32),
+            "w2": jnp.asarray(self.w_init.init((f, h), fan_in=f, fan_out=h)),
+            "b2": jnp.zeros((h,), jnp.float32),
+        }
+        self.zero_grad_parameters()
+
+    def needs_rng(self) -> bool:
+        return self.dropout_p > 0
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        mid = jax.nn.relu(input @ params["w1"] + params["b1"])
+        if training and self.dropout_p > 0:
+            mid = _inverted_dropout(mid, self.dropout_p, rng)
+        return mid @ params["w2"] + params["b2"], state
+
+    def __repr__(self):
+        return (f"FeedForwardNetwork({self.hidden_size} -> "
+                f"{self.filter_size} -> {self.hidden_size})")
+
+
+def _sinusoid_position(t: int, h: int) -> np.ndarray:
+    """The reference transformer's sinusoidal position signal."""
+    pos = np.arange(t, dtype=np.float32)[:, None]
+    dim = np.arange(0, h, 2, dtype=np.float32)[None, :]
+    angles = pos / np.power(10000.0, dim / h)
+    out = np.zeros((t, h), np.float32)
+    out[:, 0::2] = np.sin(angles)
+    out[:, 1::2] = np.cos(angles)[:, : out[:, 1::2].shape[1]]
+    return out
+
+
+class Transformer(Container):
+    """Reference-shaped transformer LM body (expected ``Transformer(
+    vocabSize, hiddenSize, numHeads, filterSize, numHiddenlayers, ...)``):
+    scaled embedding + sinusoidal positions, N pre-norm blocks of
+    :class:`Attention` (causal self-attention) and
+    :class:`FeedForwardNetwork`, and a final LayerNorm. Input: int32 (B, T)
+    token ids; output: (B, T, H) hidden states.
+
+    The TPU-first flagship (flash/ring attention, GQA, fused head) lives in
+    :mod:`bigdl_tpu.models.transformerlm`; this class keeps the reference's
+    layer-level API."""
+
+    def __init__(self, vocab_size: int, hidden_size: int, num_heads: int,
+                 filter_size: int, num_hidden_layers: int,
+                 embedding_dropout: float = 0.0,
+                 attention_dropout: float = 0.0,
+                 ffn_dropout: float = 0.0, causal: bool = True):
+        from bigdl_tpu.nn.embedding import LookupTable
+
+        mods = [LookupTable(vocab_size, hidden_size, zero_based=True)]
+        for _ in range(num_hidden_layers):
+            mods.append(LayerNorm(hidden_size))
+            mods.append(Attention(hidden_size, num_heads, attention_dropout))
+            mods.append(LayerNorm(hidden_size))
+            mods.append(FeedForwardNetwork(hidden_size, filter_size,
+                                           ffn_dropout))
+        mods.append(LayerNorm(hidden_size))   # final norm
+        super().__init__(*mods)
+        self.vocab_size, self.hidden_size = vocab_size, hidden_size
+        self.num_heads = num_heads
+        self.filter_size = filter_size
+        self.num_hidden_layers = num_hidden_layers
+        self.embedding_dropout = float(embedding_dropout)
+        self.causal = causal
+
+    def needs_rng(self) -> bool:
+        return (self.embedding_dropout > 0
+                or any(m.needs_rng() for m in self.modules))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        n, t = input.shape
+        h = self.hidden_size
+        rngs = (jax.random.split(rng, len(self.modules) + 1)
+                if rng is not None else [None] * (len(self.modules) + 1))
+        new_state = {}
+        x, s = self.modules[0].apply(params["0"], state["0"], input,
+                                     training=training, rng=rngs[0])
+        new_state["0"] = s
+        x = x * math.sqrt(h) + jnp.asarray(_sinusoid_position(t, h))
+        if training and self.embedding_dropout > 0:
+            x = _inverted_dropout(x, self.embedding_dropout, rngs[-1])
+        bias = None
+        if self.causal:
+            neg = jnp.full((t, t), -1e9, jnp.float32)
+            bias = jnp.triu(neg, k=1)[None, None, :, :]
+        i = 1
+        while i < 1 + 4 * self.num_hidden_layers:
+            ln1, attn, ln2, ffn = self.modules[i:i + 4]
+            y, s = ln1.apply(params[str(i)], state[str(i)], x,
+                             training=training, rng=rngs[i])
+            new_state[str(i)] = s
+            a_in = Table(y, y, bias) if bias is not None else Table(y, y)
+            y, s = attn.apply(params[str(i + 1)], state[str(i + 1)], a_in,
+                              training=training, rng=rngs[i + 1])
+            new_state[str(i + 1)] = s
+            x = x + y
+            y, s = ln2.apply(params[str(i + 2)], state[str(i + 2)], x,
+                             training=training, rng=rngs[i + 2])
+            new_state[str(i + 2)] = s
+            y, s = ffn.apply(params[str(i + 3)], state[str(i + 3)], y,
+                             training=training, rng=rngs[i + 3])
+            new_state[str(i + 3)] = s
+            x = x + y
+            i += 4
+        fin = len(self.modules) - 1
+        x, s = self.modules[fin].apply(params[str(fin)], state[str(fin)], x,
+                                       training=training, rng=rngs[fin])
+        new_state[str(fin)] = s
+        return x, new_state
+
+    def __repr__(self):
+        return (f"Transformer(vocab={self.vocab_size}, h={self.hidden_size}, "
+                f"heads={self.num_heads}, layers={self.num_hidden_layers})")
+
+
+from bigdl_tpu.utils.serializer import register as _register  # noqa: E402
+
+for _cls in (LayerNormalization, ExpandSize, TableOperation, Attention,
+             FeedForwardNetwork, Transformer):
+    _register(_cls)

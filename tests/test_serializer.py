@@ -301,6 +301,68 @@ EXAMPLES = {
     # graph (custom topology serialization)
     "Graph": ("graph", None),
     "StaticGraph": ("graph", None),
+    # round-5 transformer layer family
+    "Attention": (lambda: nn.Attention(6, 2).evaluate(), _x(1, 3, 6)),
+    "FeedForwardNetwork": (lambda: nn.FeedForwardNetwork(6, 12).evaluate(),
+                           _x(2, 6)),
+    "LayerNormalization": (lambda: nn.LayerNormalization(5), _x(2, 5)),
+    "ExpandSize": (lambda: nn.ExpandSize([2, -1]), jnp.ones((1, 4))),
+    "TableOperation": (lambda: nn.TableOperation(nn.CMulTable()),
+                       Table(_x(2, 3), _x(2, 1))),
+    "Transformer": (lambda: nn.Transformer(9, 8, 2, 16, 1).evaluate(),
+                    jnp.asarray([[1, 2, 3]], jnp.int32)),
+    # round-5 mask-rcnn family
+    "RoiAlign": (lambda: nn.RoiAlign(0.5, 2, 2, 2),
+                 Table(_x(1, 2, 8, 8),
+                       jnp.asarray([[0.0, 2.0, 2.0, 10.0, 10.0]]))),
+    "FPN": (lambda: nn.FPN([2, 2], 3),
+            Table(_x(1, 2, 8, 8), _x(1, 2, 4, 4))),
+    "Pooler": (lambda: nn.Pooler(2, [0.5, 0.25], 2),
+               Table(Table(_x(1, 2, 8, 8), _x(1, 2, 4, 4)),
+                     jnp.asarray([[0.0, 1.0, 1.0, 9.0, 9.0]]))),
+    "BoxHead": (lambda: nn.BoxHead(2, 2, [0.5, 0.25], 2, n_classes=3,
+                                   representation=8),
+                Table(Table(_x(1, 2, 8, 8), _x(1, 2, 4, 4)),
+                      jnp.asarray([[0.0, 1.0, 1.0, 9.0, 9.0]]))),
+    "MaskHead": (lambda: nn.MaskHead(2, 2, [0.5, 0.25], 2, n_classes=3,
+                                     layers=(4,)),
+                 Table(Table(_x(1, 2, 8, 8), _x(1, 2, 4, 4)),
+                       jnp.asarray([[0.0, 1.0, 1.0, 9.0, 9.0]]))),
+    "RegionProposal": (
+        lambda: nn.RegionProposal(2, anchor_sizes=(8, 16),
+                                  feat_strides=(4, 8), pre_nms_topn=20,
+                                  post_nms_topn=8, rpn_min_size=1),
+        Table(Table(_x(1, 2, 8, 8), _x(1, 2, 4, 4)),
+              jnp.asarray([[32.0, 32.0, 1.0]]))),
+    "DetectionOutputFrcnn": (
+        lambda: nn.DetectionOutputFrcnn(3, score_thresh=0.0,
+                                        max_per_image=4),
+        Table(_x(2, 3), 0.1 * _x(2, 12),
+              jnp.asarray([[0.0, 2.0, 2.0, 20.0, 20.0],
+                           [0.0, 4.0, 4.0, 16.0, 24.0]]),
+              jnp.asarray([[64.0, 64.0, 1.0]]))),
+    # round-5 recurrent tail (cells run one step via the Cell Table API)
+    "ConvLSTMPeephole3D": (
+        lambda: nn.ConvLSTMPeephole3D(2, 3, 3, 3),
+        Table(_x(1, 2, 3, 4, 4), jnp.zeros((1, 3, 3, 4, 4)),
+              jnp.zeros((1, 3, 3, 4, 4)))),
+    "MultiRNNCell": (
+        lambda: nn.MultiRNNCell([nn.RnnCell(4, 6, nn.Tanh()),
+                                 nn.RnnCell(6, 5, nn.Tanh())]),
+        Table(_x(2, 4), jnp.zeros((2, 6)), jnp.zeros((2, 5)))),
+    # round-5 quantized tail
+    "QuantizedSpatialDilatedConvolution": (
+        lambda: nn.SpatialDilatedConvolution(
+            2, 3, 3, 3, dilation_w=2, dilation_h=2).quantize().evaluate(),
+        _x(1, 2, 8, 8)),
+    # round-5 nn/tf graph utilities
+    "Const": (lambda: nn.Const(np.ones((2, 2), np.float32)), _x(1)),
+    # Fill requires a host-static shape, which the jitted forward-compare
+    # harness cannot feed — behavior is pinned in test_layer_tail_r5
+    "Fill": (lambda: nn.Fill(), None),
+    "Shape": (lambda: nn.Shape(), _x(2, 3)),
+    "StrideSlice": (lambda: nn.StrideSlice([(1, 0, 4, 2)]), _x(2, 4)),
+    "SplitAndSelect": (lambda: nn.SplitAndSelect(1, 0, 2), _x(2, 4)),
 }
 
 # exported names that are not concrete user-facing layers

@@ -14,16 +14,22 @@ import jax.numpy as jnp
 from bigdl_tpu.utils.tf.loader import TFImportError, load_frozen_graph
 
 tf1 = tf.compat.v1
-tf1.disable_eager_execution()
 
 
 def _freeze_v1(build):
-    """Build a graph with v1 raw control flow and return (graph_def, graph)."""
+    """Build a graph with v1 raw control flow and return (graph_def, graph).
+
+    Eager mode stays ON globally (disabling it would poison every eager-
+    dependent TF-oracle test that runs later in the process — real suite
+    failure); the explicit Graph context is graph-mode by itself. Only the
+    control-flow-v2 toggle flips, and it is restored."""
     tf1.disable_control_flow_v2()
-    g = tf1.Graph()
-    with g.as_default():
-        build()
-    tf1.enable_control_flow_v2()
+    try:
+        g = tf1.Graph()
+        with g.as_default():
+            build()
+    finally:
+        tf1.enable_control_flow_v2()
     return g.as_graph_def(), g
 
 

@@ -52,6 +52,7 @@ _ANALYTIC_STEP_FLOPS_PER_UNIT = {
     "ptb-lstm": 3 * 26.5e6,           # per word (bptt window element)
     "transformerlm": 3 * 77.5e6,      # per token @ T=512, d=512, L=6
 }
+# filled in after _long_lm_flops is defined (depends on BIGDL_BENCH_SEQ)
 
 # (unit-plural, units per sample) — images are 1/sample; LM samples are windows
 _MODEL_UNITS = {
@@ -59,6 +60,41 @@ _MODEL_UNITS = {
     "inception": ("images", 1), "vgg16": ("images", 1),
     "ptb-lstm": ("words", 35), "transformerlm": ("tokens", 512),
 }
+
+# Long-context training leg (round-4 verdict #3: tokens/sec + peak memory at
+# T=4096/8192, flash vs XLA attention). T from BIGDL_BENCH_SEQ (the env
+# propagates into the measured subprocess); BIGDL_BENCH_ATTN=flash|full picks
+# the attention implementation under test.
+def _parse_long_seq():
+    """Lenient at import (a typo must not break UNRELATED legs — the
+    orchestrator's exit-0 JSON contract covers every model); the error is
+    raised at long-leg build time so ITS line carries the reason."""
+    raw = os.environ.get("BIGDL_BENCH_SEQ", "4096")
+    try:
+        v = int(raw)
+        if v < 8:
+            raise ValueError
+        return v, None
+    except ValueError:
+        return 4096, f"BIGDL_BENCH_SEQ must be an integer >= 8, got {raw!r}"
+
+
+_LONG_SEQ, _LONG_SEQ_ERROR = _parse_long_seq()
+_MODEL_UNITS["transformerlm-long"] = ("tokens", _LONG_SEQ)
+
+
+def _long_lm_flops(t: int, d: int = 512, n_layers: int = 6,
+                   v: int = 32000) -> float:
+    """Analytic fwd FLOPs/token x3 for the long-context TransformerLM:
+    2·params for the weight matmuls (qkvo 4d² + mlp 8d² per layer, d·v
+    head) + 4·T·d per layer for QKᵀ/AV (full-matrix convention — causal
+    flash computes ~half, so its MFU reads conservatively)."""
+    matmul_params = 12 * n_layers * d * d + d * v
+    attn = 4 * t * d * n_layers
+    return 3.0 * (2 * matmul_params + attn)
+
+
+_ANALYTIC_STEP_FLOPS_PER_UNIT["transformerlm-long"] = _long_lm_flops(_LONG_SEQ)
 
 # committed measurement history (tunnel-wedge insurance; see bench_results/)
 _RESULTS_DIR = os.path.join(
@@ -106,17 +142,21 @@ def last_known_good_tpu(model: str, results_dir: str = None) -> dict | None:
                 continue
             entry = {k: rec[k] for k in
                      ("metric", "value", "unit", "dtype", "batch", "mfu",
-                      "device_kind", "timestamp", "git_commit")
+                      "seq_len", "attention_impl", "device_kind",
+                      "timestamp", "git_commit")
                      if rec.get(k) is not None}
             entry["source"] = os.path.basename(path)
-            if str(rec.get("metric", "")).startswith(model):
+            # separator-anchored: 'transformerlm' must not claim a
+            # 'transformerlm-long' record as its own last-known-good
+            if str(rec.get("metric", "")).startswith(model + "_"):
                 best_model = entry      # later same-model lines win
             best_any = entry
     return best_model or best_any
 
 # per-model default batch (samples/step) when --batch is not given
 _DEFAULT_BATCH = {"resnet50": 256, "lenet": 256, "inception": 256,
-                  "vgg16": 512, "ptb-lstm": 64, "transformerlm": 16}
+                  "vgg16": 512, "ptb-lstm": 64, "transformerlm": 16,
+                  "transformerlm-long": 1}
 
 
 def _peak_flops(device_kind: str):
@@ -162,7 +202,7 @@ def _bench_layout(model_name: str):
     if mode not in ("auto", "nchw", "nhwc"):
         raise ValueError(
             f"BIGDL_BENCH_LAYOUT must be auto|nchw|nhwc, got {mode!r}")
-    if model_name in ("ptb-lstm", "transformerlm"):
+    if model_name in ("ptb-lstm", "transformerlm", "transformerlm-long"):
         return None
     if mode == "nchw" or model_name not in _NHWC_MODELS:
         return "NCHW"
@@ -230,6 +270,26 @@ def _build(model_name: str, batch: int, n_batches: int, dtype: str):
                               num_layers=6, max_len=seq, fused_head=fused)
         shape = (batch, seq)
         criterion = lm_criterion(fused_head=fused)
+    elif model_name == "transformerlm-long":
+        # long-context training leg (verdict #3): flash vs XLA attention at
+        # T = BIGDL_BENCH_SEQ; per-block remat + fused head keep the step
+        # activation-bound, not logits-bound
+        from bigdl_tpu.models.transformerlm import TransformerLM, lm_criterion
+        if _LONG_SEQ_ERROR:
+            raise ValueError(_LONG_SEQ_ERROR)
+        seq, n_classes = _MODEL_UNITS[model_name][1], 32000
+        impl = os.environ.get("BIGDL_BENCH_ATTN", "flash")
+        # the leg IS the flash-vs-XLA A/B: "auto" would leave the emitted
+        # line unable to attribute its number to an implementation
+        if impl not in ("flash", "full"):
+            raise ValueError(f"BIGDL_BENCH_ATTN must be flash|full for the "
+                             f"long-context leg, got {impl!r}")
+        fused = os.environ.get("BIGDL_BENCH_FUSED_HEAD", "1") == "1"
+        model = TransformerLM(n_classes, embed_dim=512, num_heads=8,
+                              num_layers=6, max_len=seq, fused_head=fused,
+                              attention_impl=impl, remat=True)
+        shape = (batch, seq)
+        criterion = lm_criterion(fused_head=fused)
     else:
         raise ValueError(f"unknown model {model_name!r}")
 
@@ -293,6 +353,19 @@ def _measure(model_name: str, batch: int, iters: int, warmup: int,
     samples_per_sec = opt.state.get("throughput") or (batch * iters / dt)
     units_per_sec = samples_per_sec * per_sample
 
+    # device peak-memory telemetry (the long-context leg's memory claim needs
+    # a measured number, not a trace assertion). Read IMMEDIATELY after the
+    # timed training window: the direct-step cross-check below device_puts a
+    # second copy of params/opt-state and would inflate the reading by
+    # hundreds of MB. Absent on backends without memory_stats.
+    peak_hbm_mb = None
+    try:
+        stats = dev.memory_stats()
+        if stats and stats.get("peak_bytes_in_use"):
+            peak_hbm_mb = round(stats["peak_bytes_in_use"] / 2 ** 20, 1)
+    except Exception:
+        pass
+
     # Direct-step cross-check leg (round-2 verdict item 1): drive the SAME
     # compiled step raw — pre-placed fixed batch, loss fetched only at the end.
     # This is the framework's step capability; if the loop number diverges from
@@ -327,6 +400,7 @@ def _measure(model_name: str, batch: int, iters: int, warmup: int,
         "mfu": _mfu(units_per_sec),
         "mfu_step": _mfu(step_units_per_sec),
         "flops_per_step": flops_per_step,
+        "peak_hbm_mb": peak_hbm_mb,
         "device_kind": dev.device_kind,
         "platform": dev.platform,
         "peak_flops": peak,
@@ -689,6 +763,11 @@ def run_worker(args) -> None:
     }
     if res.get("step_leg_error"):
         line["step_leg_error"] = res["step_leg_error"]
+    if res.get("peak_hbm_mb") is not None:
+        line["peak_hbm_mb"] = res["peak_hbm_mb"]
+    if args.model == "transformerlm-long":
+        line["seq_len"] = _LONG_SEQ
+        line["attention_impl"] = os.environ.get("BIGDL_BENCH_ATTN", "flash")
     if suspect:
         line["suspect_reason"] = (
             "optimize() loop >1.5x slower than the same compiled step driven "

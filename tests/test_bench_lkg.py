@@ -113,3 +113,26 @@ class TestDegradedFallbackCarriesLKG:
         assert rec["value"] == 2300.0
         assert "last_known_good_tpu" not in rec
         assert rec["timestamp"]  # provenance stamped on every line
+
+
+def test_lkg_does_not_cross_model_prefixes(tmp_path):
+    # 'transformerlm' must not claim a 'transformerlm-long' record (review
+    # finding: startswith without separator matched across models)
+    long_rec = {"metric": "transformerlm-long_train_tokens_per_sec_per_chip",
+                "value": 900.0, "unit": "tokens/sec", "suspect": False,
+                "seq_len": 4096, "attention_impl": "flash",
+                "device_kind": "TPU v5 lite", "platform": "tpu"}
+    _write(tmp_path / "a.jsonl", [long_rec])
+    got = bm.last_known_good_tpu("transformerlm", str(tmp_path))
+    # falls back to any-model (clearly labeled by its own metric name), but
+    # must NOT be selected as the same-model best
+    assert got["metric"].startswith("transformerlm-long")
+    short_rec = {"metric": "transformerlm_train_tokens_per_sec_per_chip",
+                 "value": 111.0, "unit": "tokens/sec", "suspect": False,
+                 "device_kind": "TPU v5 lite", "platform": "tpu"}
+    _write(tmp_path / "b.jsonl", [short_rec])
+    got = bm.last_known_good_tpu("transformerlm", str(tmp_path))
+    assert got["value"] == 111.0
+    # long-leg records keep their configuration axes
+    got_long = bm.last_known_good_tpu("transformerlm-long", str(tmp_path))
+    assert got_long["seq_len"] == 4096 and got_long["attention_impl"] == "flash"

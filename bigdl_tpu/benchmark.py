@@ -96,6 +96,18 @@ def _long_lm_flops(t: int, d: int = 512, n_layers: int = 6,
 
 _ANALYTIC_STEP_FLOPS_PER_UNIT["transformerlm-long"] = _long_lm_flops(_LONG_SEQ)
 
+
+def _long_attn() -> str:
+    """The long leg's attention implementation, validated — ONE source for
+    both the model build and the emitted line (a drifted default would
+    mis-attribute the A/B number). 'auto' is rejected: the leg IS the
+    flash-vs-XLA comparison."""
+    impl = os.environ.get("BIGDL_BENCH_ATTN", "flash")
+    if impl not in ("flash", "full"):
+        raise ValueError(f"BIGDL_BENCH_ATTN must be flash|full for the "
+                         f"long-context leg, got {impl!r}")
+    return impl
+
 # committed measurement history (tunnel-wedge insurance; see bench_results/)
 _RESULTS_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -278,12 +290,7 @@ def _build(model_name: str, batch: int, n_batches: int, dtype: str):
         if _LONG_SEQ_ERROR:
             raise ValueError(_LONG_SEQ_ERROR)
         seq, n_classes = _MODEL_UNITS[model_name][1], 32000
-        impl = os.environ.get("BIGDL_BENCH_ATTN", "flash")
-        # the leg IS the flash-vs-XLA A/B: "auto" would leave the emitted
-        # line unable to attribute its number to an implementation
-        if impl not in ("flash", "full"):
-            raise ValueError(f"BIGDL_BENCH_ATTN must be flash|full for the "
-                             f"long-context leg, got {impl!r}")
+        impl = _long_attn()
         fused = os.environ.get("BIGDL_BENCH_FUSED_HEAD", "1") == "1"
         model = TransformerLM(n_classes, embed_dim=512, num_heads=8,
                               num_layers=6, max_len=seq, fused_head=fused,
@@ -767,7 +774,7 @@ def run_worker(args) -> None:
         line["peak_hbm_mb"] = res["peak_hbm_mb"]
     if args.model == "transformerlm-long":
         line["seq_len"] = _LONG_SEQ
-        line["attention_impl"] = os.environ.get("BIGDL_BENCH_ATTN", "flash")
+        line["attention_impl"] = _long_attn()
     if suspect:
         line["suspect_reason"] = (
             "optimize() loop >1.5x slower than the same compiled step driven "

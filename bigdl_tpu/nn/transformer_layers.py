@@ -304,5 +304,10 @@ class Transformer(Container):
 from bigdl_tpu.utils.serializer import register as _register  # noqa: E402
 
 for _cls in (LayerNormalization, ExpandSize, TableOperation, Attention,
-             FeedForwardNetwork, Transformer):
+             FeedForwardNetwork):
     _register(_cls)
+# The seq2seq zoo model (models/transformer, shipped round 4) owns the bare
+# "Transformer" registry name — its archives keep loading unchanged. This
+# layer-level class is NEW this round and has never been persisted under the
+# bare name, so the qualified name needs no legacy alias.
+_register(Transformer, name="nn.Transformer")

@@ -68,7 +68,13 @@ def _build_registry() -> dict[str, type]:
             obj = getattr(namespace, attr)
             if isinstance(obj, type) and issubclass(
                     obj, (AbstractModule, AbstractCriterion, InitializationMethod)):
-                reg[prefix + obj.__name__] = obj
+                # classes registered under an explicit name (register(cls,
+                # name=...)) keep it here too — the bare __name__ may belong
+                # to ANOTHER class (nn.Transformer vs the seq2seq zoo
+                # Transformer). __dict__ lookup: subclasses must not
+                # inherit the parent's explicit name.
+                n = obj.__dict__.get("__serial_name__", obj.__name__)
+                reg[prefix + n] = obj
 
     _scan(nn)
     try:
@@ -103,11 +109,24 @@ _PENDING: list[tuple[str, type]] = []
 _REV: dict | None = None
 
 
+def _check_collision(reg: dict, n: str, cls: type) -> None:
+    # a silent same-name overwrite makes round-trips ORDER-DEPENDENT on
+    # import order (real bug: nn.Transformer vs models.transformer
+    # .Transformer) — distinct classes must register under distinct names
+    old = reg.get(n)
+    if old is not None and old is not cls:
+        raise SerializationError(
+            f"serialization-registry name collision: {n!r} already maps to "
+            f"{old.__module__}.{old.__qualname__}; register "
+            f"{cls.__module__}.{cls.__qualname__} under an explicit name")
+
+
 def _registry() -> dict[str, type]:
     global _REGISTRY, _REV
     if _REGISTRY is None:
         reg = _build_registry()
         for n, c in _PENDING:
+            _check_collision(reg, n, c)
             reg[n] = c
         _REGISTRY = reg
         _REV = None   # derive strictly from the final registry
@@ -119,8 +138,20 @@ def register(cls: type, name: str | None = None) -> type:
     global _REV
     n = name or cls.__name__
     if _REGISTRY is None:
+        for pn, pc in _PENDING:
+            if pn == n and pc is not cls:
+                raise SerializationError(
+                    f"serialization-registry name collision: {n!r} already "
+                    f"pending for {pc.__module__}.{pc.__qualname__}")
+        if name is not None:
+            # only AFTER validation: a rejected registration must not leave
+            # the colliding name attached (the scan would re-import it)
+            cls.__serial_name__ = name
         _PENDING.append((n, cls))
         return cls
+    _check_collision(_REGISTRY, n, cls)
+    if name is not None:
+        cls.__serial_name__ = name   # honored by the registry scan too
     _REGISTRY[n] = cls
     if _REV is not None:
         _REV[cls] = n

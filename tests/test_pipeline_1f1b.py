@@ -242,6 +242,45 @@ class TestTrainingIntegration:
         with pytest.raises(ValueError, match="schedule"):
             GPipe(stages=_lm_stages(), schedule="pipedream")
 
+    def test_frozen_stage_stays_put_under_1f1b(self):
+        """freeze() composes with the pipelined train step: the frozen
+        stage's params pass through the flat rows byte-identical while the
+        rest trains (stop_gradient dead-codes through the per-stage vjp)."""
+        from bigdl_tpu.dataset import DataSet
+        from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
+        from bigdl_tpu.optim import SGD, Trigger
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+        Engine.reset()
+        Engine.init(mesh_shape=(2, 4), mesh_axes=("data", "pipe"), seed=0)
+        RandomGenerator.set_seed(0)
+        g = GPipe(stages=_lm_stages(), n_microbatches=2, schedule="1f1b")
+        g.modules[0].freeze()   # freeze the embedding stage
+        before = {k: np.asarray(v).copy() for k, v in
+                  jax.tree_util.tree_leaves_with_path(g.get_params()["0"])}
+        before1 = {k: np.asarray(v).copy() for k, v in
+                   jax.tree_util.tree_leaves_with_path(g.get_params()["1"])}
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                           size_average=True)
+        rng = np.random.default_rng(2)
+        samples = [Sample(rng.integers(0, VOCAB, size=(SEQ,)).astype(np.int32),
+                          rng.integers(0, VOCAB, size=(SEQ,)).astype(np.int32))
+                   for _ in range(16)]
+        data = DataSet.array(samples, distributed=True) >> SampleToMiniBatch(8)
+        opt = (DistriOptimizer(g, data, crit)
+               .set_optim_method(SGD(learningrate=0.2))
+               .set_end_when(Trigger.max_iteration(3)))
+        opt.log_every = 10 ** 9
+        opt.optimize()
+        after = dict(jax.tree_util.tree_leaves_with_path(g.get_params()["0"]))
+        for k, v in before.items():
+            np.testing.assert_array_equal(v, np.asarray(after[k]),
+                                          err_msg=str(k))
+        after1 = dict(jax.tree_util.tree_leaves_with_path(g.get_params()["1"]))
+        moved = [k for k, v in before1.items()
+                 if not np.array_equal(v, np.asarray(after1[k]))]
+        assert moved   # the unfrozen stages actually trained
+
 
 class TestMemoryProfile:
     """THE 1F1B claim (round-4 verdict #4 done-criterion): activation peak

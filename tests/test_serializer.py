@@ -609,3 +609,40 @@ class TestSession3Fixes:
             m.save_module(p)
         leftovers = [f for f in os.listdir(tmp_path) if "tmp" in f]
         assert not leftovers, leftovers
+
+
+class TestRegistryCollisions:
+    """Round-5 regression: nn.Transformer vs the seq2seq zoo Transformer
+    shared the bare registry name, making round-trips import-order-dependent.
+    Distinct classes must hold distinct names, loudly."""
+
+    def test_both_transformers_round_trip(self, tmp_path):
+        from bigdl_tpu.models.transformer.transformer import (
+            Transformer as ZooTransformer)
+        from bigdl_tpu.utils.serializer import (_reg_name, _registry,
+                                                load_module, save_module)
+
+        reg = _registry()
+        assert reg["Transformer"] is ZooTransformer
+        assert reg["nn.Transformer"] is nn.Transformer
+        assert _reg_name(nn.Transformer) == "nn.Transformer"
+        assert _reg_name(ZooTransformer) == "Transformer"
+        RandomGenerator.set_seed(1)
+        m = nn.Transformer(9, 8, 2, 16, 1).evaluate()
+        x = jnp.asarray([[1, 2, 3]], jnp.int32)
+        want, _ = m.apply(m.get_params(), m.get_state(), x)
+        save_module(m, str(tmp_path / "t.bin"))
+        m2 = load_module(str(tmp_path / "t.bin")).evaluate()
+        assert type(m2) is nn.Transformer
+        got, _ = m2.apply(m2.get_params(), m2.get_state(), x)
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   rtol=1e-6)
+
+    def test_register_refuses_silent_collision(self):
+        from bigdl_tpu.utils.serializer import SerializationError, register
+
+        class Impostor(nn.TensorModule):
+            pass
+
+        with pytest.raises(SerializationError, match="collision"):
+            register(Impostor, name="Linear")

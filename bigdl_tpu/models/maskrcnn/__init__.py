@@ -1,0 +1,3 @@
+from bigdl_tpu.models.maskrcnn.maskrcnn import MaskRCNN, MaskRCNNBackbone
+
+__all__ = ["MaskRCNN", "MaskRCNNBackbone"]

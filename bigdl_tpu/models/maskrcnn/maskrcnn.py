@@ -1,0 +1,133 @@
+"""Mask R-CNN — inference model composed from the round-5 detection family.
+
+Reference parity (SURVEY §2.1/§2.5: the reference carries the Mask-R-CNN
+module set — RoiAlign/FPN/Pooler/RegionProposal/BoxHead/MaskHead — and a zoo
+inference model over them, expected ``<dl>/models/maskrcnn`` — unverified,
+mount empty). This builder wires those modules end-to-end the way the
+reference zoo does: backbone pyramid → FPN → RPN proposals → box head →
+per-class decode/NMS → mask head on the kept detections.
+
+TPU shape discipline: every stage runs on FIXED budgets (proposal count,
+detections per image), so the whole detector traces to ONE static-shape XLA
+program — the same redesign the SSD family uses. Single-image contract
+(matching the RegionProposal/Proposal layers); vmap/loop over images for
+batches.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import Table
+
+
+def MaskRCNNBackbone(in_channels: int = 3,
+                     widths: Sequence[int] = (32, 64, 128),
+                     out_channels: int = 64) -> nn.Graph:
+    """A small conv pyramid (stride 4/8/16 feature maps) + FPN — the
+    structural stand-in for the reference's ResNet-FPN backbone (swap in
+    ``models.resnet`` stages for real training; the wire format is the
+    same: a Table of per-level (N, C, H, W) maps, fine → coarse)."""
+    inp = nn.Input()
+
+    def block(c_in, c_out, node):
+        seq = nn.Sequential()
+        seq.add(nn.SpatialMaxPooling(2, 2))
+        seq.add(nn.SpatialConvolution(c_in, c_out, 3, 3, pad_w=1, pad_h=1))
+        seq.add(nn.ReLU())
+        seq.add(nn.SpatialConvolution(c_out, c_out, 3, 3, pad_w=1, pad_h=1))
+        seq.add(nn.ReLU())
+        return seq.inputs(node)
+
+    # stride 4 stem: two stride-2 convs
+    stem = (nn.Sequential()
+            .add(nn.SpatialConvolution(in_channels, widths[0], 3, 3,
+                                       stride_w=2, stride_h=2,
+                                       pad_w=1, pad_h=1))
+            .add(nn.ReLU())
+            .add(nn.SpatialConvolution(widths[0], widths[0], 3, 3,
+                                       stride_w=2, stride_h=2,
+                                       pad_w=1, pad_h=1))
+            .add(nn.ReLU())).inputs(inp)
+    c3 = block(widths[0], widths[1], stem)           # stride 8
+    c4 = block(widths[1], widths[2], c3)             # stride 16
+    fpn = nn.FPN(list(widths), out_channels).inputs(stem, c3, c4)
+    return nn.Graph(inp, fpn)
+
+
+class MaskRCNN(nn.Container):
+    """Single-image Mask-R-CNN inference: ``(1, 3, H, W)`` pixels →
+    Table(dets (max_per_image, 6) ``[label, score, x1, y1, x2, y2]``,
+    valid (max_per_image,), masks (max_per_image, n_classes, 2·mask_res,
+    2·mask_res)). Image size is static per compile (the usual padded-batch
+    serving discipline)."""
+
+    def __init__(self, n_classes: int, image_size: Sequence[int] = (128, 128),
+                 out_channels: int = 64, post_nms_topn: int = 60,
+                 max_per_image: int = 20, box_resolution: int = 7,
+                 mask_resolution: int = 14):
+        backbone = MaskRCNNBackbone(out_channels=out_channels)
+        scales = [1.0 / 4, 1.0 / 8, 1.0 / 16]
+        rpn = nn.RegionProposal(out_channels,
+                                anchor_sizes=(32, 64, 128),
+                                feat_strides=(4, 8, 16),
+                                pre_nms_topn=4 * post_nms_topn,
+                                post_nms_topn=post_nms_topn,
+                                rpn_min_size=2)
+        box_head = nn.BoxHead(out_channels, box_resolution, scales, 2,
+                              n_classes=n_classes, representation=256)
+        mask_head = nn.MaskHead(out_channels, mask_resolution, scales, 2,
+                                n_classes=n_classes, layers=(64, 64))
+        super().__init__(backbone, rpn, box_head, mask_head)
+        self.n_classes = n_classes
+        self.image_size = tuple(int(s) for s in image_size)
+        self.max_per_image = max_per_image
+        self.detection_out = nn.DetectionOutputFrcnn(
+            n_classes, score_thresh=0.05, max_per_image=max_per_image)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if training:
+            raise ValueError(
+                "MaskRCNN is the inference composition (reference zoo "
+                "contract); train the heads against your proposal/target "
+                "sampler directly")
+        h, w = self.image_size
+        if tuple(input.shape[-2:]) != (h, w):
+            # im_info drives proposal/box clipping — a mismatched image
+            # would be silently confined to the configured bounds
+            raise ValueError(
+                f"MaskRCNN compiled for {h}x{w} images, got "
+                f"{input.shape[-2]}x{input.shape[-1]} (pad/resize, or build "
+                f"with image_size matching the serving shape)")
+        new_state = dict(state)
+
+        def run(i, x):
+            out, s = self.modules[i].apply(params[str(i)], state[str(i)], x,
+                                           training=False, rng=None)
+            new_state[str(i)] = s
+            return out
+
+        feats = run(0, input)                                   # FPN pyramid
+        im_info = jnp.asarray([[float(h), float(w), 1.0]])
+        rois, roi_valid = run(1, Table(feats, im_info)).values()
+        logits, deltas = run(2, Table(feats, rois)).values()
+        dout, _ = self.detection_out.apply(
+            {}, {}, Table(logits, deltas, rois, im_info, roi_valid))
+        dets, valid = dout.values()
+        # mask head on the KEPT detections' boxes (batch col 0)
+        det_rois = jnp.concatenate(
+            [jnp.zeros((self.max_per_image, 1)), dets[:, 2:]], axis=1)
+        masks = run(3, Table(feats, det_rois))
+        return Table(dets, valid, masks), new_state
+
+    def __repr__(self):
+        return (f"MaskRCNN(classes={self.n_classes}, "
+                f"image={self.image_size}, max={self.max_per_image})")
+
+
+from bigdl_tpu.utils.serializer import register as _register  # noqa: E402
+
+_register(MaskRCNN)

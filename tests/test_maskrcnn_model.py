@@ -1,0 +1,83 @@
+"""Mask-R-CNN zoo model (models/maskrcnn): the round-5 detection family
+composed end-to-end — backbone pyramid → FPN → RPN → box head → per-class
+decode/NMS → mask head — as ONE static-shape program. Shape/contract,
+jit-compile, and serializer round-trip coverage."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.models.maskrcnn import MaskRCNN, MaskRCNNBackbone
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def _img(h=128, w=128, seed=0):
+    return jnp.asarray(np.random.default_rng(seed)
+                       .normal(size=(1, 3, h, w)).astype(np.float32))
+
+
+class TestMaskRCNN:
+    def test_backbone_pyramid_shapes(self):
+        RandomGenerator.set_seed(0)
+        b = MaskRCNNBackbone(out_channels=32)
+        out, _ = b.apply(b.get_params(), b.get_state(), _img())
+        lvls = list(out.values())
+        assert [o.shape for o in lvls] == [
+            (1, 32, 32, 32), (1, 32, 16, 16), (1, 32, 8, 8)]
+
+    def test_end_to_end_contract(self):
+        RandomGenerator.set_seed(1)
+        m = MaskRCNN(n_classes=4, image_size=(128, 128), out_channels=32,
+                     post_nms_topn=30, max_per_image=8).evaluate()
+        dets, valid, masks = m.forward(_img(seed=2)).values()
+        assert dets.shape == (8, 6)
+        assert valid.shape == (8,)
+        assert masks.shape == (8, 4, 28, 28)
+        live = np.asarray(dets)[np.asarray(valid)]
+        if len(live):
+            assert ((live[:, 0] >= 1) & (live[:, 0] < 4)).all()
+            assert (live[:, 2:] >= 0).all() and (live[:, 2:] <= 127).all()
+
+    def test_jits_to_one_program(self):
+        RandomGenerator.set_seed(2)
+        m = MaskRCNN(n_classes=3, image_size=(64, 64), out_channels=16,
+                     post_nms_topn=12, max_per_image=4).evaluate()
+        params, mstate = m.get_params(), m.get_state()
+
+        @jax.jit
+        def serve(p, x):
+            out, _ = m.apply(p, mstate, x, training=False)
+            return tuple(out.values())
+
+        dets, valid, masks = serve(params, _img(64, 64, seed=3))
+        assert dets.shape == (4, 6) and masks.shape == (4, 3, 28, 28)
+
+    def test_training_refused_loudly(self):
+        m = MaskRCNN(n_classes=3, image_size=(64, 64), out_channels=16)
+        with pytest.raises(ValueError, match="inference"):
+            m.apply(m.get_params(), m.get_state(), _img(64, 64),
+                    training=True)
+
+    def test_serializer_roundtrip(self, tmp_path):
+        from bigdl_tpu.utils.serializer import load_module, save_module
+
+        RandomGenerator.set_seed(3)
+        m = MaskRCNN(n_classes=3, image_size=(64, 64), out_channels=16,
+                     post_nms_topn=12, max_per_image=4).evaluate()
+        x = _img(64, 64, seed=4)
+        want = m.forward(x)
+        save_module(m, str(tmp_path / "mrcnn.bin"))
+        m2 = load_module(str(tmp_path / "mrcnn.bin")).evaluate()
+        got = m2.forward(x)
+        for a, b in zip(want.values(), got.values()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-5)
+
+
+def test_mismatched_image_size_rejected():
+    RandomGenerator.set_seed(4)
+    m = MaskRCNN(n_classes=3, image_size=(64, 64), out_channels=16).evaluate()
+    with pytest.raises(ValueError, match="64x64"):
+        m.forward(_img(128, 128, seed=5))

@@ -362,3 +362,100 @@ class TestObservability:
         assert m2.router == "top2" and m2.z_loss_weight == pytest.approx(1e-3)
         np.testing.assert_allclose(np.asarray(m2.evaluate().forward(x)), want,
                                    rtol=1e-5)
+
+
+class TestExpertChoice:
+    """Expert-choice routing: experts pick their top-capacity tokens —
+    perfectly balanced by construction (the verdict's alternative to top-2)."""
+
+    def test_matches_expert_choice_loop_oracle(self):
+        RandomGenerator.set_seed(0)
+        m = MoE(8, 16, n_experts=4, capacity_factor=1.0,
+                router="expert_choice").evaluate()
+        x = _x(16, 8, seed=21)
+        out = np.asarray(m.forward(x))
+        p = {k: np.asarray(v) for k, v in m.get_params().items()}
+        logits = np.asarray(x) @ p["w_gate"]
+        probs = np.exp(logits - logits.max(1, keepdims=True))
+        probs /= probs.sum(1, keepdims=True)
+        cap = 4   # ceil(1 * 16 * 1.0 / 4)
+        ref = np.zeros_like(np.asarray(x))
+        for e in range(4):
+            chosen = np.argsort(-probs[:, e])[:cap]
+            for t in chosen:
+                h = np.maximum(np.asarray(x)[t] @ p["w1"][e] + p["b1"][e], 0)
+                ref[t] += (h @ p["w2"][e] + p["b2"][e]) * probs[t, e]
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_balanced_by_construction(self):
+        RandomGenerator.set_seed(1)
+        m = MoE(8, 16, n_experts=4, capacity_factor=1.0,
+                router="expert_choice").evaluate()
+        _, st = m.apply(m.get_params(), m.get_state(), _x(32, 8, seed=22))
+        assert float(st["aux_loss"]) == 0.0   # no balance pressure needed
+        # every expert processes exactly its capacity
+        # (observable through zero drop at cf>=1 with adversarial gates too)
+        assert 0.0 <= float(st["dropped_fraction"]) < 1.0
+
+    def test_gradients_flow(self):
+        RandomGenerator.set_seed(2)
+        m = MoE(8, 16, n_experts=4, router="expert_choice")
+        x = _x(12, 8, seed=23)
+
+        def loss(p):
+            y, _ = m.apply(p, m.get_state(), x, training=True)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(loss)(m.get_params())
+        assert float(jnp.abs(g["w_gate"]).sum()) > 0
+        assert float(jnp.abs(g["w1"]).sum()) > 0
+
+    def test_serializer_roundtrip(self, tmp_path):
+        from bigdl_tpu.utils.serializer import load_module, save_module
+
+        RandomGenerator.set_seed(3)
+        m = MoE(8, 16, n_experts=4, router="expert_choice")
+        x = _x(6, 8, seed=24)
+        want = np.asarray(m.evaluate().forward(x))
+        save_module(m, str(tmp_path / "moe_ec.bin"))
+        m2 = load_module(str(tmp_path / "moe_ec.bin"))
+        assert m2.router == "expert_choice"
+        np.testing.assert_allclose(np.asarray(m2.evaluate().forward(x)),
+                                   want, rtol=1e-5)
+
+    def test_trains_on_mesh_with_ep(self):
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        Engine.reset()
+        Engine.init(mesh_shape=(4, 2), mesh_axes=("data", "model"), seed=0)
+        RandomGenerator.set_seed(4)
+        rng = np.random.default_rng(0)
+        samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                          np.int32(rng.integers(0, 3)))
+                   for _ in range(32)]
+        data = DataSet.array(samples, distributed=True) >> SampleToMiniBatch(16)
+        model = (nn.Sequential()
+                 .add(MoE(8, 16, n_experts=4, router="expert_choice"))
+                 .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+        opt = (DistriOptimizer(model, data, nn.ClassNLLCriterion(),
+                               parameter_sync="zero1")
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_tensor_parallel(expert_parallel_rules("0"))
+               .set_end_when(Trigger.max_iteration(2)))
+        opt.log_every = 10 ** 9
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+
+
+def test_expert_choice_high_capacity_factor_clamps():
+    # review finding: cap > T crashed lax.top_k; must clamp and route all
+    RandomGenerator.set_seed(5)
+    m = MoE(8, 16, n_experts=4, capacity_factor=8.0,
+            router="expert_choice").evaluate()
+    x = _x(12, 8, seed=25)
+    _, st = m.apply(m.get_params(), m.get_state(), x)
+    assert float(st["dropped_fraction"]) == 0.0   # every token reachable

@@ -2,6 +2,7 @@
 ``bigdl.nn.keras`` — SURVEY.md §2.1, unverified)."""
 
 from bigdl_tpu.nn.keras.layers import (
+    Merge,
     Activation, AtrousConvolution2D, AveragePooling1D, AveragePooling2D,
     AveragePooling3D, BatchNormalization, Bidirectional, Convolution1D,
     Convolution2D, Convolution3D, Cropping1D, Cropping2D, Cropping3D,
@@ -41,5 +42,5 @@ __all__ = [
     "Sequential", "SimpleRNN", "SpatialDropout1D", "SpatialDropout2D",
     "SpatialDropout3D", "ThresholdedReLU", "TimeDistributed", "UpSampling1D",
     "UpSampling2D", "UpSampling3D", "ZeroPadding1D", "ZeroPadding2D",
-    "ZeroPadding3D", "merge",
+    "ZeroPadding3D", "Merge", "merge",
 ]

@@ -1120,3 +1120,87 @@ class ConvLSTM2D(_RecurrentLayer):
         if self.return_sequences:
             return (t, self.output_dim, h, w)
         return (self.output_dim, h, w)
+
+
+class Merge(KerasLayer):
+    """The keras-1 ``Merge`` LAYER (reference ``keras.Merge``; the functional
+    form is :func:`~bigdl_tpu.nn.keras.merge`): combines several inputs by
+    ``mode`` (concat|sum|mul|ave|max|dot|cos).
+
+    Two idioms:
+    - functional: ``Merge(mode="sum")([node_a, node_b])``;
+    - Sequential-first-layer: ``Merge(layers=[branch_a, branch_b],
+      mode="concat")`` where each branch is a KerasLayer with a declared
+      ``input_shape`` — the built module is a ``ParallelTable`` of the
+      branches feeding the merge, consuming a Table of inputs.
+    """
+
+    @staticmethod
+    def _branch_spec(i, l):
+        """(input_shape, output_shape, build_thunk) for a branch — a
+        KerasLayer with declared input_shape, or a built keras Sequential/
+        Model (which knows its own shapes)."""
+        if hasattr(l, "_module") and hasattr(l, "_input_shape"):
+            shape = l._input_shape()
+            if shape is None:
+                raise ValueError(f"Merge branch {i}: empty Sequential")
+            return shape, l.output_shape, (lambda: l._module())
+        if getattr(l, "input_shape", None) is None:
+            raise ValueError(
+                f"Merge branch {i} needs a declared input_shape (or pass a "
+                f"built keras Sequential/Model)")
+        return (l.input_shape, l.compute_output_shape(l.input_shape),
+                (lambda: l.build(l.input_shape)))
+
+    def __init__(self, layers=None, mode: str = "sum", concat_axis: int = 1,
+                 **kw):
+        super().__init__(**kw)
+        self.layers = list(layers) if layers is not None else None
+        self.mode = mode
+        self.concat_axis = concat_axis
+        if self.layers is not None:
+            if len(self.layers) < 2:
+                raise ValueError(
+                    f"Merge needs at least 2 branches, got {len(self.layers)}")
+            specs = [self._branch_spec(i, l)
+                     for i, l in enumerate(self.layers)]
+            self.input_shape = tuple(s[0] for s in specs)
+
+    def __call__(self, node):
+        from bigdl_tpu.nn.keras.topology import merge_nodes
+        if self.layers is not None:
+            raise ValueError(
+                "functional Merge takes the nodes directly — drop `layers`")
+        if not isinstance(node, (list, tuple)):
+            raise TypeError("Merge expects a LIST of nodes")
+        return merge_nodes(list(node), self.mode, self.concat_axis)
+
+    def build(self, input_shape):
+        from bigdl_tpu.nn.keras.topology import _merge_module
+        if self.layers is not None:
+            specs = [self._branch_spec(i, l)
+                     for i, l in enumerate(self.layers)]
+            inner, _ = _merge_module(self.mode, [s[1] for s in specs],
+                                     self.concat_axis)
+            par = N.ParallelTable()
+            for _, _, build in specs:
+                par.add(build())
+            return N.Sequential().add(par).add(inner)
+        # bare Table input: input_shape is a tuple of per-input shapes
+        if not input_shape or not isinstance(input_shape[0], (tuple, list)):
+            raise ValueError(
+                f"Merge without `layers` needs multiple inputs (a tuple of "
+                f"shapes), got {input_shape}")
+        inner, _ = _merge_module(self.mode, list(input_shape),
+                                 self.concat_axis)
+        return inner
+
+    def compute_output_shape(self, input_shape):
+        from bigdl_tpu.nn.keras.topology import _merge_module
+        if self.layers is not None:
+            shapes = [self._branch_spec(i, l)[1]
+                      for i, l in enumerate(self.layers)]
+        else:
+            shapes = list(input_shape)
+        _, shape = _merge_module(self.mode, shapes, self.concat_axis)
+        return shape

@@ -39,12 +39,12 @@ def Input(shape: Sequence[int], name: Optional[str] = None) -> KerasNode:
     return KerasNode(GraphInput(), tuple(shape))
 
 
-def merge_nodes(nodes, mode: str = "concat", concat_axis: int = 1) -> KerasNode:
-    """Merge several functional nodes (reference keras ``Merge``/``merge``)."""
-    from bigdl_tpu.nn.graph import make_node
-    nodes = list(nodes)
+def _merge_module(mode: str, shapes, concat_axis: int = 1):
+    """(module, merged shape) for a merge over inputs with the given batch-
+    free shapes — shared by the functional ``merge`` and the ``Merge``
+    layer class."""
+    shapes = [tuple(s) for s in shapes]
     if mode == "concat":
-        shapes = [n.shape for n in nodes]
         for s in shapes[1:]:
             if len(s) != len(shapes[0]):
                 raise ValueError(f"rank mismatch in concat merge: {shapes}")
@@ -56,33 +56,32 @@ def merge_nodes(nodes, mode: str = "concat", concat_axis: int = 1) -> KerasNode:
                              f"{rank}+batch shapes {shapes}")
         out = list(shapes[0])
         out[axis0] = sum(s[axis0] for s in shapes)
-        module = N.JoinTable(axis0 + 2)  # 1-based dim including batch
-        shape = tuple(out)
-    elif mode in ("sum", "add"):
-        shape = nodes[0].shape
-        module = N.CAddTable()
-    elif mode == "mul":
-        shape = nodes[0].shape
-        module = N.CMulTable()
-    elif mode == "ave":
-        shape = nodes[0].shape
-        module = N.CAveTable()
-    elif mode == "max":
-        shape = nodes[0].shape
-        module = N.CMaxTable()
-    elif mode == "dot":
-        if len(nodes) != 2:
-            raise ValueError("dot merge takes exactly two nodes")
-        shape = (1,)
-        module = N.Sequential().add(N.DotProduct()).add(N.Unsqueeze(2))
-    elif mode == "cos":
-        if len(nodes) != 2:
-            raise ValueError("cos merge takes exactly two nodes")
-        shape = (1,)
-        module = N.Sequential().add(N.CosineDistance()).add(N.Unsqueeze(2))
-    else:
-        raise ValueError(f"unknown merge mode {mode!r} "
-                         f"(concat|sum|mul|ave|max|dot|cos)")
+        return N.JoinTable(axis0 + 2), tuple(out)  # 1-based dim incl. batch
+    if mode in ("sum", "add"):
+        return N.CAddTable(), shapes[0]
+    if mode == "mul":
+        return N.CMulTable(), shapes[0]
+    if mode == "ave":
+        return N.CAveTable(), shapes[0]
+    if mode == "max":
+        return N.CMaxTable(), shapes[0]
+    if mode == "dot":
+        if len(shapes) != 2:
+            raise ValueError("dot merge takes exactly two inputs")
+        return N.Sequential().add(N.DotProduct()).add(N.Unsqueeze(2)), (1,)
+    if mode == "cos":
+        if len(shapes) != 2:
+            raise ValueError("cos merge takes exactly two inputs")
+        return N.Sequential().add(N.CosineDistance()).add(N.Unsqueeze(2)), (1,)
+    raise ValueError(f"unknown merge mode {mode!r} "
+                     f"(concat|sum|mul|ave|max|dot|cos)")
+
+
+def merge_nodes(nodes, mode: str = "concat", concat_axis: int = 1) -> KerasNode:
+    """Merge several functional nodes (reference keras ``Merge``/``merge``)."""
+    from bigdl_tpu.nn.graph import make_node
+    nodes = list(nodes)
+    module, shape = _merge_module(mode, [n.shape for n in nodes], concat_axis)
     return KerasNode(make_node(module, [n.node for n in nodes]), shape)
 
 

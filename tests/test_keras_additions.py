@@ -105,3 +105,70 @@ class TestInModel:
         model.fit(x, y, batch_size=16, nb_epoch=2)
         pred = model.predict(x[:4], batch_size=4)
         assert np.asarray(pred).shape == (4, 3)
+
+
+class TestMergeLayer:
+    """keras-1 Merge LAYER class (round 5; the functional `merge` existed)."""
+
+    def test_functional_call_merges(self):
+        import numpy as np
+        from bigdl_tpu.nn import keras as K
+
+        a, b = K.Input((4,)), K.Input((4,))
+        out = K.Merge(mode="sum")([a, b])
+        model = K.Model([a, b], out)
+        x = np.random.default_rng(0).normal(size=(2, 4)).astype(np.float32)
+        y = np.random.default_rng(1).normal(size=(2, 4)).astype(np.float32)
+        got = np.asarray(model.predict([x, y]))
+        np.testing.assert_allclose(got, x + y, rtol=1e-6)
+
+    def test_branch_layers_idiom(self):
+        import numpy as np
+        from bigdl_tpu.nn import keras as K
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+
+        RandomGenerator.set_seed(0)
+        m = K.Merge(layers=[K.Dense(3, input_shape=(4,)),
+                            K.Dense(3, input_shape=(6,))], mode="concat")
+        assert m.compute_output_shape(m.input_shape) == (6,)
+        mod = m.build(m.input_shape)
+        from bigdl_tpu.utils.table import Table
+        import jax.numpy as jnp
+        x = jnp.asarray(np.random.default_rng(2)
+                        .normal(size=(2, 4)).astype(np.float32))
+        y = jnp.asarray(np.random.default_rng(3)
+                        .normal(size=(2, 6)).astype(np.float32))
+        out, _ = mod.apply(mod.get_params(), mod.get_state(), Table(x, y))
+        assert out.shape == (2, 6)
+
+    def test_branch_without_input_shape_rejected(self):
+        from bigdl_tpu.nn import keras as K
+
+        with pytest.raises(ValueError, match="input_shape"):
+            K.Merge(layers=[K.Dense(3), K.Dense(3)], mode="sum")
+
+    def test_sequential_model_branches(self):
+        import numpy as np
+        from bigdl_tpu.nn import keras as K
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        from bigdl_tpu.utils.table import Table
+        import jax.numpy as jnp
+
+        RandomGenerator.set_seed(1)
+        left = K.Sequential().add(K.Dense(3, input_shape=(4,)))
+        right = K.Sequential().add(K.Dense(3, input_shape=(6,)))
+        m = K.Merge(layers=[left, right], mode="sum")
+        assert m.input_shape == ((4,), (6,))
+        mod = m.build(m.input_shape)
+        x = jnp.asarray(np.random.default_rng(4)
+                        .normal(size=(2, 4)).astype(np.float32))
+        y = jnp.asarray(np.random.default_rng(5)
+                        .normal(size=(2, 6)).astype(np.float32))
+        out, _ = mod.apply(mod.get_params(), mod.get_state(), Table(x, y))
+        assert out.shape == (2, 3)
+
+    def test_too_few_branches_rejected(self):
+        from bigdl_tpu.nn import keras as K
+
+        with pytest.raises(ValueError, match="at least 2"):
+            K.Merge(layers=[K.Dense(3, input_shape=(4,))])

@@ -1,14 +1,24 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
-.PHONY: test dist bench multichip clean
+SHELL := /bin/bash
+.PHONY: test t1 dist bench bench-smoke multichip clean
 
 test:
 	python -m pytest tests/ -x -q
+
+# ROADMAP.md tier-1 verify, verbatim — the no-worse-than-seed gate.
+t1:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 dist:
 	bash make-dist.sh
 
 bench:
 	python bench.py
+
+# CPU smoke of the bench's training leg: catches loop-overhead regressions
+# (loop_step_ratio, fused vs per-step legs) without a TPU.
+bench-smoke:
+	JAX_PLATFORMS=cpu python bench.py --model lenet --no-compare-dtypes --no-streamed
 
 multichip:
 	python -m bigdl_tpu.cli dryrun-multichip -n 8

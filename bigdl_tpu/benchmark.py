@@ -317,14 +317,34 @@ def _build(model_name: str, batch: int, n_batches: int, dtype: str):
     return model, DataSet.array(batches), criterion
 
 
+def _bench_fuse_steps() -> int:
+    """Fused-window size for the bench's training legs (BIGDL_FUSE_STEPS,
+    default 8 — the bench's in-memory dataset is 8 batches, so K=8 makes each
+    epoch exactly one fused dispatch). 1 disables fusion."""
+    raw = os.environ.get("BIGDL_FUSE_STEPS", "8")
+    try:
+        v = int(raw)
+        if v < 1:
+            raise ValueError
+        return v
+    except ValueError:
+        raise ValueError(f"BIGDL_FUSE_STEPS must be an integer >= 1, got {raw!r}")
+
+
 def _measure(model_name: str, batch: int, iters: int, warmup: int,
-             dtype: str, streamed: bool = False) -> dict:
+             dtype: str, streamed: bool = False,
+             fuse_steps: int | None = None) -> dict:
     """Train `warmup` iters (compile + steady-state), then time `iters` more
     through the same LocalOptimizer (compiled-step cache keeps it warm).
 
     ``streamed=True`` disables the device batch cache, so every step pays the
     host→device transfer on the feed path (prefetch-overlapped) — the
-    fresh-data-every-step number, vs the cached-RDD-analog headline."""
+    fresh-data-every-step number, vs the cached-RDD-analog headline.
+
+    ``fuse_steps`` > 1 runs the timed leg through the fused multi-step
+    dispatch path (one jitted scan per K steps) and ALSO times a per-step
+    (K=1) comparison leg on the same warm optimizer, so the emitted line
+    carries both the fused and the classic loop numbers."""
     import jax.numpy as jnp
 
     from bigdl_tpu.optim import SGD
@@ -338,19 +358,25 @@ def _measure(model_name: str, batch: int, iters: int, warmup: int,
     Engine.init(compute_dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32)
     dev = Engine.devices()[0]
 
+    fuse = _bench_fuse_steps() if fuse_steps is None else fuse_steps
     model, dataset, criterion = _build(model_name, batch, n_batches=8, dtype=dtype)
     opt = LocalOptimizer(model, dataset, criterion)
     opt.set_optim_method(SGD(learningrate=0.01, momentum=0.9, dampening=0.0))
+    opt.set_fuse_steps(fuse)
     opt.log_every = 10 ** 9  # no per-iter logging during warmup
 
+    # with fusion the warmup must cover the per-step first window PLUS at
+    # least one full fused window, so both programs are compiled before the
+    # timed leg opens
+    warmup = max(warmup, 2 * fuse) if fuse > 1 else warmup
     opt.set_end_when(Trigger.max_iteration(warmup))
     opt.optimize()
 
     # The loop logs windowed throughput; one window ending exactly at the last
     # iteration covers the post-warmup steps and EXCLUDES optimize()'s one-time
-    # costs (first-step sync starts the window) and end-of-run teardown (full
-    # param/state device_get) from the timing. Optimizer state (momentum) carries
-    # over — optimize() on the same instance is a continuation.
+    # costs (first-step/window sync starts the window) and end-of-run teardown
+    # (full param/state device_get) from the timing. Optimizer state (momentum)
+    # carries over — optimize() on the same instance is a continuation.
     opt.log_every = warmup + iters
     opt.set_end_when(Trigger.max_iteration(warmup + iters))
     t0 = time.perf_counter()
@@ -359,6 +385,23 @@ def _measure(model_name: str, batch: int, iters: int, warmup: int,
     unit, per_sample = _MODEL_UNITS.get(model_name, ("records", 1))
     samples_per_sec = opt.state.get("throughput") or (batch * iters / dt)
     units_per_sec = samples_per_sec * per_sample
+
+    # per-step (K=1) comparison leg on the same warm optimizer: the classic
+    # loop's number, so fused-vs-per-step is measured in ONE process on the
+    # same compiled step
+    perstep_units_per_sec = None
+    if fuse > 1:
+        n2 = max(iters // 2, 5)
+        start = warmup + iters
+        opt.set_fuse_steps(1)
+        opt.log_every = start + n2
+        opt.set_end_when(Trigger.max_iteration(start + n2))
+        t1 = time.perf_counter()
+        opt.optimize()
+        dt2 = time.perf_counter() - t1
+        sps2 = opt.state.get("throughput") or (batch * n2 / dt2)
+        perstep_units_per_sec = sps2 * per_sample
+        opt.set_fuse_steps(fuse)
 
     # device peak-memory telemetry (the long-context leg's memory claim needs
     # a measured number, not a trace assertion). Read IMMEDIATELY after the
@@ -402,6 +445,8 @@ def _measure(model_name: str, batch: int, iters: int, warmup: int,
     return {
         "unit": unit,
         "units_per_sec": units_per_sec,
+        "units_per_sec_perstep": perstep_units_per_sec,
+        "fuse_steps": fuse,
         "units_per_sec_step": step_units_per_sec,
         "step_leg_error": step_error,
         "mfu": _mfu(units_per_sec),
@@ -746,9 +791,12 @@ def run_worker(args) -> None:
     res = _measure(args.model, args.batch, args.iters, args.warmup, args.dtype)
     unit = res["unit"]
     loop_ups, step_ups = res["units_per_sec"], res["units_per_sec_step"]
+    perstep_ups, fuse = res["units_per_sec_perstep"], res["fuse_steps"]
     if step_ups is None:
         ratio, suspect = None, False  # cross-check unavailable; loop stands alone
     else:
+        # the primary loop number (fused when fusion is on) vs the raw compiled
+        # step: ~1.0 means the loop itself costs nothing beyond the program
         ratio = (step_ups / loop_ups) if loop_ups else float("inf")
         suspect = ratio > 1.5
     value, mfu = (step_ups, res["mfu_step"]) if suspect else (loop_ups, res["mfu"])
@@ -760,6 +808,7 @@ def run_worker(args) -> None:
         "dtype": args.dtype,
         "batch": args.batch,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "fuse_steps": fuse,
         f"{unit}_per_sec_loop": round(loop_ups, 1),
         f"{unit}_per_sec_step": round(step_ups, 1) if step_ups is not None else None,
         "loop_step_ratio": round(ratio, 2) if ratio is not None else None,
@@ -768,6 +817,15 @@ def run_worker(args) -> None:
         "platform": res["platform"],
         "feed_wait_ms": round(res["feed_wait_ms"], 2),
     }
+    if fuse > 1 and perstep_ups is not None:
+        # both dispatch legs, explicitly: the fused window loop and the
+        # classic per-step loop, plus their ratio (the loop-overhead win)
+        line[f"{unit}_per_sec_fused"] = round(loop_ups, 1)
+        line[f"{unit}_per_sec_perstep"] = round(perstep_ups, 1)
+        line["fused_speedup"] = (round(loop_ups / perstep_ups, 3)
+                                 if perstep_ups else None)
+        if step_ups is not None and perstep_ups:
+            line["perstep_step_ratio"] = round(step_ups / perstep_ups, 2)
     if res.get("step_leg_error"):
         line["step_leg_error"] = res["step_leg_error"]
     if res.get("peak_hbm_mb") is not None:
@@ -791,6 +849,26 @@ def run_worker(args) -> None:
         except Exception as e:
             line["streamed_leg_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(line))
+
+
+def _probe_backend(env: dict, timeout: float) -> str | None:
+    """Cheap bounded device probe. BENCH_r05 burned 2×420 s in ``Engine.init``
+    'auto' backend-discovery watchdogs before the CPU fallback engaged; this
+    tiny subprocess attempts device discovery under a short deadline so a hung
+    accelerator runtime degrades the bench to CPU in seconds, not minutes.
+    Returns None when the backend answers, else the failure reason."""
+    code = "import jax; print(jax.device_count(), jax.devices()[0].platform)"
+    try:
+        p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return f"device probe timed out after {timeout:.0f}s"
+    except OSError as e:
+        return f"device probe failed to spawn: {e}"
+    if p.returncode != 0:
+        tail = (p.stderr or p.stdout or "").strip().splitlines()[-3:]
+        return f"device probe rc={p.returncode}: " + " | ".join(tail)[-300:]
+    return None
 
 
 def _spawn(argv, env, timeout):
@@ -841,11 +919,22 @@ def run_orchestrator(args) -> None:
     if args.ablate:
         worker_argv.append("--ablate")
     env = dict(os.environ)
+    # Fast-fail: one cheap bounded probe decides whether the accelerator
+    # backend answers AT ALL before any full measurement attempt is allowed
+    # to sink its 420 s Engine.init watchdog (BENCH_r05 lost 14 minutes to
+    # exactly that). BIGDL_BENCH_PROBE_TIMEOUT=0 disables the probe.
+    probe_timeout = float(env.get("BIGDL_BENCH_PROBE_TIMEOUT", "45"))
+    probe_err = None
+    if env.get("JAX_PLATFORMS") != "cpu" and probe_timeout > 0:
+        probe_err = _probe_backend(env, probe_timeout)
+        if probe_err:
+            print(f"bench: {probe_err}; skipping accelerator attempts",
+                  file=sys.stderr)
     # TPU attach in this environment swings from ~20 s to outright hangs; give a
     # real attempt generous headroom (the subprocess timeout still bounds it)
     env.setdefault("BIGDL_INIT_TIMEOUT", "420")
     attempts = []
-    for attempt in (1, 2):
+    for attempt in () if probe_err else (1, 2):
         print(f"bench: attempt {attempt}: {args.model} dtype={args.dtype} "
               f"batch={args.batch}", file=sys.stderr)
         result, err = _spawn(worker_argv, env, args.timeout)
@@ -887,6 +976,8 @@ def run_orchestrator(args) -> None:
             return
         attempts.append(f"attempt{attempt}: {err}")
         print(f"bench: {err}", file=sys.stderr)
+    if probe_err:
+        attempts.append(f"probe: {probe_err}")
 
     if args.int8_infer or args.serving or args.decode_infer or args.ablate:
         # a LeNet training number would not answer an inference-path request:

@@ -13,18 +13,30 @@ Design:
   and parks up to ``depth`` placed batches in a bounded queue. ``device_put`` only
   *enqueues* a DMA, so the producer is never blocked on the device — the queue depth
   bounds device-memory overcommit to ``depth`` batches.
+- ``window > 1`` assembles fused-dispatch training windows: the producer groups
+  ``window`` consecutive batches and hands the LIST to ``put_fn`` (the trainer stacks
+  them into a device super-batch with a leading scan axis). The trailing partial group
+  at epoch end is delivered as a shorter list — the trainer falls back to per-step
+  dispatch for it. Queue items are ``(batches, placed)`` either way; with windowing,
+  ``batches`` is a list.
 - Exceptions in the producer surface in the consumer (training loop) with their original
   traceback as ``__cause__``.
 - ``close()`` (also on ``__exit__`` / generator abandonment) stops the producer promptly —
-  mid-epoch breaks (endWhen triggers) must not leak threads.
+  mid-epoch breaks (endWhen triggers) must not leak threads. A producer that fails to
+  join within the timeout is logged loudly and remembered, so the NEXT ``__iter__``
+  can say which earlier epoch leaked it.
 - ``depth=0`` degrades to fully synchronous iteration (debug / determinism studies).
 """
 
 from __future__ import annotations
 
+import itertools
+import logging
 import queue
 import threading
 from typing import Callable, Iterator
+
+logger = logging.getLogger("bigdl_tpu.dataset")
 
 _END = object()
 
@@ -33,20 +45,29 @@ class PrefetchingFeed:
     """Iterate ``(batch, placed)`` pairs with a background producer.
 
     ``make_iter``: zero-arg callable returning the epoch's batch iterator.
-    ``put_fn``: MiniBatch → device-placed pytree (e.g. trainer's ``_put_batch``).
+    ``put_fn``: MiniBatch → device-placed pytree (e.g. trainer's ``_put_batch``);
+    with ``window > 1`` it receives a LIST of up to ``window`` MiniBatches instead.
     ``depth``: producer queue bound (placed batches in flight); 0 = synchronous.
+    ``window``: fused-dispatch group size; 1 (default) feeds single batches.
     """
 
+    #: close() waits this long for the producer before declaring it leaked
+    JOIN_TIMEOUT = 5.0
+
     def __init__(self, make_iter: Callable[[], Iterator], put_fn: Callable,
-                 depth: int = 2):
+                 depth: int = 2, window: int = 1):
         if depth < 0:
             raise ValueError(f"depth must be >= 0, got {depth}")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
         self.make_iter = make_iter
         self.put_fn = put_fn
         self.depth = depth
+        self.window = window
         self._queue: queue.Queue | None = None
         self._stop: threading.Event | None = None
         self._thread: threading.Thread | None = None
+        self._leaked_thread: threading.Thread | None = None
 
     # ------------------------------------------------------------- producer
     @staticmethod
@@ -61,9 +82,16 @@ class PrefetchingFeed:
             except queue.Full:
                 continue
 
+    def _grouped(self, it):
+        """Group the epoch iterator into ``window``-sized lists (trailing
+        partial list included) when windowing; pass through otherwise."""
+        if self.window == 1:
+            return it
+        return iter(lambda: list(itertools.islice(it, self.window)), [])
+
     def _produce(self, it, q: queue.Queue, stop: threading.Event) -> None:
         try:
-            for batch in it:
+            for batch in self._grouped(it):
                 if stop.is_set():
                     return
                 placed = self.put_fn(batch)
@@ -76,8 +104,21 @@ class PrefetchingFeed:
 
     # ------------------------------------------------------------- consumer
     def __iter__(self):
+        leaked = self._leaked_thread
+        if leaked is not None and leaked.is_alive():
+            # breadcrumb from an earlier close() that timed out: the producer
+            # is still running (likely wedged in put_fn / dataset IO) and its
+            # queue references are gone — say so instead of silently stacking
+            # another thread on top of it
+            logger.warning(
+                "PrefetchingFeed: previously leaked producer thread %r is "
+                "still alive; a prior close() timed out. Starting a new "
+                "producer anyway — if this recurs, the put_fn or dataset "
+                "iterator is blocking indefinitely.", leaked.name)
+        elif leaked is not None:
+            self._leaked_thread = None  # it eventually finished; forget it
         if self.depth == 0:
-            for batch in self.make_iter():
+            for batch in self._grouped(self.make_iter()):
                 yield batch, self.put_fn(batch)
             return
         self._stop = threading.Event()
@@ -111,7 +152,17 @@ class PrefetchingFeed:
             except queue.Empty:
                 pass
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            self._thread.join(timeout=self.JOIN_TIMEOUT)
+            if self._thread.is_alive():
+                # the producer did not stop: it is wedged somewhere that
+                # ignores the stop event (device_put, dataset IO). Leaking a
+                # daemon thread is survivable but must not be silent.
+                logger.warning(
+                    "PrefetchingFeed.close: producer thread %r did not join "
+                    "within %.1fs and was leaked (daemon). It is likely "
+                    "blocked in put_fn or the dataset iterator.",
+                    self._thread.name, self.JOIN_TIMEOUT)
+                self._leaked_thread = self._thread
             self._thread = None
 
     def __enter__(self):

@@ -11,7 +11,9 @@ over per-core model replicas with thread pools and sums gradients (SURVEY.md §3
 ENTIRE iteration — forward, loss, backward, optimizer update — is ONE compiled XLA program
 (``jit`` with donated buffers). Per-core replication is XLA's job on a single chip; across
 chips the same step compiles over a mesh (DistriOptimizer). Checkpoint/retry semantics (§5.3)
-are preserved in the loop.
+are preserved in the loop. With ``BIGDL_FUSE_STEPS=K`` the loop itself fuses too: K steps
+dispatch as one ``lax.scan`` over a device-stacked super-batch, with losses/metrics
+accumulated on device and trigger boundaries kept exact (``Trigger.next_fire_in``).
 """
 
 from __future__ import annotations
@@ -116,7 +118,19 @@ class Optimizer:
         self.device_cache_mb: float = float(
             os.environ.get("BIGDL_DEVICE_CACHE_MB", "2048"))
         self._device_batch_cache: Optional[dict] = None
-        self._step_cache = None
+        # Fused multi-step dispatch (BIGDL_FUSE_STEPS / set_fuse_steps): K
+        # consecutive optimizer steps run as ONE jitted lax.scan over a
+        # device-stacked super-batch, with losses/metrics accumulated in the
+        # scan outputs and fetched once per window — the per-step Python
+        # dispatch and host round trip disappear into the compiled program.
+        # 1 (default) preserves the classic per-step loop exactly.
+        self.fuse_steps: int = int(os.environ.get("BIGDL_FUSE_STEPS", "1"))
+        self._step_cache = self._window_cache = None
+        self._window_cache_bytes = 0.0
+        # False until one real step has run: the first-ever dispatch goes
+        # per-step because module state may materialize structure on first
+        # apply, which a fused window's scan carry cannot morph
+        self._state_materialized = False
 
     # fluent config (reference API shape) ----------------------------------
     def set_model(self, model: AbstractModule) -> "Optimizer":
@@ -124,14 +138,15 @@ class Optimizer:
         swap in a modified network, continue). Invalidates the compiled step
         and the optimizer slots (new parameter tree)."""
         self.model = model
-        self._step_cache = None
+        self._step_cache = self._window_cache = None
         self._final_ostate = None
+        self._state_materialized = False
         return self
 
     def set_criterion(self, criterion: AbstractCriterion) -> "Optimizer":
         """Swap the training criterion (reference ``setCriterion``)."""
         self.criterion = criterion
-        self._step_cache = None
+        self._step_cache = self._window_cache = None
         return self
 
     def set_train_data(self, dataset: AbstractDataSet) -> "Optimizer":
@@ -143,7 +158,7 @@ class Optimizer:
 
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
         self.optim_method = method
-        self._step_cache = None
+        self._step_cache = self._window_cache = None
         # the old method's slot pytree must not leak into the new method's step
         self._final_ostate = None
         return self
@@ -184,7 +199,7 @@ class Optimizer:
             groups = old + groups
             default = default.default
         self.optim_method = CompositeOptimMethod(groups, default)
-        self._step_cache = None
+        self._step_cache = self._window_cache = None
         self._final_ostate = None
         return self
 
@@ -192,7 +207,7 @@ class Optimizer:
         """Scale for module-declared ``aux_loss`` state leaves added to the
         objective (MoE load balancing). 0 disables."""
         self.aux_loss_weight = float(weight)
-        self._step_cache = None
+        self._step_cache = self._window_cache = None
         return self
 
     def set_prefetch(self, depth: int) -> "Optimizer":
@@ -203,6 +218,22 @@ class Optimizer:
         self.prefetch_depth = depth
         return self
 
+    def set_fuse_steps(self, k: int) -> "Optimizer":
+        """Fused multi-step dispatch: run ``k`` consecutive optimizer steps as
+        ONE jitted ``lax.scan`` over a device-stacked super-batch, fetching the
+        per-step losses/metrics in a single host round trip per window. The
+        window is trigger-aware — it is clipped (falling back to per-step
+        dispatch) so that ``end_when`` / validation / checkpoint / parameter-
+        histogram triggers still fire at their exact iteration boundaries.
+        ``k=1`` (default) is exactly the classic per-step loop. Keep ``k=1``
+        when debugging (per-step profiler windows, ``BIGDL_SYNC_METRICS``
+        force it anyway)."""
+        if k != int(k) or int(k) < 1:
+            raise ValueError(f"fuse_steps must be a positive integer, got {k!r}")
+        self.fuse_steps = int(k)
+        self._window_cache = None
+        return self
+
     def set_check_numerics(self, enabled: bool = True) -> "Optimizer":
         """Enable the numerics sanitizer: every step runs under
         ``jax.experimental.checkify`` float checks, and a NaN/inf produced
@@ -210,7 +241,7 @@ class Optimizer:
         the location of the generating op (the reference has no sanitizer —
         SURVEY.md §5.2 — this is the functional-JAX upgrade)."""
         self.check_numerics = enabled
-        self._step_cache = None
+        self._step_cache = self._window_cache = None
         return self
 
     def set_profile(self, trace_dir: str, start_iter: int = 10,
@@ -258,18 +289,18 @@ class Optimizer:
 
     def set_constant_gradient_clipping(self, min_v: float, max_v: float) -> "Optimizer":
         self.grad_clip_const = (min_v, max_v)
-        self._step_cache = None
+        self._step_cache = self._window_cache = None
         return self
 
     def set_gradient_clipping_by_l2_norm(self, clip_norm: float) -> "Optimizer":
         self.grad_clip_norm = clip_norm
-        self._step_cache = None
+        self._step_cache = self._window_cache = None
         return self
 
     def disable_gradient_clipping(self) -> "Optimizer":
         self.grad_clip_const = None
         self.grad_clip_norm = None
-        self._step_cache = None
+        self._step_cache = self._window_cache = None
         return self
 
     def set_gradient_accumulation(self, n_micro: int) -> "Optimizer":
@@ -289,7 +320,7 @@ class Optimizer:
         if n_micro != int(n_micro) or int(n_micro) < 1:
             raise ValueError(f"n_micro must be a positive integer, got {n_micro!r}")
         self.grad_accum = int(n_micro)
-        self._step_cache = None
+        self._step_cache = self._window_cache = None
         return self
 
     # ------------------------------------------------------------- compile
@@ -542,6 +573,105 @@ class Optimizer:
             return jax.jit(self._wrap_checkify(step), donate_argnums=(0, 1, 2))
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    # ------------------------------------------------- fused window compile
+    def _make_window_fn(self, k: int):
+        """K optimizer steps as ONE program: ``lax.scan`` over the leading
+        (window) axis of a stacked super-batch, params/model-state/optimizer-
+        state in the carry, per-step losses and observable state scalars in
+        the scan outputs — they stay device-resident until the loop's batched
+        fetch, so a K-window costs one dispatch and zero per-step host syncs."""
+        step = self._make_step_fn()
+        unroll = self._window_unroll(k)
+
+        def window(params, mstate, ostate, step_idx0, inp, target, base_rng):
+            def body(carry, xs):
+                p, ms, os_ = carry
+                x, t, off = xs
+                p, ms, os_, loss = step(p, ms, os_, step_idx0 + off, x, t,
+                                        base_rng)
+                sm = tuple(v for _, v in self._collect_state_metrics(ms))
+                return (p, ms, os_), (loss, sm)
+
+            (params, mstate, ostate), (losses, sms) = jax.lax.scan(
+                body, (params, mstate, ostate),
+                (inp, target, jnp.arange(k, dtype=jnp.int32)), unroll=unroll)
+            return params, mstate, ostate, losses, sms
+
+        return window
+
+    @staticmethod
+    def _window_unroll(k: int) -> int:
+        """Scan unroll factor for the fused window (``BIGDL_FUSE_UNROLL``:
+        "auto" | int, clamped to [1, K]). XLA:CPU codegens while-loop bodies
+        ~2x slower than the same ops straight-line (measured here: LeNet step
+        214 ms/step rolled vs 115 ms/step fully unrolled), so "auto" unrolls
+        fully on CPU; TPU keeps the rolled scan — its loop codegen carries no
+        such penalty and compile time scales with unroll x body size."""
+        raw = os.environ.get("BIGDL_FUSE_UNROLL", "auto").strip().lower()
+        if raw in ("auto", ""):
+            try:
+                platform = Engine.devices()[0].platform
+            except Exception:
+                platform = "cpu"
+            return k if platform == "cpu" else 1
+        return max(1, min(int(raw), k))
+
+    def _wrap_checkify_window(self, window):
+        """Sanitizer wrap for the fused path: the whole scanned window runs
+        under checkify (checkify composes through ``lax.scan``), so a NaN/inf
+        produced at ANY step of the window surfaces — with the generating
+        op's location — at the window's loss flush."""
+        from jax.experimental import checkify
+
+        def window_guarded(*args):
+            params, mstate, ostate, losses, sms = window(*args)
+            checkify.check(jnp.all(jnp.isfinite(losses)),
+                           "non-finite loss (divergence) in fused window: "
+                           "min {loss}", loss=jnp.min(losses))
+            return params, mstate, ostate, losses, sms
+
+        checked = checkify.checkify(
+            window_guarded,
+            errors=checkify.float_checks | checkify.user_checks)
+
+        def window_with_err(*args):
+            err, out = checked(*args)
+            return (*out, err)
+
+        return window_with_err
+
+    def _compile_window(self, k: int):
+        window = self._make_window_fn(k)
+        if self.check_numerics:
+            window = self._wrap_checkify_window(window)
+        return jax.jit(window, donate_argnums=(0, 1, 2))
+
+    def _state_metric_tags(self, mstate) -> list:
+        """Tags of the observable state scalars, in the same order the traced
+        window's scan outputs carry their stacked values."""
+        return [t for t, _ in self._collect_state_metrics(mstate)]
+
+    def _fusible_steps(self, state: dict) -> int:
+        """How many iterations, starting at ``state['neval']``, may run inside
+        one fused dispatch without an in-loop trigger firing strictly before
+        the window's end (a trigger firing exactly AT the window end is fine —
+        triggers are evaluated after the window completes, at the same
+        iteration a per-step loop would evaluate them). Per-step debug modes
+        (profiler trace, synchronous metrics) force per-step dispatch."""
+        if self.profile_dir is not None or getattr(self, "_profiling", False) \
+                or self.sync_metrics:
+            return 1
+        bound = self.end_when.next_fire_in(state)
+        for trig in (self.val_trigger, self.checkpoint_trigger):
+            if trig is not None and self._in_scope(trig, boundary=False):
+                bound = min(bound, trig.next_fire_in(state))
+        if self.train_summary is not None \
+                and hasattr(self.train_summary, "get_summary_trigger"):
+            ptrig = self.train_summary.get_summary_trigger("Parameters")
+            if ptrig is not None:
+                bound = min(bound, ptrig.next_fire_in(state))
+        return bound
+
     def _make_eval_fn(self):
         from bigdl_tpu.optim.evaluator import cached_forward_jit
         return cached_forward_jit(self.model)
@@ -562,6 +692,7 @@ class Optimizer:
         # dtype change invalidates too: cached inputs are placed pre-cast to
         # the compute dtype and must not leak into a different-precision run
         self._device_batch_cache = None
+        self._window_cache_bytes = 0.0
         self._device_cache_ds = ds
         self._device_cache_dtype = cdt
         if os.environ.get("BIGDL_DEVICE_CACHE", "1") == "0":
@@ -597,6 +728,46 @@ class Optimizer:
     def _place_batch(self, batch: MiniBatch):
         return (jax.device_put(self._feed_cast(batch.input)),
                 jax.device_put(batch.target))
+
+    @staticmethod
+    def _stack_window(xs: list):
+        """Stack a window of per-batch (possibly nested) host pytrees along a
+        new leading scan axis — host-side, in the producer thread, so the
+        stacked super-batch ships as ONE h2d transfer."""
+        return jax.tree_util.tree_map(lambda *leaves: np.stack(leaves), *xs)
+
+    def _put_window(self, batches: list):
+        """Feed path for fused dispatch: a FULL window of ``fuse_steps``
+        batches becomes one device-stacked super-batch (leading scan axis);
+        a partial trailing window degrades to a list of per-batch placements
+        (the loop runs those per-step). Stacked windows ride the device batch
+        cache too, but keyed by batch-identity tuples — shuffled epochs form
+        new windows, so the window cache is additionally byte-bounded by
+        BIGDL_DEVICE_CACHE_MB (beyond it, windows place uncached)."""
+        if len(batches) < self.fuse_steps:
+            return [self._put_batch(b) for b in batches]
+        cache = self._device_batch_cache
+        key = tuple(id(b) for b in batches)
+        if cache is not None:
+            hit = cache.get(key)
+            if hit is not None and all(a is b for a, b in zip(hit[0], batches)):
+                return hit[1]
+        with self.metrics.timer("put_batch"):
+            placed = self._place_window(batches)
+        if cache is not None:
+            nbytes = sum(getattr(b.input, "nbytes", 0)
+                         + getattr(b.target, "nbytes", 0) for b in batches)
+            if self._window_cache_bytes + nbytes <= self.device_cache_mb * 1e6:
+                cache[key] = (list(batches), placed)
+                self._window_cache_bytes += nbytes
+        return placed
+
+    def _place_window(self, batches: list):
+        inp = self._stack_window([b.input for b in batches])
+        target = self._stack_window([b.target for b in batches])
+        return (jax.device_put(
+                    jax.tree_util.tree_map(self._feed_cast, inp)),
+                jax.device_put(target))
 
     @staticmethod
     def _feed_cast(x):
@@ -705,6 +876,17 @@ class Optimizer:
             self._step_cache_dtype = cdt
             self._step_cache_scales = scales_key
         step_fn = self._step_cache
+        # fused-window program cache: keyed like the step cache plus the
+        # window size (a new K is a new scan trip count = a new program)
+        fuse = max(1, int(self.fuse_steps))
+        window_fn = None
+        if fuse > 1:
+            wkey = (cdt, scales_key, fuse)
+            if self._window_cache is None \
+                    or getattr(self, "_window_cache_key", None) != wkey:
+                self._window_cache = self._compile_window(fuse)
+                self._window_cache_key = wkey
+            window_fn = self._window_cache
         base_rng = RandomGenerator.next_key()
         self._setup_device_cache()
 
@@ -722,12 +904,49 @@ class Optimizer:
         stop = False
         self._profiling = False
 
+        def flush_and_log(start_it: int, end_it: int) -> None:
+            """Log-boundary handling for completed iterations
+            ``[start_it, end_it]``: when a ``log_every`` boundary was crossed,
+            fetch all complete losses in one round trip; the newest entry stays
+            pending so the fetch never stalls on the in-flight step or window
+            (preserves the lagged logging semantics). The fetch doubles as the
+            throughput window's device sync, so records (counted per flushed
+            step) over dt is honest completion throughput, not host dispatch
+            rate."""
+            nonlocal records, window_t0
+            if (end_it // self.log_every) <= ((start_it - 1) // self.log_every):
+                return  # no log boundary inside [start_it, end_it]
+            records += self._flush_pending(pending, state, keep_last=True)
+            if "loss" in state and records > 0:
+                dt = time.perf_counter() - window_t0
+                thr = records / dt if dt > 0 else 0.0
+                state["throughput"] = thr
+                drops = [v for t, v in
+                         (state.get("state_metrics") or {}).items()
+                         if t.endswith("dropped_fraction")]
+                logger.info(
+                    "Epoch %d iter %d: loss %.6f, %.1f records/s%s",
+                    state["epoch"], state["neval"], state["loss"],
+                    thr,
+                    (", moe drop %.1f%%" % (100 * max(drops))
+                     if drops else ""))
+                records = 0
+                window_t0 = time.perf_counter()
+            elif "loss" in state:
+                # nothing fetched yet this window (e.g. the first
+                # boundaries after a warm start) — loss only, and the
+                # window keeps accumulating
+                logger.info("Epoch %d iter %d: loss %.6f",
+                            state["epoch"], state["neval"], state["loss"])
+
         while not stop:
             state["epoch_finished"] = False
             self.dataset.shuffle()
             epoch_had_data = False
-            feed = PrefetchingFeed(lambda: self.dataset.data(train=True),
-                                   self._put_batch, self.prefetch_depth)
+            feed = PrefetchingFeed(
+                lambda: self.dataset.data(train=True),
+                self._put_window if fuse > 1 else self._put_batch,
+                self.prefetch_depth, window=fuse)
             with feed:
                 feed_it = iter(feed)
                 while True:
@@ -740,91 +959,155 @@ class Optimizer:
                     # steady state the producer thread hides assembly + transfer
                     with self.metrics.timer("feed"):
                         try:
-                            batch, (inp, target) = next(feed_it)
+                            item, placed = next(feed_it)
                         except StopIteration:
                             break
                     epoch_had_data = True
 
-                    if self.profile_dir is not None and not self._profiling \
-                            and state["neval"] >= self.profile_start_iter:
-                        jax.profiler.start_trace(self.profile_dir)
-                        self._profiling = True
-                        profile_stop_at = state["neval"] + self.profile_n_iters
-
-                    step_idx = jnp.asarray(state["neval"] - 1, jnp.int32)
-                    with self.metrics.timer("step_dispatch"):
-                        out = step_fn(
-                            params, mstate, ostate, step_idx, inp, target, base_rng)
-                    if self.check_numerics:
-                        params, mstate, ostate, loss, err = out
+                    batches = item if fuse > 1 else [item]
+                    # full windows arrive device-stacked (leading scan axis);
+                    # partial trailing windows (and fuse==1) arrive as
+                    # per-batch placements
+                    stacked = singles = None
+                    if fuse > 1 and not isinstance(placed, list):
+                        stacked = placed
                     else:
-                        (params, mstate, ostate, loss), err = out, None
-                    run_iters += 1
-                    if self.sync_metrics:
-                        with self.metrics.timer("step_device"):
-                            jax.block_until_ready(loss)
+                        singles = placed if fuse > 1 else [placed]
 
-                    if self._profiling and state["neval"] + 1 >= profile_stop_at:
-                        jax.block_until_ready(loss)
-                        jax.profiler.stop_trace()
-                        self._profiling = False
-                        self.profile_dir = None  # one window per optimize()
-                        logger.info("profiler trace captured")
-
-                    smetrics = self._collect_state_metrics(mstate)
-                    if run_iters == 1:
-                        # First step of this optimize() call absorbs compile, param
-                        # re-placement, and feed spin-up. Wait for it, then start the
-                        # throughput window — one-time costs must not be billed to
-                        # steady-state throughput (round-2 bench bug).
-                        val = float(jax.device_get(loss))
-                        if err is not None:
-                            jax.device_get(err).throw()
-                        state["loss"] = val
-                        fetched = {t: float(jax.device_get(v))
-                                   for t, v in smetrics}
-                        if fetched:
-                            state["state_metrics"] = fetched
-                        self._write_iter_summary(state["neval"], val, state,
-                                                 fetched)
-                        records = 0
-                        window_t0 = time.perf_counter()
-                    else:
-                        pending.append((state["neval"], loss, batch.valid, err,
-                                        smetrics))
-                    if state["neval"] % self.log_every == 0:
-                        # fetch all complete losses in one round trip; the newest
-                        # stays pending so the fetch never stalls on the in-flight
-                        # step (preserves the one-step-lagged logging semantics).
-                        # The fetch doubles as the window's device sync, so
-                        # records (counted per flushed step) over dt is honest
-                        # completion throughput, not host dispatch rate.
-                        records += self._flush_pending(pending, state, keep_last=True)
-                        if "loss" in state and records > 0:
-                            dt = time.perf_counter() - window_t0
-                            thr = records / dt if dt > 0 else 0.0
-                            state["throughput"] = thr
-                            drops = [v for t, v in
-                                     (state.get("state_metrics") or {}).items()
-                                     if t.endswith("dropped_fraction")]
-                            logger.info(
-                                "Epoch %d iter %d: loss %.6f, %.1f records/s%s",
-                                state["epoch"], state["neval"], state["loss"],
-                                thr,
-                                (", moe drop %.1f%%" % (100 * max(drops))
-                                 if drops else ""))
+                    if stacked is not None \
+                            and (run_iters > 0 or self._state_materialized) \
+                            and self._fusible_steps(state) >= len(batches):
+                        # -------- fused dispatch: K steps, ONE compiled scan,
+                        # losses/metrics device-resident until the next flush
+                        k = len(batches)
+                        start_it = state["neval"]
+                        step_idx0 = jnp.asarray(start_it - 1, jnp.int32)
+                        inp, target = stacked
+                        with self.metrics.timer("step_dispatch"):
+                            out = window_fn(params, mstate, ostate, step_idx0,
+                                            inp, target, base_rng)
+                        if self.check_numerics:
+                            params, mstate, ostate, losses, sms, err = out
+                        else:
+                            (params, mstate, ostate, losses, sms), err = \
+                                out, None
+                        first = run_iters == 0
+                        run_iters += k
+                        tags = self._state_metric_tags(mstate)
+                        if first:
+                            # first dispatch of this (continuation) optimize():
+                            # absorb compile/re-placement synchronously and
+                            # start the throughput window at the window's end —
+                            # one-time costs must not bill to steady state
+                            vals, sm_vals = jax.device_get((losses, sms))
+                            if err is not None:
+                                jax.device_get(err).throw()
+                            for i in range(k):
+                                metrics = {t: float(s[i])
+                                           for t, s in zip(tags, sm_vals)}
+                                state["loss"] = float(vals[i])
+                                if metrics:
+                                    state["state_metrics"] = metrics
+                                self._write_iter_summary(
+                                    start_it + i, float(vals[i]), state, metrics)
                             records = 0
                             window_t0 = time.perf_counter()
-                        elif "loss" in state:
-                            # nothing fetched yet this window (e.g. the first
-                            # boundaries after a warm start) — loss only, and the
-                            # window keeps accumulating
-                            logger.info("Epoch %d iter %d: loss %.6f",
-                                        state["epoch"], state["neval"], state["loss"])
+                        else:
+                            for i in range(k):
+                                # per-step exactness survives fusion: every
+                                # step's loss/metric scalars queue individually
+                                # (summaries land with their true iteration);
+                                # the window's joined checkify error rides the
+                                # LAST entry so any flush covering the window
+                                # surfaces it
+                                pending.append(
+                                    (start_it + i, losses[i], batches[i].valid,
+                                     err if i == k - 1 else None,
+                                     [(t, s[i]) for t, s in zip(tags, sms)],
+                                     start_it))  # dispatch group = window start
+                        state["neval"] = start_it + k - 1
+                        flush_and_log(start_it, state["neval"])
+                        # no in-loop trigger can have fired STRICTLY inside
+                        # the window (_fusible_steps clipped it); evaluating
+                        # once at the window end is per-step exact
+                        self._fire_triggers(params, mstate, ostate, state,
+                                            boundary=False, pending=pending)
+                        state["neval"] += 1
+                        continue
 
-                    self._fire_triggers(params, mstate, ostate, state,
-                                        boundary=False, pending=pending)
-                    state["neval"] += 1
+                    # ---------- per-step dispatch: fuse==1, the run's first
+                    # window (absorbs compile and may materialize module-state
+                    # structure a scan carry could not morph), a partial
+                    # trailing window, or a trigger boundary inside the window
+                    for i, batch in enumerate(batches):
+                        if i > 0 and self.end_when(state):
+                            stop = True
+                            break
+                        if singles is not None:
+                            inp, target = singles[i]
+                        else:
+                            # boundary fallback: slice this step's batch out of
+                            # the stacked window (a device-side view; no h2d)
+                            inp, target = jax.tree_util.tree_map(
+                                lambda a: a[i], stacked)
+
+                        if self.profile_dir is not None and not self._profiling \
+                                and state["neval"] >= self.profile_start_iter:
+                            jax.profiler.start_trace(self.profile_dir)
+                            self._profiling = True
+                            profile_stop_at = state["neval"] + self.profile_n_iters
+
+                        step_idx = jnp.asarray(state["neval"] - 1, jnp.int32)
+                        with self.metrics.timer("step_dispatch"):
+                            out = step_fn(
+                                params, mstate, ostate, step_idx, inp, target,
+                                base_rng)
+                        if self.check_numerics:
+                            params, mstate, ostate, loss, err = out
+                        else:
+                            (params, mstate, ostate, loss), err = out, None
+                        run_iters += 1
+                        if self.sync_metrics:
+                            with self.metrics.timer("step_device"):
+                                jax.block_until_ready(loss)
+
+                        if self._profiling and state["neval"] + 1 >= profile_stop_at:
+                            jax.block_until_ready(loss)
+                            jax.profiler.stop_trace()
+                            self._profiling = False
+                            self.profile_dir = None  # one window per optimize()
+                            logger.info("profiler trace captured")
+
+                        smetrics = self._collect_state_metrics(mstate)
+                        if run_iters == 1:
+                            # First step of this optimize() call absorbs compile, param
+                            # re-placement, and feed spin-up. Wait for it, then start the
+                            # throughput window — one-time costs must not be billed to
+                            # steady-state throughput (round-2 bench bug).
+                            val = float(jax.device_get(loss))
+                            if err is not None:
+                                jax.device_get(err).throw()
+                            state["loss"] = val
+                            fetched = {t: float(jax.device_get(v))
+                                       for t, v in smetrics}
+                            if fetched:
+                                state["state_metrics"] = fetched
+                            self._write_iter_summary(state["neval"], val, state,
+                                                     fetched)
+                            # a full step completed: module state is
+                            # materialized, future windows may fuse from item 1
+                            self._state_materialized = True
+                            records = 0
+                            window_t0 = time.perf_counter()
+                        else:
+                            pending.append((state["neval"], loss, batch.valid,
+                                            err, smetrics, state["neval"]))
+                        flush_and_log(state["neval"], state["neval"])
+                        self._fire_triggers(params, mstate, ostate, state,
+                                            boundary=False, pending=pending)
+                        state["neval"] += 1
+                    if stop:
+                        break
             if stop:
                 break
             if not epoch_had_data:
@@ -867,19 +1150,37 @@ class Optimizer:
     def _flush_pending(self, pending: list, state: dict, keep_last: bool) -> int:
         """Fetch queued device losses in ONE host round trip, write their exact
         per-iteration summary scalars, and update ``state['loss']``. With
-        ``keep_last`` the newest entry stays queued (it may still be in flight).
+        ``keep_last`` the newest DISPATCH stays queued while it is still in
+        flight: one step in per-step mode, the whole newest window in fused
+        mode — all of a window's scalars live in one program's outputs, so
+        fetching any of them would sync the entire window. If the newest
+        dispatch has already completed (``is_ready`` — always true under
+        synchronous CPU dispatch), it is fetched too: the flush never stalls,
+        and the throughput window's record count matches the work its wall
+        clock actually covered.
         Returns the number of records covered by the fetched (= completed) steps."""
-        to_fetch = pending[:-1] if keep_last else list(pending)
+        if keep_last and pending:
+            try:
+                ready = bool(pending[-1][1].is_ready())
+            except Exception:
+                ready = False  # can't probe → conservatively keep it queued
+            if ready:
+                to_fetch = list(pending)
+            else:
+                last_group = pending[-1][5]
+                to_fetch = [e for e in pending if e[5] != last_group]
+        else:
+            to_fetch = list(pending)
         if not to_fetch:
             return 0
         with self.metrics.timer("loss_fetch"):
             vals, errs, mvals = jax.device_get(
-                ([l for _, l, _, _, _ in to_fetch],
-                 [e for _, _, _, e, _ in to_fetch],
-                 [[v for _, v in m] for _, _, _, _, m in to_fetch]))
+                ([l for _, l, _, _, _, _ in to_fetch],
+                 [e for _, _, _, e, _, _ in to_fetch],
+                 [[v for _, v in m] for _, _, _, _, m, _ in to_fetch]))
         records = 0
-        for (it, _, valid, _, sm), v, err, mv in zip(to_fetch, vals, errs,
-                                                     mvals):
+        for (it, _, valid, _, sm, _), v, err, mv in zip(to_fetch, vals, errs,
+                                                        mvals):
             if err is not None:
                 err.throw()  # checkify sanitizer: NaN/inf with op location
             state["loss"] = float(v)

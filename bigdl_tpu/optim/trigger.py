@@ -9,21 +9,46 @@ Reference parity (SURVEY.md §2.3, expected ``<dl>/optim/Trigger.scala`` — unv
 
 from __future__ import annotations
 
-from typing import Callable
+import sys
+from typing import Callable, Optional
 
 
 class Trigger:
     """``scope`` controls when side-effect triggers are evaluated by the trainer:
-    'iteration' (inside the batch loop), 'epoch' (at epoch boundaries), or 'any'."""
+    'iteration' (inside the batch loop), 'epoch' (at epoch boundaries), or 'any'.
+
+    ``steps_fn`` (optional) answers the fused-dispatch boundary query
+    (:meth:`next_fire_in`): given the trainer state with ``neval`` = the
+    iteration about to run, how many iterations may execute before this
+    trigger must be re-evaluated. Schedule-driven factories provide it;
+    data-dependent triggers (minLoss/maxScore) leave it unset, which the
+    trainer reads as "could fire after any iteration" (no fusion past it).
+    """
+
+    #: next_fire_in value meaning "cannot fire inside the batch loop at all"
+    #: (epoch-scoped / epoch-counted triggers) — effectively no constraint.
+    NEVER_IN_LOOP = sys.maxsize
 
     def __init__(self, fn: Callable[[dict], bool], name: str = "trigger",
-                 scope: str = "any"):
+                 scope: str = "any",
+                 steps_fn: Optional[Callable[[dict], int]] = None):
         self._fn = fn
         self._name = name
         self.scope = scope
+        self._steps_fn = steps_fn
 
     def __call__(self, state: dict) -> bool:
         return bool(self._fn(state))
+
+    def next_fire_in(self, state: dict) -> int:
+        """Iterations (>= 1) that may run, starting at ``state['neval']``,
+        before this trigger could first fire. A window fused over exactly this
+        many steps evaluates the trigger at the same iteration a per-step loop
+        would — ``1`` means "evaluate after every step" (the conservative
+        default for data-dependent triggers)."""
+        if self._steps_fn is None:
+            return 1
+        return max(1, int(self._steps_fn(state)))
 
     def __repr__(self):
         return f"Trigger({self._name})"
@@ -31,22 +56,33 @@ class Trigger:
     # factories ------------------------------------------------------------
     @staticmethod
     def every_epoch() -> "Trigger":
+        # epoch_finished is only set at epoch boundaries, never inside the
+        # batch loop — no in-loop fusion constraint
         return Trigger(lambda s: s.get("epoch_finished", False), "everyEpoch",
-                       scope="epoch")
+                       scope="epoch",
+                       steps_fn=lambda s: Trigger.NEVER_IN_LOOP)
 
     @staticmethod
     def several_iteration(interval: int) -> "Trigger":
+        # fires at iterations i with i % interval == 0; from neval=cur the
+        # first such i is cur + ((-cur) % interval), and a window may cover
+        # it inclusively (triggers are evaluated after the step completes)
         return Trigger(lambda s: s.get("neval", 0) % interval == 0,
-                       f"severalIteration({interval})", scope="iteration")
+                       f"severalIteration({interval})", scope="iteration",
+                       steps_fn=lambda s: ((-s.get("neval", 0)) % interval) + 1)
 
     @staticmethod
     def max_epoch(n: int) -> "Trigger":
-        return Trigger(lambda s: s.get("epoch", 1) > n, f"maxEpoch({n})")
+        # depends only on the epoch counter, which is constant inside the loop
+        return Trigger(lambda s: s.get("epoch", 1) > n, f"maxEpoch({n})",
+                       steps_fn=lambda s: Trigger.NEVER_IN_LOOP)
 
     @staticmethod
     def max_iteration(n: int) -> "Trigger":
-        # checked at loop top with neval starting at 1 → runs exactly n iterations
-        return Trigger(lambda s: s.get("neval", 0) > n, f"maxIteration({n})")
+        # checked at loop top with neval starting at 1 → runs exactly n iterations;
+        # from neval=cur exactly n - cur + 1 iterations remain runnable
+        return Trigger(lambda s: s.get("neval", 0) > n, f"maxIteration({n})",
+                       steps_fn=lambda s: n - s.get("neval", 0) + 1)
 
     @staticmethod
     def min_loss(value: float) -> "Trigger":
@@ -59,8 +95,16 @@ class Trigger:
 
     @staticmethod
     def and_(*triggers: "Trigger") -> "Trigger":
-        return Trigger(lambda s: all(t(s) for t in triggers), "and")
+        # fires only when ALL children fire, so it cannot fire before the
+        # latest first-possible-fire among them; an unpredictable child
+        # contributes 1 (could be true any time) and does not constrain the max
+        return Trigger(lambda s: all(t(s) for t in triggers), "and",
+                       steps_fn=lambda s: max(
+                           (t.next_fire_in(s) for t in triggers), default=1))
 
     @staticmethod
     def or_(*triggers: "Trigger") -> "Trigger":
-        return Trigger(lambda s: any(t(s) for t in triggers), "or")
+        # fires as soon as ANY child fires: the earliest child bound wins
+        return Trigger(lambda s: any(t(s) for t in triggers), "or",
+                       steps_fn=lambda s: min(
+                           (t.next_fire_in(s) for t in triggers), default=1))

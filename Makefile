@@ -15,10 +15,12 @@ dist:
 bench:
 	python bench.py
 
-# CPU smoke of the bench's training leg: catches loop-overhead regressions
-# (loop_step_ratio, fused vs per-step legs) without a TPU.
+# CPU smoke of the bench's training + eval legs: catches loop-overhead
+# regressions (loop_step_ratio, fused vs per-step legs) and eval-path
+# regressions (eval fused speedup, val_fetch_bytes_per_image) without a TPU.
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --model lenet --no-compare-dtypes --no-streamed
+	JAX_PLATFORMS=cpu python bench.py --model lenet --eval-bench --no-compare-dtypes --no-streamed
 
 multichip:
 	python -m bigdl_tpu.cli dryrun-multichip -n 8

@@ -609,6 +609,63 @@ def _measure_decode_infer(batch: int, prompt_len: int = 32,
             "cached_beam4_tokens_per_sec": round(beam_tps, 1)}
 
 
+def _measure_eval(model_name: str, batch: int, iters: int) -> dict:
+    """Eval-throughput leg: Evaluator.test through the device-resident
+    fused-window path (BIGDL_EVAL_FUSE_STEPS stacked batches per jitted
+    forward+fold scan, O(1) metric scalars fetched per pass) vs the per-batch
+    path (fuse_steps=1) on the same warm model — plus the honest d2h
+    accounting (``val_fetch_bytes_per_image``: accuracy-only eval fetches a
+    couple of scalars per PASS, so this reads ~0, vs 4 x num_classes bytes
+    per image when logits come home)."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim.evaluator import Evaluator, eval_fuse_steps
+    from bigdl_tpu.optim.validation import Top1Accuracy
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    Engine.init(compute_dtype=jnp.bfloat16)
+    dev = Engine.devices()[0]
+    n_batches = 8
+    fuse = eval_fuse_steps(os.environ.get("BIGDL_EVAL_FUSE_STEPS", "8"))
+    model, dataset, _ = _build(model_name, batch, n_batches=n_batches,
+                               dtype="bf16")
+    model.evaluate()
+    evaluator = Evaluator(model)
+    methods = [Top1Accuracy()]
+    total = batch * n_batches
+
+    def timed(fuse_steps):
+        evaluator.test(dataset, methods, fuse_steps=fuse_steps)  # compile+warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            evaluator.test(dataset, methods, fuse_steps=fuse_steps)
+        return total * iters / (time.perf_counter() - t0), evaluator.last_stats
+
+    fused_sps, fused_stats = timed(fuse)
+    perstep_sps, perstep_stats = timed(1)
+    unit, per_sample = _MODEL_UNITS.get(model_name, ("records", 1))
+    return {
+        "value": round(fused_sps * per_sample, 1),
+        "unit": f"{unit}/sec",
+        "batch": batch,
+        "dtype": "bf16",
+        "eval_fuse_steps": fuse,
+        f"eval_{unit}_per_sec_fused": round(fused_sps * per_sample, 1),
+        f"eval_{unit}_per_sec_perstep": round(perstep_sps * per_sample, 1),
+        "eval_fused_speedup": (round(fused_sps / perstep_sps, 3)
+                               if perstep_sps else None),
+        "val_fetch_bytes_per_image": round(
+            fused_stats["fetch_bytes"] / total, 4),
+        "val_fetch_bytes_per_image_perstep": round(
+            perstep_stats["fetch_bytes"] / total, 4),
+        "val_wait_ms": round(fused_stats["wait_ms"], 2),
+        "fused_windows": fused_stats["fused_windows"],
+        "device_kind": dev.device_kind,
+        "platform": dev.platform,
+    }
+
+
 def _measure_serving(model_name: str, batch: int, iters: int) -> dict:
     """Serving-path micro-bench: Predictor.predict and Evaluator.test
     throughput through the framework's own eval machinery (per-batch h2d,
@@ -918,6 +975,8 @@ def run_orchestrator(args) -> None:
         worker_argv.append("--decode-infer")
     if args.ablate:
         worker_argv.append("--ablate")
+    if args.eval_bench:
+        worker_argv.append("--eval-bench")
     env = dict(os.environ)
     # Fast-fail: one cheap bounded probe decides whether the accelerator
     # backend answers AT ALL before any full measurement attempt is allowed
@@ -943,7 +1002,8 @@ def run_orchestrator(args) -> None:
             # discard the good primary number above
             if args.compare_dtypes and args.dtype == "bf16" \
                     and not args.int8_infer and not args.serving \
-                    and not args.decode_infer and not args.ablate:
+                    and not args.decode_infer and not args.ablate \
+                    and not args.eval_bench:
                 # the comparison leg only feeds the ratio — skip its streamed
                 # measurement (it would be discarded)
                 cmp_argv = ["--run", "--model", args.model,
@@ -979,12 +1039,14 @@ def run_orchestrator(args) -> None:
     if probe_err:
         attempts.append(f"probe: {probe_err}")
 
-    if args.int8_infer or args.serving or args.decode_infer or args.ablate:
+    if args.int8_infer or args.serving or args.decode_infer or args.ablate \
+            or args.eval_bench:
         # a LeNet training number would not answer an inference-path request:
         # fail loudly with the metric the caller asked for
         kind = ("int8_vs_bf16_infer" if args.int8_infer
                 else "serving" if args.serving
-                else "decode_infer" if args.decode_infer else "step_ablation")
+                else "decode_infer" if args.decode_infer
+                else "eval_throughput" if args.eval_bench else "step_ablation")
         _emit({
             "metric": f"{args.model}_{kind}",
             "value": None,
@@ -1051,6 +1113,9 @@ def main(argv=None):
     p.add_argument("--ablate", action="store_true",
                    help="step-time attribution: fwd / fwd+bwd / update "
                         "sub-program timings + XLA cost-analysis roofline")
+    p.add_argument("--eval-bench", action="store_true",
+                   help="eval-throughput leg: device-resident fused eval "
+                        "windows vs per-batch eval, plus d2h bytes/image")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
     args = p.parse_args(argv)
@@ -1078,6 +1143,11 @@ def _run_worker_modes(args) -> int:
     elif args.decode_infer:
         res = _measure_decode_infer(min(args.batch, 16))
         res["metric"] = "transformerlm_decode_infer"
+        res["vs_baseline"] = None
+        print(json.dumps(res))
+    elif args.eval_bench:
+        res = _measure_eval(args.model, args.batch, max(args.iters // 4, 3))
+        res["metric"] = f"{args.model}_eval_throughput"
         res["vs_baseline"] = None
         print(json.dumps(res))
     elif args.ablate:

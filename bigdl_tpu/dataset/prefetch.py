@@ -19,6 +19,11 @@ Design:
   at epoch end is delivered as a shorter list — the trainer falls back to per-step
   dispatch for it. Queue items are ``(batches, placed)`` either way; with windowing,
   ``batches`` is a list.
+- ``train=False`` selects eval-window semantics: the trailing partial group is split
+  into SINGLE-batch groups instead of one shorter list. An eval consumer then sees
+  exactly two static shapes — the full K-window (fused scan program) and the single
+  batch (per-batch program) — so a ragged tail never forces a fresh XLA compile per
+  distinct tail length the way stacking a variable-K remainder would.
 - Exceptions in the producer surface in the consumer (training loop) with their original
   traceback as ``__cause__``.
 - ``close()`` (also on ``__exit__`` / generator abandonment) stops the producer promptly —
@@ -49,13 +54,16 @@ class PrefetchingFeed:
     with ``window > 1`` it receives a LIST of up to ``window`` MiniBatches instead.
     ``depth``: producer queue bound (placed batches in flight); 0 = synchronous.
     ``window``: fused-dispatch group size; 1 (default) feeds single batches.
+    ``train``: window-tail policy — True delivers the trailing partial group as
+    one shorter list (trainer falls back per-step); False (eval mode) splits it
+    into single-batch groups so eval programs keep exactly two static shapes.
     """
 
     #: close() waits this long for the producer before declaring it leaked
     JOIN_TIMEOUT = 5.0
 
     def __init__(self, make_iter: Callable[[], Iterator], put_fn: Callable,
-                 depth: int = 2, window: int = 1):
+                 depth: int = 2, window: int = 1, train: bool = True):
         if depth < 0:
             raise ValueError(f"depth must be >= 0, got {depth}")
         if window < 1:
@@ -64,6 +72,7 @@ class PrefetchingFeed:
         self.put_fn = put_fn
         self.depth = depth
         self.window = window
+        self.train = train
         self._queue: queue.Queue | None = None
         self._stop: threading.Event | None = None
         self._thread: threading.Thread | None = None
@@ -84,10 +93,24 @@ class PrefetchingFeed:
 
     def _grouped(self, it):
         """Group the epoch iterator into ``window``-sized lists (trailing
-        partial list included) when windowing; pass through otherwise."""
+        partial list included) when windowing; pass through otherwise. Eval
+        mode (``train=False``) splits the partial tail into singleton groups
+        instead — two static shapes total for the consumer's programs."""
         if self.window == 1:
             return it
-        return iter(lambda: list(itertools.islice(it, self.window)), [])
+        groups = iter(lambda: list(itertools.islice(it, self.window)), [])
+        if self.train:
+            return groups
+
+        def eval_groups():
+            for group in groups:
+                if len(group) == self.window:
+                    yield group
+                else:
+                    for batch in group:
+                        yield [batch]
+
+        return eval_groups()
 
     def _produce(self, it, q: queue.Queue, stop: threading.Event) -> None:
         try:
@@ -125,7 +148,8 @@ class PrefetchingFeed:
         self._queue = queue.Queue(maxsize=self.depth)
         self._thread = threading.Thread(
             target=self._produce, args=(self.make_iter(), self._queue, self._stop),
-            name="bigdl-prefetch", daemon=True)
+            name="bigdl-prefetch" if self.train else "bigdl-prefetch-eval",
+            daemon=True)
         self._thread.start()
         try:
             while True:

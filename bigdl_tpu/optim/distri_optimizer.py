@@ -172,9 +172,6 @@ class DistriOptimizer(Optimizer):
         return (jax.device_put(inp, self._window_sh),
                 jax.device_put(target, self._window_sh))
 
-    def _put_input(self, batch):
-        return jax.device_put(self._feed_cast(batch.input), self._batch_sh)
-
     def _optimize_impl(self):
         # compile path sets mesh/shardings before the first _put_batch
         logger.info("DistriOptimizer: mesh=%s sync=%s",

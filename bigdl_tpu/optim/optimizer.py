@@ -672,10 +672,6 @@ class Optimizer:
                 bound = min(bound, ptrig.next_fire_in(state))
         return bound
 
-    def _make_eval_fn(self):
-        from bigdl_tpu.optim.evaluator import cached_forward_jit
-        return cached_forward_jit(self.model)
-
     def _setup_device_cache(self) -> None:
         """Enable the device batch cache when the dataset re-yields identical
         MiniBatch objects (plain LocalDataSet — transformed pipelines build
@@ -779,11 +775,6 @@ class Optimizer:
         if cdt != jnp.float32 and getattr(x, "dtype", None) == np.float32:
             return np.asarray(x).astype(cdt)  # bf16 is a valid numpy dtype here
         return x
-
-    def _put_input(self, batch: MiniBatch):
-        """Inputs-only placement for the eval path (targets stay on host there).
-        Same pre-transfer cast as the train feed — the eval jit casts anyway."""
-        return jax.device_put(self._feed_cast(batch.input))
 
     # ------------------------------------------------------------ optimize
     def _stop_profiler_if_active(self) -> None:
@@ -1299,31 +1290,33 @@ class Optimizer:
     def _run_validation(self, params, mstate, state) -> None:
         if self.val_dataset is None or not self.val_methods:
             return
-        eval_fn = getattr(self, "_eval_fn", None)
-        if eval_fn is None:
-            eval_fn = self._eval_fn = self._make_eval_fn()
-        results = [None] * len(self.val_methods)
-
-        def _apply(outs_host, metas):
-            for out, (target, valid) in zip(outs_host, metas):
-                for i, m in enumerate(self.val_methods):
-                    r = m.apply(np.asarray(out), target, valid)
-                    results[i] = r if results[i] is None else results[i] + r
-
-        # dispatch eval steps asynchronously and fetch outputs in chunks — one
-        # host round trip per chunk instead of per batch (this backend charges
-        # ~75 ms per fetch; per-batch sync made validation throughput ugly)
-        from bigdl_tpu.optim.evaluator import _fetch as _fetch_eval
-        chunk, metas = [], []
-        for batch in self.val_dataset.data(train=False):
-            inp = self._put_input(batch)
-            chunk.append(eval_fn(params, mstate, inp))
-            metas.append((np.asarray(batch.target), batch.valid))
-            if len(chunk) >= 16:
-                _apply(_fetch_eval(chunk), metas)
-                chunk, metas = [], []
-        if chunk:
-            _apply(_fetch_eval(chunk), metas)
+        # Device-resident evaluation (the eval mirror of the fused training
+        # windows): the shared engine runs fused forward+fold windows on its
+        # OWN feed — mid-training validation no longer drains the training
+        # feed's pipelining — and device-capable methods fold on device, so
+        # the pass fetches O(1) metric scalars instead of per-batch logits.
+        from bigdl_tpu.optim.evaluator import run_device_eval
+        with self.metrics.timer("validation"):
+            results, stats = run_device_eval(
+                self.model, params, mstate, self.val_dataset,
+                list(self.val_methods), depth=self.prefetch_depth,
+                allow_empty=True)
+        # observability pair: how many bytes validation pulled off the device
+        # and how long the loop was blocked on those fetches
+        state["val_fetch_bytes"] = stats["fetch_bytes"]
+        state["val_wait_ms"] = stats["wait_ms"]
+        self.metrics.add("val_fetch_wait", stats["wait_ms"] / 1e3)
+        logger.info(
+            "Validation pass: %d batches (%d fused windows), "
+            "val_fetch_bytes=%d, val_wait_ms=%.1f",
+            stats["batches"], stats["fused_windows"], stats["fetch_bytes"],
+            stats["wait_ms"])
+        if self.val_summary is not None:
+            self.val_summary.add_scalar("ValFetchBytes",
+                                        float(stats["fetch_bytes"]),
+                                        state["neval"])
+            self.val_summary.add_scalar("ValWaitMs", float(stats["wait_ms"]),
+                                        state["neval"])
         state.setdefault("scores", {})
         for m, r in zip(self.val_methods, results):
             if r is not None:

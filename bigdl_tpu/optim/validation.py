@@ -6,10 +6,29 @@ aggregate with ``+`` and ``.result()`` yields (value, count).
 
 Padded batches: methods take ``valid`` (real sample count) so the repeated padding rows
 never contaminate metrics.
+
+Device-fold protocol (TPU-native): a method that can fold its metric ON DEVICE
+exposes three extra hooks so the evaluator never has to fetch the logits tensor
+to host — a whole eval pass then costs O(1) metric scalars of d2h traffic
+instead of O(batch x classes) per batch:
+
+- ``device_fold(out, target, valid_mask) -> small pytree`` — jnp ops, traced
+  inside the evaluator's jitted forward+fold program. ``valid_mask`` is a
+  (batch,) bool vector (False on padded tail rows).
+- ``merge(acc, part) -> pytree`` — accumulate two partials (also traced; runs
+  in the eval scan carry). Default: leafwise add.
+- ``finalize(acc_host) -> ValidationResult`` — host-side, from the single
+  fetched pytree.
+
+``has_device_fold()`` gates the protocol; methods without a device kernel
+(MeanAveragePrecision's global AP ranking, HitRatio/NDCG's group regrouping)
+keep the host ``apply`` fallback automatically — the evaluator fetches outputs
+only for those.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -58,6 +77,25 @@ class ValidationMethod:
     def apply(self, output, target, valid: int | None = None) -> ValidationResult:
         raise NotImplementedError
 
+    # ------------------------------------------------- device-fold protocol
+    def has_device_fold(self) -> bool:
+        """Whether this method provides a jit-traceable device kernel. False
+        here means the evaluator fetches outputs and uses ``apply`` (host)."""
+        return False
+
+    def device_fold(self, out, target, valid_mask):
+        """Per-batch partial as a SMALL pytree of device scalars (jnp ops;
+        traced). Padded rows carry ``valid_mask=False`` and must not count."""
+        raise NotImplementedError(f"{self.name} has no device fold")
+
+    def merge(self, acc, part):
+        """Accumulate two partials (traced — runs in the eval scan carry)."""
+        return jax.tree_util.tree_map(jnp.add, acc, part)
+
+    def finalize(self, acc) -> ValidationResult:
+        """Host-side: the fetched accumulated pytree → a ValidationResult."""
+        raise NotImplementedError(f"{self.name} has no device fold")
+
     def __repr__(self):
         return self.name
 
@@ -69,6 +107,12 @@ def _mask_valid(n: int, valid: int | None):
 
 
 class TopKAccuracy(ValidationMethod):
+    """Top-k membership by RANK COUNTING instead of a full sort: the target is
+    in the top k iff (#scores strictly greater) + (#equal scores at a smaller
+    class index) < k — the stable-descending-sort semantics, O(C) per row vs
+    argsort's O(C log C), and expressed in pure comparisons so the host and
+    device folds agree BITWISE."""
+
     def __init__(self, k: int, one_based: bool = False):
         self.k = k
         self.one_based = one_based
@@ -81,12 +125,43 @@ class TopKAccuracy(ValidationMethod):
             t = t - 1
         if out.ndim == 1:
             out = out[None]
-        topk = np.argsort(-out, axis=1)[:, : self.k]
-        correct = (topk == t[:, None]).any(axis=1).astype(np.float64)
+        out = out.reshape(out.shape[0], -1)
+        correct = self._rank_correct(np, out, t).astype(np.float64)
         mask = _mask_valid(len(t), valid)
         if mask is not None:
             correct = correct[mask]
         return AccuracyResult(correct.sum(), len(correct))
+
+    def _rank_correct(self, xp, out, t):
+        """Shared host(np)/device(jnp) top-k membership: boolean per row.
+        Out-of-range targets (never produced by a sane pipeline, but padding
+        must not crash) score False, like the old argsort membership test."""
+        c = out.shape[1]
+        safe_t = xp.clip(t, 0, c - 1)
+        s = xp.take_along_axis(out, safe_t[:, None], axis=1)[:, 0]
+        greater = (out > s[:, None]).sum(axis=1)
+        ties_before = ((out == s[:, None])
+                       & (xp.arange(c)[None, :] < t[:, None])).sum(axis=1)
+        return (greater + ties_before < self.k) & (t >= 0) & (t < c)
+
+    # ------------------------------------------------- device-fold protocol
+    def has_device_fold(self) -> bool:
+        return True
+
+    def device_fold(self, out, target, valid_mask):
+        t = jnp.reshape(target, (-1,)).astype(jnp.int32)
+        if self.one_based:
+            t = t - 1
+        if out.ndim == 1:
+            out = out[None]
+        out = jnp.reshape(out, (out.shape[0], -1))
+        correct = self._rank_correct(jnp, out, t) & valid_mask
+        return (jnp.sum(correct.astype(jnp.float32)),
+                jnp.sum(valid_mask.astype(jnp.int32)))
+
+    def finalize(self, acc) -> ValidationResult:
+        correct, count = acc
+        return AccuracyResult(float(correct), int(count))
 
 
 class TreeNNAccuracy(ValidationMethod):
@@ -109,6 +184,21 @@ class TreeNNAccuracy(ValidationMethod):
             t = t[:, 0]
         return Top1Accuracy(self.one_based).apply(out, t, valid)
 
+    # root-slice then plain Top-1 — the slice is static, so the device kernel
+    # rides the same rank-count fold
+    def has_device_fold(self) -> bool:
+        return True
+
+    def device_fold(self, out, target, valid_mask):
+        if out.ndim == 3:
+            out = out[:, 0, :]
+        if target.ndim == 2:
+            target = target[:, 0]
+        return Top1Accuracy(self.one_based).device_fold(out, target, valid_mask)
+
+    def finalize(self, acc) -> ValidationResult:
+        return Top1Accuracy(self.one_based).finalize(acc)
+
 
 class Top1Accuracy(TopKAccuracy):
     def __init__(self, one_based: bool = False):
@@ -125,16 +215,59 @@ class Loss(ValidationMethod):
         from bigdl_tpu.nn.criterion import ClassNLLCriterion
         self.criterion = criterion or ClassNLLCriterion()
         self.name = "Loss"
+        self._fwd = None       # jitted criterion forward, cached per instance
+        self._row_fwd = None   # vmapped per-row criterion for the device fold
 
     def apply(self, output, target, valid=None):
-        n = np.asarray(output).shape[0]
+        # one host->jax conversion, one cached jit — the old path rebuilt jnp
+        # arrays from a double np.asarray and re-entered the criterion facade
+        # (and its output/grad bookkeeping) every batch
+        out = np.asarray(output)
+        t = np.asarray(target)
+        n = out.shape[0]
         if valid is not None and valid < n:
-            output = np.asarray(output)[:valid]
-            target = np.asarray(target)[:valid]
+            out, t = out[:valid], t[:valid]
             n = valid
-        loss = float(self.criterion.forward(jnp.asarray(np.asarray(output)),
-                                            jnp.asarray(np.asarray(target))))
+        if self._fwd is None:
+            self._fwd = jax.jit(self.criterion.apply)
+        loss = float(self._fwd(out, t))
         return LossResult(loss * n, n)
+
+    # ------------------------------------------------- device-fold protocol
+    def has_device_fold(self) -> bool:
+        """Device-foldable only when the criterion is a plain mean reduction:
+        the fold sums PER-ROW losses under the valid mask, which equals
+        ``mean(loss[:valid]) * valid`` only if the batch loss is the mean of
+        independent per-row losses. Criteria that normalize by a per-batch
+        quantity (class-weighted NLL's weight-sum denominator) or reduce by
+        sum keep the host fallback."""
+        c = self.criterion
+        if getattr(c, "weights", None) is not None:
+            return False
+        inner = getattr(c, "inner", None)  # CrossEntropyCriterion wraps NLL
+        if inner is not None and getattr(inner, "weights", None) is not None:
+            return False
+        return getattr(c, "size_average", None) is True
+
+    def device_fold(self, out, target, valid_mask):
+        if self._row_fwd is None:
+            crit = self.criterion
+            self._row_fwd = jax.vmap(
+                lambda o, t: crit.apply(
+                    jax.tree_util.tree_map(lambda a: a[None], o),
+                    jax.tree_util.tree_map(lambda a: a[None], t)))
+        per_row = self._row_fwd(out, target)
+        per_row = jnp.where(valid_mask, per_row, 0.0)
+        return (jnp.sum(per_row), jnp.sum(valid_mask.astype(jnp.int32)))
+
+    def finalize(self, acc) -> ValidationResult:
+        loss_sum, count = acc
+        return LossResult(float(loss_sum), int(count))
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_fwd"] = d["_row_fwd"] = None  # jitted closures don't pickle
+        return d
 
 
 class MAPResult(ValidationResult):
@@ -253,6 +386,21 @@ class MAE(ValidationMethod):
             out, t = out[:valid], t[:valid]
             n = valid
         return LossResult(float(np.abs(out - t).mean()) * n, n)
+
+    # mean over the valid slice x n == sum of per-row means (rows are
+    # same-shape) — maskable, so the fold runs on device
+    def has_device_fold(self) -> bool:
+        return True
+
+    def device_fold(self, out, target, valid_mask):
+        diff = jnp.abs(out - target)
+        per_row = jnp.mean(jnp.reshape(diff, (diff.shape[0], -1)), axis=1)
+        per_row = jnp.where(valid_mask, per_row, 0.0)
+        return (jnp.sum(per_row), jnp.sum(valid_mask.astype(jnp.int32)))
+
+    def finalize(self, acc) -> ValidationResult:
+        loss_sum, count = acc
+        return LossResult(float(loss_sum), int(count))
 
 
 class HitRatio(ValidationMethod):

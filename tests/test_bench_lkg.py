@@ -69,7 +69,7 @@ def _args(**over):
     base = dict(model="resnet50", batch=256, iters=24, warmup=12,
                 dtype="bf16", compare_dtypes=False, streamed=False,
                 timeout=5, int8_infer=False, serving=False,
-                decode_infer=False, ablate=False)
+                decode_infer=False, ablate=False, eval_bench=False)
     base.update(over)
     return argparse.Namespace(**base)
 

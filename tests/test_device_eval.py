@@ -1,0 +1,286 @@
+"""Device-resident evaluation (BIGDL_EVAL_FUSE_STEPS): fused eval windows,
+on-device metric folds, one-scalar fetch.
+
+Pins the tentpole contracts (the eval mirror of tests/test_fused_windows.py):
+- device-fold results equal host-fold results for Top1/TopK/Loss/MAE on
+  padded-tail datasets (accuracy counts bitwise, loss to float tolerance);
+- fused (K>1) and per-batch (K=1) eval produce identical results;
+- methods WITHOUT a device kernel (MeanAveragePrecision-shaped) fall back to
+  the host fold automatically, composing with device-capable methods in one
+  method list;
+- empty datasets raise like the classic evaluator;
+- accuracy-only eval fetches O(1) scalars per pass (< 8 bytes/image), and the
+  feed's eval mode splits ragged tails into singleton groups (two static
+  program shapes, never a per-tail-length recompile).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.prefetch import PrefetchingFeed
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.optim import Evaluator, Loss, Predictor, Top1Accuracy
+from bigdl_tpu.optim.validation import (MAE, AccuracyResult, Top5Accuracy,
+                                        TopKAccuracy, ValidationMethod)
+from bigdl_tpu.utils.engine import Engine
+
+
+@pytest.fixture(autouse=True)
+def engine():
+    Engine.init(seed=7)
+
+
+def _model(in_dim=6, classes=5):
+    return nn.Sequential().add(nn.Linear(in_dim, classes)).add(nn.LogSoftMax())
+
+
+def _samples(n=21, dim=6, classes=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return [Sample(rng.normal(size=(dim,)).astype(np.float32),
+                   np.int32(rng.integers(0, classes)))
+            for _ in range(n)]
+
+
+def _host_fold(model, samples, methods, batch_size):
+    """Reference: classic per-batch host fold via ValidationMethod.apply."""
+    ds = DataSet.array(samples) >> SampleToMiniBatch(batch_size)
+    model.evaluate()
+    results = [None] * len(methods)
+    for b in ds.data(train=False):
+        out = np.asarray(model.forward(b.input))
+        for i, m in enumerate(methods):
+            r = m.apply(out, np.asarray(b.target), b.valid)
+            results[i] = r if results[i] is None else results[i] + r
+    return [r.result() for r in results]
+
+
+class TestDeviceHostEquivalence:
+    @pytest.mark.parametrize("k", [1, 3])
+    def test_topk_padded_tail_bitwise(self, k):
+        """21 samples, batch 4 → 6 batches with a 1-valid padded tail. The
+        device rank-count fold and the host fold must agree EXACTLY on
+        correct-counts (pure comparisons, no float arithmetic)."""
+        model = _model()
+        samples = _samples()
+        methods = [TopKAccuracy(k)]
+        host = _host_fold(model, samples, methods, 4)
+        res = Evaluator(model).test(samples, [TopKAccuracy(k)], batch_size=4,
+                                    fuse_steps=3)
+        (v, c), = [r.result() for r, _ in res]
+        assert (v, c) == (pytest.approx(host[0][0]), host[0][1])
+        assert c == 21  # padding rows never counted
+
+    def test_loss_padded_tail(self):
+        model = _model()
+        samples = _samples(n=19)
+        host = _host_fold(model, samples, [Loss()], 4)
+        res = Evaluator(model).test(samples, [Loss()], batch_size=4,
+                                    fuse_steps=2)
+        v, c = res[0][0].result()
+        assert c == 19
+        assert v == pytest.approx(host[0][0], rel=1e-5)
+
+    def test_mae_device_fold_matches_host(self):
+        m = MAE()
+        rng = np.random.default_rng(3)
+        out = rng.normal(size=(8, 4)).astype(np.float32)
+        tgt = rng.normal(size=(8, 4)).astype(np.float32)
+        host = m.apply(out, tgt, valid=5).result()
+        import jax.numpy as jnp
+        fold = m.device_fold(jnp.asarray(out), jnp.asarray(tgt),
+                             jnp.arange(8) < 5)
+        dev = m.finalize(tuple(np.asarray(x) for x in fold)).result()
+        assert dev[1] == host[1] == 5
+        assert dev[0] == pytest.approx(host[0], rel=1e-6)
+
+    def test_topk_tie_semantics_match(self):
+        """Tied scores: both folds use stable-descending-sort semantics
+        (ties broken by smaller class index) — bitwise identical."""
+        out = np.asarray([[0.5, 0.5, 0.1],
+                          [0.5, 0.5, 0.1],
+                          [0.1, 0.5, 0.5]], np.float32)
+        t = np.asarray([0, 1, 1], np.int32)
+        m = TopKAccuracy(1)
+        host = m.apply(out, t).result()
+        import jax.numpy as jnp
+        fold = m.device_fold(jnp.asarray(out), jnp.asarray(t),
+                             jnp.ones(3, bool))
+        dev = m.finalize(tuple(np.asarray(x) for x in fold)).result()
+        assert dev == host == (pytest.approx(2 / 3), 3)
+
+    def test_weighted_loss_keeps_host_fallback(self):
+        """Class-weighted NLL normalizes by a per-batch weight sum — not
+        per-row decomposable, so the device kernel must decline."""
+        crit = nn.ClassNLLCriterion(weights=np.asarray([1.0, 2.0, 1.0, 1.0,
+                                                        1.0], np.float32))
+        assert not Loss(crit).has_device_fold()
+        assert Loss().has_device_fold()
+        # and the evaluator still produces the host-exact number through it
+        model = _model()
+        samples = _samples(n=9)
+        host = _host_fold(model, samples, [Loss(crit)], 4)
+        res = Evaluator(model).test(samples, [Loss(crit)], batch_size=4,
+                                    fuse_steps=2)
+        v, c = res[0][0].result()
+        assert c == 9 and v == pytest.approx(host[0][0], rel=1e-5)
+
+
+class TestFusedVsPerBatch:
+    def test_fused_equals_perbatch_and_host(self):
+        model = _model()
+        samples = _samples(n=26, seed=4)
+        methods_host = _host_fold(model, samples,
+                                  [Top1Accuracy(), Top5Accuracy(), Loss()], 4)
+        ev = Evaluator(model)
+        fused = ev.test(samples, [Top1Accuracy(), Top5Accuracy(), Loss()],
+                        batch_size=4, fuse_steps=3)
+        assert ev.last_stats["fused_windows"] >= 1
+        per = ev.test(samples, [Top1Accuracy(), Top5Accuracy(), Loss()],
+                      batch_size=4, fuse_steps=1)
+        assert ev.last_stats["fused_windows"] == 0
+        for (rf, _), (rp, _), h in zip(fused, per, methods_host):
+            vf, cf = rf.result()
+            vp, cp = rp.result()
+            assert cf == cp == h[1] == 26
+            assert vf == pytest.approx(vp, rel=1e-6)
+            assert vf == pytest.approx(h[0], rel=1e-5)
+
+    def test_accuracy_only_fetch_is_scalars(self):
+        """The acceptance number: accuracy-only eval must fetch O(1) metric
+        scalars for the whole pass — under 8 bytes per image."""
+        model = _model()
+        samples = _samples(n=32, seed=5)
+        ev = Evaluator(model)
+        ev.test(samples, [Top1Accuracy()], batch_size=4, fuse_steps=4)
+        assert ev.last_stats["fetch_bytes"] <= 8  # one f32 + one i32 scalar
+        assert ev.last_stats["fetch_bytes"] / 32 < 8.0
+        assert ev.last_stats["wait_ms"] >= 0.0
+
+    def test_predictor_fused_equals_single_shot(self):
+        model = _model()
+        x = np.random.default_rng(0).normal(size=(26, 6)).astype(np.float32)
+        ref = np.asarray(model.evaluate().forward(x))
+        for fuse in (1, 3, 8):
+            out = Predictor(model).predict(x, batch_size=4, fuse_steps=fuse)
+            assert out.shape == (26, 5)
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class _HostOnlyCount(ValidationMethod):
+    """No-device-kernel probe: counts valid rows on host and records every
+    output shape it saw (proves the fallback fetched real logits)."""
+
+    name = "HostOnlyCount"
+
+    def __init__(self):
+        self.seen_shapes = []
+
+    def apply(self, output, target, valid=None):
+        out = np.asarray(output)
+        self.seen_shapes.append(out.shape)
+        n = out.shape[0] if valid is None else min(valid, out.shape[0])
+        return AccuracyResult(float(n), int(n))
+
+
+class TestFallbackPaths:
+    def test_no_device_kernel_method_falls_back(self):
+        model = _model()
+        samples = _samples(n=10, seed=6)
+        probe = _HostOnlyCount()
+        assert not probe.has_device_fold()
+        res = Evaluator(model).test(samples, [probe], batch_size=4,
+                                    fuse_steps=2)
+        v, c = res[0][0].result()
+        assert c == 10 and v == pytest.approx(1.0)
+        # 10 samples / batch 4 → 3 batches, each fetched at full batch shape
+        assert probe.seen_shapes == [(4, 5)] * 3
+
+    def test_mixed_device_and_host_methods(self):
+        """Device-capable and host-only methods in ONE list: each folds its
+        own way, results align with the methods order."""
+        model = _model()
+        samples = _samples(n=13, seed=8)
+        probe = _HostOnlyCount()
+        host = _host_fold(model, samples, [Top1Accuracy()], 4)
+        res = Evaluator(model).test(samples, [Top1Accuracy(), probe],
+                                    batch_size=4, fuse_steps=2)
+        (acc, m0), (cnt, m1) = res
+        assert m0.name == "Top1Accuracy" and m1.name == "HostOnlyCount"
+        assert acc.result() == (pytest.approx(host[0][0]), 13)
+        assert cnt.result() == (pytest.approx(1.0), 13)
+
+    def test_empty_dataset_raises(self):
+        model = _model()
+        ds = DataSet.array([]) >> SampleToMiniBatch(4)
+        with pytest.raises(ValueError, match="empty"):
+            Evaluator(model).test(ds, [Top1Accuracy()], fuse_steps=2)
+        with pytest.raises(ValueError, match="empty"):
+            Predictor(model).predict(ds)
+
+    def test_optimizer_validation_uses_device_eval(self, tmp_path):
+        """Mid-training validation rides the same engine: scores land in
+        state plus the val_fetch_bytes/val_wait_ms observability pair."""
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+        rng = np.random.default_rng(0)
+        batches = [MiniBatch(rng.normal(size=(8, 6)).astype(np.float32),
+                             rng.integers(0, 5, size=(8,)).astype(np.int32))
+                   for _ in range(6)]
+        model = _model()
+        val_ds = DataSet.array(_samples(n=17, seed=9)) >> SampleToMiniBatch(4)
+        opt = (LocalOptimizer(model, DataSet.array(batches),
+                              nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.05))
+               .set_validation(Trigger.several_iteration(4), val_ds,
+                               [Top1Accuracy(), Loss()])
+               .set_end_when(Trigger.max_iteration(8)))
+        opt.optimize()
+        assert "scores" in opt.state
+        assert set(opt.state["scores"]) == {"Top1Accuracy", "Loss"}
+        assert 0.0 <= opt.state["scores"]["Top1Accuracy"] <= 1.0
+        # observability pair: accuracy+loss are device-folded → tiny fetch
+        assert opt.state["val_fetch_bytes"] <= 64
+        assert opt.state["val_wait_ms"] >= 0.0
+
+
+class TestEvalFeedMode:
+    def test_eval_tail_splits_into_singletons(self):
+        items = list(range(8))
+        feed = PrefetchingFeed(lambda: iter(items), lambda g: g,
+                               depth=2, window=3, train=False)
+        got = [g for g, _ in feed]
+        assert got == [[0, 1, 2], [3, 4, 5], [6], [7]]
+
+    def test_train_tail_stays_grouped(self):
+        items = list(range(8))
+        feed = PrefetchingFeed(lambda: iter(items), lambda g: g,
+                               depth=2, window=3, train=True)
+        got = [g for g, _ in feed]
+        assert got == [[0, 1, 2], [3, 4, 5], [6, 7]]
+
+    def test_eval_mode_synchronous(self):
+        feed = PrefetchingFeed(lambda: iter(range(5)), lambda g: g,
+                               depth=0, window=2, train=False)
+        got = [g for g, _ in feed]
+        assert got == [[0, 1], [2, 3], [4]]
+
+    def test_env_knob_validation(self):
+        from bigdl_tpu.optim.evaluator import eval_fuse_steps
+        assert eval_fuse_steps(4) == 4
+        assert eval_fuse_steps("6") == 6
+        with pytest.raises(ValueError):
+            eval_fuse_steps(0)
+        old = os.environ.get("BIGDL_EVAL_FUSE_STEPS")
+        try:
+            os.environ["BIGDL_EVAL_FUSE_STEPS"] = "5"
+            assert eval_fuse_steps() == 5
+        finally:
+            if old is None:
+                os.environ.pop("BIGDL_EVAL_FUSE_STEPS", None)
+            else:
+                os.environ["BIGDL_EVAL_FUSE_STEPS"] = old

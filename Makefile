@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 dist bench bench-smoke multichip clean
+.PHONY: test t1 dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -21,6 +21,12 @@ bench:
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --model lenet --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --model lenet --eval-bench --no-compare-dtypes --no-streamed
+
+# Host input-pipeline leg (decode→augment→stack on a synthetic image folder):
+# pipeline_images_per_sec at BIGDL_DATA_WORKERS 0/1/4/auto + per-stage ms.
+# Host-only — needs no accelerator.
+bench-pipeline:
+	JAX_PLATFORMS=cpu python bench.py --pipeline-bench --no-compare-dtypes --no-streamed
 
 multichip:
 	python -m bigdl_tpu.cli dryrun-multichip -n 8

@@ -2,9 +2,12 @@ from bigdl_tpu.dataset.dataset import (
     AbstractDataSet, DataSet, DistributedDataSet, LocalDataSet, TransformedDataSet,
     is_distributed,
 )
+from bigdl_tpu.dataset.parallel import ParallelTransformer, data_workers, plan_stages
+from bigdl_tpu.dataset.profiling import feed_stats, stage_deltas_ms
 from bigdl_tpu.dataset.sample import MiniBatch, Sample, SampleToMiniBatch
 from bigdl_tpu.dataset.transformer import (
-    ChainedTransformer, Identity, MapTransformer, Transformer,
+    ChainedTransformer, FusedTransformer, Identity, MapTransformer, Transformer,
+    flatten_chain, fuse_chain, sample_index_scope,
 )
 from bigdl_tpu.dataset.text import (
     Dictionary, LabeledSentenceToSample, SentenceTokenizer, TextToLabeledSentence,

@@ -17,6 +17,7 @@ index, 0-based by default (``one_based=True`` matches the reference's Scala/Torc
 from __future__ import annotations
 
 import os
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional, Sequence
@@ -24,6 +25,7 @@ from typing import Iterator, Optional, Sequence
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.profiling import STAGE_DECODE, feed_stats
 from bigdl_tpu.utils.random_generator import RandomGenerator
 
 _EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp")
@@ -58,9 +60,34 @@ class ImageFolderDataSet(AbstractDataSet):
         if not self._items:
             raise ValueError(f"no images with extensions {exts} under {root}")
         self._order = np.arange(len(self._items))
+        self._ex: Optional[ThreadPoolExecutor] = None
 
     def size(self) -> int:
         return len(self._items)
+
+    def _executor(self) -> ThreadPoolExecutor:
+        """One decode pool per DATASET, reused across epochs — the per-epoch
+        pool was spun up inside ``data()`` and abandoned (``shutdown(wait=
+        False)``) whenever the generator closed, stacking orphaned idle
+        threads epoch after epoch."""
+        if self._ex is None:
+            self._ex = ThreadPoolExecutor(self.num_workers,
+                                          thread_name_prefix="bigdl-decode")
+        return self._ex
+
+    def close(self) -> None:
+        """Deterministically shut the decode pool down (tests / long-lived
+        processes swapping datasets). Safe to call repeatedly; a later
+        ``data()`` recreates the pool."""
+        if self._ex is not None:
+            self._ex.shutdown(wait=False, cancel_futures=True)
+            self._ex = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def shuffle(self) -> None:
         perm = RandomGenerator.numpy().permutation(len(self._items))
@@ -73,17 +100,18 @@ class ImageFolderDataSet(AbstractDataSet):
         from bigdl_tpu.transform.vision.image import ImageFeature
 
         path, label = item
+        t0 = time.perf_counter()
         with PILImage.open(path) as img:
             arr = np.asarray(img.convert("RGB"))
+        feed_stats.add(STAGE_DECODE, time.perf_counter() - t0)
         return ImageFeature(arr, label, uri=path)
 
     def data(self, train: bool) -> Iterator:
         # sliding window of decode futures: bounded memory, preserved order,
-        # decode parallelism = num_workers
-        ex = ThreadPoolExecutor(self.num_workers,
-                                thread_name_prefix="bigdl-decode")
+        # decode parallelism = num_workers; the pool outlives the epoch
+        ex = self._executor()
+        window: deque = deque()
         try:
-            window: deque = deque()
             depth = self.num_workers * 2
             for i in self._order:
                 window.append(ex.submit(self._decode, self._items[i]))
@@ -92,7 +120,9 @@ class ImageFolderDataSet(AbstractDataSet):
             while window:
                 yield window.popleft().result()
         finally:
-            ex.shutdown(wait=False, cancel_futures=True)
+            # abandoned mid-epoch: cancel queued decodes, keep the pool
+            for f in window:
+                f.cancel()
 
 
 def write_synthetic_image_folder(root: str, n_classes: int = 4,

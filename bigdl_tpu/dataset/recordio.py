@@ -27,14 +27,16 @@ from __future__ import annotations
 import io
 import os
 import struct
+import time
 import zlib
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Iterable, Iterator, Sequence
+from typing import Callable, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.profiling import STAGE_DECODE, feed_stats
 from bigdl_tpu.utils.random_generator import RandomGenerator
 
 _MAGIC = b"BDLR"
@@ -130,9 +132,23 @@ class RecordFileDataSet(AbstractDataSet):
             raise RecordIOError(f"no records in {self.paths}")
         self._order = np.arange(len(self._index))
         self._fds: dict[int, int] = {}
+        self._ex: Optional[ThreadPoolExecutor] = None
 
     def size(self) -> int:
         return len(self._index)
+
+    def _executor(self) -> ThreadPoolExecutor:
+        """One decode pool per dataset, reused across epochs (see
+        ``ImageFolderDataSet._executor`` — same per-epoch-leak fix)."""
+        if self._ex is None:
+            self._ex = ThreadPoolExecutor(self.num_workers,
+                                          thread_name_prefix="bigdl-recordio")
+        return self._ex
+
+    def close(self) -> None:
+        if self._ex is not None:
+            self._ex.shutdown(wait=False, cancel_futures=True)
+            self._ex = None
 
     def shuffle(self) -> None:
         perm = RandomGenerator.numpy().permutation(len(self._index))
@@ -158,6 +174,10 @@ class RecordFileDataSet(AbstractDataSet):
         return payload
 
     def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
         for fd in getattr(self, "_fds", {}).values():
             try:
                 os.close(fd)
@@ -165,13 +185,15 @@ class RecordFileDataSet(AbstractDataSet):
                 pass
 
     def _load(self, i: int):
-        return self.decoder(self._read(i))
+        t0 = time.perf_counter()
+        out = self.decoder(self._read(i))
+        feed_stats.add(STAGE_DECODE, time.perf_counter() - t0)
+        return out
 
     def data(self, train: bool) -> Iterator:
-        ex = ThreadPoolExecutor(self.num_workers,
-                                thread_name_prefix="bigdl-recordio")
+        ex = self._executor()
+        window: deque = deque()
         try:
-            window: deque = deque()
             depth = self.num_workers * 2
             for i in self._order:
                 window.append(ex.submit(self._load, int(i)))
@@ -180,7 +202,9 @@ class RecordFileDataSet(AbstractDataSet):
             while window:
                 yield window.popleft().result()
         finally:
-            ex.shutdown(wait=False, cancel_futures=True)
+            # abandoned mid-epoch: cancel queued reads, keep the pool
+            for f in window:
+                f.cancel()
 
 
 # ------------------------------------------------------------- image packing

@@ -8,14 +8,27 @@ transformer.
 TPU-native: host-side numpy until the trainer's device put; batches keep STATIC shapes
 (fixed batch size — the final partial batch is padded up and carries an explicit valid-count
 so jit never sees a new shape; the reference padded too, for a different reason).
+
+Zero-alloc assembly: ``SampleToMiniBatch`` stacks into a small RING of
+preallocated output buffers (``BIGDL_BATCH_RING`` slots, default 4) instead of
+fresh allocations every batch. A batch's buffers return to the ring when the
+consumer calls ``MiniBatch.recycle()`` — the trainer's feed path does, right
+after ``device_put`` has copied the bytes out. Consumers that never recycle
+(tests, ad-hoc iteration) simply drain the ring and fall back to fresh
+allocations — identical behavior to the pre-ring code, never a deadlock and
+never an aliased buffer.
 """
 
 from __future__ import annotations
 
+import os
+import queue
+import time
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
 
+from bigdl_tpu.dataset.profiling import STAGE_STACK, feed_stats
 from bigdl_tpu.dataset.transformer import Transformer
 
 
@@ -52,9 +65,21 @@ class MiniBatch:
         self.input = input
         self.target = target
         self.valid = valid if valid is not None else _batch_dim(input)
+        self._ring_slot = None
 
     def size(self) -> int:
         return _batch_dim(self.input)
+
+    def recycle(self) -> None:
+        """Return this batch's buffers to the assembly ring (no-op for
+        non-ring batches). Only the consumer that has finished reading
+        ``input``/``target`` may call this — afterwards the arrays may be
+        overwritten by a later batch. Scalar metadata (``valid``) stays
+        usable."""
+        slot = self._ring_slot
+        if slot is not None:
+            self._ring_slot = None
+            slot.release()
 
     def __repr__(self):
         return f"MiniBatch(size={self.size()}, valid={self.valid})"
@@ -66,18 +91,87 @@ def _batch_dim(x) -> int:
     return int(np.asarray(x).shape[0])
 
 
+def batch_ring_depth(default: int = 4) -> int:
+    """``BIGDL_BATCH_RING``: preallocated output-buffer slots per
+    SampleToMiniBatch (0 disables the ring — every batch allocates fresh)."""
+    raw = os.environ.get("BIGDL_BATCH_RING", "").strip()
+    if raw == "":
+        return default
+    try:
+        v = int(raw)
+        if v < 0:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            f"BIGDL_BATCH_RING must be a non-negative integer, got {raw!r}"
+        ) from None
+    return v
+
+
+class _RingSlot:
+    """One preallocated output buffer set: per-feature and per-label arrays of
+    shape (batch_size, *sample_shape). Arrays materialize on first fill (the
+    sample shapes are unknown until then) and are reused verbatim afterwards."""
+
+    __slots__ = ("feats", "labels", "_free")
+
+    def __init__(self, free: "queue.SimpleQueue"):
+        self.feats: Optional[tuple] = None
+        self.labels: Optional[tuple] = None
+        self._free = free
+
+    def release(self) -> None:
+        self._free.put(self)
+
+    def compatible(self, samples: Sequence[Sample]) -> bool:
+        if self.feats is None:
+            return True
+        s = samples[0]
+        return (len(self.feats) == len(s.feature)
+                and len(self.labels) == len(s.label)
+                and all(b.shape[1:] == a.shape and b.dtype == a.dtype
+                        for b, a in zip(self.feats, s.feature))
+                and all(b.shape[1:] == a.shape and b.dtype == a.dtype
+                        for b, a in zip(self.labels, s.label)))
+
+
+class _BufferRing:
+    """Fixed set of ``depth`` slots handed out through a thread-safe free
+    queue. ``acquire`` never blocks: an exhausted ring (consumer not
+    recycling) degrades to fresh allocations at the call site."""
+
+    def __init__(self, depth: int):
+        self._free: queue.SimpleQueue = queue.SimpleQueue()
+        for _ in range(depth):
+            self._free.put(_RingSlot(self._free))
+
+    def acquire(self) -> Optional[_RingSlot]:
+        try:
+            return self._free.get_nowait()
+        except queue.Empty:
+            return None
+
+
 class SampleToMiniBatch(Transformer):
     """Group Samples into fixed-size MiniBatches.
 
     ``pad_last=True`` (default) repeats trailing samples so every batch has exactly
     ``batch_size`` rows (static shapes for XLA) and records ``valid`` for correct metrics;
     ``pad_last=False`` drops the final partial batch (training-loop default).
+
+    ``ring_depth`` (default from ``BIGDL_BATCH_RING``) sizes the preallocated
+    output-buffer ring; 0 stacks into fresh arrays every batch. Samples whose
+    shapes vary from batch to batch disable the ring automatically (static
+    slot shapes can't serve them).
     """
 
-    def __init__(self, batch_size: int, pad_last: bool = True):
+    def __init__(self, batch_size: int, pad_last: bool = True,
+                 ring_depth: Optional[int] = None):
         assert batch_size > 0
         self.batch_size = batch_size
         self.pad_last = pad_last
+        depth = batch_ring_depth() if ring_depth is None else int(ring_depth)
+        self._ring = _BufferRing(depth) if depth > 0 else None
 
     def __call__(self, prev: Iterator) -> Iterator:
         return self._gen(prev)
@@ -95,8 +189,49 @@ class SampleToMiniBatch(Transformer):
                 buf.append(buf[valid - 1])
             yield self._stack(buf, self.batch_size, valid)
 
+    # ------------------------------------------------------------- stacking
+    def _stack(self, samples: Sequence[Sample], batch_size: int,
+               valid: Optional[int] = None) -> MiniBatch:
+        t0 = time.perf_counter()
+        slot = self._ring.acquire() if self._ring is not None else None
+        if slot is not None and not slot.compatible(samples):
+            # variable-shape stream: the ring's static buffers can't serve it
+            slot.release()
+            slot = None
+            self._ring = None
+        if slot is not None:
+            batch = self._stack_into(slot, samples, batch_size, valid)
+        else:
+            batch = self._stack_fresh(samples, batch_size, valid)
+        feed_stats.add(STAGE_STACK, time.perf_counter() - t0)
+        return batch
+
     @staticmethod
-    def _stack(samples: Sequence[Sample], batch_size: int, valid: Optional[int] = None):
+    def _stack_into(slot: _RingSlot, samples: Sequence[Sample],
+                    batch_size: int, valid: Optional[int]) -> MiniBatch:
+        s0 = samples[0]
+        if slot.feats is None:
+            slot.feats = tuple(
+                np.empty((batch_size,) + a.shape, a.dtype) for a in s0.feature)
+            slot.labels = tuple(
+                np.empty((batch_size,) + a.shape, a.dtype) for a in s0.label)
+        # np.stack(out=...) copies straight into the preallocated slot — the
+        # steady-state feed allocates nothing per batch
+        for j, out in enumerate(slot.feats):
+            np.stack([s.feature[j] for s in samples], out=out)
+        for j, out in enumerate(slot.labels):
+            np.stack([s.label[j] for s in samples], out=out)
+        n_f, n_l = len(slot.feats), len(slot.labels)
+        input = slot.feats[0] if n_f == 1 else slot.feats
+        target = (slot.labels[0] if n_l == 1 else slot.labels) if n_l else None
+        batch = MiniBatch(input, target,
+                          valid if valid is not None else len(samples))
+        batch._ring_slot = slot
+        return batch
+
+    @staticmethod
+    def _stack_fresh(samples: Sequence[Sample], batch_size: int,
+                     valid: Optional[int] = None) -> MiniBatch:
         # native GIL-free copy when available (runs in the prefetch producer
         # thread — overlap with the main thread is the point); numpy otherwise
         from bigdl_tpu.native import pack_batch

@@ -44,6 +44,12 @@ class Metrics:
         with self._lock:
             return dict(self._sums)
 
+    def counts(self) -> dict[str, int]:
+        """Occurrences per phase (feed-stage attribution needs sums AND
+        counts to diff mean ms across a window)."""
+        with self._lock:
+            return dict(self._counts)
+
     def reset(self) -> None:
         with self._lock:
             self._sums.clear()

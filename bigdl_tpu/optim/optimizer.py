@@ -41,6 +41,30 @@ from bigdl_tpu.utils.random_generator import RandomGenerator
 
 logger = logging.getLogger("bigdl_tpu.optim")
 
+_PUT_ALIASES_HOST: Optional[bool] = None
+
+
+def _device_put_may_alias() -> bool:
+    """Does ``jax.device_put`` of an aligned numpy array share the HOST buffer
+    (PJRT zero-copy) instead of copying? Decides whether the feed may recycle
+    a ring-assembled batch's buffers right after placement: under zero-copy
+    the "device" buffer IS the host array for its whole lifetime, so reuse
+    would corrupt an in-flight step. Probed once with a 64-byte-aligned array
+    (the alignment PJRT requires before it will zero-copy)."""
+    global _PUT_ALIASES_HOST
+    if _PUT_ALIASES_HOST is None:
+        try:
+            raw = np.zeros(4096 + 64, np.uint8)
+            off = (-raw.ctypes.data) % 64
+            host = raw[off:off + 4096].view(np.float32)
+            placed = jax.device_put(host)
+            jax.block_until_ready(placed)
+            _PUT_ALIASES_HOST = (int(placed.unsafe_buffer_pointer())
+                                 == int(host.ctypes.data))
+        except Exception:
+            _PUT_ALIASES_HOST = True  # can't prove a copy → never recycle
+    return _PUT_ALIASES_HOST
+
 
 class Optimizer:
     """Front-end factory + shared trainer implementation."""
@@ -719,6 +743,15 @@ class Optimizer:
             placed = self._place_batch(batch)
         if cache is not None:
             cache[id(batch)] = (batch, placed)
+        elif getattr(batch, "_ring_slot", None) is not None \
+                and not _device_put_may_alias():
+            # ring-assembled batch (SampleToMiniBatch): hand its buffers back
+            # for reuse once the device owns the bytes. PJRT may keep reading
+            # the host buffer until the transfer completes, so wait for the
+            # placed arrays HERE in the producer thread (the step loop's
+            # overlap is untouched) before the ring may overwrite them.
+            jax.block_until_ready(placed)
+            batch.recycle()
         return placed
 
     def _place_batch(self, batch: MiniBatch):
@@ -756,6 +789,12 @@ class Optimizer:
             if self._window_cache_bytes + nbytes <= self.device_cache_mb * 1e6:
                 cache[key] = (list(batches), placed)
                 self._window_cache_bytes += nbytes
+        else:
+            # the stacked super-batch holds fresh copies (np.stack), so the
+            # per-batch ring buffers are reusable regardless of whether the
+            # device_put of the STACK zero-copies
+            for b in batches:
+                b.recycle()
         return placed
 
     def _place_window(self, batches: list):
@@ -882,9 +921,16 @@ class Optimizer:
         self._setup_device_cache()
 
         from bigdl_tpu.dataset.prefetch import PrefetchingFeed
+        from bigdl_tpu.dataset.profiling import feed_stats
 
         state = self.state
         records = 0
+        # per-stage feed attribution baseline: the decode/augment/stack stages
+        # report into the process-wide sink; h2d is this optimizer's own
+        # put_batch timer. Snapshot here so summaries show THIS run's means.
+        feed_stage_snap0 = feed_stats.snapshot()
+        h2d_snap0 = (self.metrics.totals().get("put_batch", 0.0),
+                     self.metrics.counts().get("put_batch", 0))
         window_t0 = time.perf_counter()
         # device-side losses awaiting fetch: list of (neval, DeviceArray). Fetched
         # in batches every log_every iterations — this backend charges ~75 ms per
@@ -929,6 +975,16 @@ class Optimizer:
                 # window keeps accumulating
                 logger.info("Epoch %d iter %d: loss %.6f",
                             state["epoch"], state["neval"], state["loss"])
+            stages = self._feed_stage_report(feed_stage_snap0, h2d_snap0)
+            if stages:
+                # decode/augment are ms/IMAGE, stack/h2d ms/BATCH — per-stage
+                # regressions show as their own training summary curves
+                # instead of smearing into the single feed-wait number
+                state["feed_stage_ms"] = stages
+                if self.train_summary is not None:
+                    for stage, ms in stages.items():
+                        self.train_summary.add_scalar(
+                            f"FeedStage/{stage}_ms", ms, state["neval"])
 
         while not stop:
             state["epoch_finished"] = False
@@ -1121,7 +1177,27 @@ class Optimizer:
         self._final_ostate = jax.device_get(ostate)
         if self.metrics.summary():
             logger.info("phase timings (mean): %r", self.metrics)
+        stages = self._feed_stage_report(feed_stage_snap0, h2d_snap0)
+        if stages:
+            state["feed_stage_ms"] = stages
+            logger.info(
+                "feed stage attribution (mean ms — decode/augment per image, "
+                "stack/h2d per batch): %r", stages)
         return self.model
+
+    def _feed_stage_report(self, stage_snap0, h2d_snap0) -> dict:
+        """Mean ms per stage occurrence since the run's baseline snapshots:
+        decode/augment/stack from the dataset layer's stage sink, h2d from
+        this optimizer's ``put_batch`` timer."""
+        from bigdl_tpu.dataset.profiling import stage_deltas_ms
+        out = {stage: round(d["ms"], 3)
+               for stage, d in stage_deltas_ms(stage_snap0).items()}
+        h2d_t, h2d_n = (self.metrics.totals().get("put_batch", 0.0),
+                        self.metrics.counts().get("put_batch", 0))
+        if h2d_n > h2d_snap0[1]:
+            out["h2d"] = round(
+                1e3 * (h2d_t - h2d_snap0[0]) / (h2d_n - h2d_snap0[1]), 3)
+        return out
 
     # ---------------------------------------------------------- loss flush
     def _collect_state_metrics(self, mstate) -> list:

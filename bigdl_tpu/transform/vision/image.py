@@ -11,6 +11,15 @@ decodes JPEGs); images are numpy HWC arrays (PIL for codec work, pure numpy for 
 math), and the pipeline output feeds ``SampleToMiniBatch`` → device. Randomized
 transforms draw from a per-pipeline ``numpy.random.Generator`` seeded via
 ``Engine``'s seed for reproducibility.
+
+Deterministic parallel randomness: when the parallel transform engine
+(``dataset/parallel.py``) runs a sample under ``sample_index_scope(i)``, the
+``_rng`` property resolves to a per-sample generator derived from
+(this transformer's seed material, sample index ``i``) instead of the shared
+sequential stream — so the SAME sample gets the SAME augmentation no matter
+how many workers run the pipeline or in what order they finish. Outside a
+scope (the classic serial path) draws come from the shared stream exactly as
+before.
 """
 
 from __future__ import annotations
@@ -21,7 +30,9 @@ from typing import Iterator, Optional, Sequence
 import numpy as np
 
 from bigdl_tpu.dataset.sample import Sample
-from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.dataset.transformer import (
+    Transformer, current_sample_index, current_sample_rng_cache,
+)
 
 _FLOAT = np.float32
 
@@ -107,7 +118,8 @@ class FeatureTransformer(Transformer):
     # identically-seeded run rebuilding the same pipeline reproduces exactly.
 
     def __init__(self):
-        self._rng = np.random.default_rng(self._seed())
+        self._seed_material = list(self._seed())
+        self._stream_rng = np.random.default_rng(self._seed_material)
 
     @classmethod
     def _seed(cls):
@@ -121,12 +133,44 @@ class FeatureTransformer(Transformer):
             pass
         return [int.from_bytes(os.urandom(4), "little"), salt]
 
+    @property
+    def _rng(self) -> np.random.Generator:
+        """Sequential stream rng — unless a ``sample_index_scope`` is active,
+        in which case a per-(transformer, sample) generator derived from
+        (seed material, sample index). The derived generator is cached for the
+        scope's duration so several draws inside one ``transform_feature``
+        advance ONE stream (Expand's ratio/y/x must not all see draw #1)."""
+        index = current_sample_index()
+        if index is None or self._seed_material is None:
+            return self._stream_rng
+        cache = current_sample_rng_cache()
+        rng = cache.get(id(self)) if cache is not None else None
+        if rng is None:
+            rng = np.random.default_rng([*self._seed_material, index])
+            if cache is not None:
+                cache[id(self)] = rng
+        return rng
+
+    @_rng.setter
+    def _rng(self, rng) -> None:
+        # direct assignment (legacy/custom transformers): honor it as the
+        # sequential stream; per-sample derivation is disabled because the
+        # seed material behind the assigned generator is unknown
+        self._seed_material = None
+        self._stream_rng = rng
+
     def set_seed(self, seed: int) -> "FeatureTransformer":
-        self._rng = np.random.default_rng(seed)
+        self._seed_material = [int(seed)]
+        self._stream_rng = np.random.default_rng(self._seed_material)
         return self
 
     def transform_feature(self, feature: ImageFeature) -> ImageFeature:
         raise NotImplementedError
+
+    def element_fn(self):
+        """Per-record callable — FeatureTransformers are element-wise by
+        construction, so every vision stage fuses and parallelizes."""
+        return self.transform_feature
 
     def __call__(self, prev: Iterator) -> Iterator:
         return (self.transform_feature(f) for f in prev)
@@ -378,14 +422,20 @@ class MatToTensor(FeatureTransformer):
 class ImageFrameToSample(Transformer):
     """ImageFeature stream → Sample stream (feature = image, label if any)."""
 
+    @staticmethod
+    def _to_sample(f: ImageFeature) -> Sample:
+        label = f.get(ImageFeature.LABEL)
+        if label is None:
+            return Sample(f.image)
+        return Sample(f.image, np.int32(label)
+                      if np.isscalar(label) else np.asarray(label))
+
+    def element_fn(self):
+        # one feature → one sample: fuses with the vision chain ahead of it
+        return self._to_sample
+
     def __call__(self, prev: Iterator) -> Iterator:
-        for f in prev:
-            label = f.get(ImageFeature.LABEL)
-            if label is None:
-                yield Sample(f.image)
-            else:
-                yield Sample(f.image, np.int32(label)
-                             if np.isscalar(label) else np.asarray(label))
+        return (self._to_sample(f) for f in prev)
 
 
 class Pipeline:

@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -8,6 +8,15 @@ test:
 # ROADMAP.md tier-1 verify, verbatim — the no-worse-than-seed gate.
 t1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# Fault-injection suite only (docs/robustness.md): every recovery path —
+# decode error, transform-worker death, h2d failure, non-finite loss,
+# SIGTERM preemption, SIGKILL-during-checkpoint-write, corrupt checkpoint on
+# disk — fired deterministically via BIGDL_FAULT_PLAN / inject_faults().
+# These tests are unmarked-slow, so `make t1` runs them too; this target is
+# the fast inner loop when working on fault tolerance.
+t1-faults:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
 dist:
 	bash make-dist.sh

@@ -26,6 +26,8 @@ import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.profiling import STAGE_DECODE, feed_stats
+from bigdl_tpu.dataset.resilience import SKIPPED, run_guarded
+from bigdl_tpu.utils.faults import SITE_DECODE, fault_point
 from bigdl_tpu.utils.random_generator import RandomGenerator
 
 _EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp")
@@ -94,17 +96,23 @@ class ImageFolderDataSet(AbstractDataSet):
         self._order = self._order[perm]
 
     @staticmethod
-    def _decode(item: tuple[str, int]):
+    def _decode_one(item: tuple[str, int]):
         from PIL import Image as PILImage
 
         from bigdl_tpu.transform.vision.image import ImageFeature
 
+        fault_point(SITE_DECODE)  # scripted decode failure, if any
         path, label = item
         t0 = time.perf_counter()
         with PILImage.open(path) as img:
             arr = np.asarray(img.convert("RGB"))
         feed_stats.add(STAGE_DECODE, time.perf_counter() - t0)
         return ImageFeature(arr, label, uri=path)
+
+    def _decode(self, item: tuple[str, int]):
+        # corrupt-sample policy (BIGDL_BAD_SAMPLE_POLICY): a truncated or
+        # unreadable image can skip/retry instead of killing the decode pool
+        return run_guarded("decode", self._decode_one, item)
 
     def data(self, train: bool) -> Iterator:
         # sliding window of decode futures: bounded memory, preserved order,
@@ -116,9 +124,13 @@ class ImageFolderDataSet(AbstractDataSet):
             for i in self._order:
                 window.append(ex.submit(self._decode, self._items[i]))
                 if len(window) >= depth:
-                    yield window.popleft().result()
+                    out = window.popleft().result()
+                    if out is not SKIPPED:
+                        yield out
             while window:
-                yield window.popleft().result()
+                out = window.popleft().result()
+                if out is not SKIPPED:
+                    yield out
         finally:
             # abandoned mid-epoch: cancel queued decodes, keep the pool
             for f in window:

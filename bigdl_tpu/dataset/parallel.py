@@ -28,6 +28,7 @@ parallel runs, preserving stream semantics exactly.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from collections import deque
@@ -35,13 +36,28 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional, Sequence
 
 from bigdl_tpu.dataset.profiling import STAGE_AUGMENT, feed_stats
+from bigdl_tpu.dataset.resilience import SKIPPED, run_guarded
 from bigdl_tpu.dataset.transformer import (
     FusedTransformer, Transformer, fuse_chain, sample_index_scope,
 )
+from bigdl_tpu.utils.faults import (
+    SITE_TRANSFORM_WORKER, WorkerDeathError, fault_point,
+)
+from bigdl_tpu.utils.robustness import events
+
+logger = logging.getLogger("bigdl_tpu.dataset")
 
 #: upper bound for BIGDL_DATA_WORKERS=auto — beyond this the GIL'd fraction of
 #: the per-image work dominates and extra threads only add contention
 _AUTO_CAP = 8
+
+
+def worker_crash_budget(default: int = 2) -> int:
+    """``BIGDL_WORKER_CRASH_BUDGET``: transform-worker deaths absorbed per
+    :class:`ParallelTransformer` (pool respawn + in-place re-execution)
+    before the death propagates to the consumer."""
+    return max(0, int(os.environ.get("BIGDL_WORKER_CRASH_BUDGET",
+                                     str(default))))
 
 
 def data_workers(default: int = 0) -> int:
@@ -91,6 +107,7 @@ class ParallelTransformer(Transformer):
         if self.window < 1:
             raise ValueError(f"window must be >= 1, got {self.window}")
         self._ex: Optional[ThreadPoolExecutor] = None
+        self._crashes = 0  # worker deaths absorbed so far (crash budget)
 
     def element_fn(self):
         # parallelism is an execution property, not a semantic one: the stage
@@ -104,32 +121,71 @@ class ParallelTransformer(Transformer):
         return self._ex
 
     def _apply(self, index: int, item):
+        fault_point(SITE_TRANSFORM_WORKER)  # scripted worker death, if any
         t0 = time.perf_counter()
         with sample_index_scope(index):
-            out = self._fn(item)
+            out = run_guarded("transform", self._fn, item)
         feed_stats.add(STAGE_AUGMENT, time.perf_counter() - t0)
         return out
 
     def __call__(self, prev: Iterator) -> Iterator:
         return self._gen(prev)
 
+    def _result(self, fut, index: int, item):
+        """Resolve one ordered-window future. A worker death (simulated thread
+        loss) is absorbed by the crash budget: the pool is respawned for
+        future submissions and THIS element re-executes in place — under
+        ``sample_index_scope`` the redo is bitwise-identical, so degraded
+        epochs stay deterministic. Past the budget the death propagates."""
+        try:
+            return fut.result()
+        except WorkerDeathError:
+            self._crashes += 1
+            budget = worker_crash_budget()
+            events.record("worker_respawn", crashes=self._crashes,
+                          budget=budget)
+            if self._crashes > budget:
+                logger.error(
+                    "ParallelTransformer: worker crash budget exhausted "
+                    "(%d > %d); propagating", self._crashes, budget)
+                raise
+            logger.warning(
+                "ParallelTransformer: worker died (%d/%d absorbed); "
+                "respawning pool and re-executing element %d",
+                self._crashes, budget, index)
+            self._respawn()
+            return self._apply(index, item)
+
+    def _respawn(self) -> None:
+        """Retire the current executor (in-flight futures drain naturally —
+        their threads are unaffected) and let the next submission build a
+        fresh pool."""
+        old, self._ex = self._ex, None
+        if old is not None:
+            old.shutdown(wait=False)
+
     def _gen(self, prev: Iterator):
-        ex = self._executor()
-        window: deque = deque()
+        window: deque = deque()  # (future, index, item) in submission order
         try:
             for index, item in enumerate(prev):
-                window.append(ex.submit(self._apply, index, item))
+                window.append(
+                    (self._executor().submit(self._apply, index, item),
+                     index, item))
                 if len(window) >= self.window:
-                    # .result() re-raises a worker exception with the worker's
+                    # result() re-raises a worker exception with the worker's
                     # original traceback attached — the consumer sees WHERE in
                     # the transform chain it blew up, not just that it did
-                    yield window.popleft().result()
+                    out = self._result(*window.popleft())
+                    if out is not SKIPPED:  # corrupt-sample policy drop
+                        yield out
             while window:
-                yield window.popleft().result()
+                out = self._result(*window.popleft())
+                if out is not SKIPPED:
+                    yield out
         finally:
             # abandoned mid-epoch (endWhen break): drop queued work, keep the
             # pool — running tasks finish and are discarded
-            for f in window:
+            for f, _, _ in window:
                 f.cancel()
 
     def close(self) -> None:

@@ -37,6 +37,8 @@ import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.profiling import STAGE_DECODE, feed_stats
+from bigdl_tpu.dataset.resilience import SKIPPED, run_guarded
+from bigdl_tpu.utils.faults import SITE_DECODE, fault_point
 from bigdl_tpu.utils.random_generator import RandomGenerator
 
 _MAGIC = b"BDLR"
@@ -184,11 +186,17 @@ class RecordFileDataSet(AbstractDataSet):
             except OSError:
                 pass
 
-    def _load(self, i: int):
+    def _load_one(self, i: int):
+        fault_point(SITE_DECODE)  # scripted decode failure, if any
         t0 = time.perf_counter()
         out = self.decoder(self._read(i))
         feed_stats.add(STAGE_DECODE, time.perf_counter() - t0)
         return out
+
+    def _load(self, i: int):
+        # corrupt-sample policy (BIGDL_BAD_SAMPLE_POLICY): a CRC-failing or
+        # undecodable record can skip/retry instead of killing the feed
+        return run_guarded("decode", self._load_one, i)
 
     def data(self, train: bool) -> Iterator:
         ex = self._executor()
@@ -198,9 +206,13 @@ class RecordFileDataSet(AbstractDataSet):
             for i in self._order:
                 window.append(ex.submit(self._load, int(i)))
                 if len(window) >= depth:
-                    yield window.popleft().result()
+                    out = window.popleft().result()
+                    if out is not SKIPPED:
+                        yield out
             while window:
-                yield window.popleft().result()
+                out = window.popleft().result()
+                if out is not SKIPPED:
+                    yield out
         finally:
             # abandoned mid-epoch: cancel queued reads, keep the pool
             for f in window:

@@ -18,10 +18,12 @@ accumulated on device and trigger boundaries kept exact (``Trigger.next_fire_in`
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
-import pickle
+import re
 import sys
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -29,17 +31,63 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu.dataset.dataset import AbstractDataSet, is_distributed
+from bigdl_tpu.dataset.dataset import (
+    AbstractDataSet, TransformedDataSet, is_distributed,
+)
 from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.nn.abstractnn import AbstractModule
 from bigdl_tpu.nn.criterion import AbstractCriterion
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
+from bigdl_tpu.utils import faults, file as ckpt_file
 from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.file import CheckpointCorruptError
 from bigdl_tpu.utils.random_generator import RandomGenerator
+from bigdl_tpu.utils.robustness import events
 
 logger = logging.getLogger("bigdl_tpu.optim")
+
+#: pickle-backend checkpoint file names: ``checkpoint.<neval>.pkl``
+#: (versioned) or ``checkpoint.pkl`` (over_write_checkpoint rolling file)
+_CKPT_RE = re.compile(r"^checkpoint(?:\.(\d+))?\.pkl$")
+
+
+def _ckpt_version(name: str) -> Optional[int]:
+    """Numeric version of a pickle checkpoint file name; the unversioned
+    rolling file sorts below every versioned one; non-checkpoint names
+    (quarantined ``*.corrupt``, tmp files) return None."""
+    m = _CKPT_RE.match(name)
+    if m is None:
+        return None
+    return int(m.group(1)) if m.group(1) is not None else -1
+
+
+class TrainingPreempted(RuntimeError):
+    """Raised by ``optimize()`` after a SIGTERM/SIGINT graceful stop: the run
+    halted at a step boundary and (when a checkpoint path is configured) an
+    emergency checkpoint with full resume state was made durable first.
+    ``optimize(resume="auto")`` in a fresh process continues the run
+    bitwise-identically. ``checkpoint_path`` is None when no checkpoint was
+    configured (progress since the last external snapshot is lost)."""
+
+    def __init__(self, message: str, checkpoint_path: Optional[str] = None,
+                 iteration: int = 0):
+        super().__init__(message)
+        self.checkpoint_path = checkpoint_path
+        self.iteration = iteration
+
+
+class NonFiniteLossError(RuntimeError):
+    """The fetched training loss was NaN/inf. ``optimize()`` responds by
+    rolling back to the last good checkpoint, at most
+    ``BIGDL_MAX_NAN_ROLLBACKS`` times (default 2), then aborts — a
+    deterministic divergence must not burn the whole generic retry budget
+    re-reaching the same NaN."""
+
+    def __init__(self, message: str, iteration: int = 0):
+        super().__init__(message)
+        self.iteration = iteration
 
 _PUT_ALIASES_HOST: Optional[bool] = None
 
@@ -155,6 +203,21 @@ class Optimizer:
         # per-step because module state may materialize structure on first
         # apply, which a fused window's scan carry cannot morph
         self._state_materialized = False
+        # ------------------------------------------------ fault tolerance
+        # keep-last-N retention for versioned pickle checkpoints
+        # (BIGDL_CKPT_KEEP; 0 = keep everything, the classic behavior)
+        self.ckpt_keep: int = int(os.environ.get("BIGDL_CKPT_KEEP", "0"))
+        # preemption (SIGTERM/SIGINT graceful stop): set by the signal
+        # handler, checked at step/window boundaries
+        self._preempt: Optional[threading.Event] = None
+        self._prev_handlers: dict = {}
+        # mid-epoch resume bookkeeping: feed position + RNG/order snapshots
+        # captured at each epoch start, carried in checkpoint payloads
+        self._epoch_batches = 0
+        self._epoch_rng: Optional[dict] = None
+        self._epoch_order = None
+        self._resume_feed: Optional[dict] = None
+        self._resume_base_rng = None
 
     # fluent config (reference API shape) ----------------------------------
     def set_model(self, model: AbstractModule) -> "Optimizer":
@@ -734,6 +797,7 @@ class Optimizer:
         # runs in the prefetch producer thread: assembly already happened in the
         # dataset iterator; this just enqueues the h2d DMA (once per distinct
         # batch when the device cache is on)
+        faults.fault_point(faults.SITE_H2D)  # scripted transfer failure
         cache = self._device_batch_cache
         if cache is not None:
             hit = cache.get(id(batch))
@@ -775,6 +839,7 @@ class Optimizer:
         BIGDL_DEVICE_CACHE_MB (beyond it, windows place uncached)."""
         if len(batches) < self.fuse_steps:
             return [self._put_batch(b) for b in batches]
+        faults.fault_point(faults.SITE_H2D)  # scripted transfer failure
         cache = self._device_batch_cache
         key = tuple(id(b) for b in batches)
         if cache is not None:
@@ -827,25 +892,203 @@ class Optimizer:
                 logger.exception("failed to stop profiler trace")
             self._profiling = False
 
-    def optimize(self) -> AbstractModule:
+    @staticmethod
+    def _is_nonfinite_failure(exc: BaseException) -> bool:
+        """Classify a failure as loss divergence: the explicit finite-loss
+        guard, or a checkify sanitizer error (user finite check or a
+        float_checks NaN/inf from inside the step)."""
+        if isinstance(exc, NonFiniteLossError):
+            return True
+        msg = str(exc)
+        return ("non-finite loss" in msg or "nan generated by" in msg
+                or "inf generated by" in msg)
+
+    def optimize(self, resume: Optional[str] = None) -> AbstractModule:
+        """Run the training loop. ``resume="auto"`` first restores the newest
+        loadable checkpoint under ``set_checkpoint``'s path (corrupt files are
+        quarantined, with automatic fallback to the previous version) and
+        continues the run — including mid-epoch feed position, RNG streams,
+        and trigger bookkeeping, so a preempted run restarts bitwise-
+        identically to one that was never interrupted. With no checkpoint on
+        disk, ``resume="auto"`` starts from scratch."""
         Engine._require_init()
+        if resume not in (None, "auto"):
+            raise ValueError(f"resume must be None or 'auto', got {resume!r}")
+        # robustness-report baseline spans the WHOLE optimize() call —
+        # resume/quarantine events during restore and rollback/retry events
+        # between _optimize_impl attempts must all show in the final report
+        self._rob_snap0 = events.snapshot()
+        if resume == "auto" and self.checkpoint_path is not None \
+                and self._has_checkpoint():
+            self._load_latest_checkpoint()
+            events.record("resume", path=self.checkpoint_path,
+                          neval=self.state.get("neval", 0))
         retry_budget = Engine.config().failure_retry_times
+        max_nan = int(os.environ.get("BIGDL_MAX_NAN_ROLLBACKS", "2"))
+        nan_rollbacks = 0
+        self._install_signal_handlers()
+        try:
+            return self._optimize_with_retry(retry_budget, max_nan,
+                                             nan_rollbacks)
+        finally:
+            self._restore_signal_handlers()
+            self._rob_snap0 = None
+
+    def _optimize_with_retry(self, retry_budget: int, max_nan: int,
+                             nan_rollbacks: int) -> AbstractModule:
         while True:
             try:
                 return self._optimize_impl()
-            except KeyboardInterrupt:
+            except (KeyboardInterrupt, TrainingPreempted):
                 self._stop_profiler_if_active()
                 raise
-            except Exception:
+            except Exception as e:
                 self._stop_profiler_if_active()
+                if self._is_nonfinite_failure(e):
+                    # divergence gets its own bounded rollback counter: the
+                    # last GOOD checkpoint is restored (the trigger path
+                    # flushes losses before every write, so a poisoned state
+                    # is never checkpointed), and a NaN that keeps coming
+                    # back aborts instead of retrying forever
+                    nan_rollbacks += 1
+                    self.state["nan_rollbacks"] = nan_rollbacks
+                    if nan_rollbacks > max_nan or not self._has_checkpoint():
+                        raise
+                    events.record("nan_rollback", rollbacks=nan_rollbacks)
+                    logger.exception(
+                        "non-finite loss; rolling back to last good "
+                        "checkpoint (%d/%d rollbacks, BIGDL_MAX_NAN_ROLLBACKS)",
+                        nan_rollbacks, max_nan)
+                    self._load_latest_checkpoint()
+                    # the reload replaced self.state wholesale — the rollback
+                    # count must survive it (observability + tests)
+                    self.state["nan_rollbacks"] = nan_rollbacks
+                    continue
                 retry_budget -= 1
                 if retry_budget < 0 or not self._has_checkpoint():
                     raise  # no recovery point yet → surface the original failure
+                events.record("retry_rollback", retries_left=retry_budget)
                 logger.exception(
                     "training failed; retrying from last checkpoint "
                     "(%d retries left)", retry_budget)
                 time.sleep(Engine.config().failure_retry_interval)
                 self._load_latest_checkpoint()
+
+    # ---------------------------------------------------------- preemption
+    def _install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT request a graceful stop at the next step/window
+        boundary: the loop writes an emergency checkpoint (with full resume
+        state) and raises :class:`TrainingPreempted`. A second SIGINT
+        escalates to an immediate KeyboardInterrupt. Handlers can only be
+        installed on the main thread; elsewhere preemption is disabled (the
+        process's own main thread owns signal disposition)."""
+        import signal
+
+        evt = threading.Event()
+
+        def _handler(signum, frame):
+            if evt.is_set() and signum == signal.SIGINT:
+                raise KeyboardInterrupt
+            evt.set()
+            logger.warning(
+                "received %s: stopping gracefully at the next step boundary "
+                "(emergency checkpoint%s)", signal.Signals(signum).name,
+                "" if self.checkpoint_path else
+                " SKIPPED — no checkpoint path configured")
+
+        try:
+            self._prev_handlers = {
+                sig: signal.signal(sig, _handler)
+                for sig in (signal.SIGTERM, signal.SIGINT)}
+            self._preempt = evt
+        except ValueError:  # not the main thread
+            self._preempt = None
+            self._prev_handlers = {}
+
+    def _restore_signal_handlers(self) -> None:
+        import signal
+
+        for sig, h in self._prev_handlers.items():
+            try:
+                signal.signal(sig, h)
+            except (ValueError, TypeError):
+                pass
+        self._prev_handlers = {}
+        self._preempt = None
+
+    def _preempt_requested(self) -> bool:
+        return self._preempt is not None and self._preempt.is_set()
+
+    def _do_preempt(self, params, mstate, ostate, state, pending) -> None:
+        """Graceful-stop tail, run at a step/window boundary:
+        flush device losses (a deferred NaN must surface before anything is
+        persisted), write + land the emergency checkpoint, publish the
+        trained state back onto the model, then raise TrainingPreempted."""
+        self._flush_pending(pending, state, keep_last=False)
+        path = None
+        if self.checkpoint_path is not None:
+            # state["neval"] is already the NEXT iteration at a boundary
+            self._save_checkpoint(params, mstate, ostate, state,
+                                  neval_next=state["neval"])
+            self._join_checkpoint_writer()
+            path = self.checkpoint_path
+        self.model.set_params(jax.device_get(params))
+        self.model.set_state(jax.device_get(mstate))
+        self._final_ostate = jax.device_get(ostate)
+        events.record("preemption", iteration=state["neval"],
+                      checkpoint=path)
+        logger.warning(
+            "training preempted before iteration %d%s", state["neval"],
+            f"; emergency checkpoint in {path}" if path
+            else " (no checkpoint configured — progress not persisted)")
+        raise TrainingPreempted(
+            f"training preempted before iteration {state['neval']}",
+            checkpoint_path=path, iteration=state["neval"])
+
+    # ------------------------------------------------- resume bookkeeping
+    def _feed_base(self):
+        """Innermost dataset under the transformer spine (owner of the
+        epoch-order permutation)."""
+        ds = self.dataset
+        while isinstance(ds, TransformedDataSet):
+            ds = ds.base
+        return ds
+
+    def _base_order_copy(self):
+        order = getattr(self._feed_base(), "_order", None)
+        return None if order is None else np.array(order, copy=True)
+
+    def _resume_info(self, state, neval_next: int) -> dict:
+        """Everything beyond params/slots that bitwise mid-epoch resume
+        needs: the absolute feed position inside the current epoch, the RNG
+        state as of this epoch's shuffle (skipped batches re-run their
+        transforms on resume, replaying the exact RNG stream), the epoch's
+        shuffled order (shuffles COMPOSE across epochs, so replaying the
+        permutation from scratch would not reproduce it), and the run's base
+        PRNG key for traced randomness."""
+        base_rng = getattr(self, "_base_rng", None)
+        mid_epoch = not bool(state.get("epoch_finished", False))
+        return {
+            "neval_next": int(neval_next),
+            "epoch": int(state.get("epoch", 1)),
+            "mid_epoch": mid_epoch,
+            "feed_pos": int(self._epoch_batches),
+            # mid-epoch: the epoch-start snapshot (resume replays forward
+            # from it); boundary: the CURRENT state — the feed is closed, so
+            # this is race-free and includes every draw the finished epoch
+            # made
+            "epoch_rng": (self._epoch_rng if mid_epoch
+                          else RandomGenerator.state_dict()),
+            "epoch_order": self._epoch_order,
+            "base_rng": (None if base_rng is None
+                         else np.asarray(jax.device_get(base_rng))),
+        }
+
+    def _apply_resume_info(self, resume: dict) -> None:
+        self.state["neval"] = int(resume["neval_next"])
+        self._resume_feed = resume
+        if resume.get("base_rng") is not None:
+            self._resume_base_rng = np.asarray(resume["base_rng"])
 
     def _has_checkpoint(self) -> bool:
         # land any in-flight write; a FAILED write logs (older files may still
@@ -917,7 +1160,15 @@ class Optimizer:
                 self._window_cache = self._compile_window(fuse)
                 self._window_cache_key = wkey
             window_fn = self._window_cache
-        base_rng = RandomGenerator.next_key()
+        # traced-randomness base key: a resumed run reuses the interrupted
+        # run's key (stored in the checkpoint) — drawing a fresh one would
+        # change every dropout mask downstream of the resume point
+        if self._resume_base_rng is not None:
+            base_rng = jnp.asarray(self._resume_base_rng)
+            self._resume_base_rng = None
+        else:
+            base_rng = RandomGenerator.next_key()
+        self._base_rng = base_rng
         self._setup_device_cache()
 
         from bigdl_tpu.dataset.prefetch import PrefetchingFeed
@@ -929,6 +1180,9 @@ class Optimizer:
         # report into the process-wide sink; h2d is this optimizer's own
         # put_batch timer. Snapshot here so summaries show THIS run's means.
         feed_stage_snap0 = feed_stats.snapshot()
+        rob_snap0 = getattr(self, "_rob_snap0", None)
+        if rob_snap0 is None:  # _optimize_impl called outside optimize()
+            rob_snap0 = events.snapshot()
         h2d_snap0 = (self.metrics.totals().get("put_batch", 0.0),
                      self.metrics.counts().get("put_batch", 0))
         window_t0 = time.perf_counter()
@@ -985,13 +1239,56 @@ class Optimizer:
                     for stage, ms in stages.items():
                         self.train_summary.add_scalar(
                             f"FeedStage/{stage}_ms", ms, state["neval"])
+            # robustness events (skips/retries/rollbacks/respawns/...) ride
+            # the same rails: cumulative per-kind counts as summary curves
+            rob = events.deltas(rob_snap0)
+            if rob and self.train_summary is not None:
+                for kind, n in rob.items():
+                    self.train_summary.add_scalar(
+                        f"Robustness/{kind}", float(n), state["neval"])
 
+        resume_feed, self._resume_feed = self._resume_feed, None
         while not stop:
             state["epoch_finished"] = False
-            self.dataset.shuffle()
-            epoch_had_data = False
+            skip = 0
+            if resume_feed is not None:
+                # re-enter the interrupted epoch exactly: restore the RNG to
+                # its state as of that epoch's shuffle and reinstall the
+                # epoch's shuffled order (shuffles compose across epochs, so
+                # re-deriving the permutation would not reproduce it). The
+                # first `feed_pos` batches are then re-transformed and
+                # DISCARDED below — replaying their RNG draws so everything
+                # downstream of the resume point is bitwise-identical.
+                if resume_feed.get("epoch_rng") is not None:
+                    RandomGenerator.load_state_dict(resume_feed["epoch_rng"])
+                if resume_feed.get("mid_epoch"):
+                    base = self._feed_base()
+                    order = resume_feed.get("epoch_order")
+                    if order is not None and hasattr(base, "_order"):
+                        base._order = np.array(order, copy=True)
+                    skip = int(resume_feed.get("feed_pos", 0))
+                    self._epoch_rng = resume_feed.get("epoch_rng")
+                    self._epoch_order = self._base_order_copy()
+                else:
+                    # epoch-boundary checkpoint: the next shuffle is the
+                    # first divergent draw — run it normally
+                    self.dataset.shuffle()
+                    self._epoch_rng = RandomGenerator.state_dict()
+                    self._epoch_order = self._base_order_copy()
+                resume_feed = None
+            else:
+                self.dataset.shuffle()
+                self._epoch_rng = RandomGenerator.state_dict()
+                self._epoch_order = self._base_order_copy()
+            self._epoch_batches = skip
+            # a fully-consumed epoch resumed at its tail legitimately yields
+            # no further batches
+            epoch_had_data = skip > 0
+            make_iter = ((lambda s=skip: itertools.islice(
+                self.dataset.data(train=True), s, None)) if skip
+                else (lambda: self.dataset.data(train=True)))
             feed = PrefetchingFeed(
-                lambda: self.dataset.data(train=True),
+                make_iter,
                 self._put_window if fuse > 1 else self._put_batch,
                 self.prefetch_depth, window=fuse)
             with feed:
@@ -1040,6 +1337,7 @@ class Optimizer:
                                 out, None
                         first = run_iters == 0
                         run_iters += k
+                        self._epoch_batches += k
                         tags = self._state_metric_tags(mstate)
                         if first:
                             # first dispatch of this (continuation) optimize():
@@ -1052,11 +1350,13 @@ class Optimizer:
                             for i in range(k):
                                 metrics = {t: float(s[i])
                                            for t, s in zip(tags, sm_vals)}
-                                state["loss"] = float(vals[i])
+                                val = self._guard_loss(start_it + i,
+                                                       float(vals[i]))
+                                state["loss"] = val
                                 if metrics:
                                     state["state_metrics"] = metrics
                                 self._write_iter_summary(
-                                    start_it + i, float(vals[i]), state, metrics)
+                                    start_it + i, val, state, metrics)
                             records = 0
                             window_t0 = time.perf_counter()
                         else:
@@ -1079,7 +1379,16 @@ class Optimizer:
                         # once at the window end is per-step exact
                         self._fire_triggers(params, mstate, ostate, state,
                                             boundary=False, pending=pending)
+                        fired = any([
+                            faults.fault_point(faults.SITE_SIGTERM,
+                                               index=it) is not None
+                            for it in range(start_it, start_it + k)])
+                        if fired and self._preempt is not None:
+                            self._preempt.wait(1.0)
                         state["neval"] += 1
+                        if self._preempt_requested():
+                            self._do_preempt(params, mstate, ostate, state,
+                                             pending)
                         continue
 
                     # ---------- per-step dispatch: fuse==1, the run's first
@@ -1125,6 +1434,7 @@ class Optimizer:
                             self.profile_dir = None  # one window per optimize()
                             logger.info("profiler trace captured")
 
+                        self._epoch_batches += 1
                         smetrics = self._collect_state_metrics(mstate)
                         if run_iters == 1:
                             # First step of this optimize() call absorbs compile, param
@@ -1134,6 +1444,7 @@ class Optimizer:
                             val = float(jax.device_get(loss))
                             if err is not None:
                                 jax.device_get(err).throw()
+                            val = self._guard_loss(state["neval"], val)
                             state["loss"] = val
                             fetched = {t: float(jax.device_get(v))
                                        for t, v in smetrics}
@@ -1152,7 +1463,14 @@ class Optimizer:
                         flush_and_log(state["neval"], state["neval"])
                         self._fire_triggers(params, mstate, ostate, state,
                                             boundary=False, pending=pending)
+                        if faults.fault_point(faults.SITE_SIGTERM,
+                                              index=state["neval"]) \
+                                is not None and self._preempt is not None:
+                            self._preempt.wait(1.0)
                         state["neval"] += 1
+                        if self._preempt_requested():
+                            self._do_preempt(params, mstate, ostate, state,
+                                             pending)
                     if stop:
                         break
             if stop:
@@ -1161,11 +1479,14 @@ class Optimizer:
                 raise RuntimeError("dataset yielded no batches")
             state["epoch"] += 1
             state["epoch_finished"] = True
+            self._epoch_batches = 0
             # full flush so Plateau(loss) sees the latest value; the records stay
             # in the running window (the next log boundary bills them)
             records += self._flush_pending(pending, state, keep_last=False)
             self._fire_triggers(params, mstate, ostate, state, boundary=True,
                                 pending=pending)
+            if self._preempt_requested():
+                self._do_preempt(params, mstate, ostate, state, pending)
             if self.end_when(state):
                 break
 
@@ -1183,6 +1504,12 @@ class Optimizer:
             logger.info(
                 "feed stage attribution (mean ms — decode/augment per image, "
                 "stack/h2d per batch): %r", stages)
+        rob = events.deltas(rob_snap0)
+        if rob:
+            # end-of-run robustness report: a run that silently absorbed
+            # faults must not look identical to a clean one
+            state["robustness"] = rob
+            logger.info("robustness report: %s", events.format_report(rob))
         return self.model
 
     def _feed_stage_report(self, stage_snap0, h2d_snap0) -> dict:
@@ -1200,6 +1527,19 @@ class Optimizer:
         return out
 
     # ---------------------------------------------------------- loss flush
+    def _guard_loss(self, it: int, v: float) -> float:
+        """Finite-loss guard at every host loss fetch (fused and per-step,
+        with or without the checkify sanitizer): NaN/inf raises
+        :class:`NonFiniteLossError`, which ``optimize()`` answers with a
+        bounded rollback to the last good checkpoint. The ``nonfinite_loss``
+        fault site poisons the fetched value here for deterministic tests."""
+        if faults.check_fault(faults.SITE_NONFINITE_LOSS, index=it) is not None:
+            v = float("nan")
+        if not np.isfinite(v):
+            raise NonFiniteLossError(
+                f"non-finite loss at iteration {it}: {v}", iteration=it)
+        return v
+
     def _collect_state_metrics(self, mstate) -> list:
         """(tag, device_scalar) pairs for observable module-state leaves
         (OBSERVABLE_STATE_LEAVES — MoE routing health). The walk is cheap
@@ -1250,7 +1590,10 @@ class Optimizer:
                                                         mvals):
             if err is not None:
                 err.throw()  # checkify sanitizer: NaN/inf with op location
-            state["loss"] = float(v)
+            # finite-loss guard rides every fetch path — deferred (pending)
+            # losses included, or a NaN surfacing after a log boundary would
+            # slip past the rollback machinery into state/checkpoints
+            state["loss"] = self._guard_loss(it, float(v))
             records += valid
             metrics = {tag: float(x) for (tag, _), x in zip(sm, mv)}
             if metrics:
@@ -1313,10 +1656,10 @@ class Optimizer:
         if self.checkpoint_trigger is not None and self.checkpoint_path is not None \
                 and self._in_scope(self.checkpoint_trigger, boundary) \
                 and self.checkpoint_trigger(state):
-            if self.check_numerics and pending:
-                # a deferred checkify error must throw BEFORE the write — a
-                # NaN-poisoned checkpoint would become the retry loop's
-                # deterministic-failure resume point
+            if pending:
+                # deferred losses (and any checkify error) must surface
+                # BEFORE the write — a NaN-poisoned checkpoint would become
+                # the retry loop's deterministic-failure resume point
                 self._flush_pending(pending, state, keep_last=False)
             self._save_checkpoint(params, mstate, ostate, state)
         # scalar summaries (Loss/LearningRate/Throughput) are written by
@@ -1409,21 +1752,35 @@ class Optimizer:
         tag = "" if self.overwrite_checkpoint else f".{state['neval']}"
         return os.path.join(self.checkpoint_path, f"checkpoint{tag}.pkl")
 
-    def _save_checkpoint(self, params, mstate, ostate, state) -> None:
+    def _save_checkpoint(self, params, mstate, ostate, state,
+                         neval_next: Optional[int] = None) -> None:
         """Fetch on the loop thread (consistent snapshot), write on a background
         thread — the disk write must not stall the step loop (the reference's
         driver-side save had the same property via Spark async jobs). With
         backend="orbax" the write goes through orbax's AsyncCheckpointer
-        instead. At most one write is in flight either way."""
+        instead. At most one write is in flight either way.
+
+        ``neval_next`` is the first iteration a resumed run should execute;
+        trigger-path saves default it from the loop's pre/post-increment
+        convention (in-loop triggers fire with ``state["neval"]`` = the
+        just-completed iteration; epoch-boundary and preemption saves see the
+        counter already advanced). The payload carries full resume state —
+        RNG snapshot, feed position, epoch order — so ``resume="auto"``
+        restarts mid-epoch bitwise-identically; the bytes go through
+        ``utils/file.py`` (CRC32 footer, fsync-before-rename)."""
         os.makedirs(self.checkpoint_path, exist_ok=True)
         if self.checkpoint_backend == "orbax":
             self._save_checkpoint_orbax(params, mstate, ostate, state)
             return
+        if neval_next is None:
+            neval_next = state["neval"] + \
+                (0 if state.get("epoch_finished") else 1)
         payload = {
             "params": jax.device_get(params),
             "mstate": jax.device_get(mstate),
             "ostate": jax.device_get(ostate),
             "state": dict(state),
+            "resume": self._resume_info(state, neval_next),
         }
         sched = getattr(self.optim_method, "learningrate_schedule", None)
         if getattr(sched, "stateful", False):
@@ -1433,10 +1790,33 @@ class Optimizer:
 
         def _write():
             try:
-                tmp = path + ".tmp"
-                with open(tmp, "wb") as f:
-                    pickle.dump(payload, f)
-                os.replace(tmp, path)
+                # scripted write failures (fault suite): "torn" leaves a
+                # truncated file at the FINAL path (simulating bit rot / a
+                # pre-hardening writer — exercises quarantine-on-load),
+                # "error" fails the write (surfaced at the next join),
+                # "kill" SIGKILLs mid-write with only the tmp file dirty
+                # (the atomic-rename protocol must keep the dir loadable)
+                action = faults.check_fault(faults.SITE_CKPT_WRITE)
+                data = ckpt_file.dumps(payload)
+                if action == "torn":
+                    with open(path, "wb") as f:
+                        f.write(data[:max(len(ckpt_file.MAGIC) + 1,
+                                          len(data) // 2)])
+                    logger.warning("fault plan: torn checkpoint at %s", path)
+                    return
+                if action == "error":
+                    raise faults.FaultError(
+                        "injected checkpoint write failure")
+                if action == "kill":
+                    tmp = path + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(data[:len(data) // 2])
+                        f.flush()
+                        os.fsync(f.fileno())
+                        import signal
+                        os.kill(os.getpid(), signal.SIGKILL)
+                ckpt_file.save(payload, path)
+                self._prune_old_checkpoints()
                 logger.info("checkpoint written: %s", path)
             except BaseException as e:  # surfaced at the next join
                 self._ckpt_error = e
@@ -1445,6 +1825,27 @@ class Optimizer:
         t = threading.Thread(target=_write, name="bigdl-ckpt-writer", daemon=False)
         t.start()
         self._ckpt_thread = t
+
+    def _prune_old_checkpoints(self) -> None:
+        """Keep-last-N retention (``BIGDL_CKPT_KEEP``) for versioned pickle
+        checkpoints; 0 keeps everything. Runs on the writer thread after a
+        successful write, so the newest file is always on disk before any
+        older one is removed. Quarantined ``*.corrupt`` files are pruned with
+        their version."""
+        keep = self.ckpt_keep
+        if keep <= 0 or self.overwrite_checkpoint:
+            return
+        versioned = sorted(
+            (p for p in os.listdir(self.checkpoint_path)
+             if _CKPT_RE.match(p) and _ckpt_version(p) >= 0),
+            key=_ckpt_version)
+        for name in versioned[:-keep]:
+            full = os.path.join(self.checkpoint_path, name)
+            for victim in (full, full + ".corrupt"):
+                try:
+                    os.remove(victim)
+                except OSError:
+                    pass
 
     def _save_checkpoint_orbax(self, params, mstate, ostate, state) -> None:
         import json
@@ -1564,6 +1965,14 @@ class Optimizer:
             logger.error("background checkpoint write failed: %r", err)
 
     def _load_latest_checkpoint(self) -> None:
+        """Restore the newest LOADABLE checkpoint. Version selection is
+        numeric (``checkpoint.9.pkl`` < ``checkpoint.10.pkl`` — an mtime or
+        lexicographic sort gets this wrong the moment neval crosses a digit
+        boundary or a file is touched); a candidate that fails its CRC /
+        truncation check is renamed aside as ``<name>.corrupt`` (quarantined
+        for postmortem, never re-tried) and the previous version is used
+        instead. Payloads carrying resume info re-arm the feed/RNG for
+        bitwise mid-epoch continuation."""
         self._join_checkpoint_writer()  # in-flight write must land before reading
         if self.checkpoint_backend == "orbax":
             if self._load_latest_checkpoint_orbax():
@@ -1571,13 +1980,33 @@ class Optimizer:
             raise RuntimeError(
                 f"no orbax checkpoint found under {self.checkpoint_path}")
         cand = sorted(
-            (p for p in os.listdir(self.checkpoint_path) if p.startswith("checkpoint")
-             and p.endswith(".pkl")),
-            key=lambda p: os.path.getmtime(os.path.join(self.checkpoint_path, p)))
+            (p for p in os.listdir(self.checkpoint_path)
+             if _ckpt_version(p) is not None),
+            key=_ckpt_version)
         if not cand:
             raise RuntimeError(f"no checkpoint found under {self.checkpoint_path}")
-        with open(os.path.join(self.checkpoint_path, cand[-1]), "rb") as f:
-            payload = pickle.load(f)
+        payload = name = None
+        while cand:
+            name = cand.pop()  # newest remaining version
+            full = os.path.join(self.checkpoint_path, name)
+            try:
+                payload = ckpt_file.load(full)
+                break
+            except CheckpointCorruptError as e:
+                quarantined = full + ".corrupt"
+                try:
+                    os.replace(full, quarantined)
+                except OSError:
+                    quarantined = "<unremovable>"
+                events.record("ckpt_quarantined", path=full, error=str(e))
+                logger.error(
+                    "corrupt checkpoint %s quarantined as %s (%s); falling "
+                    "back to the previous version", full, quarantined, e)
+        if payload is None:
+            raise RuntimeError(
+                f"no loadable checkpoint under {self.checkpoint_path} "
+                f"(every candidate failed its integrity check and was "
+                f"quarantined)")
         self.model.set_params(payload["params"])
         self.model.set_state(payload["mstate"])
         self._resume_ostate = payload["ostate"]
@@ -1585,7 +2014,9 @@ class Optimizer:
         sched = getattr(self.optim_method, "learningrate_schedule", None)
         if getattr(sched, "stateful", False) and "sched_state" in payload:
             sched.load_state_dict(payload["sched_state"])
-        logger.info("resumed from checkpoint %s at iter %d", cand[-1],
+        if payload.get("resume") is not None:
+            self._apply_resume_info(payload["resume"])
+        logger.info("resumed from checkpoint %s at iter %d", name,
                     self.state.get("neval", 0))
 
 

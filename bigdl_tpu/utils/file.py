@@ -6,16 +6,94 @@ Reference parity (SURVEY.md §2.5, expected ``<dl>/utils/File.scala`` and
 
 TPU-native: modules are pickle-safe (jit caches dropped, arrays → numpy on
 ``__getstate__``), so ``save``/``load`` are one format; a content header versions the file.
-Writes are atomic (tmp + rename) so a killed process never leaves a torn checkpoint —
-required by the retry-from-checkpoint semantics (SURVEY.md §5.3).
+
+Hardened for the retry-from-checkpoint contract (SURVEY.md §5.3):
+
+- writes are atomic (tmp + rename) AND durable — the payload is fsynced
+  before the rename and the directory entry after it, so a power cut or
+  SIGKILL never promotes a half-written file over a good one;
+- every write carries a CRC32 footer over the pickle payload, verified on
+  load: bit-rot or a torn file raises :class:`CheckpointCorruptError` (with
+  the path and expected/actual CRC, or the truncation offset) instead of a
+  bare ``EOFError``/``UnpicklingError`` deep inside pickle;
+- files written by the pre-CRC format (header, no footer) and plain pickles
+  from other tools still load.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+import struct
+import zlib
 
 MAGIC = b"BIGDL_TPU_V1\n"
+#: CRC footer: tag + crc32 of the pickle payload between header and footer
+_CRC_TAG = b"BDLCRC32"
+_FOOTER = struct.Struct("<8sI")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A persisted file failed its integrity check (CRC mismatch or
+    truncated payload). Carries ``path`` so recovery layers can quarantine
+    the exact file."""
+
+    def __init__(self, path: str, message: str):
+        super().__init__(message)
+        self.path = path
+
+
+def dumps(obj) -> bytes:
+    """Serialize ``obj`` to the on-disk format: header + pickle + CRC
+    footer."""
+    payload = pickle.dumps(obj)
+    return MAGIC + payload + _FOOTER.pack(_CRC_TAG, zlib.crc32(payload))
+
+
+def loads(data: bytes, path: str = "<bytes>"):
+    """Inverse of :func:`dumps`, with integrity verification. Accepts the
+    footer-less V1 layout and plain pickles for back-compat."""
+    if data.startswith(MAGIC):
+        body = data[len(MAGIC):]
+        if len(body) >= _FOOTER.size \
+                and body[-_FOOTER.size:-_FOOTER.size + len(_CRC_TAG)] == _CRC_TAG:
+            payload = body[:-_FOOTER.size]
+            expected = _FOOTER.unpack(body[-_FOOTER.size:])[1]
+            actual = zlib.crc32(payload)
+            if actual != expected:
+                raise CheckpointCorruptError(
+                    path,
+                    f"{path}: CRC mismatch (expected {expected:#010x}, got "
+                    f"{actual:#010x}) — the file is corrupt")
+        else:
+            payload = body  # pre-CRC writer: header but no footer
+    else:
+        payload = data  # plain pickle fallback (files from other tools)
+    try:
+        return pickle.loads(payload)
+    except (EOFError, pickle.UnpicklingError, IndexError) as e:
+        # a CRC-verified payload that still fails to unpickle means the file
+        # was TRUNCATED before the footer existed (torn write without rename
+        # protection) or written torn by a crashed process
+        raise CheckpointCorruptError(
+            path,
+            f"{path}: truncated or torn payload ({len(payload)} bytes "
+            f"present; unpickling failed: {e})") from e
+
+
+def _fsync_dir(d: str) -> None:
+    """Make the rename itself durable (the file's fsync alone does not pin
+    the directory entry). Best-effort — not every FS supports dir fds."""
+    try:
+        fd = os.open(d or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def save(obj, path: str, overwrite: bool = True) -> None:
@@ -26,15 +104,17 @@ def save(obj, path: str, overwrite: bool = True) -> None:
         os.makedirs(d, exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(MAGIC)
-        pickle.dump(obj, f)
+        f.write(dumps(obj))
+        f.flush()
+        try:
+            os.fsync(f.fileno())
+        except OSError:
+            pass  # exotic FS without fsync: atomicity still holds
     os.replace(tmp, path)
+    _fsync_dir(d)
 
 
 def load(path: str):
     with open(path, "rb") as f:
-        head = f.read(len(MAGIC))
-        if head != MAGIC:
-            # plain pickle fallback (e.g. files written by other tools)
-            f.seek(0)
-        return pickle.load(f)
+        data = f.read()
+    return loads(data, path)

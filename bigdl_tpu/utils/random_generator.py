@@ -71,6 +71,30 @@ class RandomGenerator:
             cls._salt_counter += 1
             return cls._salt_counter
 
+    # Checkpointable state (preemption-safe resume) ------------------------
+    @classmethod
+    def state_dict(cls) -> dict:
+        """Full snapshot of the global RNG: seed, numpy bit-generator state,
+        and the key/salt counters. A resumed run restored from this continues
+        the exact stream an uninterrupted run would have drawn — required for
+        bitwise-identical mid-epoch resume (shuffles and randomized
+        transforms all draw from here)."""
+        with cls._lock:
+            return {"seed": cls._seed,
+                    "np_state": cls._np.bit_generator.state,
+                    "key_counter": cls._key_counter,
+                    "salt_counter": cls._salt_counter}
+
+    @classmethod
+    def load_state_dict(cls, state: dict) -> None:
+        with cls._lock:
+            cls._seed = int(state["seed"])
+            cls._np = np.random.default_rng(cls._seed)
+            cls._np.bit_generator.state = state["np_state"]
+            cls._key_counter = int(state["key_counter"])
+            cls._salt_counter = int(state["salt_counter"])
+            cls._base_key = None  # rebuilt lazily from the restored seed
+
     # JAX keys for traced randomness ---------------------------------------
     @classmethod
     def next_key(cls):

@@ -1,0 +1,99 @@
+"""Process-wide robustness event sink — the observability rail for fault
+tolerance.
+
+Every recovery action the framework takes (a corrupt sample skipped or
+retried, a transform worker respawned, a rollback to a checkpoint, a
+preemption, a quarantined checkpoint file, an injected fault firing) is
+recorded here by the layer that took it. The trainer turns the counts into
+``Robustness/<kind>`` training summaries and an end-of-run report, the same
+way ``dataset/profiling.feed_stats`` feeds the ``FeedStage/*`` curves — a
+run that silently survived twelve decode errors should not LOOK identical to
+a clean one.
+
+Event kinds in use (free-form strings; these are the conventions):
+
+- ``sample_skipped`` / ``sample_retried`` — corrupt-sample policy actions
+  (``dataset/resilience.py``), tagged with the stage that failed;
+- ``worker_respawn`` — a transform worker death absorbed by the crash budget
+  (``dataset/parallel.py``);
+- ``retry_rollback`` — the optimizer retry loop reloaded a checkpoint after
+  a training failure;
+- ``nan_rollback`` — the non-finite-loss guard restored the last good
+  checkpoint;
+- ``preemption`` — SIGTERM/SIGINT graceful stop with emergency checkpoint;
+- ``resume`` — ``optimize(resume="auto")`` restored a run from disk;
+- ``ckpt_quarantined`` — a torn/corrupt checkpoint file was renamed aside
+  and an older version used instead;
+- ``fault_injected`` — a scripted fault from ``utils/faults.py`` fired.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+#: recent-event detail log bound — counts are unbounded, details are a window
+_LOG_CAP = 256
+
+
+class RobustnessEvents:
+    """Thread-safe counter + bounded detail log. One process-wide instance
+    (``events``); producer threads, decode pools, and the training loop all
+    record into it."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        self._log: list[dict] = []
+
+    def record(self, kind: str, **info) -> None:
+        with self._lock:
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            if len(self._log) < _LOG_CAP:
+                entry = {"kind": kind}
+                entry.update(info)
+                self._log.append(entry)
+
+    def counts(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+    def snapshot(self) -> dict:
+        """Baseline for :meth:`deltas` — take one at run start so a report
+        covers THIS run, not the process's whole history."""
+        return self.counts()
+
+    def deltas(self, snapshot: dict) -> dict:
+        """Per-kind counts accrued since ``snapshot`` (zero-delta kinds
+        omitted)."""
+        now = self.counts()
+        out = {}
+        for kind, n in now.items():
+            d = n - snapshot.get(kind, 0)
+            if d > 0:
+                out[kind] = d
+        return out
+
+    def recent(self, kind: Optional[str] = None) -> list:
+        with self._lock:
+            log = list(self._log)
+        if kind is None:
+            return log
+        return [e for e in log if e["kind"] == kind]
+
+    def format_report(self, counts: Optional[dict] = None) -> str:
+        """One-line-per-kind human report (the end-of-run robustness
+        summary)."""
+        counts = self.counts() if counts is None else counts
+        if not counts:
+            return "no robustness events"
+        return "; ".join(f"{kind}={n}" for kind, n in sorted(counts.items()))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._log.clear()
+
+
+#: the process-wide sink
+events = RobustnessEvents()

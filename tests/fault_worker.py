@@ -1,0 +1,41 @@
+"""Subprocess target for the fault-injection suite (tests/test_faults.py).
+
+Trains a tiny seeded model with versioned checkpoints under ``argv[2]``; the
+parent process scripts failures via ``BIGDL_FAULT_PLAN`` (e.g. SIGKILL
+mid-checkpoint-write) and asserts on what survives on disk. Mode ``resume``
+restarts with ``optimize(resume="auto")``; both modes print the final
+iteration counter for the parent to parse.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main() -> int:
+    mode, ckpt_dir = sys.argv[1], sys.argv[2]
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                      np.int32(rng.integers(0, 3))) for _ in range(64)]
+    data = DataSet.array(samples) >> SampleToMiniBatch(16)
+    Engine.init(seed=3)
+    model = nn.Sequential().add(nn.Linear(8, 3)).add(nn.LogSoftMax())
+    opt = (LocalOptimizer(model, data, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(learningrate=0.1))
+           .set_end_when(Trigger.max_iteration(10))
+           .set_checkpoint(ckpt_dir, Trigger.several_iteration(3)))
+    opt.optimize(resume="auto" if mode == "resume" else None)
+    print(f"FINAL_NEVAL={opt.state['neval']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,7 +70,7 @@ class TestCheckNumerics:
         """Divergence (inf/NaN) with a checkpoint trigger: any checkpoint that
         lands on disk must hold finite params — the deferred error throws
         before the write."""
-        import pickle
+        from bigdl_tpu.utils import file as ckpt_file
 
         monkeypatch.setenv("BIGDL_FAILURE_RETRY_TIMES", "0")
         Engine.reset()
@@ -95,8 +95,7 @@ class TestCheckNumerics:
         for f in os.listdir(tmp_path):
             if not f.endswith(".pkl"):
                 continue
-            with open(tmp_path / f, "rb") as fh:
-                payload = pickle.load(fh)
+            payload = ckpt_file.load(str(tmp_path / f))
             import jax
             for leaf in jax.tree_util.tree_leaves(payload["params"]):
                 assert np.isfinite(np.asarray(leaf)).all(), f

@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 t1-faults dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults t1-obs dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -18,6 +18,13 @@ t1:
 t1-faults:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
+# Observability suite only (docs/observability.md): span tracer Chrome-trace
+# export, JSONL event log + `bigdl-tpu diag` round trip, metric registry,
+# hang-watchdog stall dumps, zero-cost disabled path. Unmarked-slow, so
+# `make t1` runs these too; this is the fast inner loop for obs work.
+t1-obs:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
 dist:
 	bash make-dist.sh
 
@@ -30,6 +37,7 @@ bench:
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --model lenet --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --model lenet --eval-bench --no-compare-dtypes --no-streamed
+	JAX_PLATFORMS=cpu python bench.py --model lenet --obs-bench --no-compare-dtypes --no-streamed
 
 # Host input-pipeline leg (decode→augment→stack on a synthetic image folder):
 # pipeline_images_per_sec at BIGDL_DATA_WORKERS 0/1/4/auto + per-stage ms.

@@ -770,6 +770,86 @@ def _measure_pipeline(batch: int) -> dict:
     }
 
 
+def _measure_obs(batch: int, iters: int) -> dict:
+    """Observability-overhead leg (CPU LeNet smoke): the SAME training loop
+    with the span tracer off vs on, plus a validity check of the artifacts
+    the traced leg produced (Chrome trace loads as JSON, the JSONL event log
+    carries a run_report). The published gate: tracing on costs < 3% of
+    images/sec — observability that taxes the hot path does not get left
+    enabled, and then it observes nothing."""
+    import json
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu.obs import trace
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    Engine.init(compute_dtype=jnp.float32)
+    iters = max(iters, 12)
+    warm = 3
+    tmp = tempfile.mkdtemp(prefix="bigdl-obs-bench-")
+
+    def leg(traced: bool) -> float:
+        model, dataset, criterion = _build("lenet", batch, n_batches=8,
+                                           dtype="fp32")
+        opt = Optimizer(model, dataset, criterion)
+        trace.reset()
+        # explicit configure wins over any ambient BIGDL_TRACE: each leg
+        # measures exactly the state its name claims
+        if traced:
+            trace.configure(enabled=True, trace_dir=tmp)
+        else:
+            trace.configure(enabled=False)
+        opt.set_end_when(Trigger.max_iteration(warm))
+        opt.optimize()  # compile + feed spin-up outside the timed window
+        t0 = time.perf_counter()
+        opt.set_end_when(Trigger.max_iteration(warm + iters))
+        opt.optimize()
+        dt = time.perf_counter() - t0
+        return batch * iters / dt
+
+    try:
+        off_a = leg(False)
+        traced_ips = leg(True)
+        # artifact validity while the traced run's buffers are still live
+        chrome = trace.export_chrome()
+        with open(chrome) as f:
+            tr = json.load(f)
+        span_events = [e for e in tr["traceEvents"] if e.get("ph") == "X"]
+        n_threads = len({e["tid"] for e in span_events})
+        jsonl = trace.jsonl_path()
+        kinds = {e.get("kind") for e in trace.read_events(jsonl)}
+        trace.reset()
+        off_b = leg(False)
+    finally:
+        trace.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+    # best-of-two untraced legs: the gate must measure the tracer, not an
+    # unlucky scheduler hiccup in one reference run
+    off_ips = max(off_a, off_b)
+    overhead = max(0.0, 1.0 - traced_ips / off_ips) if off_ips else 0.0
+    return {
+        "value": round(traced_ips, 1),
+        "unit": "images/sec",
+        "batch": batch,
+        "iters": iters,
+        "dtype": "fp32",
+        "obs_images_per_sec_traced": round(traced_ips, 1),
+        "obs_images_per_sec_off": round(off_ips, 1),
+        "obs_overhead_pct": round(100.0 * overhead, 2),
+        "obs_overhead_ok": overhead < 0.03,
+        "trace_span_events": len(span_events),
+        "trace_threads": n_threads,
+        "trace_valid": bool(span_events) and n_threads >= 2,
+        "jsonl_has_run_report": "run_report" in kinds,
+    }
+
+
 def _measure_serving(model_name: str, batch: int, iters: int) -> dict:
     """Serving-path micro-bench: Predictor.predict and Evaluator.test
     throughput through the framework's own eval machinery (per-batch h2d,
@@ -1067,6 +1147,7 @@ def run_orchestrator(args) -> None:
     """Always prints one JSON line and exits 0 — degraded runs carry a reason."""
     # tolerate hand-built Namespaces (tests/drivers) predating this flag
     pipeline_bench = getattr(args, "pipeline_bench", False)
+    obs_bench = getattr(args, "obs_bench", False)
     worker_argv = ["--run", "--model", args.model, "--batch", str(args.batch),
                    "--iters", str(args.iters), "--warmup", str(args.warmup),
                    "--dtype", args.dtype]
@@ -1085,6 +1166,8 @@ def run_orchestrator(args) -> None:
         worker_argv.append("--eval-bench")
     if pipeline_bench:
         worker_argv.append("--pipeline-bench")
+    if obs_bench:
+        worker_argv.append("--obs-bench")
     env = dict(os.environ)
     # Fast-fail: one cheap bounded probe decides whether the accelerator
     # backend answers AT ALL before any full measurement attempt is allowed
@@ -1111,7 +1194,8 @@ def run_orchestrator(args) -> None:
             if args.compare_dtypes and args.dtype == "bf16" \
                     and not args.int8_infer and not args.serving \
                     and not args.decode_infer and not args.ablate \
-                    and not args.eval_bench and not pipeline_bench:
+                    and not args.eval_bench and not pipeline_bench \
+                    and not obs_bench:
                 # the comparison leg only feeds the ratio — skip its streamed
                 # measurement (it would be discarded)
                 cmp_argv = ["--run", "--model", args.model,
@@ -1148,7 +1232,7 @@ def run_orchestrator(args) -> None:
         attempts.append(f"probe: {probe_err}")
 
     if args.int8_infer or args.serving or args.decode_infer or args.ablate \
-            or args.eval_bench or pipeline_bench:
+            or args.eval_bench or pipeline_bench or obs_bench:
         # a LeNet training number would not answer an inference-path request:
         # fail loudly with the metric the caller asked for
         kind = ("int8_vs_bf16_infer" if args.int8_infer
@@ -1156,6 +1240,7 @@ def run_orchestrator(args) -> None:
                 else "decode_infer" if args.decode_infer
                 else "eval_throughput" if args.eval_bench
                 else "input_pipeline" if pipeline_bench
+                else "obs_overhead" if obs_bench
                 else "step_ablation")
         _emit({
             "metric": f"{args.model}_{kind}",
@@ -1231,6 +1316,10 @@ def main(argv=None):
                    help="host input-pipeline leg: decode→augment→stack "
                         "images/sec on a synthetic image folder at "
                         "BIGDL_DATA_WORKERS 0/1/4/auto, with per-stage ms")
+    p.add_argument("--obs-bench", dest="obs_bench", action="store_true",
+                   help="observability-overhead leg: CPU LeNet images/sec "
+                        "with the span tracer off vs on (gate: <3% "
+                        "overhead), plus trace/JSONL artifact validity")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
     args = p.parse_args(argv)
@@ -1268,6 +1357,11 @@ def _run_worker_modes(args) -> int:
     elif args.pipeline_bench:
         res = _measure_pipeline(min(args.batch, 32))
         res["metric"] = "input_pipeline_images_per_sec"
+        res["vs_baseline"] = None
+        print(json.dumps(res))
+    elif getattr(args, "obs_bench", False):
+        res = _measure_obs(min(args.batch, 128), args.iters)
+        res["metric"] = "lenet_obs_overhead"
         res["vs_baseline"] = None
         print(json.dumps(res))
     elif args.ablate:

@@ -95,8 +95,49 @@ def _launch_multihost(args) -> int:
     return next((rc for rc in rcs if rc), 0)
 
 
+def _run_diag(path: str) -> int:
+    """Re-render the unified run report from a saved JSONL event log
+    (``BIGDL_OBS_LOG``): the LAST ``run_report`` record renders through the
+    same formatter the trainer used, so the text matches the live run's
+    byte-for-byte. Watchdog dumps in the log are summarized on stderr."""
+    from bigdl_tpu.obs import report as obs_report
+    from bigdl_tpu.obs import trace
+
+    try:
+        events = trace.read_events(path)
+    except OSError as e:
+        print(f"diag: cannot read {path}: {e}", file=sys.stderr)
+        return 1
+    report = None
+    dumps = 0
+    kinds: dict = {}
+    for ev in events:
+        kind = ev.get("kind")
+        kinds[kind] = kinds.get(kind, 0) + 1
+        if kind == "run_report":
+            report = ev.get("report")
+        elif kind == "watchdog_dump":
+            dumps += 1
+    if report is None:
+        print(f"diag: no run_report event in {path} "
+              f"(events seen: {kinds or 'none'})", file=sys.stderr)
+        return 1
+    print(obs_report.format_report(report))
+    if dumps:
+        print(f"diag: {dumps} watchdog dump(s) in the log — the run stalled; "
+              f"thread stacks are in the watchdog_dump records",
+              file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    import os as _os
+    if _os.environ.get("BIGDL_TRACE"):
+        # tracing runs print their active obs configuration up front so the
+        # artifact paths are known before any training output scrolls by
+        from bigdl_tpu.obs import describe_config
+        print(describe_config(), file=sys.stderr)
     # bench forwards option-style args; argparse REMAINDER cannot capture a
     # leading option (py3.12), so hand the tail to the benchmark CLI directly
     if argv[:1] == ["bench"]:
@@ -127,6 +168,11 @@ def main(argv=None) -> int:
     sub.add_parser("models", help="list available training mains")
     sub.add_parser("env", help="print the BIGDL_* environment flags in effect")
 
+    diag = sub.add_parser(
+        "diag", help="re-render the unified run report from a saved JSONL "
+                     "event log (BIGDL_OBS_LOG / docs/observability.md)")
+    diag.add_argument("jsonl", help="path to the JSONL event log")
+
     launch = sub.add_parser(
         "launch", help="spawn an N-process jax.distributed training run on "
                        "this host (the spark-submit analog; each process = "
@@ -142,6 +188,8 @@ def main(argv=None) -> int:
                         help="arguments forwarded to the model's own CLI")
 
     args = p.parse_args(argv)
+    if args.command == "diag":
+        return _run_diag(args.jsonl)
     if args.command == "train":
         mod, _ = _TRAIN_MAINS[args.model]
         return _run_module(mod, args.rest)

@@ -27,6 +27,7 @@ import numpy as np
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.profiling import STAGE_DECODE, feed_stats
 from bigdl_tpu.dataset.resilience import SKIPPED, run_guarded
+from bigdl_tpu.obs import trace
 from bigdl_tpu.utils.faults import SITE_DECODE, fault_point
 from bigdl_tpu.utils.random_generator import RandomGenerator
 
@@ -104,8 +105,9 @@ class ImageFolderDataSet(AbstractDataSet):
         fault_point(SITE_DECODE)  # scripted decode failure, if any
         path, label = item
         t0 = time.perf_counter()
-        with PILImage.open(path) as img:
-            arr = np.asarray(img.convert("RGB"))
+        with trace.span("feed/decode"):
+            with PILImage.open(path) as img:
+                arr = np.asarray(img.convert("RGB"))
         feed_stats.add(STAGE_DECODE, time.perf_counter() - t0)
         return ImageFeature(arr, label, uri=path)
 

@@ -37,6 +37,7 @@ from typing import Iterator, Optional, Sequence
 
 from bigdl_tpu.dataset.profiling import STAGE_AUGMENT, feed_stats
 from bigdl_tpu.dataset.resilience import SKIPPED, run_guarded
+from bigdl_tpu.obs import trace
 from bigdl_tpu.dataset.transformer import (
     FusedTransformer, Transformer, fuse_chain, sample_index_scope,
 )
@@ -122,10 +123,13 @@ class ParallelTransformer(Transformer):
 
     def _apply(self, index: int, item):
         fault_point(SITE_TRANSFORM_WORKER)  # scripted worker death, if any
-        t0 = time.perf_counter()
-        with sample_index_scope(index):
-            out = run_guarded("transform", self._fn, item)
-        feed_stats.add(STAGE_AUGMENT, time.perf_counter() - t0)
+        # worker-thread spans: the stage span wraps the per-element work span
+        # so the trace shows transform workers nested under their stage
+        with trace.span("feed/transform"):
+            t0 = time.perf_counter()
+            with sample_index_scope(index), trace.span("feed/augment"):
+                out = run_guarded("transform", self._fn, item)
+            feed_stats.add(STAGE_AUGMENT, time.perf_counter() - t0)
         return out
 
     def __call__(self, prev: Iterator) -> Iterator:

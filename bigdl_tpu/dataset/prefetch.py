@@ -44,6 +44,8 @@ import threading
 from collections import deque
 from typing import Callable, Iterator
 
+from bigdl_tpu.obs import trace
+
 logger = logging.getLogger("bigdl_tpu.dataset")
 
 _END = object()
@@ -152,7 +154,10 @@ class PrefetchingFeed:
             for batch in self._grouped(it):
                 if stop.is_set():
                     return
-                placed = self.put_fn(batch)
+                # producer-thread span: batch assembly + device placement
+                # (h2d nests inside via the trainer's feed/h2d span)
+                with trace.span("feed/put_batch"):
+                    placed = self.put_fn(batch)
                 # a False put means close() fired — the consumer is gone, so
                 # dropping the item is the only non-deadlocking option
                 if not q.put((batch, placed)) or stop.is_set():

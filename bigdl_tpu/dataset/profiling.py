@@ -11,7 +11,9 @@ stage is visible instead of smearing into one opaque wait number.
 Kept dependency-free (no ``optim`` import): the dataset layer must not import
 the optimizer. Timings are wall-clock sums per stage occurrence; decode/augment
 count per IMAGE, stack per BATCH, h2d lives in the optimizer's own metrics
-(``put_batch``) and is merged by the consumer.
+(``put_batch``) and is merged by the consumer. Every add also publishes into
+the obs metric registry as ``feed/<stage>`` so the unified run report and
+bench legs read one source.
 """
 
 from __future__ import annotations
@@ -19,6 +21,8 @@ from __future__ import annotations
 import threading
 import time
 from collections import defaultdict
+
+from bigdl_tpu.obs.registry import registry as _obs_registry
 
 STAGE_DECODE = "decode"
 STAGE_AUGMENT = "augment"
@@ -42,6 +46,7 @@ class FeedStageStats:
         with self._lock:
             self._sums[stage] += seconds
             self._counts[stage] += 1
+        _obs_registry.histogram("feed/" + stage).observe(seconds)
 
     def timer(self, stage: str) -> "_StageTimer":
         return _StageTimer(self, stage)

@@ -38,6 +38,7 @@ import numpy as np
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.profiling import STAGE_DECODE, feed_stats
 from bigdl_tpu.dataset.resilience import SKIPPED, run_guarded
+from bigdl_tpu.obs import trace
 from bigdl_tpu.utils.faults import SITE_DECODE, fault_point
 from bigdl_tpu.utils.random_generator import RandomGenerator
 
@@ -189,7 +190,8 @@ class RecordFileDataSet(AbstractDataSet):
     def _load_one(self, i: int):
         fault_point(SITE_DECODE)  # scripted decode failure, if any
         t0 = time.perf_counter()
-        out = self.decoder(self._read(i))
+        with trace.span("feed/decode"):
+            out = self.decoder(self._read(i))
         feed_stats.add(STAGE_DECODE, time.perf_counter() - t0)
         return out
 

@@ -30,6 +30,7 @@ import numpy as np
 
 from bigdl_tpu.dataset.profiling import STAGE_STACK, feed_stats
 from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.obs import trace
 
 
 class Sample:
@@ -193,16 +194,18 @@ class SampleToMiniBatch(Transformer):
     def _stack(self, samples: Sequence[Sample], batch_size: int,
                valid: Optional[int] = None) -> MiniBatch:
         t0 = time.perf_counter()
-        slot = self._ring.acquire() if self._ring is not None else None
-        if slot is not None and not slot.compatible(samples):
-            # variable-shape stream: the ring's static buffers can't serve it
-            slot.release()
-            slot = None
-            self._ring = None
-        if slot is not None:
-            batch = self._stack_into(slot, samples, batch_size, valid)
-        else:
-            batch = self._stack_fresh(samples, batch_size, valid)
+        with trace.span("feed/stack"):
+            slot = self._ring.acquire() if self._ring is not None else None
+            if slot is not None and not slot.compatible(samples):
+                # variable-shape stream: the ring's static buffers can't
+                # serve it
+                slot.release()
+                slot = None
+                self._ring = None
+            if slot is not None:
+                batch = self._stack_into(slot, samples, batch_size, valid)
+            else:
+                batch = self._stack_fresh(samples, batch_size, valid)
         feed_stats.add(STAGE_STACK, time.perf_counter() - t0)
         return batch
 

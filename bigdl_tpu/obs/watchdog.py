@@ -1,0 +1,164 @@
+"""Hang watchdog — turn silent TPU/feed stalls into actionable reports.
+
+A background monitor thread watches the training loop's heartbeat (one beat
+per completed step or fused window). When the gap since the last beat exceeds
+the limit — ``BIGDL_WATCHDOG_FACTOR`` × the rolling median step time (default
+10×), or the hard ``BIGDL_WATCHDOG_S`` timeout, whichever is smaller — it
+dumps every Python thread's stack plus the tracer's open-span tree to stderr
+and the JSONL event log, once per stall. A later heartbeat re-arms it.
+
+The watchdog arms at the FIRST heartbeat: the initial step absorbs XLA
+compilation, whose duration says nothing about a steady-state hang, so the
+interval before any step completes is never flagged. Enabled by setting
+``BIGDL_WATCHDOG_S`` (> 0); constructed per training run by the Optimizer.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Callable, Optional
+
+from bigdl_tpu.obs import trace
+
+#: ratio-rule floor — a sub-ms median must not make a 10 ms hiccup "a hang"
+_MIN_LIMIT_S = 0.25
+
+
+def from_env() -> Optional["HangWatchdog"]:
+    """Build a watchdog from ``BIGDL_WATCHDOG_S`` / ``BIGDL_WATCHDOG_FACTOR``,
+    or None when unset/non-positive."""
+    raw = os.environ.get("BIGDL_WATCHDOG_S", "").strip()
+    if not raw:
+        return None
+    try:
+        hard = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"BIGDL_WATCHDOG_S must be a number of seconds, got {raw!r}"
+        ) from None
+    if hard <= 0:
+        return None
+    factor = float(os.environ.get("BIGDL_WATCHDOG_FACTOR", "10"))
+    return HangWatchdog(hard_s=hard, factor=factor)
+
+
+class HangWatchdog:
+    """Monitor thread + heartbeat API. ``sink`` (tests) receives the dump
+    text in addition to stderr and the JSONL log."""
+
+    def __init__(self, hard_s: Optional[float] = None, factor: float = 10.0,
+                 poll_s: Optional[float] = None,
+                 sink: Optional[Callable[[str], None]] = None):
+        if hard_s is None and factor <= 0:
+            raise ValueError("watchdog needs a hard timeout or a factor")
+        self.hard_s = hard_s
+        self.factor = factor
+        self.sink = sink
+        self.dumps = 0
+        self._durs: deque = deque(maxlen=64)
+        self._last: Optional[float] = None  # None = not yet armed
+        self._dumped = False
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        bound = hard_s if hard_s is not None else 1.0
+        self._poll_s = poll_s if poll_s is not None else max(0.05, bound / 8)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="bigdl-watchdog", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def heartbeat(self, duration_s: Optional[float] = None) -> None:
+        """Mark a completed step/window (optionally recording its wall time
+        into the rolling-median window) and re-arm the dump."""
+        if duration_s is not None:
+            self._durs.append(float(duration_s))
+        self._last = time.perf_counter()
+        self._dumped = False
+
+    # ------------------------------------------------------------- monitor
+    def _limit(self) -> Optional[float]:
+        limits = []
+        if self.hard_s is not None:
+            limits.append(self.hard_s)
+        if self.factor > 0 and len(self._durs) >= 5:
+            med = sorted(self._durs)[len(self._durs) // 2]
+            limits.append(max(self.factor * med, _MIN_LIMIT_S))
+        return min(limits) if limits else None
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self._poll_s):
+            last = self._last
+            if last is None or self._dumped:
+                continue
+            limit = self._limit()
+            if limit is None:
+                continue
+            elapsed = time.perf_counter() - last
+            if elapsed > limit:
+                self._dumped = True
+                self.dumps += 1
+                try:
+                    self.dump(elapsed, limit)
+                except Exception:
+                    traceback.print_exc(file=sys.stderr)
+
+    # ---------------------------------------------------------------- dump
+    @staticmethod
+    def thread_stacks() -> dict:
+        """{thread name (tid): formatted stack} for every live Python
+        thread."""
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        for tid, frame in sys._current_frames().items():
+            label = f"{names.get(tid, '?')} ({tid})"
+            out[label] = "".join(traceback.format_stack(frame))
+        return out
+
+    def dump(self, elapsed: float, limit: float) -> None:
+        """Write the stall report to stderr, the JSONL event log, and the
+        optional sink: what every thread is executing plus the tracer's
+        open-span tree (empty unless ``BIGDL_TRACE`` is on)."""
+        stacks = self.thread_stacks()
+        spans = trace.open_spans()
+        lines = [
+            "=" * 70,
+            f"BIGDL WATCHDOG: no step completed for {elapsed:.1f}s "
+            f"(limit {limit:.1f}s, median of last {len(self._durs)} steps: "
+            + (f"{sorted(self._durs)[len(self._durs) // 2] * 1e3:.1f} ms)"
+               if self._durs else "n/a)"),
+            "possible hang — dumping all thread stacks and open spans",
+        ]
+        for label, entries in spans.items():
+            chain = " > ".join(
+                f"{e['name']} ({e['age_ms']:.0f}ms)" for e in entries)
+            lines.append(f"open spans [{label}]: {chain}")
+        if not spans:
+            lines.append("open spans: none recorded (BIGDL_TRACE off?)")
+        for label, stack in stacks.items():
+            lines.append(f"--- thread {label} ---")
+            lines.append(stack.rstrip())
+        lines.append("=" * 70)
+        text = "\n".join(lines)
+        print(text, file=sys.stderr, flush=True)
+        trace.event("watchdog_dump", elapsed_s=round(elapsed, 3),
+                    limit_s=round(limit, 3), threads=stacks,
+                    open_spans=spans)
+        if self.sink is not None:
+            self.sink(text)

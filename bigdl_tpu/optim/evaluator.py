@@ -38,6 +38,7 @@ import numpy as np
 from bigdl_tpu.dataset.dataset import AbstractDataSet, DataSet
 from bigdl_tpu.dataset.prefetch import PrefetchingFeed
 from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
+from bigdl_tpu.obs import trace
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 from bigdl_tpu.utils.engine import Engine
 
@@ -307,7 +308,8 @@ def run_device_eval(model, params, mstate, dataset,
         # host fold for methods without a device kernel: fetch the window's
         # outputs (the ONLY d2h logits traffic left) and apply per batch
         t0 = time.perf_counter()
-        outs = _fetch(outs_dev)
+        with trace.span("eval/fetch"):
+            outs = _fetch(outs_dev)
         stats["wait_ms"] += (time.perf_counter() - t0) * 1e3
         stats["fetch_bytes"] += _nbytes(outs_dev)
         per_batch = outs if is_window else [outs]
@@ -320,7 +322,7 @@ def run_device_eval(model, params, mstate, dataset,
     feed = PrefetchingFeed(lambda: dataset.data(train=False), place,
                            depth=_prefetch_depth(depth),
                            window=fuse, train=False)
-    with feed:
+    with feed, trace.span("eval/pass"):
         for group, placed in feed:
             if not isinstance(group, list):
                 group = [group]
@@ -331,10 +333,14 @@ def run_device_eval(model, params, mstate, dataset,
                                     group[0])
             inp, tgt, mask = placed
             if len(group) > 1:
-                carry, outs = foldK(params, mstate, carry, inp, tgt, mask)
+                with trace.span("eval/window", {"k": len(group)}):
+                    carry, outs = foldK(params, mstate, carry, inp, tgt,
+                                        mask)
                 stats["fused_windows"] += 1
             else:
-                carry, outs = fold1(params, mstate, carry, inp, tgt, mask)
+                with trace.span("eval/batch"):
+                    carry, outs = fold1(params, mstate, carry, inp, tgt,
+                                        mask)
             if need_outs:
                 if pending is not None:
                     # double-buffer: fetch window i-1 while window i computes

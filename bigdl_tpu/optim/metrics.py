@@ -16,10 +16,14 @@ import threading
 import time
 from collections import defaultdict
 
+from bigdl_tpu.obs.registry import registry as _obs_registry
+
 
 class Metrics:
     """Thread-safe phase-timing accumulator (the producer thread times
-    ``put_batch`` while the step loop times ``feed``/``step_dispatch``)."""
+    ``put_batch`` while the step loop times ``feed``/``step_dispatch``).
+    Every add also publishes into the process-wide obs registry as
+    ``phase/<name>`` — the unified run report reads one source."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -30,6 +34,7 @@ class Metrics:
         with self._lock:
             self._sums[name] += seconds
             self._counts[name] += 1
+        _obs_registry.histogram("phase/" + name).observe(seconds)
 
     def timer(self, name: str):
         return _Timer(self, name)

@@ -37,6 +37,10 @@ from bigdl_tpu.dataset.dataset import (
 from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.nn.abstractnn import AbstractModule
 from bigdl_tpu.nn.criterion import AbstractCriterion
+from bigdl_tpu.obs import registry as obs_registry
+from bigdl_tpu.obs import report as obs_report
+from bigdl_tpu.obs import trace
+from bigdl_tpu.obs import watchdog as obs_watchdog
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
@@ -218,6 +222,9 @@ class Optimizer:
         self._epoch_order = None
         self._resume_feed: Optional[dict] = None
         self._resume_base_rng = None
+        # hang watchdog (obs/watchdog.py, BIGDL_WATCHDOG_S): owned per
+        # optimize() call; the loop heartbeats it per step/window
+        self._watchdog = None
 
     # fluent config (reference API shape) ----------------------------------
     def set_model(self, model: AbstractModule) -> "Optimizer":
@@ -803,7 +810,7 @@ class Optimizer:
             hit = cache.get(id(batch))
             if hit is not None and hit[0] is batch:
                 return hit[1]
-        with self.metrics.timer("put_batch"):
+        with self.metrics.timer("put_batch"), trace.span("feed/h2d"):
             placed = self._place_batch(batch)
         if cache is not None:
             cache[id(batch)] = (batch, placed)
@@ -846,7 +853,7 @@ class Optimizer:
             hit = cache.get(key)
             if hit is not None and all(a is b for a, b in zip(hit[0], batches)):
                 return hit[1]
-        with self.metrics.timer("put_batch"):
+        with self.metrics.timer("put_batch"), trace.span("feed/h2d"):
             placed = self._place_window(batches)
         if cache is not None:
             nbytes = sum(getattr(b.input, "nbytes", 0)
@@ -927,10 +934,19 @@ class Optimizer:
         max_nan = int(os.environ.get("BIGDL_MAX_NAN_ROLLBACKS", "2"))
         nan_rollbacks = 0
         self._install_signal_handlers()
+        # unified observability: re-read the BIGDL_TRACE/BIGDL_OBS_LOG config
+        # and arm the hang watchdog (if BIGDL_WATCHDOG_S is set) for the
+        # whole run, retries included
+        trace.configure_from_env()
+        self._watchdog = obs_watchdog.from_env()
+        if self._watchdog is not None:
+            self._watchdog.start()
         try:
             return self._optimize_with_retry(retry_budget, max_nan,
                                              nan_rollbacks)
         finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
             self._restore_signal_handlers()
             self._rob_snap0 = None
 
@@ -1172,19 +1188,19 @@ class Optimizer:
         self._setup_device_cache()
 
         from bigdl_tpu.dataset.prefetch import PrefetchingFeed
-        from bigdl_tpu.dataset.profiling import feed_stats
 
         state = self.state
         records = 0
-        # per-stage feed attribution baseline: the decode/augment/stack stages
-        # report into the process-wide sink; h2d is this optimizer's own
-        # put_batch timer. Snapshot here so summaries show THIS run's means.
-        feed_stage_snap0 = feed_stats.snapshot()
+        # per-stage feed attribution baseline: every rail (decode/augment/
+        # stack stage timers, the h2d put_batch phase, robustness counters)
+        # publishes into the obs registry — ONE snapshot is the run baseline
+        # for the summary curves and the end-of-run report.
+        reg = obs_registry.registry
+        reg_snap0 = reg.snapshot()
+        step_hist = reg.histogram("train/step_wall")
         rob_snap0 = getattr(self, "_rob_snap0", None)
         if rob_snap0 is None:  # _optimize_impl called outside optimize()
             rob_snap0 = events.snapshot()
-        h2d_snap0 = (self.metrics.totals().get("put_batch", 0.0),
-                     self.metrics.counts().get("put_batch", 0))
         window_t0 = time.perf_counter()
         # device-side losses awaiting fetch: list of (neval, DeviceArray). Fetched
         # in batches every log_every iterations — this backend charges ~75 ms per
@@ -1212,6 +1228,7 @@ class Optimizer:
                 dt = time.perf_counter() - window_t0
                 thr = records / dt if dt > 0 else 0.0
                 state["throughput"] = thr
+                reg.gauge("train/throughput").set(thr)
                 drops = [v for t, v in
                          (state.get("state_metrics") or {}).items()
                          if t.endswith("dropped_fraction")]
@@ -1229,7 +1246,7 @@ class Optimizer:
                 # window keeps accumulating
                 logger.info("Epoch %d iter %d: loss %.6f",
                             state["epoch"], state["neval"], state["loss"])
-            stages = self._feed_stage_report(feed_stage_snap0, h2d_snap0)
+            stages = self._feed_stage_report(reg_snap0)
             if stages:
                 # decode/augment are ms/IMAGE, stack/h2d ms/BATCH — per-stage
                 # regressions show as their own training summary curves
@@ -1248,6 +1265,7 @@ class Optimizer:
                         f"Robustness/{kind}", float(n), state["neval"])
 
         resume_feed, self._resume_feed = self._resume_feed, None
+        iter_mark = time.perf_counter()
         while not stop:
             state["epoch_finished"] = False
             skip = 0
@@ -1291,7 +1309,8 @@ class Optimizer:
                 make_iter,
                 self._put_window if fuse > 1 else self._put_batch,
                 self.prefetch_depth, window=fuse)
-            with feed:
+            with feed, trace.span("train/epoch",
+                                  {"epoch": state["epoch"]}):
                 feed_it = iter(feed)
                 while True:
                     # endWhen is evaluated at loop top with the reference's 1-based
@@ -1301,11 +1320,15 @@ class Optimizer:
                         break
                     # "feed" = time the step loop actually *waits* on data; in
                     # steady state the producer thread hides assembly + transfer
-                    with self.metrics.timer("feed"):
+                    t_feed0 = time.perf_counter()
+                    with self.metrics.timer("feed"), \
+                            trace.span("train/feed_wait"):
                         try:
                             item, placed = next(feed_it)
                         except StopIteration:
                             break
+                    self._obs_feed_wait(time.perf_counter() - t_feed0,
+                                        step_hist)
                     epoch_had_data = True
 
                     batches = item if fuse > 1 else [item]
@@ -1327,7 +1350,8 @@ class Optimizer:
                         start_it = state["neval"]
                         step_idx0 = jnp.asarray(start_it - 1, jnp.int32)
                         inp, target = stacked
-                        with self.metrics.timer("step_dispatch"):
+                        with self.metrics.timer("step_dispatch"), \
+                                trace.span("train/window", {"k": k}):
                             out = window_fn(params, mstate, ostate, step_idx0,
                                             inp, target, base_rng)
                         if self.check_numerics:
@@ -1379,6 +1403,8 @@ class Optimizer:
                         # once at the window end is per-step exact
                         self._fire_triggers(params, mstate, ostate, state,
                                             boundary=False, pending=pending)
+                        for it in range(start_it, start_it + k):
+                            faults.fault_point(faults.SITE_STALL, index=it)
                         fired = any([
                             faults.fault_point(faults.SITE_SIGTERM,
                                                index=it) is not None
@@ -1386,6 +1412,9 @@ class Optimizer:
                         if fired and self._preempt is not None:
                             self._preempt.wait(1.0)
                         state["neval"] += 1
+                        now = time.perf_counter()
+                        self._obs_step(now - iter_mark, k, step_hist)
+                        iter_mark = now
                         if self._preempt_requested():
                             self._do_preempt(params, mstate, ostate, state,
                                              pending)
@@ -1414,7 +1443,8 @@ class Optimizer:
                             profile_stop_at = state["neval"] + self.profile_n_iters
 
                         step_idx = jnp.asarray(state["neval"] - 1, jnp.int32)
-                        with self.metrics.timer("step_dispatch"):
+                        with self.metrics.timer("step_dispatch"), \
+                                trace.span("train/step"):
                             out = step_fn(
                                 params, mstate, ostate, step_idx, inp, target,
                                 base_rng)
@@ -1463,11 +1493,16 @@ class Optimizer:
                         flush_and_log(state["neval"], state["neval"])
                         self._fire_triggers(params, mstate, ostate, state,
                                             boundary=False, pending=pending)
+                        faults.fault_point(faults.SITE_STALL,
+                                           index=state["neval"])
                         if faults.fault_point(faults.SITE_SIGTERM,
                                               index=state["neval"]) \
                                 is not None and self._preempt is not None:
                             self._preempt.wait(1.0)
                         state["neval"] += 1
+                        now = time.perf_counter()
+                        self._obs_step(now - iter_mark, 1, step_hist)
+                        iter_mark = now
                         if self._preempt_requested():
                             self._do_preempt(params, mstate, ostate, state,
                                              pending)
@@ -1498,7 +1533,7 @@ class Optimizer:
         self._final_ostate = jax.device_get(ostate)
         if self.metrics.summary():
             logger.info("phase timings (mean): %r", self.metrics)
-        stages = self._feed_stage_report(feed_stage_snap0, h2d_snap0)
+        stages = self._feed_stage_report(reg_snap0)
         if stages:
             state["feed_stage_ms"] = stages
             logger.info(
@@ -1510,21 +1545,68 @@ class Optimizer:
             # faults must not look identical to a clean one
             state["robustness"] = rob
             logger.info("robustness report: %s", events.format_report(rob))
+        # ---- unified run report: ONE merged view (step percentiles, feed
+        # attribution, robustness counters, span totals) — logged here,
+        # stored in state, appended to the JSONL event log (from which
+        # `bigdl-tpu diag` re-renders the identical text), and the Chrome
+        # trace exported alongside when tracing is on
+        wd = self._watchdog
+        run_report = obs_report.build_report(
+            reg_snap0, reg.snapshot(), span_totals=trace.span_totals(),
+            robustness=rob, watchdog_dumps=wd.dumps if wd is not None else 0)
+        state["run_report"] = run_report
+        logger.info("run report:\n%s", obs_report.format_report(run_report))
+        trace.event("run_report", report=run_report)
+        chrome = trace.export_chrome()
+        if chrome is not None:
+            logger.info("chrome trace written: %s (event log: %s)",
+                        chrome, trace.jsonl_path())
         return self.model
 
-    def _feed_stage_report(self, stage_snap0, h2d_snap0) -> dict:
-        """Mean ms per stage occurrence since the run's baseline snapshots:
-        decode/augment/stack from the dataset layer's stage sink, h2d from
-        this optimizer's ``put_batch`` timer."""
-        from bigdl_tpu.dataset.profiling import stage_deltas_ms
-        out = {stage: round(d["ms"], 3)
-               for stage, d in stage_deltas_ms(stage_snap0).items()}
-        h2d_t, h2d_n = (self.metrics.totals().get("put_batch", 0.0),
-                        self.metrics.counts().get("put_batch", 0))
-        if h2d_n > h2d_snap0[1]:
-            out["h2d"] = round(
-                1e3 * (h2d_t - h2d_snap0[0]) / (h2d_n - h2d_snap0[1]), 3)
+    @staticmethod
+    def _feed_stage_report(reg_snap0: dict) -> dict:
+        """Mean ms per stage occurrence since the run's registry baseline.
+        Every stage rail publishes into the obs registry (``feed/<stage>``
+        from the dataset layer, ``phase/put_batch`` = h2d from the trainer's
+        own timer), so ONE snapshot delta is the whole attribution."""
+        snap1 = obs_registry.registry.snapshot()
+        h0 = reg_snap0.get("histograms", {})
+        out = {}
+        for name, h in snap1.get("histograms", {}).items():
+            if name.startswith("feed/"):
+                stage = name[len("feed/"):]
+            elif name == "phase/put_batch":
+                stage = "h2d"
+            else:
+                continue
+            base = h0.get(name, {})
+            dc = h["count"] - base.get("count", 0)
+            dt = h["total"] - base.get("total", 0.0)
+            if dc > 0:
+                out[stage] = round(1e3 * dt / dc, 3)
         return out
+
+    # ------------------------------------------------------- observability
+    def _obs_step(self, wall_s: float, k: int, step_hist) -> None:
+        """Per-step observability bookkeeping at a step/window boundary:
+        record the per-step wall time (window wall / k) into the rolling
+        ``train/step_wall`` histogram and heartbeat the hang watchdog with
+        the whole dispatch unit's duration."""
+        per = wall_s / k
+        for _ in range(k):
+            step_hist.observe(per)
+        wd = self._watchdog
+        if wd is not None:
+            wd.heartbeat(wall_s)
+
+    @staticmethod
+    def _obs_feed_wait(wait_s: float, step_hist) -> None:
+        """Feed-stall accounting: a step that waited on data longer than
+        half the rolling median step time (and >10 ms) counts as a stall —
+        the one number that says "the accelerator sat idle for the feed"."""
+        med = step_hist.median()
+        if med is not None and wait_s > max(0.010, 0.5 * med):
+            obs_registry.registry.counter("train/feed_stall").inc()
 
     # ---------------------------------------------------------- loss flush
     def _guard_loss(self, it: int, v: float) -> float:
@@ -1580,7 +1662,7 @@ class Optimizer:
             to_fetch = list(pending)
         if not to_fetch:
             return 0
-        with self.metrics.timer("loss_fetch"):
+        with self.metrics.timer("loss_fetch"), trace.span("train/loss_fetch"):
             vals, errs, mvals = jax.device_get(
                 ([l for _, l, _, _, _, _ in to_fetch],
                  [e for _, _, _, e, _, _ in to_fetch],
@@ -1715,7 +1797,7 @@ class Optimizer:
         # feed's pipelining — and device-capable methods fold on device, so
         # the pass fetches O(1) metric scalars instead of per-batch logits.
         from bigdl_tpu.optim.evaluator import run_device_eval
-        with self.metrics.timer("validation"):
+        with self.metrics.timer("validation"), trace.span("train/validation"):
             results, stats = run_device_eval(
                 self.model, params, mstate, self.val_dataset,
                 list(self.val_methods), depth=self.prefetch_depth,
@@ -1815,7 +1897,8 @@ class Optimizer:
                         os.fsync(f.fileno())
                         import signal
                         os.kill(os.getpid(), signal.SIGKILL)
-                ckpt_file.save(payload, path)
+                with trace.span("ckpt/write", {"path": path}):
+                    ckpt_file.save(payload, path)
                 self._prune_old_checkpoints()
                 logger.info("checkpoint written: %s", path)
             except BaseException as e:  # surfaced at the next join

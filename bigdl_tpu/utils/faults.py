@@ -25,6 +25,10 @@ site                      where it fires
 ``ckpt_write``            the background checkpoint writer — ``torn`` leaves a
                           half-written final file, ``error`` fails the write,
                           ``kill`` SIGKILLs the process mid-write
+``stall``                 the trainer's step loop — sleeps
+                          ``BIGDL_FAULT_STALL_S`` seconds (default 2) at
+                          iteration N (matched by ``index``), simulating a
+                          silent device/feed hang for the obs watchdog suite
 ========================  ====================================================
 
 A plan is a ``;``-separated list of entries ``site@N`` or ``site@N=action``.
@@ -59,10 +63,11 @@ SITE_H2D = "h2d"
 SITE_NONFINITE_LOSS = "nonfinite_loss"
 SITE_SIGTERM = "sigterm"
 SITE_CKPT_WRITE = "ckpt_write"
+SITE_STALL = "stall"
 
 #: sites whose plan entries match the caller-supplied ``index`` (training
 #: iteration) instead of the site's hit counter
-_INDEX_MATCHED = frozenset({SITE_NONFINITE_LOSS, SITE_SIGTERM})
+_INDEX_MATCHED = frozenset({SITE_NONFINITE_LOSS, SITE_SIGTERM, SITE_STALL})
 
 _DEFAULT_ACTION = {
     SITE_DECODE: "error",
@@ -71,10 +76,11 @@ _DEFAULT_ACTION = {
     SITE_NONFINITE_LOSS: "nan",
     SITE_SIGTERM: "sigterm",
     SITE_CKPT_WRITE: "torn",
+    SITE_STALL: "stall",
 }
 
 _KNOWN_ACTIONS = frozenset({"error", "death", "nan", "sigterm", "torn",
-                            "kill"})
+                            "kill", "stall"})
 
 
 class FaultError(RuntimeError):
@@ -247,4 +253,8 @@ def fault_point(site: str, index: Optional[int] = None) -> Optional[str]:
         import signal
         os.kill(os.getpid(),
                 signal.SIGTERM if action == "sigterm" else signal.SIGKILL)
+    if action == "stall":
+        # simulated silent hang (watchdog suite): block the calling thread
+        import time
+        time.sleep(float(os.environ.get("BIGDL_FAULT_STALL_S", "2")))
     return action

@@ -24,11 +24,30 @@ class LoggerFilter:
                  loggers: tuple[str, ...] = _NOISY) -> None:
         """Raise ``loggers`` to ``level`` on the console; with ``path``, send
         their full output to a file instead of dropping it (reference
-        ``LoggerFilter.redirect`` semantics)."""
+        ``LoggerFilter.redirect`` semantics).
+
+        Idempotent: calling it again re-applies the new level/path without
+        stacking saved state, so ``restore`` always returns to the TRUE
+        pre-redirect baseline (levels/handlers/propagate as they were before
+        the FIRST redirect), not to an intermediate redirect."""
+        already_saved = {id(lg) for lg, _ in cls._saved_levels}
         for name in loggers:
             lg = logging.getLogger(name)
-            cls._saved_levels.append((lg, lg.level))
+            if id(lg) not in already_saved:
+                # first redirect of this logger: its current state IS the
+                # baseline restore() must return to
+                cls._saved_levels.append((lg, lg.level))
             lg.setLevel(level if path is None else logging.DEBUG)
+            # a repeated redirect replaces this logger's file handler (and
+            # keeps the ORIGINAL propagate flag for restore) instead of
+            # stacking a second handler on it
+            for i, (olg, oh, was_propagating) in enumerate(cls._handlers):
+                if olg is lg:
+                    olg.removeHandler(oh)
+                    oh.close()
+                    lg.propagate = was_propagating
+                    del cls._handlers[i]
+                    break
             if path is not None:
                 h = logging.FileHandler(path)
                 h.setLevel(logging.DEBUG)

@@ -32,6 +32,9 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from bigdl_tpu.obs import trace as _obs_trace
+from bigdl_tpu.obs.registry import registry as _obs_registry
+
 #: recent-event detail log bound — counts are unbounded, details are a window
 _LOG_CAP = 256
 
@@ -53,6 +56,10 @@ class RobustnessEvents:
                 entry = {"kind": kind}
                 entry.update(info)
                 self._log.append(entry)
+        # unified rails: the counter is readable from the obs registry and
+        # the action lands in the structured JSONL event log (when active)
+        _obs_registry.counter("robustness/" + kind).inc()
+        _obs_trace.event("robustness", event=kind, **info)
 
     def counts(self) -> dict:
         with self._lock:

@@ -36,15 +36,19 @@ class Summary:
         return self
 
     def read_scalar(self, tag: str):
-        """Return [(step, value, wall_time)] for ``tag`` across this mode's files."""
+        """Return [(step, value, wall_time)] for ``tag`` across this mode's
+        files, ordered by ``(step, wall_time)`` — lexical filename order lies
+        the moment a timestamp crosses a digit boundary or several writers
+        share a second."""
         out = []
-        for fname in sorted(os.listdir(self.dir)):
+        for fname in os.listdir(self.dir):
             if ".tfevents." not in fname:
                 continue
             for ev in read_events(os.path.join(self.dir, fname)):
                 for t, v in ev["values"]:
                     if t == tag and v is not None:
                         out.append((ev["step"], v, ev["wall_time"]))
+        out.sort(key=lambda r: (r[0], r[2]))
         return out
 
     def close(self) -> None:

@@ -135,12 +135,21 @@ def encode_file_version_event(wall_time: float) -> bytes:
 
 
 # ------------------------------------------------------------------ writer
+import itertools
+
+#: per-process writer sequence number: two writers opened in the same second
+#: on one host must not collide on (timestamp, hostname) alone — the pid
+#: disambiguates across processes, the counter within one
+_WRITER_SEQ = itertools.count()
+
+
 class EventWriter:
     """Appends TFRecord-framed Event protos to one event file."""
 
     def __init__(self, log_dir: str):
         os.makedirs(log_dir, exist_ok=True)
-        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{socket.gethostname()}.{os.getpid()}.{next(_WRITER_SEQ)}")
         self.path = os.path.join(log_dir, fname)
         self._f = open(self.path, "ab")
         self._write_record(encode_file_version_event(time.time()))

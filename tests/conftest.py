@@ -24,10 +24,15 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _fresh_engine():
-    """Reset Engine + RNG between tests for determinism."""
+    """Reset Engine + RNG (and the obs tracer/registry sinks) between tests
+    for determinism."""
     yield
+    from bigdl_tpu.obs import trace
+    from bigdl_tpu.obs.registry import registry as obs_registry
     from bigdl_tpu.utils.engine import Engine
     from bigdl_tpu.utils.random_generator import RandomGenerator
 
     Engine.reset()
     RandomGenerator.set_seed(1)
+    trace.reset()
+    obs_registry.reset()

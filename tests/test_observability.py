@@ -1,0 +1,401 @@
+"""Unified observability suite (`make t1-obs`): span tracer Chrome-trace
+export, the metric registry, the hang watchdog, the JSONL event log +
+`bigdl-tpu diag` round trip, and the satellites (EventWriter filename
+collisions, `read_scalar` ordering, idempotent `LoggerFilter.redirect`).
+
+Acceptance shape: a LeNet-class CPU smoke run with tracing on produces a
+Chrome-trace JSON that loads (well-formed X events, per-thread tids, spans
+nested by time containment across >= 3 threads — step loop, prefetch
+producer, transform worker), a JSONL event log that `diag` re-renders into
+the SAME run report the trainer printed, and a watchdog that provably fires
+on an injected stall and dumps thread stacks + open spans.
+"""
+
+import json
+import logging
+import os
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.dataset import DataSet
+from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
+from bigdl_tpu.dataset.transformer import MapTransformer
+from bigdl_tpu.obs import report as obs_report
+from bigdl_tpu.obs import trace, watchdog
+from bigdl_tpu.obs.registry import MetricRegistry, registry as obs_registry
+from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+from bigdl_tpu.utils import faults
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+pytestmark = pytest.mark.obs
+
+
+def _data(n=64, batch=16, transformed=False):
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                      np.int32(rng.integers(0, 3))) for _ in range(n)]
+    ds = DataSet.array(samples)
+    if transformed:
+        # a real transform stage so BIGDL_DATA_WORKERS spawns worker threads
+        ds = ds >> MapTransformer(lambda s: s)
+    return ds >> SampleToMiniBatch(batch)
+
+
+def _model():
+    return nn.Sequential().add(nn.Linear(8, 3)).add(nn.LogSoftMax())
+
+
+def _train(ds, n_iter=10, seed=3):
+    Engine.reset()
+    RandomGenerator.set_seed(1)
+    Engine.init(seed=seed)
+    opt = (LocalOptimizer(_model(), ds, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(learningrate=0.1))
+           .set_end_when(Trigger.max_iteration(n_iter)))
+    opt.optimize()
+    return opt
+
+
+# ------------------------------------------------------------- span tracer
+class TestChromeTraceExport:
+    def test_trace_valid_spans_threads_and_nesting(self, tmp_path,
+                                                   monkeypatch):
+        # the acceptance smoke: training through a parallel transform
+        # pipeline with tracing on → spans on >= 3 threads (step loop,
+        # prefetch producer, transform worker), all well-formed, nested
+        monkeypatch.setenv("BIGDL_DATA_WORKERS", "2")
+        trace.configure(enabled=True, trace_dir=str(tmp_path))
+        _train(_data(transformed=True), n_iter=8)
+        path = trace.chrome_path()
+        assert path is not None and os.path.exists(path)
+        with open(path) as f:
+            doc = json.load(f)  # valid JSON or this raises
+        events = doc["traceEvents"]
+        spans = [e for e in events if e.get("ph") == "X"]
+        assert spans, "no spans recorded"
+        for e in spans:
+            assert e["ph"] == "X"
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+            assert "tid" in e and "pid" in e and "name" in e
+        # thread-name metadata present for every span-carrying tid
+        meta = {e["tid"]: e["args"]["name"] for e in events
+                if e.get("ph") == "M" and e.get("name") == "thread_name"}
+        tids = {e["tid"] for e in spans}
+        assert tids <= set(meta)
+        by_thread_kind = {}
+        for e in spans:
+            by_thread_kind.setdefault(meta[e["tid"]], set()).add(e["name"])
+        step_threads = [t for t, names in by_thread_kind.items()
+                        if "train/step" in names]
+        producer = [t for t, names in by_thread_kind.items()
+                    if "feed/put_batch" in names]
+        workers = [t for t, names in by_thread_kind.items()
+                   if "feed/augment" in names]
+        assert step_threads and producer and workers
+        assert len(tids) >= 3
+        # the producer thread is not the step loop, workers are neither
+        assert set(producer).isdisjoint(step_threads)
+        assert set(workers).isdisjoint(step_threads)
+
+    def test_worker_spans_nest_under_their_stage(self, tmp_path,
+                                                 monkeypatch):
+        # nesting by time containment on the same tid: every feed/augment
+        # span lies inside a feed/transform span on its worker thread
+        monkeypatch.setenv("BIGDL_DATA_WORKERS", "2")
+        trace.configure(enabled=True, trace_dir=str(tmp_path))
+        _train(_data(transformed=True), n_iter=6)
+        with open(trace.export_chrome()) as f:
+            spans = [e for e in json.load(f)["traceEvents"]
+                     if e.get("ph") == "X"]
+        outer = [e for e in spans if e["name"] == "feed/transform"]
+        inner = [e for e in spans if e["name"] == "feed/augment"]
+        assert outer and inner
+        for e in inner:
+            assert any(o["tid"] == e["tid"]
+                       and o["ts"] <= e["ts"]
+                       and e["ts"] + e["dur"] <= o["ts"] + o["dur"] + 1e-3
+                       for o in outer), "augment span not nested in stage"
+
+    def test_disabled_path_allocates_no_spans(self):
+        # the zero-cost pin: with tracing off, span() returns the shared
+        # no-op singleton and constructs NOTHING — counted per _Span.__init__
+        trace.configure(enabled=False)
+        made0 = trace._SPANS_CREATED
+        _train(_data(), n_iter=6)
+        assert trace._SPANS_CREATED == made0
+        s1 = trace.span("train/step")
+        s2 = trace.span("feed/decode")
+        assert s1 is s2  # the singleton, not a fresh object
+        assert trace._SPANS_CREATED == made0
+
+    def test_span_totals_and_open_spans(self):
+        trace.configure(enabled=True)
+        with trace.span("outer"):
+            with trace.span("inner"):
+                open_now = trace.open_spans()
+        tot = trace.span_totals()
+        assert tot["outer"]["count"] == 1 and tot["inner"]["count"] == 1
+        (stack,) = open_now.values()
+        assert [e["name"] for e in stack] == ["outer", "inner"]
+        assert trace.open_spans() == {}  # all closed again
+
+
+# --------------------------------------------------------- metric registry
+class TestMetricRegistry:
+    def test_counter_gauge_histogram(self):
+        reg = MetricRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(2)
+        reg.gauge("g").set(4.5)
+        h = reg.histogram("h")
+        for v in range(1, 101):
+            h.observe(float(v))
+        snap = reg.snapshot()
+        assert snap["counters"]["c"] == 3
+        assert snap["gauges"]["g"] == 4.5
+        hs = snap["histograms"]["h"]
+        assert hs["count"] == 100 and hs["min"] == 1.0 and hs["max"] == 100.0
+        assert abs(hs["mean"] - 50.5) < 1e-9
+        assert 49 <= hs["p50"] <= 52
+        assert 94 <= hs["p95"] <= 97
+        assert 98 <= hs["p99"] <= 100
+        assert h.median() == pytest.approx(51.0, abs=2)
+
+    def test_median_needs_min_count(self):
+        reg = MetricRegistry()
+        h = reg.histogram("h")
+        for _ in range(7):
+            h.observe(1.0)
+        assert h.median() is None
+        h.observe(1.0)
+        assert h.median() == 1.0
+
+    def test_legacy_rails_publish_through(self):
+        from bigdl_tpu.dataset.profiling import feed_stats
+        from bigdl_tpu.optim.metrics import Metrics
+        from bigdl_tpu.utils.robustness import events
+
+        snap0 = obs_registry.snapshot()
+        c0 = snap0["histograms"].get("phase/put_batch", {}).get("count", 0)
+        d0 = snap0["histograms"].get("feed/decode", {}).get("count", 0)
+        r0 = snap0["counters"].get("robustness/sample_skipped", 0)
+        Metrics().add("put_batch", 0.002)
+        feed_stats.add("decode", 0.001)
+        events.record("sample_skipped", stage="decode")
+        snap1 = obs_registry.snapshot()
+        assert snap1["histograms"]["phase/put_batch"]["count"] == c0 + 1
+        assert snap1["histograms"]["feed/decode"]["count"] == d0 + 1
+        assert snap1["counters"]["robustness/sample_skipped"] == r0 + 1
+
+
+# ------------------------------------------------------------ run report
+class TestRunReportAndDiag:
+    def test_report_in_state_and_text(self):
+        opt = _train(_data(), n_iter=10)
+        rep = opt.state["run_report"]
+        assert rep["steps"]["count"] == 10
+        assert rep["steps"]["p95_ms"] >= rep["steps"]["p50_ms"]
+        assert "h2d" in rep["feed_stages"]
+        text = obs_report.format_report(rep)
+        assert text.startswith("=== bigdl-tpu run report ===")
+        assert "steps: 10" in text
+
+    def test_diag_rerenders_identical_report(self, tmp_path, capsys):
+        from bigdl_tpu import cli
+
+        trace.configure(enabled=True, trace_dir=str(tmp_path))
+        opt = _train(_data(), n_iter=10)
+        jsonl = trace.jsonl_path()
+        expected = obs_report.format_report(opt.state["run_report"])
+        rc = cli.main(["diag", jsonl])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out == expected + "\n"
+
+    def test_diag_without_report_fails_cleanly(self, tmp_path, capsys):
+        from bigdl_tpu import cli
+
+        p = tmp_path / "empty.jsonl"
+        p.write_text('{"ts": 0, "kind": "robustness", "event": "resume"}\n')
+        rc = cli.main(["diag", str(p)])
+        assert rc == 1
+        assert "no run_report" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------- watchdog
+class TestHangWatchdog:
+    def test_unit_fires_on_missing_heartbeat(self):
+        dumps = []
+        wd = watchdog.HangWatchdog(hard_s=0.15, poll_s=0.02,
+                                   sink=dumps.append)
+        wd.start()
+        try:
+            wd.heartbeat(0.01)
+            time.sleep(0.6)
+        finally:
+            wd.stop()
+        assert wd.dumps == 1  # once per stall, not once per poll
+        assert "BIGDL WATCHDOG" in dumps[0]
+        assert "thread MainThread" in dumps[0]
+
+    def test_not_armed_before_first_heartbeat(self):
+        dumps = []
+        wd = watchdog.HangWatchdog(hard_s=0.05, poll_s=0.02,
+                                   sink=dumps.append)
+        wd.start()
+        try:
+            time.sleep(0.3)  # compile-time analog: no heartbeat yet
+        finally:
+            wd.stop()
+        assert dumps == []
+
+    def test_heartbeat_rearms(self):
+        dumps = []
+        wd = watchdog.HangWatchdog(hard_s=0.1, poll_s=0.02,
+                                   sink=dumps.append)
+        wd.start()
+        try:
+            wd.heartbeat(0.01)
+            time.sleep(0.3)
+            wd.heartbeat(0.01)
+            time.sleep(0.3)
+        finally:
+            wd.stop()
+        assert wd.dumps == 2
+
+    def test_fires_on_injected_stall_with_stacks_and_spans(
+            self, tmp_path, monkeypatch):
+        # the acceptance scenario: a scripted mid-run stall
+        # (utils/faults.py `stall` site) trips the hard BIGDL_WATCHDOG_S
+        # timeout; the dump carries every thread's stack and the open-span
+        # tree, in the JSONL log
+        monkeypatch.setenv("BIGDL_WATCHDOG_S", "0.4")
+        monkeypatch.setenv("BIGDL_FAULT_STALL_S", "1.2")
+        trace.configure(enabled=True, trace_dir=str(tmp_path))
+        with faults.inject_faults("stall@4") as plan:
+            opt = _train(_data(), n_iter=8)
+        assert plan.unfired() == []
+        assert opt._watchdog is not None and opt._watchdog.dumps >= 1
+        evs = trace.read_events(trace.jsonl_path())
+        dumps = [e for e in evs if e["kind"] == "watchdog_dump"]
+        assert len(dumps) >= 1
+        d = dumps[0]
+        assert d["elapsed_s"] > d["limit_s"]
+        # every live thread's stack, including the stalled step loop
+        assert any("MainThread" in k for k in d["threads"])
+        assert any("time.sleep" in s or "fault_point" in s
+                   for s in d["threads"].values())
+        # the open-span tree shows what the loop was inside when it hung
+        assert d["open_spans"], "no open spans in the dump"
+        names = [e["name"] for stack in d["open_spans"].values()
+                 for e in stack]
+        assert any(n.startswith("train/") for n in names)
+        # the run report counts the dump
+        assert opt.state["run_report"]["watchdog_dumps"] >= 1
+
+    def test_from_env_disabled_by_default(self, monkeypatch):
+        monkeypatch.delenv("BIGDL_WATCHDOG_S", raising=False)
+        assert watchdog.from_env() is None
+        monkeypatch.setenv("BIGDL_WATCHDOG_S", "0")
+        assert watchdog.from_env() is None
+        monkeypatch.setenv("BIGDL_WATCHDOG_S", "30")
+        wd = watchdog.from_env()
+        assert wd is not None and wd.hard_s == 30.0
+
+
+# ------------------------------------------------- satellite: EventWriter
+class TestEventWriterSatellites:
+    def test_same_second_writers_do_not_collide(self, tmp_path):
+        from bigdl_tpu.visualization.tensorboard import EventWriter
+
+        a = EventWriter(str(tmp_path))
+        b = EventWriter(str(tmp_path))  # same host, same wall-clock second
+        assert a.path != b.path
+        a.add_scalar("x", 1.0, 1)
+        b.add_scalar("x", 2.0, 2)
+        a.close()
+        b.close()
+        assert len([f for f in os.listdir(tmp_path)
+                    if ".tfevents." in f]) == 2
+
+    def test_read_scalar_orders_by_step_then_wall_time(self, tmp_path):
+        from bigdl_tpu.visualization import TrainSummary
+
+        s = TrainSummary(str(tmp_path), "app")
+        # first writer logs LATER steps; a second (lexically later file)
+        # logs earlier steps — lexical file order would interleave wrongly
+        s.add_scalar("Loss", 3.0, 30)
+        s.close()
+        s2 = TrainSummary(str(tmp_path), "app")
+        s2.add_scalar("Loss", 1.0, 10)
+        s2.add_scalar("Loss", 2.0, 20)
+        s2.close()
+        got = TrainSummary(str(tmp_path), "app").read_scalar("Loss")
+        steps = [r[0] for r in got]
+        assert steps == sorted(steps) == [10, 20, 30]
+        walls = [r[2] for r in got]
+        assert all(w > 0 for w in walls)
+
+
+# ---------------------------------------------- satellite: LoggerFilter
+class TestLoggerFilterIdempotency:
+    def test_redirect_restore_round_trip(self, tmp_path):
+        from bigdl_tpu.utils.logger_filter import LoggerFilter
+
+        names = ("bigdl_test_noisy_a", "bigdl_test_noisy_b")
+        lgs = [logging.getLogger(n) for n in names]
+        base_levels = [lg.level for lg in lgs]
+        base_handlers = [list(lg.handlers) for lg in lgs]
+        base_prop = [lg.propagate for lg in lgs]
+        try:
+            LoggerFilter.redirect(level=logging.ERROR, loggers=names)
+            # repeated redirects (incl. a path change) must not stack state
+            LoggerFilter.redirect(path=str(tmp_path / "a.log"),
+                                  loggers=names)
+            LoggerFilter.redirect(path=str(tmp_path / "b.log"),
+                                  loggers=names)
+            for lg in lgs:
+                assert len([h for h in lg.handlers
+                            if isinstance(h, logging.FileHandler)]) == 1
+            mine = [e for e in LoggerFilter._saved_levels if e[0] in lgs]
+            assert len(mine) == len(names)  # one baseline per logger, ever
+            LoggerFilter.restore()
+        finally:
+            LoggerFilter._handlers.clear()
+            LoggerFilter._saved_levels.clear()
+        for lg, lvl, handlers, prop in zip(lgs, base_levels, base_handlers,
+                                           base_prop):
+            assert lg.level == lvl
+            assert lg.handlers == handlers
+            assert lg.propagate == prop
+
+
+# --------------------------------------------------- feed-stall + faults
+class TestFeedStallCounter:
+    def test_slow_feed_counts_stalls(self):
+        # a dataset whose batches arrive far slower than the (tiny) step
+        # time must show up as feed stalls in the run report
+        rng = np.random.default_rng(0)
+        samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                          np.int32(rng.integers(0, 3))) for _ in range(48)]
+
+        def slow(s):
+            time.sleep(0.03)
+            return s
+
+        ds = (DataSet.array(samples) >> MapTransformer(slow)
+              >> SampleToMiniBatch(4))
+        opt = _train(ds, n_iter=24)
+        rep = opt.state["run_report"]
+        assert rep["feed_stalls"] >= 1
+
+    def test_stall_site_sleeps(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_FAULT_STALL_S", "0.2")
+        with faults.inject_faults("stall@1"):
+            t0 = time.perf_counter()
+            faults.fault_point(faults.SITE_STALL, index=1)
+            assert time.perf_counter() - t0 >= 0.2

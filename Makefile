@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 t1-faults t1-obs dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults t1-obs t1-kernels dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -25,6 +25,14 @@ t1-faults:
 t1-obs:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
+# Kernel-equivalence suite only (docs/performance.md "Kernel fusion & memory"):
+# fused conv-bn(-relu) vs unfused fp32 bitwise, flat-param SGD/Adam updates vs
+# per-leaf, grad-accum M∈{1,2,4} vs M=1 on LeNet, remat policies, bench-probe
+# retry hardening. Unmarked-slow, so `make t1` runs these too; this target is
+# the fast inner loop for kernel work.
+t1-kernels:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m kernels --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
 dist:
 	bash make-dist.sh
 
@@ -32,12 +40,15 @@ bench:
 	python bench.py
 
 # CPU smoke of the bench's training + eval legs: catches loop-overhead
-# regressions (loop_step_ratio, fused vs per-step legs) and eval-path
-# regressions (eval fused speedup, val_fetch_bytes_per_image) without a TPU.
+# regressions (loop_step_ratio, fused vs per-step legs), eval-path
+# regressions (eval fused speedup, val_fetch_bytes_per_image), and kernel
+# regressions (conv-bn folding, flat updates, grad-accum/remat memory proxy)
+# without a TPU.
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --model lenet --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --model lenet --eval-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --model lenet --obs-bench --no-compare-dtypes --no-streamed
+	JAX_PLATFORMS=cpu python bench.py --kernel-bench --no-compare-dtypes --no-streamed
 
 # Host input-pipeline leg (decode→augment→stack on a synthetic image folder):
 # pipeline_images_per_sec at BIGDL_DATA_WORKERS 0/1/4/auto + per-stage ms.

@@ -1,0 +1,131 @@
+"""Fused conv → batch-norm → ReLU kernel.
+
+The vision zoo (LeNet/ResNet/Inception/VGG) is built from
+``SpatialConvolution → SpatialBatchNormalization → ReLU`` triples. Under one
+``jit`` XLA already fuses the BN *elementwise tail* into the conv epilogue,
+but the module boundary still costs structure: three modules means three
+params/state subtrees threaded through every step, three ``named_scope``
+rows, and — the real prize — no way to run the classic inference-time
+BN *folding*, where the per-channel scale/shift collapses into the conv
+weights and the normalisation disappears from the program entirely.
+
+:class:`FusedConvBNReLU` owns a (conv, bn) pair as one module:
+
+- **training** (and eval with folding off): delegates to the wrapped
+  modules' own ``apply`` in sequence — the SAME ops in the SAME order, so
+  the fused module is **bitwise identical** to the unfused stack in fp32
+  (pinned by tests/test_kernels.py) while presenting one fusion region to
+  the compiler and one node to the graph;
+- **inference with folding** (``BIGDL_CONVBN_FOLD``, default on): the BN
+  running statistics are folded into the conv — ``w' = w · s``,
+  ``b' = b · s + (β − μ·s)`` with ``s = γ·rsqrt(σ² + ε)`` — and the whole
+  triple runs as ONE conv(+bias)(+relu). Equivalent within float tolerance
+  (the op order changes); the training path is never folded.
+
+Models opt in via the graph-level pass :func:`bigdl_tpu.nn.graph.fuse_conv_bn`
+(env knob ``BIGDL_CONVBN_FUSE=1`` applies it automatically in the Optimizer);
+with the knob off no model is touched — the legacy path is byte-identical.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def fold_bn_scale_shift(bn_params: dict, bn_state: dict, eps: float):
+    """Per-channel (scale, shift) equivalent to an eval-mode batch norm:
+    ``bn(y) == y * scale + shift`` with running statistics. Math in fp32
+    (the unfused BN normalises in fp32 too)."""
+    mean = bn_state["running_mean"].astype(jnp.float32)
+    var = bn_state["running_var"].astype(jnp.float32)
+    inv = jax.lax.rsqrt(var + eps)
+    if "weight" in bn_params:  # affine
+        scale = bn_params["weight"].astype(jnp.float32) * inv
+        shift = bn_params["bias"].astype(jnp.float32) - mean * scale
+    else:
+        scale = inv
+        shift = -mean * scale
+    return scale, shift
+
+
+def fold_bn_into_conv(weight, bias, scale, shift):
+    """Fold a per-output-channel (scale, shift) into OIHW conv weights:
+    returns ``(w', b')`` with ``w' = w·s`` (output-channel axis 0) and
+    ``b' = b·s + shift`` (``bias`` may be None)."""
+    w = weight.astype(jnp.float32) * scale[:, None, None, None]
+    b = shift if bias is None else bias.astype(jnp.float32) * scale + shift
+    return w.astype(weight.dtype), b
+
+
+def fold_enabled() -> bool:
+    """Inference folding knob, read at trace time (``BIGDL_CONVBN_FOLD``,
+    default on — folding only ever applies inside an explicitly fused
+    module, so the legacy unfused path is unaffected either way)."""
+    return os.environ.get("BIGDL_CONVBN_FOLD", "1") != "0"
+
+
+from bigdl_tpu.nn.abstractnn import Container  # noqa: E402
+from bigdl_tpu.utils.serializer import register as _register_serializable  # noqa: E402
+
+
+@_register_serializable
+class FusedConvBNReLU(Container):
+    """One module owning a ``SpatialConvolution → SpatialBatchNormalization
+    (→ ReLU)`` triple. Params/state nest as children ``{"0": conv, "1": bn}``
+    (Container semantics: freeze/regularizers/serialization all keep
+    working). ``fold_inference=None`` defers to ``BIGDL_CONVBN_FOLD`` at
+    trace time; the training path is never folded."""
+
+    def __init__(self, conv, bn, relu: bool = False,
+                 fold_inference: bool | None = None):
+        super().__init__(conv, bn)
+        self.conv, self.bn = conv, bn
+        self.with_relu = bool(relu)
+        self.fold_inference = fold_inference
+
+    def _folds(self) -> bool:
+        if self.fold_inference is not None:
+            return bool(self.fold_inference)
+        return fold_enabled()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        with jax.named_scope(f"fused_conv_bn[{self.conv.name}]"):
+            if not training and self._folds():
+                return self._apply_folded(params, state, input)
+            # delegation path: the exact unfused op sequence — bitwise equal
+            # to Sequential(conv, bn[, relu]) in fp32
+            out, cs = self.conv.apply(params["0"], state["0"], input,
+                                      training=training, rng=None)
+            out, bs = self.bn.apply(params["1"], state["1"], out,
+                                    training=training, rng=None)
+            if self.with_relu:
+                out = jax.nn.relu(out)
+            return out, {"0": cs, "1": bs}
+
+    def _apply_folded(self, params, state, input):
+        from bigdl_tpu.nn import layout
+        scale, shift = fold_bn_scale_shift(params["1"], state["1"],
+                                           self.bn.eps)
+        cp = params["0"]
+        w, b = fold_bn_into_conv(cp["weight"], cp.get("bias"), scale, shift)
+        # reuse the conv's own apply for layout/groups/padding/squeeze; the
+        # folded shift rides its bias slot when the conv has one
+        if "bias" in cp:
+            out, cs = self.conv.apply({"weight": w, "bias": b.astype(w.dtype)},
+                                      state["0"], input, training=False,
+                                      rng=None)
+        else:
+            out, cs = self.conv.apply({"weight": w}, state["0"], input,
+                                      training=False, rng=None)
+            out = out + b.astype(out.dtype).reshape(
+                layout.bias_shape(self.bn.n_output, out.ndim))
+        if self.with_relu:
+            out = jax.nn.relu(out)
+        return out, {"0": cs, "1": dict(state["1"])}
+
+    def __repr__(self):
+        tail = " -> ReLU" if self.with_relu else ""
+        return f"FusedConvBNReLU({self.conv!r} -> {self.bn!r}{tail})"

@@ -1,0 +1,117 @@
+"""Flat-param optimizer update — one fused vector kernel per step.
+
+A model's parameter pytree has one leaf per weight tensor; an elementwise
+optimizer (SGD/Adam/…) traced over it emits one tiny kernel *per leaf per
+op* — on small-layer models (LeNet: 10 leaves; anything with many norms or
+biases: hundreds) the per-kernel launch/bookkeeping overhead dominates the
+actual update math. The fix, standard in TPU training stacks: flatten the
+params/grads/slot pytrees into a handful of contiguous 1-D vectors (one per
+dtype), run the update as a few big fused vector ops, and slice the result
+back into leaves. Concatenate/slice/reshape are exact, and an elementwise
+update computes bit-for-bit the same value per element on the flat vector
+as per leaf — the jitted flat update is **bitwise identical** to the jitted
+per-leaf reference (pinned by tests/test_kernels.py). Inside the full
+compiled train step, XLA may contract FMAs differently around the two
+forms, so end-to-end training agrees to ~1 ulp rather than bitwise.
+
+:class:`FlatParamUpdate` wraps any :class:`OptimMethod` whose ``update`` is
+purely elementwise (``elementwise_update = True`` on the class): the inner
+method's ``tree_map`` update simply runs over the {dtype: vector} pytree
+instead of the model tree. Slots are created flat and STAY flat (the scan
+carry / donation / checkpoint all see a static small pytree); only
+params/grads are flattened and the new params unflattened, per step.
+
+Enable with ``BIGDL_FLAT_UPDATE=1`` / ``Optimizer.set_flat_update(True)``;
+default off (legacy path byte-identical). Methods with per-leaf behavior
+(``layer_lr_mults``, LARS's per-layer trust ratio, L-BFGS's own flattening,
+composite routing) are automatically left on the per-leaf path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def flat_supported(method) -> bool:
+    """Can ``method`` run on the flat vector? Requires a purely elementwise
+    update (class opt-in) and no per-leaf LR multipliers."""
+    if isinstance(method, FlatParamUpdate):
+        return False
+    if getattr(method, "layer_lr_mults", None):
+        return False  # path-keyed multipliers need the leaf structure
+    return bool(getattr(method, "elementwise_update", False))
+
+
+class FlatSpec:
+    """Static flattening plan for one pytree structure: leaves group by
+    dtype (first-seen order) and concatenate into one 1-D vector per group.
+    Built from tracers or arrays — only shape/dtype are read."""
+
+    def __init__(self, tree):
+        leaves, self.treedef = jax.tree_util.tree_flatten(tree)
+        self.metas = []           # per leaf: (group_key, offset, shape)
+        sizes: dict[str, int] = {}  # running group sizes → offsets
+        for leaf in leaves:
+            key = str(jnp.result_type(leaf))
+            shape = tuple(jnp.shape(leaf))
+            n = 1
+            for d in shape:
+                n *= d
+            off = sizes.get(key, 0)
+            self.metas.append((key, off, shape))
+            sizes[key] = off + n
+        self.group_keys = list(sizes)
+
+    def flatten(self, tree) -> dict:
+        """Pytree → {dtype_key: 1-D vector} (order per ``metas``)."""
+        leaves = self.treedef.flatten_up_to(tree)
+        groups: dict[str, list] = {k: [] for k in self.group_keys}
+        for (key, _, _), leaf in zip(self.metas, leaves):
+            groups[key].append(jnp.reshape(leaf, (-1,)))
+        return {k: (v[0] if len(v) == 1 else jnp.concatenate(v))
+                if v else jnp.zeros((0,), k)
+                for k, v in groups.items()}
+
+    def unflatten(self, flat: dict):
+        """{dtype_key: vector} → pytree of the original structure."""
+        leaves = []
+        for key, off, shape in self.metas:
+            n = 1
+            for d in shape:
+                n *= d
+            leaves.append(jnp.reshape(flat[key][off:off + n], shape))
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+from bigdl_tpu.optim.optim_method import OptimMethod  # noqa: E402
+
+
+class FlatParamUpdate(OptimMethod):
+    """Run an elementwise :class:`OptimMethod` over dtype-grouped flat
+    vectors. Stateless wrapper: the flattening plan is re-derived from the
+    (static) parameter structure on every call, so two wrappers over the
+    same inner method are interchangeable (checkpoint slots carry over)."""
+
+    def __init__(self, inner: OptimMethod):
+        self.inner = inner
+
+    @property
+    def learningrate_schedule(self):
+        return getattr(self.inner, "learningrate_schedule", None)
+
+    def init_state(self, params) -> dict:
+        spec = FlatSpec(params)
+        return self.inner.init_state(spec.flatten(params))
+
+    def update(self, params, grads, state, step):
+        spec = FlatSpec(params)
+        new_flat, new_state = self.inner.update(
+            spec.flatten(params), spec.flatten(grads), state, step)
+        return spec.unflatten(new_flat), new_state
+
+    def get_learning_rate(self, step):
+        return self.inner.get_learning_rate(step)
+
+    def __repr__(self):
+        return f"FlatParamUpdate({self.inner!r})"

@@ -29,7 +29,9 @@ from bigdl_tpu.nn.convolution import (
     SpatialSeparableConvolution, SpatialShareConvolution, TemporalConvolution,
 )
 from bigdl_tpu.nn.embedding import HashBucketEmbedding, LookupTable
-from bigdl_tpu.nn.graph import Graph, Input, ModuleNode, StaticGraph
+from bigdl_tpu.nn.graph import (
+    Graph, Input, ModuleNode, StaticGraph, fuse_conv_bn,
+)
 from bigdl_tpu.nn.normalization import (
     Add, BatchNormalization, CAdd, CMul, Dropout, GaussianDropout, GaussianNoise,
     LayerNorm, Mul, Normalize, RMSNorm, SpatialBatchNormalization,
@@ -108,3 +110,19 @@ from bigdl_tpu.nn.shape_ops import (
     Reverse, Select, SpatialZeroPadding, SplitTable, Squeeze, Tile, Transpose,
     Unsqueeze, View,
 )
+
+
+def __getattr__(name):
+    # FusedConvBNReLU subclasses Container, so kernels/conv_bn.py imports
+    # this package — resolve the re-export lazily to break the cycle
+    if name == "FusedConvBNReLU":
+        from bigdl_tpu.kernels.conv_bn import FusedConvBNReLU
+        return FusedConvBNReLU
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    # advertise the lazy export: the serialization registry scans dir(nn),
+    # and a fresh process must resolve FusedConvBNReLU without having
+    # imported kernels/conv_bn first
+    return sorted(list(globals()) + ["FusedConvBNReLU"])

@@ -91,6 +91,21 @@ class SpatialConvolution(TensorModule):
             out = out[0]
         return out, state
 
+    def fuse_bn(self, bn, relu: bool = False,
+                fold_inference: Optional[bool] = None):
+        """Fuse an adjacent :class:`~bigdl_tpu.nn.normalization
+        .SpatialBatchNormalization` (and optional trailing ReLU) into one
+        :class:`~bigdl_tpu.kernels.conv_bn.FusedConvBNReLU` module — the
+        manual entry point of the graph-level ``nn.fuse_conv_bn`` pass.
+        This module's live parameter arrays carry over untouched."""
+        from bigdl_tpu.kernels.conv_bn import FusedConvBNReLU
+        if bn.n_output != self.n_output_plane:
+            raise ValueError(
+                f"fuse_bn: bn features {bn.n_output} != conv output planes "
+                f"{self.n_output_plane}")
+        return FusedConvBNReLU(self, bn, relu=relu,
+                               fold_inference=fold_inference)
+
     def __repr__(self):
         return (f"SpatialConvolution({self.n_input_plane} -> {self.n_output_plane}, "
                 f"{self.kernel_w}x{self.kernel_h}, {self.stride_w},{self.stride_h}, "

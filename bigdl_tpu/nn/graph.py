@@ -163,3 +163,133 @@ class Graph(Container):
 
 # Reference alias: StaticGraph is the concrete eager-plan graph class.
 StaticGraph = Graph
+
+
+# --------------------------------------------------------------------- fusion
+def _fusible_conv(m) -> bool:
+    from bigdl_tpu.nn.convolution import SpatialConvolution
+    return isinstance(m, SpatialConvolution)
+
+
+def _fusible_bn(conv, m) -> bool:
+    from bigdl_tpu.nn.normalization import SpatialBatchNormalization
+    return (isinstance(m, SpatialBatchNormalization)
+            and m.n_output == conv.n_output_plane and not m.sync)
+
+
+def _is_relu(m) -> bool:
+    from bigdl_tpu.nn.activation import ReLU
+    return type(m) is ReLU  # ReLU6 etc. have different math
+
+
+def _fuse_sequential(seq) -> int:
+    """Collapse adjacent conv → bn (→ relu) children of a Sequential into
+    :class:`~bigdl_tpu.kernels.conv_bn.FusedConvBNReLU` nodes, in place.
+    Returns the number of pairs fused."""
+    from bigdl_tpu.kernels.conv_bn import FusedConvBNReLU
+    out, fused, i = [], 0, 0
+    mods = seq.modules
+    while i < len(mods):
+        m = mods[i]
+        if (_fusible_conv(m) and i + 1 < len(mods)
+                and _fusible_bn(m, mods[i + 1])):
+            relu = i + 2 < len(mods) and _is_relu(mods[i + 2])
+            out.append(FusedConvBNReLU(m, mods[i + 1], relu=relu))
+            fused += 1
+            i += 3 if relu else 2
+        else:
+            out.append(m)
+            i += 1
+    if fused:
+        seq.modules = out
+        seq.__dict__.pop("_cached_fwd_jit", None)
+    return fused
+
+
+def _fuse_graph(g: Graph) -> tuple[Graph, int]:
+    """Merge conv → bn (→ relu) chains of a module DAG into single fused
+    nodes (the bn/relu must be the conv's only consumer). Rewires the node
+    graph in place and rebuilds the Graph container around it."""
+    from bigdl_tpu.kernels.conv_bn import FusedConvBNReLU
+
+    succs: dict[int, list[ModuleNode]] = {}
+    for n in g.sorted_nodes:
+        for p in n.prev_nodes:
+            succs.setdefault(p.id, []).append(n)
+
+    def sole_successor(node):
+        s = succs.get(node.id, [])
+        return s[0] if len(s) == 1 else None
+
+    fused = 0
+    outputs = list(g.output_nodes)
+    for node in g.sorted_nodes:
+        conv = node.module
+        if conv is None or not _fusible_conv(conv):
+            continue
+        bn_node = sole_successor(node)
+        if bn_node is None or bn_node.module is None \
+                or not _fusible_bn(conv, bn_node.module) \
+                or len(bn_node.prev_nodes) != 1:
+            continue
+        relu_node = sole_successor(bn_node)
+        if relu_node is not None and (relu_node.module is None
+                                      or not _is_relu(relu_node.module)
+                                      or len(relu_node.prev_nodes) != 1):
+            relu_node = None
+        tail = relu_node if relu_node is not None else bn_node
+        node.module = FusedConvBNReLU(conv, bn_node.module,
+                                      relu=relu_node is not None)
+        fused += 1
+        # consumers of the absorbed tail now read the fused node
+        for consumer in succs.get(tail.id, []):
+            consumer.prev_nodes = [node if p is tail else p
+                                   for p in consumer.prev_nodes]
+        succs[node.id] = succs.pop(tail.id, [])
+        outputs = [node if o is tail else o for o in outputs]
+    if not fused:
+        return g, 0
+    return Graph(g.input_nodes, outputs), fused
+
+
+def fuse_conv_bn(model):
+    """Graph-level conv-bn(-relu) fusion pass: walk the module tree (and any
+    :class:`Graph` DAGs) replacing adjacent ``SpatialConvolution →
+    SpatialBatchNormalization (→ ReLU)`` chains with one
+    :class:`~bigdl_tpu.kernels.conv_bn.FusedConvBNReLU` module. Parameter
+    and state arrays carry over untouched (the fused module owns the SAME
+    child modules), so the fused model is bitwise-identical in fp32 on the
+    training path and runs folded single-conv inference.
+
+    Rewrites containers in place and returns the (possibly new, for a root
+    Graph) fused model. Applied automatically by the Optimizer when
+    ``BIGDL_CONVBN_FUSE=1``; off by default.
+    """
+    from bigdl_tpu.kernels.conv_bn import FusedConvBNReLU
+    from bigdl_tpu.nn.containers import Sequential
+
+    total = 0
+
+    def walk(m):
+        nonlocal total
+        if isinstance(m, FusedConvBNReLU):
+            return m  # already fused — don't descend into its children
+        if isinstance(m, Graph):
+            for node in m.exec_nodes:
+                node.module = walk(node.module)
+            m.modules = [n.module for n in m.exec_nodes]
+            new_g, n = _fuse_graph(m)
+            total += n
+            return new_g
+        if isinstance(m, Container):
+            m.modules = [walk(c) for c in m.modules]
+            if isinstance(m, Sequential):
+                total += _fuse_sequential(m)
+        return m
+
+    model = walk(model)
+    if total:
+        import logging
+        logging.getLogger("bigdl_tpu.nn").info(
+            "conv-bn fusion pass: %d conv-bn(-relu) chains fused", total)
+    return model

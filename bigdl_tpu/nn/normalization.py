@@ -192,6 +192,13 @@ class SpatialBatchNormalization(BatchNormalization):
     """BN over the channel axis of spatial input (reference
     ``nn.SpatialBatchNormalization``; channel axis follows ``nn.layout``)."""
 
+    def folded_scale_shift(self, params, state):
+        """Per-channel (scale, shift) with ``bn(y) == y*scale + shift`` under
+        the running statistics — what the conv-bn fusion kernel folds into
+        the adjacent conv's weights (kernels/conv_bn.py)."""
+        from bigdl_tpu.kernels.conv_bn import fold_bn_scale_shift
+        return fold_bn_scale_shift(params, state, self.eps)
+
     def _reduce_axes(self, x):
         from bigdl_tpu.nn import layout
         ca = layout.channel_axis(x.ndim)

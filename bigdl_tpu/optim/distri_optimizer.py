@@ -51,6 +51,21 @@ class DistriOptimizer(Optimizer):
         self._batch_sh = None
         self.tp_rules = None
 
+    def _flat_update_ok(self) -> bool:
+        # ZeRO-1/FSDP shard slot leaves per PARAMETER over the data axis and
+        # TP shards them per rule path — a dtype-grouped flat vector has
+        # neither the leaf structure nor guaranteed divisibility, so the
+        # flat update only rides the replicated (allreduce) configuration.
+        if self.parameter_sync != "allreduce" or self.tp_rules is not None:
+            if self.flat_update:
+                logger.warning(
+                    "BIGDL_FLAT_UPDATE ignored: flat-param updates need "
+                    "replicated optimizer slots (parameter_sync='allreduce' "
+                    "without tensor parallelism); got sync=%r tp=%s",
+                    self.parameter_sync, self.tp_rules is not None)
+            return False
+        return True
+
     def set_parameter_sync(self, mode: str) -> "DistriOptimizer":
         if mode not in self._SYNC_MODES:
             raise ValueError(f"parameter_sync must be one of {self._SYNC_MODES}")
@@ -79,8 +94,9 @@ class DistriOptimizer(Optimizer):
 
         params = self.model.get_params()
         # shapes only — no device allocation for the throwaway state
+        method = self._effective_method()
         ostate_shapes = jax.eval_shape(
-            lambda p: self.optim_method.init_state_trimmed(
+            lambda p: method.init_state_trimmed(
                 p, self._trainable_mask()), params)
         if self.parameter_sync == "fsdp" and self.tp_rules is not None:
             raise ValueError(

@@ -29,6 +29,13 @@ def decayed_lr(learningrate, learningrate_decay, step):
 
 
 class OptimMethod:
+    #: True when ``update`` is a purely elementwise map over the param/grad/
+    #: slot leaves (no per-leaf norms, no path-keyed routing) — such methods
+    #: may run over dtype-grouped FLAT vectors (kernels/fused_update.py,
+    #: BIGDL_FLAT_UPDATE=1) with bitwise-identical results, replacing the
+    #: per-leaf kernel launches with a few fused vector ops.
+    elementwise_update = False
+
     def init_state(self, params) -> dict:
         return {}
 
@@ -102,6 +109,8 @@ class SGD(OptimMethod):
     without recompiling. ``layer_lr_mults`` maps a parameter-path substring to a
     per-layer LR multiplier (reference: per-layer ``learningRateMult``).
     """
+
+    elementwise_update = True  # flat-eligible unless layer_lr_mults set
 
     def __init__(self, learningrate: float = 1e-3, learningrate_decay: float = 0.0,
                  weightdecay: float = 0.0, momentum: float = 0.0,
@@ -182,6 +191,8 @@ class SGD(OptimMethod):
 class Adam(OptimMethod):
     """Adam (reference ``<dl>/optim/Adam.scala`` — unverified)."""
 
+    elementwise_update = True
+
     def __init__(self, learningrate: float = 1e-3, learningrate_decay: float = 0.0,
                  beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8):
         self.learningrate = learningrate
@@ -239,6 +250,8 @@ class Adagrad(OptimMethod):
     ``clr = lr / (1 + step·decay)`` — matches torch.optim.Adagrad.
     """
 
+    elementwise_update = True
+
     def __init__(self, learningrate: float = 1e-3, learningrate_decay: float = 0.0,
                  weightdecay: float = 0.0):
         self.learningrate = learningrate
@@ -266,6 +279,8 @@ class Adadelta(OptimMethod):
 
     Matches torch.optim.Adadelta with ``lr`` scaling (reference uses lr = 1).
     """
+
+    elementwise_update = True
 
     def __init__(self, decayrate: float = 0.9, epsilon: float = 1e-10,
                  learningrate: float = 1.0):
@@ -299,6 +314,8 @@ class Adamax(OptimMethod):
     ``u = max(β₂·u, |g|); p -= (lr / (1-β₁ᵗ)) · m / (u + ε)``.
     """
 
+    elementwise_update = True
+
     def __init__(self, learningrate: float = 0.002, beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-38):
         self.learningrate = learningrate
@@ -328,6 +345,8 @@ class RMSprop(OptimMethod):
     ``eps`` outside the sqrt... (torch adds eps after sqrt; so do we).
     """
 
+    elementwise_update = True
+
     def __init__(self, learningrate: float = 1e-2, learningrate_decay: float = 0.0,
                  decayrate: float = 0.99, epsilon: float = 1e-8):
         self.learningrate = learningrate
@@ -356,6 +375,8 @@ class Ftrl(OptimMethod):
 
     TensorFlow-style FTRL with L1/L2 regularization and optional L2 shrinkage.
     """
+
+    elementwise_update = True
 
     def __init__(self, learningrate: float = 1e-3, learningrate_power: float = -0.5,
                  initial_accumulator_value: float = 0.1,

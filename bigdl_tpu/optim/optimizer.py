@@ -158,7 +158,20 @@ class Optimizer:
         self.summary_trigger: Optional[Trigger] = None
         self.grad_clip_const: Optional[tuple[float, float]] = None
         self.grad_clip_norm: Optional[float] = None
-        self.grad_accum: int = 1       # set_gradient_accumulation(n)
+        # on-device microbatch accumulation (set_gradient_accumulation /
+        # BIGDL_GRAD_ACCUM): M microbatches scanned inside the compiled step
+        self.grad_accum: int = self._env_int("BIGDL_GRAD_ACCUM", 1)
+        # rematerialization policy on the model apply (set_remat /
+        # BIGDL_REMAT): "none" (default — save all activations), "dots"
+        # (save matmul/conv results, recompute the elementwise glue), "full"
+        # (recompute everything in backward — minimum activation memory)
+        self.remat: str = self._env_remat()
+        # flat-param optimizer update (set_flat_update / BIGDL_FLAT_UPDATE):
+        # elementwise methods run over dtype-grouped flat vectors inside the
+        # jitted step (kernels/fused_update.py) — bitwise-identical, one
+        # fused vector kernel instead of per-leaf launches
+        self.flat_update: bool = os.environ.get(
+            "BIGDL_FLAT_UPDATE", "0") == "1"
         # Auxiliary-loss convention: modules that declare an ``aux_loss`` leaf
         # in their state (MoE load balancing, parallel/moe.py) get it added to
         # the training objective scaled by this weight. 0.01 is the Switch
@@ -397,6 +410,75 @@ class Optimizer:
         self._step_cache = self._window_cache = None
         return self
 
+    _REMAT_MODES = ("none", "dots", "full")
+
+    @staticmethod
+    def _env_int(name: str, default: int) -> int:
+        raw = os.environ.get(name, str(default))
+        try:
+            v = int(raw)
+            if v < 1:
+                raise ValueError
+        except ValueError:
+            raise ValueError(f"{name} must be an integer >= 1, got {raw!r}")
+        return v
+
+    @classmethod
+    def _env_remat(cls) -> str:
+        mode = os.environ.get("BIGDL_REMAT", "none").strip().lower()
+        if mode not in cls._REMAT_MODES:
+            raise ValueError(
+                f"BIGDL_REMAT must be one of {cls._REMAT_MODES}, got {mode!r}")
+        return mode
+
+    def set_remat(self, mode: str) -> "Optimizer":
+        """Gradient rematerialization policy for the model apply inside the
+        compiled step (``jax.checkpoint``): "none" keeps XLA's default (all
+        activations live to backward), "dots" saves matmul/conv outputs and
+        recomputes the elementwise glue, "full" recomputes the whole forward
+        during backward — the activation-memory floor. Composes with
+        gradient accumulation and the fused scan window; numerically the
+        recomputation re-runs the identical ops."""
+        mode = str(mode).strip().lower()
+        if mode not in self._REMAT_MODES:
+            raise ValueError(
+                f"remat mode must be one of {self._REMAT_MODES}, got {mode!r}")
+        self.remat = mode
+        self._step_cache = self._window_cache = None
+        return self
+
+    def set_flat_update(self, enabled: bool = True) -> "Optimizer":
+        """Run elementwise optimizer updates (SGD/Adam/…) over dtype-grouped
+        FLAT parameter vectors inside the jitted step — a few fused vector
+        kernels instead of one launch per parameter leaf, bitwise-identical
+        to the per-leaf update (kernels/fused_update.py). Methods needing
+        leaf structure (layer_lr_mults, LARS, L-BFGS, composite) silently
+        keep the per-leaf path."""
+        self.flat_update = bool(enabled)
+        self._step_cache = self._window_cache = None
+        self._final_ostate = None  # slot layout changes with the wrapper
+        return self
+
+    def _flat_update_ok(self) -> bool:
+        """Subclass hook: may the flat update replace the per-leaf one under
+        the current sharding configuration?"""
+        return True
+
+    def _effective_method(self) -> OptimMethod:
+        """The method the compiled step actually runs: the configured one,
+        wrapped for flat-vector updates when enabled and eligible."""
+        method = self.optim_method
+        if self.flat_update and self._flat_update_ok():
+            from bigdl_tpu.kernels.fused_update import (
+                FlatParamUpdate, flat_supported,
+            )
+            if flat_supported(method):
+                return FlatParamUpdate(method)
+            logger.warning(
+                "BIGDL_FLAT_UPDATE: %r has no elementwise flat form; "
+                "keeping the per-leaf update", method)
+        return method
+
     def set_gradient_accumulation(self, n_micro: int) -> "Optimizer":
         """Split every mini-batch into ``n_micro`` microbatches inside the
         compiled step (``lax.scan``), averaging gradients before the single
@@ -432,8 +514,9 @@ class Optimizer:
         """Do carried/resumed slots structurally fit what the current
         freeze configuration would allocate?"""
         try:
+            method = self._effective_method()
             expected = jax.eval_shape(
-                lambda p: self.optim_method.init_state_trimmed(p, mask), params)
+                lambda p: method.init_state_trimmed(p, mask), params)
         except Exception:
             return True   # can't predict (exotic method): let it ride
         exp_flat, exp_def = jax.tree_util.tree_flatten(expected)
@@ -456,7 +539,8 @@ class Optimizer:
     def _make_step_fn(self):
         from bigdl_tpu.nn.precision import cast_floating
 
-        model, criterion, method = self.model, self.criterion, self.optim_method
+        model, criterion = self.model, self.criterion
+        method = self._effective_method()
         needs_rng = model.needs_rng()
         aux_w = self.aux_loss_weight
         # per-layer LR multipliers (setScaleW/setScaleB): static constants —
@@ -538,6 +622,17 @@ class Optimizer:
                     return model.pipeline_train_step(p, x, t, criterion,
                                                      mesh, dax)
 
+        # rematerialization policy (set_remat / BIGDL_REMAT): wraps the whole
+        # loss (model apply + criterion) in jax.checkpoint so backward
+        # recomputes instead of holding activations — "dots" keeps matmul/
+        # conv results (cheap to hold, expensive to recompute), "full" holds
+        # nothing. Recomputation re-runs identical ops; composed with the
+        # microbatch scan below this is what lets batch-256-equivalent
+        # training fit in a fraction of the activation HBM.
+        remat = self.remat
+        remat_policy = (jax.checkpoint_policies.checkpoint_dots
+                        if remat == "dots" else None)
+
         def step(params, mstate, ostate, step_idx, inp, target, base_rng):
             rng0 = jax.random.fold_in(base_rng, step_idx) if needs_rng else None
 
@@ -560,6 +655,8 @@ class Optimizer:
                     loss = loss + model.regularizer_penalty(p)
                 return loss, new_ms
 
+            if remat != "none":
+                loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
             vg = jax.value_and_grad(loss_fn, has_aux=True)
             if pipe_fn is not None:
                 # stages are stateless (GPipe contract) → mstate passes
@@ -1128,6 +1225,17 @@ class Optimizer:
                 "Plateau monitoring a validation metric without set_validation never "
                 "sees a value — the LR will stay at its base value; configure "
                 "validation or use monitor='loss'")
+        # conv-bn fusion pass (BIGDL_CONVBN_FUSE=1): rewrite adjacent
+        # conv→bn(→relu) chains into FusedConvBNReLU modules once, before
+        # the parameter checkout — the whole vision zoo picks it up with no
+        # model changes. Off (default): the model is never touched.
+        if os.environ.get("BIGDL_CONVBN_FUSE", "0") == "1" \
+                and not getattr(self, "_convbn_fused", False):
+            from bigdl_tpu.nn.graph import fuse_conv_bn
+            self.model = fuse_conv_bn(self.model)
+            self._convbn_fused = True
+            self._step_cache = self._window_cache = None
+            self._state_materialized = False
         self.model.training()
         params = self.model.get_params()
         mstate = self.model.get_state()
@@ -1150,7 +1258,7 @@ class Optimizer:
                 "moments start fresh)")
             ostate = None
         if ostate is None:
-            ostate = self.optim_method.init_state_trimmed(params, mask)
+            ostate = self._effective_method().init_state_trimmed(params, mask)
         self._resume_ostate = None
         # step cache is keyed on the Engine compute dtype (the casts are baked
         # into the trace) AND the model's gradient-scale fingerprint — freeze/

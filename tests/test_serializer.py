@@ -102,6 +102,10 @@ EXAMPLES = {
     # linear / conv / pooling / embedding / attention
     "Linear": (lambda: nn.Linear(4, 3), _x(2, 4)),
     "SpatialConvolution": (lambda: nn.SpatialConvolution(2, 4, 3, 3), _x(1, 2, 8, 8)),
+    "FusedConvBNReLU": (
+        lambda: nn.SpatialConvolution(2, 4, 3, 3, 1, 1, 1, 1).fuse_bn(
+            nn.SpatialBatchNormalization(4), relu=True),
+        _x(1, 2, 8, 8)),
     "SpatialDilatedConvolution": (
         lambda: nn.SpatialDilatedConvolution(2, 4, 3, 3, dilation_w=2, dilation_h=2),
         _x(1, 2, 10, 10)),

@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 t1-faults t1-obs t1-kernels dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -33,6 +33,15 @@ t1-obs:
 t1-kernels:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m kernels --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
+# Online-serving suite only (docs/serving.md): continuous-batching bitwise
+# equality vs per-request greedy decode, bucket/padding invariance, slot
+# recycling under randomized arrivals, per-slot cache reset/assign, the
+# shared request-plane queue, quantized + multi-tenant snapshots. Unmarked-
+# slow, so `make t1` runs these too; this is the fast inner loop for
+# serving-engine work.
+t1-serving:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serving --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
 dist:
 	bash make-dist.sh
 
@@ -49,6 +58,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --model lenet --eval-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --model lenet --obs-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --kernel-bench --no-compare-dtypes --no-streamed
+	JAX_PLATFORMS=cpu python bench.py --serving-bench --no-compare-dtypes --no-streamed
 
 # Host input-pipeline leg (decode→augment→stack on a synthetic image folder):
 # pipeline_images_per_sec at BIGDL_DATA_WORKERS 0/1/4/auto + per-stage ms.

@@ -1145,6 +1145,89 @@ def _measure_serving(model_name: str, batch: int, iters: int) -> dict:
             "batch": batch, "dtype": "bf16"}
 
 
+def _measure_serving_bench(n_requests: int = 24, slots: int = 8,
+                           max_new: int = 16) -> dict:
+    """Online serving-engine leg: sustained requests/sec through the
+    continuous-batching engine vs the one-request-at-a-time baseline (a
+    slots=1 engine — per-request decode through the same code path), with
+    TTFT / per-token latency percentiles read from ONE obs-registry
+    snapshot, and the compile-count assertion proving bucket reuse: the
+    whole run must use at most ``len(buckets) + 2`` device programs
+    (one prefill per bucket + one decode + one slot-assign) no matter how
+    many distinct prompt lengths arrive."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.models.transformerlm import TransformerLM
+    from bigdl_tpu.obs.registry import registry
+    from bigdl_tpu.serving import ServingEngine
+
+    dev = jax.devices()[0]
+    buckets = (16, 32, 48)
+    max_len = 64 + max_new
+    lm = TransformerLM(1000, embed_dim=64, num_heads=4, num_layers=2,
+                       max_len=max_len).evaluate()
+    rng = np.random.default_rng(0)
+    reqs = [rng.integers(0, 1000, (int(rng.integers(4, 49)),))
+            .astype(np.int32) for _ in range(n_requests)]
+
+    def pct(snap, name):
+        h = snap["histograms"].get(name, {})
+        return {q: (round(h[f"p{q}"], 2) if h.get(f"p{q}") is not None
+                    else None) for q in (50, 99)}
+
+    def run(n_slots, sequential):
+        eng = ServingEngine(lm, max_len=max_len, slots=n_slots,
+                            buckets=buckets)
+        try:
+            # compile + warm EVERY grid point (one prompt per prefill
+            # bucket) so both timed legs are compile-free
+            for plen in (8, 24, 40):
+                warm = np.arange(plen, dtype=np.int32) % 1000
+                eng.submit(warm, max_new).result(timeout=300)
+            registry.reset()
+            t0 = time.perf_counter()
+            if sequential:
+                for p in reqs:
+                    eng.submit(p, max_new).result(timeout=300)
+            else:
+                for h in [eng.submit(p, max_new) for p in reqs]:
+                    h.result(timeout=300)
+            wall = time.perf_counter() - t0
+            return n_requests / wall, registry.snapshot(), eng.stats()
+        finally:
+            eng.shutdown()
+
+    # one-request-at-a-time baseline FIRST (its prefill programs are shared
+    # with the batched engine via the model's apply cache — the timed window
+    # of both legs is compile-free)
+    seq_rps, seq_snap, _ = run(1, sequential=True)
+    rps, snap, stats = run(slots, sequential=False)
+
+    grid_bound = len(buckets) + 2
+    ttft, tpot = pct(snap, "serving/ttft_ms"), pct(snap, "serving/tpot_ms")
+    return {
+        "value": round(rps, 2),
+        "unit": "req/sec",
+        "n_requests": n_requests,
+        "slots": slots,
+        "buckets": list(buckets),
+        "max_new_tokens": max_new,
+        "requests_per_sec": round(rps, 2),
+        "requests_per_sec_sequential": round(seq_rps, 2),
+        "serving_speedup": round(rps / seq_rps, 2) if seq_rps else None,
+        "ttft_ms_p50": ttft[50], "ttft_ms_p99": ttft[99],
+        "tpot_ms_p50": tpot[50], "tpot_ms_p99": tpot[99],
+        "sequential_ttft_ms_p99": pct(seq_snap, "serving/ttft_ms")[99],
+        "slot_recycles": stats["slot_recycles"],
+        "compiled_programs": stats["compiled_programs"],
+        "program_grid_bound": grid_bound,
+        "compile_count_ok": stats["compiled_programs"] <= grid_bound,
+        "device_kind": dev.device_kind,
+        "platform": dev.platform,
+    }
+
+
 def _measure_ablation(model_name: str, batch: int, iters: int) -> dict:
     """Step-time attribution (the committed profile analysis): time the full
     compiled train step and its sub-programs — forward-only, forward+backward,
@@ -1434,6 +1517,7 @@ def run_orchestrator(args) -> None:
     obs_bench = getattr(args, "obs_bench", False)
     kernel_bench = getattr(args, "kernel_bench", False)
     precision_bench = getattr(args, "precision_bench", False)
+    serving_bench = getattr(args, "serving_bench", False)
     worker_argv = ["--run", "--model", args.model, "--batch", str(args.batch),
                    "--iters", str(args.iters), "--warmup", str(args.warmup),
                    "--dtype", args.dtype]
@@ -1458,6 +1542,8 @@ def run_orchestrator(args) -> None:
         worker_argv.append("--kernel-bench")
     if precision_bench:
         worker_argv.append("--precision-bench")
+    if serving_bench:
+        worker_argv.append("--serving-bench")
     env = dict(os.environ)
     # Fast-fail: one cheap bounded probe decides whether the accelerator
     # backend answers AT ALL before any full measurement attempt is allowed
@@ -1486,7 +1572,7 @@ def run_orchestrator(args) -> None:
                     and not args.decode_infer and not args.ablate \
                     and not args.eval_bench and not pipeline_bench \
                     and not obs_bench and not kernel_bench \
-                    and not precision_bench:
+                    and not precision_bench and not serving_bench:
                 # the comparison leg only feeds the ratio — skip its streamed
                 # measurement (it would be discarded)
                 cmp_argv = ["--run", "--model", args.model,
@@ -1524,7 +1610,7 @@ def run_orchestrator(args) -> None:
 
     if args.int8_infer or args.serving or args.decode_infer or args.ablate \
             or args.eval_bench or pipeline_bench or obs_bench \
-            or kernel_bench or precision_bench:
+            or kernel_bench or precision_bench or serving_bench:
         # a LeNet training number would not answer an inference-path request:
         # fail loudly with the metric the caller asked for
         kind = ("int8_vs_bf16_infer" if args.int8_infer
@@ -1535,6 +1621,7 @@ def run_orchestrator(args) -> None:
                 else "obs_overhead" if obs_bench
                 else "kernel_bench" if kernel_bench
                 else "precision_bench" if precision_bench
+                else "serving_engine" if serving_bench
                 else "step_ablation")
         record = {
             "metric": f"{args.model}_{kind}",
@@ -1639,6 +1726,12 @@ def main(argv=None):
                    help="low-precision step experiment: fp32 vs bf16 train-"
                         "step throughput, int8 quantized-forward family, "
                         "fp8 forward probe")
+    p.add_argument("--serving-bench", dest="serving_bench",
+                   action="store_true",
+                   help="online serving-engine leg: continuous-batching "
+                        "sustained req/s vs the one-request-at-a-time "
+                        "baseline, TTFT/per-token p50/p99, compile-count "
+                        "assertion proving prefill-bucket reuse")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
     args = p.parse_args(argv)
@@ -1693,6 +1786,11 @@ def _run_worker_modes(args) -> int:
         res = _measure_precision(args.model, args.batch,
                                  max(args.iters // 2, 8))
         res["metric"] = f"{args.model}_precision_bench"
+        res["vs_baseline"] = None
+        print(json.dumps(res))
+    elif getattr(args, "serving_bench", False):
+        res = _measure_serving_bench()
+        res["metric"] = "transformerlm_serving_engine"
         res["vs_baseline"] = None
         print(json.dumps(res))
     elif args.ablate:

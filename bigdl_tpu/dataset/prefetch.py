@@ -28,7 +28,8 @@ Design:
   traceback as ``__cause__``.
 - ``close()`` (also on ``__exit__`` / generator abandonment) stops the producer promptly —
   mid-epoch breaks (endWhen triggers) must not leak threads. The hand-off queue is
-  condition-based (``_ClosableQueue``): a producer blocked on a full queue wakes the
+  condition-based (``utils.queues.ClosableQueue``, shared with the serving
+  request plane): a producer blocked on a full queue wakes the
   instant ``close()`` fires instead of busy-polling a 100 ms put-timeout, so close()
   latency is microseconds and an idle full queue burns zero wakeups. A producer that
   fails to join within the timeout is logged loudly and remembered, so the NEXT
@@ -41,58 +42,15 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
-from collections import deque
 from typing import Callable, Iterator
 
 from bigdl_tpu.obs import trace
+from bigdl_tpu.utils.queues import CLOSED as _CLOSED
+from bigdl_tpu.utils.queues import ClosableQueue as _ClosableQueue
 
 logger = logging.getLogger("bigdl_tpu.dataset")
 
 _END = object()
-_CLOSED = object()
-
-
-class _ClosableQueue:
-    """Bounded FIFO whose blocked ``put``/``get`` wake immediately on
-    ``close()`` — the event-aware replacement for ``queue.Queue`` put-timeout
-    polling. ``put`` returns False (item dropped) once closed; ``get`` returns
-    the ``_CLOSED`` sentinel once closed and drained."""
-
-    def __init__(self, maxsize: int):
-        self._maxsize = maxsize
-        self._items: deque = deque()
-        lock = threading.Lock()
-        self._not_full = threading.Condition(lock)
-        self._not_empty = threading.Condition(lock)
-        self._closed = False
-
-    def put(self, item) -> bool:
-        with self._not_full:
-            while len(self._items) >= self._maxsize and not self._closed:
-                self._not_full.wait()
-            if self._closed:
-                return False
-            self._items.append(item)
-            self._not_empty.notify()
-            return True
-
-    def get(self):
-        with self._not_empty:
-            while not self._items and not self._closed:
-                self._not_empty.wait()
-            if not self._items:
-                return _CLOSED
-            item = self._items.popleft()
-            self._not_full.notify()
-            return item
-
-    def close(self) -> None:
-        """Drop buffered items, wake every waiter. Idempotent."""
-        with self._not_full:
-            self._closed = True
-            self._items.clear()
-            self._not_full.notify_all()
-            self._not_empty.notify_all()
 
 
 class PrefetchingFeed:

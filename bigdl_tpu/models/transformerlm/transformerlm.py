@@ -45,11 +45,20 @@ class PositionEmbedding(TensorModule):
 
     def apply(self, params, state, input, *, training=False, rng=None):
         if isinstance(state, dict) and "pos_idx" in state:
-            # cached incremental decode (nn.incremental): input is the single
-            # next position — add its embedding, advance the counter
+            # cached incremental decode (nn.incremental): input is the next
+            # t positions (t > 1 = the serving engine's chunked prefill) —
+            # add their embeddings, advance the counter. A (b,) pos_idx is
+            # the per-slot continuous-batching form: every row embeds at its
+            # own depth.
             idx = state["pos_idx"]
-            emb = jnp.take(params["pos"], idx, axis=0)
-            return input + emb[None, None, :], {"pos_idx": idx + 1}
+            t = input.shape[1]
+            if idx.ndim == 1:
+                pp = idx[:, None] + jnp.arange(t)[None, :]          # (b, t)
+                return input + jnp.take(params["pos"], pp, axis=0), \
+                    {"pos_idx": idx + t}
+            pp = idx + jnp.arange(t)                                # (t,)
+            emb = jnp.take(params["pos"], pp, axis=0)               # (t, E)
+            return input + emb[None], {"pos_idx": idx + t}
         t = input.shape[1]
         if t > self.max_len:
             raise ValueError(f"sequence length {t} > max_len {self.max_len}")

@@ -84,8 +84,8 @@ from bigdl_tpu.nn.multibox import MultiBoxCriterion, encode_ssd, match_priors
 from bigdl_tpu.nn.tree import BinaryTreeLSTM
 from bigdl_tpu.nn.beam_search import SequenceBeamSearch, greedy_decode
 from bigdl_tpu.nn.incremental import (
-    beam_generate, clear_decode_cache, generate, greedy_generate,
-    install_decode_cache)
+    assign_cache_slot, beam_generate, clear_decode_cache, generate,
+    greedy_generate, install_decode_cache, reset_decode_slot)
 from bigdl_tpu.nn.volumetric import (
     VolumetricAveragePooling, VolumetricConvolution, VolumetricFullConvolution,
     VolumetricMaxPooling,

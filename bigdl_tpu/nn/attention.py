@@ -26,11 +26,17 @@ def rope_rotate(x: jnp.ndarray, positions: jnp.ndarray,
     pair turns by ``pos / base^(2i/d)`` — attention scores then depend only
     on RELATIVE distance, which is what lets RoPE models extrapolate and
     makes the rotation cache-free (the decode path rotates the single new
-    position by its absolute index; nothing else changes)."""
+    position by its absolute index; nothing else changes).
+
+    ``positions`` may also be (b, t) — per-BATCH-ROW absolute positions, the
+    continuous-batching decode case where every cache slot sits at its own
+    depth; ``x`` is then (b, h, t, d) and the angles broadcast over heads."""
     d = x.shape[-1]
     half = d // 2
     inv_freq = 1.0 / (base ** (jnp.arange(half, dtype=jnp.float32) / half))
-    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (t, half)
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., t, half)
+    if positions.ndim == 2:
+        ang = ang[:, None]                 # (b, 1, t, half): broadcast heads
     cos, sin = jnp.cos(ang), jnp.sin(ang)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin,
@@ -292,9 +298,14 @@ class MultiHeadAttention(TensorModule):
     def _decode_step(self, params, state, q, k, v, b, t, e):
         """KV-cached incremental decode (``nn.incremental.install_decode_cache``
         puts the cache in this module's state; containers thread it through
-        unchanged APIs). Input is the single next position (t == 1): append
-        k/v at ``pos``, attend q against the cached prefix under a ``<= pos``
-        mask — O(L) per step instead of the O(L^2) full-prefix re-run. The
+        unchanged APIs). Input is the next ``t`` positions (t == 1 for the
+        classic token-by-token decode; t > 1 is the CHUNKED prefill the
+        serving engine uses to absorb a whole prompt in one program): append
+        k/v at ``pos``, attend each query against the cached prefix up to its
+        own position — O(L) per token instead of the O(L^2) full-prefix
+        re-run. ``pos`` is a scalar for lock-step batches, or a PER-ROW (b,)
+        vector for continuous batching where every cache slot sits at its own
+        depth (the serving engine's slot-recycled decode batch). The
         reference SequenceBeamSearch's numHiddenLayers/hiddenSize constructor
         args exist for exactly this cache; here it is module state, not a
         search-owned buffer."""
@@ -302,32 +313,53 @@ class MultiHeadAttention(TensorModule):
 
         from bigdl_tpu.parallel.ring_attention import full_attention
 
-        if t != 1:
-            raise ValueError(
-                f"cached decode feeds one position at a time, got t={t}")
         pos = state["pos"]
+        per_slot = pos.ndim == 1
         if getattr(self, "rope", False):
-            # rotate the single new position by its ABSOLUTE index; cached
-            # keys were already rotated when they were written
-            ppos = jnp.full((1,), pos)
+            # rotate the new positions by their ABSOLUTE indices; cached
+            # keys were already rotated when they were written. Per-slot,
+            # every row rotates by its own depth.
+            if per_slot:
+                ppos = pos[:, None] + jnp.arange(t)[None, :]        # (b, t)
+            else:
+                ppos = pos + jnp.arange(t)                          # (t,)
             q = rope_rotate(q, ppos, self.rope_base)
             k = rope_rotate(k, ppos, self.rope_base)
         # cache persists at kv_heads width — the GQA memory win; heads are
         # broadcast per step only inside the fused attend
-        ck = lax.dynamic_update_slice(state["cache_k"], k, (0, 0, pos, 0))
-        cv = lax.dynamic_update_slice(state["cache_v"], v, (0, 0, pos, 0))
+        if per_slot:
+            # every row writes its chunk at its OWN position: one vmapped
+            # dynamic_update_slice instead of a batch-wide slice
+            row_write = jax.vmap(
+                lambda c, u, p: lax.dynamic_update_slice(c, u, (0, p, 0)))
+            ck = row_write(state["cache_k"], k, pos)
+            cv = row_write(state["cache_v"], v, pos)
+        else:
+            ck = lax.dynamic_update_slice(state["cache_k"], k, (0, 0, pos, 0))
+            cv = lax.dynamic_update_slice(state["cache_v"], v, (0, 0, pos, 0))
         lmax = ck.shape[2]
-        kv_mask = jnp.arange(lmax) <= pos
-        if getattr(self, "window", None) is not None:
-            kv_mask &= jnp.arange(lmax) > pos - self.window
+        # query j (absolute position pos+j) sees keys <= pos+j: causal within
+        # the chunk, full visibility of the cached prefix
+        kpos = jnp.arange(lmax)
+        if per_slot:
+            qpos = pos[:, None] + jnp.arange(t)[None, :]            # (b, t)
+            kv_mask = kpos[None, None, :] <= qpos[:, :, None]       # (b, t, L)
+            if getattr(self, "window", None) is not None:
+                kv_mask &= kpos[None, None, :] > qpos[:, :, None] - self.window
+            kv_mask = kv_mask[:, None]                              # (b,1,t,L)
+        else:
+            qpos = pos + jnp.arange(t)                              # (t,)
+            kv_mask = kpos[None, :] <= qpos[:, None]                # (t, L)
+            if getattr(self, "window", None) is not None:
+                kv_mask &= kpos[None, :] > qpos[:, None] - self.window
+            kv_mask = kv_mask[None, None]                           # (1,1,t,L)
         o = full_attention(q, self._expand_kv(ck), self._expand_kv(cv),
-                           causal=False,
-                           kv_mask=kv_mask[None, None, None])
-        o = o.transpose(0, 2, 1, 3).reshape(b, 1, e)
+                           causal=False, kv_mask=kv_mask)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, e)
         out = o @ self._w(params, "out_weight").T
         if self.with_bias:
             out = out + params["out_bias"]
-        return out, {"cache_k": ck, "cache_v": cv, "pos": pos + 1}
+        return out, {"cache_k": ck, "cache_v": cv, "pos": pos + t}
 
     def __repr__(self):
         gqa = (f", kv_heads={self.kv_heads}"

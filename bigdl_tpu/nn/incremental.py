@@ -40,7 +40,7 @@ _iter_modules = iter_modules   # backward-compatible private alias
 
 def install_decode_cache(model: AbstractModule, batch_size: int,
                          max_len: int, dtype=jnp.float32,
-                         roots=None) -> dict:
+                         roots=None, per_slot: bool = False) -> dict:
     """Install zeroed decode caches into ``model``'s attention/position
     modules and return the full state pytree to carry through decode steps.
 
@@ -48,6 +48,15 @@ def install_decode_cache(model: AbstractModule, batch_size: int,
     target embedding + decoder stack — the bidirectional encoder is never
     stepped incrementally and must stay cache-free). Default: the whole
     model.
+
+    ``per_slot=True`` makes the position counters PER-ROW ``(batch_size,)``
+    int32 vectors instead of batch-wide scalars: each cache row (a serving
+    "slot") then sits at its own decode depth, which is what lets a
+    continuous-batching engine reset and reassign ONE finished slot
+    mid-flight (:func:`reset_decode_slot` / :func:`assign_cache_slot`)
+    while the other rows keep decoding — no drain-and-refill. The scalar
+    form is the lock-step ``generate``/``beam_generate`` fast path and
+    cannot express a single-slot reset.
 
     The model's regular (training/eval) path is restored by
     :func:`clear_decode_cache` — cached state and full-sequence apply are
@@ -76,6 +85,8 @@ def install_decode_cache(model: AbstractModule, batch_size: int,
                 f"(max_len={mod.max_len}); the cached path would otherwise "
                 f"silently clamp positions the uncached path rejects")
 
+    pos0 = (jnp.zeros((batch_size,), jnp.int32) if per_slot
+            else jnp.asarray(0, jnp.int32))
     for mod in attns:
         # GQA caches store kv_heads (<= num_heads) — the cache-memory win
         kv_h = getattr(mod, "kv_heads", mod.num_heads)
@@ -84,12 +95,98 @@ def install_decode_cache(model: AbstractModule, batch_size: int,
                                   mod.head_dim), dtype),
             "cache_v": jnp.zeros((batch_size, kv_h, max_len,
                                   mod.head_dim), dtype),
-            "pos": jnp.asarray(0, jnp.int32),
+            "pos": pos0,
         })
     for mod in mods:
         if isinstance(mod, PositionEmbedding):
-            mod.set_state({"pos_idx": jnp.asarray(0, jnp.int32)})
+            mod.set_state({"pos_idx": pos0})
     return model.get_state()
+
+
+#: decode-cache leaf names (the same key set the beam reorder gathers on):
+#: per-row K/V buffers and the position counters. CONTRACT: a future module
+#: carrying other per-slot decode state must use these names or extend this
+#: set — unlisted leaves would silently survive a slot reset.
+_CACHE_ROW_KEYS = ("cache_k", "cache_v")
+_CACHE_POS_KEYS = ("pos", "pos_idx")
+
+
+def _leaf_key(path):
+    return path and getattr(path[-1], "key", None)
+
+
+def reset_decode_slot(state: dict, slot) -> dict:
+    """Return ``state`` with ONE cache row wiped: slot ``slot``'s K/V rows
+    zeroed and its position counters reset to 0, every other row untouched.
+    Purely functional (the input pytree is not mutated) and jit-safe with a
+    traced ``slot`` — one compiled program serves every slot index.
+
+    Requires a ``per_slot=True`` cache: a batch-wide scalar position cannot
+    express "this row restarts while the others keep decoding". This is the
+    primitive behind continuous-batching slot recycling — before it, freeing
+    one sequence meant reinstalling (and re-prefilling) the WHOLE batch."""
+    slot = jnp.asarray(slot, jnp.int32)
+
+    def g(path, leaf):
+        key = _leaf_key(path)
+        if key in _CACHE_ROW_KEYS:
+            return leaf.at[slot].set(jnp.zeros((), leaf.dtype))
+        if key in _CACHE_POS_KEYS:
+            if leaf.ndim != 1:
+                raise ValueError(
+                    "reset_decode_slot needs a per-slot cache "
+                    "(install_decode_cache(..., per_slot=True)); this cache "
+                    "has a batch-wide scalar position and can only be reset "
+                    "whole — reinstall instead")
+            return leaf.at[slot].set(0)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(g, state)
+
+
+def assign_cache_slot(dst_state: dict, src_state: dict, slot,
+                      pos=None) -> dict:
+    """Scatter a batch-1 cache (``src_state`` — typically a just-prefilled
+    prompt) into row ``slot`` of a per-slot decode cache ``dst_state`` and
+    return the updated pytree. The source row replaces the destination row
+    WHOLE (same max_len), so no stale K/V from the slot's previous occupant
+    survives; the position counters take the source's value unless ``pos``
+    overrides them (the
+    bucketed-prefill case: the prompt was right-padded to a static bucket
+    length, so the TRUE prompt length — not the bucket length — must become
+    the slot's depth; the pad positions beyond it are then never attended
+    and are overwritten as decoding proceeds).
+
+    Jit-safe with traced ``slot``/``pos``: ONE compiled program performs
+    every mid-flight slot assignment regardless of which slot frees up —
+    the gather/scatter half of continuous batching."""
+    slot = jnp.asarray(slot, jnp.int32)
+    if pos is not None:
+        pos = jnp.asarray(pos, jnp.int32)
+
+    def g(path, d, s):
+        key = _leaf_key(path)
+        if key in _CACHE_ROW_KEYS:
+            if s.shape[0] != 1:
+                raise ValueError(
+                    f"assign_cache_slot source must be a batch-1 cache, got "
+                    f"leading dim {s.shape[0]} for {key}")
+            if s.shape[1:] != d.shape[1:]:
+                raise ValueError(
+                    f"cache row shape mismatch for {key}: source "
+                    f"{s.shape[1:]} vs destination {d.shape[1:]} — prefill "
+                    f"and decode caches must share max_len/heads/head_dim")
+            return d.at[slot].set(s[0].astype(d.dtype))
+        if key in _CACHE_POS_KEYS:
+            if d.ndim != 1:
+                raise ValueError(
+                    "assign_cache_slot destination needs a per-slot cache "
+                    "(install_decode_cache(..., per_slot=True))")
+            v = s.reshape(-1)[0] if pos is None else pos
+            return d.at[slot].set(v)
+        return d
+
+    return jax.tree_util.tree_map_with_path(g, dst_state, src_state)
 
 
 def clear_decode_cache(model: AbstractModule) -> None:

@@ -1,0 +1,24 @@
+"""Online serving: continuous batching over the KV-cached decode path.
+
+The request-serving half of the framework (docs/serving.md): an admission
+queue + slot scheduler coalesce concurrent requests into one static-shape
+decode batch with per-slot cache depths, recycling a finished sequence's
+KV-cache row to the next waiting request mid-flight. Programs compile once
+per (prefill-bucket | decode | assign) grid point; per-request TTFT and
+per-token latency publish through the obs metric registry.
+"""
+
+from bigdl_tpu.serving.engine import EngineShutdown, ServingEngine
+from bigdl_tpu.serving.multitenant import SnapshotServer
+from bigdl_tpu.serving.request import (
+    FINISH_EOS, FINISH_LENGTH, CompletedRequest, RequestHandle,
+)
+from bigdl_tpu.serving.scheduler import (
+    SlotScheduler, default_buckets, pick_bucket,
+)
+
+__all__ = [
+    "CompletedRequest", "EngineShutdown", "FINISH_EOS", "FINISH_LENGTH",
+    "RequestHandle", "ServingEngine", "SlotScheduler", "SnapshotServer",
+    "default_buckets", "pick_bucket",
+]

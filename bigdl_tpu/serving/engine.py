@@ -1,0 +1,425 @@
+"""Online serving engine: continuous batching over the KV-cached decode path.
+
+The offline decode APIs (``nn.greedy_generate``) serve one padded batch per
+call — between calls the chip idles, and a straggler holds the whole batch.
+This engine turns per-request traffic into SATURATED static-shape device
+programs:
+
+- **Admission queue** (``utils.queues.ClosableQueue``): clients ``submit()``
+  from any thread; one engine thread owns all device state.
+- **Continuous decode batch**: a fixed grid of ``slots`` KV-cache rows with
+  PER-SLOT positions (``install_decode_cache(per_slot=True)``). Every tick
+  runs ONE decode program over the whole grid; each active row sits at its
+  own depth.
+- **Slot recycling**: a finished sequence's row is reset and reassigned to a
+  waiting request mid-flight (``assign_cache_slot``) — the other rows never
+  stop decoding. No drain-and-refill.
+- **Static-shape buckets**: prompts prefill right-padded to a small
+  length grid, so the engine compiles exactly ``len(buckets)`` prefill
+  programs + 1 decode program + 1 slot-assign program — ever. ``stats()``
+  counts them; the bench asserts the bound.
+- **SLO knob** (``admit_wait_ms``): on an idle engine, wait this long for
+  more arrivals before the first prefill — trades batch fill (throughput)
+  against TTFT. 0 (default) = serve immediately.
+
+Per-request latency lands in the obs metric registry (``serving/ttft_ms``,
+``serving/tpot_ms``, ``serving/queue_wait_ms``, ``serving/e2e_ms``
+histograms): p50/p99 TTFT and time-per-token are one ``registry.snapshot()``
+away, the same rail the run report and bench legs read. Decode is greedy —
+the bitwise-equality contract with ``nn.greedy_generate`` is pinned by
+``tests/test_serving.py``.
+
+Quantized snapshots serve through the same engine unchanged: ``quantize()``
+swaps Linear for int8 modules but leaves the attention stack (and its cache)
+intact — see ``serving/multitenant.py`` for several snapshots on one chip.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.obs import trace
+from bigdl_tpu.obs.registry import registry
+from bigdl_tpu.serving.request import (
+    FINISH_EOS, FINISH_LENGTH, Request, RequestHandle,
+)
+from bigdl_tpu.serving.scheduler import (
+    SlotScheduler, default_buckets, pick_bucket,
+)
+from bigdl_tpu.utils.queues import CLOSED, EMPTY, ClosableQueue
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def _parse_buckets(spec: str) -> tuple[int, ...]:
+    return tuple(int(x) for x in spec.replace(" ", "").split(",") if x)
+
+
+class EngineShutdown(RuntimeError):
+    """Raised from ``RequestHandle.result()`` for requests the engine could
+    not finish (shutdown or engine-thread failure)."""
+
+
+class ServingEngine:
+    """Continuous-batching request server over one model snapshot.
+
+    ``model``: a causal LM built from cached-decode-capable modules
+    (``MultiHeadAttention`` stacks — native or int8-quantized).
+    ``max_len``: per-slot KV-cache length; every request needs
+    ``prompt_len + max_new_tokens <= max_len``.
+    ``slots``: decode-batch rows held on device (BIGDL_SERVE_SLOTS, def. 8).
+    ``buckets``: static prefill-length grid (BIGDL_SERVE_BUCKETS, default
+    a doubling grid up to ``max_len``); a prompt longer than the largest
+    bucket is rejected at submit.
+    ``eos_id``: optional stop token (per engine; None = length-capped only).
+    ``admit_wait_ms``: idle batch-fill wait, the SLO knob
+    (BIGDL_SERVE_ADMIT_WAIT_MS, default 0).
+    """
+
+    def __init__(self, model, max_len: int, slots: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = None,
+                 admit_wait_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 dtype=None, name: str = "serve"):
+        import jax.numpy as jnp
+
+        from bigdl_tpu import nn
+
+        if slots is None:
+            slots = _env_int("BIGDL_SERVE_SLOTS", 8)
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        if buckets is None:
+            spec = os.environ.get("BIGDL_SERVE_BUCKETS", "")
+            buckets = (_parse_buckets(spec) if spec
+                       else default_buckets(max_len))
+        buckets = tuple(sorted({int(b) for b in buckets}))
+        if not buckets or buckets[0] < 1 or buckets[-1] > max_len:
+            raise ValueError(
+                f"buckets must be within [1, max_len={max_len}], "
+                f"got {buckets}")
+        if admit_wait_ms is None:
+            admit_wait_ms = float(os.environ.get(
+                "BIGDL_SERVE_ADMIT_WAIT_MS", "0"))
+        if queue_depth is None:
+            queue_depth = _env_int("BIGDL_SERVE_QUEUE_DEPTH", 256)
+        self._model = model
+        self._nn = nn
+        self.name = name
+        self.max_len = int(max_len)
+        self.slots = int(slots)
+        self.buckets = buckets
+        self.eos_id = eos_id
+        self.admit_wait_s = admit_wait_ms / 1000.0
+        self._dtype = jnp.float32 if dtype is None else dtype
+        self._params = model.get_params()
+        # functional cache states: install → capture → clear, so the module
+        # itself stays clean (the cached path branches on the PASSED state)
+        self._dec_state = nn.install_decode_cache(
+            model, self.slots, self.max_len, dtype=self._dtype, per_slot=True)
+        nn.clear_decode_cache(model)
+        self._pre_state0 = nn.install_decode_cache(
+            model, 1, self.max_len, dtype=self._dtype, per_slot=True)
+        nn.clear_decode_cache(model)
+
+        self._queue: ClosableQueue = ClosableQueue(queue_depth)
+        self._sched = SlotScheduler(self.slots)
+        self._programs: set = set()      # distinct compiled-program keys used
+        self._submitted = 0
+        self._completed = 0
+        self._start_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._failure: Optional[BaseException] = None
+
+    # ------------------------------------------------------------ programs
+    def _fn(self, key, build):
+        """Get-or-compile a device program, counting distinct keys used —
+        the compile-count ledger behind ``stats()['compiled_programs']``.
+        Cached on the MODEL (like ``generate``'s scan), so engines over the
+        same snapshot share programs."""
+        import jax
+
+        fn = self._model._apply_cache.get(key)
+        if fn is None:
+            fn = jax.jit(build())
+            self._model._apply_cache[key] = fn
+        self._programs.add(key)
+        return fn
+
+    def _dtype_name(self):
+        import jax.numpy as jnp
+        return jnp.dtype(self._dtype).name
+
+    def _prefill(self, params, state, tokens):
+        """(1, Lb) tokens → ((1, Lb) greedy next-token ids, filled cache)."""
+        import jax.numpy as jnp
+
+        lb = tokens.shape[1]
+        key = ("serve_prefill", lb, self.max_len, self._dtype_name())
+
+        def build():
+            def run(params, state, tokens):
+                logits, st = self._model.apply(params, state, tokens,
+                                               training=False, rng=None)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), st
+            return run
+
+        return self._fn(key, build)(params, state, tokens)
+
+    def _decode(self, params, state, tok):
+        """One continuous-batch tick: (S,) last tokens → (S,) next tokens."""
+        import jax.numpy as jnp
+
+        key = ("serve_decode", self.slots, self.max_len, self._dtype_name())
+
+        def build():
+            def run(params, state, tok):
+                logits, st = self._model.apply(params, state, tok[:, None],
+                                               training=False, rng=None)
+                return (jnp.argmax(logits[:, 0, :], axis=-1)
+                        .astype(jnp.int32), st)
+            return run
+
+        return self._fn(key, build)(params, state, tok)
+
+    def _assign(self, dst, src, slot, pos):
+        """Scatter a prefilled batch-1 cache into decode row ``slot`` with
+        TRUE prompt length ``pos`` — one program for every slot index."""
+        key = ("serve_assign", self.slots, self.max_len, self._dtype_name())
+        nn = self._nn
+
+        def build():
+            def run(dst, src, slot, pos):
+                return nn.assign_cache_slot(dst, src, slot, pos=pos)
+            return run
+
+        return self._fn(key, build)(dst, src, slot, pos)
+
+    # ------------------------------------------------------------- clients
+    def submit(self, prompt, max_new_tokens: int,
+               request_id=None) -> RequestHandle:
+        """Enqueue one request; returns immediately with a handle. Raises
+        ``ValueError`` for requests that can never fit (cache length or
+        bucket grid) and ``EngineShutdown`` after :meth:`shutdown`."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt_len {prompt.size} + max_new_tokens {max_new_tokens} "
+                f"exceeds the engine's cache length max_len={self.max_len}")
+        if pick_bucket(prompt.size, self.buckets) is None:
+            raise ValueError(
+                f"prompt_len {prompt.size} exceeds the largest prefill "
+                f"bucket {self.buckets[-1]}; widen buckets= "
+                f"(or BIGDL_SERVE_BUCKETS)")
+        if request_id is None:
+            request_id = self._submitted
+        req = Request(request_id, prompt, max_new_tokens)
+        self.start()
+        if not self._queue.put(req):
+            raise EngineShutdown(f"engine {self.name!r} is shut down")
+        self._submitted += 1
+        registry.counter("serving/requests").inc()
+        return req.handle
+
+    def start(self) -> "ServingEngine":
+        """Start the engine thread (idempotent; ``submit`` calls it)."""
+        with self._start_lock:
+            if self._thread is None:
+                if self._stop.is_set():
+                    raise EngineShutdown(
+                        f"engine {self.name!r} is shut down")
+                self._thread = threading.Thread(
+                    target=self._loop, name=f"bigdl-serve-{self.name}",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def shutdown(self, wait: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting requests, wake the engine thread, abort anything
+        unfinished (their handles raise :class:`EngineShutdown`)."""
+        self._stop.set()
+        self._queue.close()
+        t = self._thread
+        if wait and t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    def stats(self) -> dict:
+        """Engine-side ledger: compiled-program count (the bucket-reuse
+        proof), slot recycles, completion counts. Latency percentiles live
+        in the obs registry (``serving/*`` histograms)."""
+        return {
+            "name": self.name,
+            "slots": self.slots,
+            "buckets": self.buckets,
+            "max_len": self.max_len,
+            "compiled_programs": len(self._programs),
+            "program_grid_bound": len(self.buckets) + 2,
+            "slot_recycles": self._sched.recycles,
+            "submitted": self._submitted,
+            "completed": self._completed,
+            "active_slots": self._sched.active_count,
+            "queued": self._queue.qsize(),
+        }
+
+    # -------------------------------------------------------- engine thread
+    def _loop(self) -> None:
+        pending: list[Request] = []
+        try:
+            while not self._stop.is_set():
+                closed = self._gather(pending)
+                while pending and self._sched.has_free() \
+                        and not self._stop.is_set():
+                    self._admit(pending.pop(0))
+                if self._sched.any_active() and not self._stop.is_set():
+                    self._tick()
+                elif closed:
+                    break
+        except BaseException as e:  # noqa: BLE001 — fail handles, not silence
+            self._failure = e
+            trace.event("serving_engine_failure", engine=self.name,
+                        error=f"{type(e).__name__}: {e}")
+        finally:
+            self._abort_outstanding(pending)
+
+    def _gather(self, pending: list) -> bool:
+        """Pull arrivals into ``pending``. Blocks only when the engine is
+        fully idle; returns True once the queue is closed and drained."""
+        if self._sched.any_active() or pending:
+            while True:   # non-blocking drain between decode ticks
+                item = self._queue.get(timeout=0)
+                if item is EMPTY or item is CLOSED:
+                    return item is CLOSED
+                pending.append(item)
+        item = self._queue.get()      # idle: sleep until traffic or shutdown
+        if item is CLOSED:
+            return True
+        pending.append(item)
+        # SLO batch-fill wait: an idle engine lingers admit_wait_s for
+        # co-batchable arrivals before paying the first prefill — higher
+        # batch fill (throughput) for admit_wait of added TTFT
+        if self.admit_wait_s > 0:
+            deadline = time.perf_counter() + self.admit_wait_s
+            while len(pending) < self.slots:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                nxt = self._queue.get(timeout=remaining)
+                if nxt is EMPTY:
+                    break
+                if nxt is CLOSED:
+                    return True
+                pending.append(nxt)
+        return False
+
+    def _admit(self, req: Request) -> None:
+        """Prefill ``req``'s prompt into a free slot: one bucketed prefill
+        program, one slot-assign scatter — and the FIRST generated token
+        falls out of the prefill logits (TTFT ends here)."""
+        import jax.numpy as jnp
+
+        recycles_before = self._sched.recycles
+        slot = self._sched.admit(req)
+        if self._sched.recycles > recycles_before:
+            registry.counter("serving/slot_recycles").inc()
+        req.admit_t = time.perf_counter()
+        plen = req.prompt_len
+        lb = pick_bucket(plen, self.buckets)
+        padded = np.zeros((1, lb), np.int32)
+        padded[0, :plen] = req.prompt
+        with trace.span("serve/prefill", {"bucket": lb, "slot": slot.index}):
+            next_all, filled = self._prefill(
+                self._params, self._pre_state0, jnp.asarray(padded))
+            self._dec_state = self._assign(
+                self._dec_state, filled, slot.index, plen)
+            first = int(np.asarray(next_all)[0, plen - 1])
+        req.first_token_t = time.perf_counter()
+        req.generated.append(first)
+        registry.histogram("serving/queue_wait_ms").observe(
+            (req.admit_t - req.submit_t) * 1e3)
+        registry.histogram("serving/ttft_ms").observe(
+            (req.first_token_t - req.submit_t) * 1e3)
+        if self._finished(req, first):
+            self._finish(slot, first)
+        else:
+            slot.last_token = first
+        registry.gauge("serving/active_slots").set(self._sched.active_count)
+
+    def _tick(self) -> None:
+        """One continuous-batch decode step over the whole slot grid. Free
+        rows ride along with a dummy token (static shape!); their output is
+        ignored and their stale cache is wiped on reassignment."""
+        import jax.numpy as jnp
+
+        active = self._sched.active_slots()
+        tok = np.zeros((self.slots,), np.int32)
+        for slot in active:
+            tok[slot.index] = slot.last_token
+        with trace.span("serve/decode_step", {"active": len(active)}):
+            nxt, self._dec_state = self._decode(
+                self._params, self._dec_state, jnp.asarray(tok))
+            nxt = np.asarray(nxt)
+        for slot in active:
+            req = slot.request
+            t = int(nxt[slot.index])
+            req.generated.append(t)
+            if self._finished(req, t):
+                self._finish(slot, t)
+            else:
+                slot.last_token = t
+        registry.gauge("serving/active_slots").set(self._sched.active_count)
+
+    def _finished(self, req: Request, token: int) -> bool:
+        return ((self.eos_id is not None and token == self.eos_id)
+                or len(req.generated) >= req.max_new_tokens)
+
+    def _finish(self, slot, last_token: int) -> None:
+        req = slot.request
+        reason = (FINISH_EOS if (self.eos_id is not None
+                                 and last_token == self.eos_id)
+                  else FINISH_LENGTH)
+        result = req.complete(reason)
+        self._completed += 1
+        registry.counter("serving/completed").inc()
+        registry.histogram("serving/e2e_ms").observe(result.latency_s * 1e3)
+        tpot = result.time_per_token_s()
+        if tpot is not None:
+            registry.histogram("serving/tpot_ms").observe(tpot * 1e3)
+        self._sched.release(slot)
+
+    def _abort_outstanding(self, pending: list) -> None:
+        err = self._failure or EngineShutdown(
+            f"engine {self.name!r} shut down before the request finished")
+        for slot in self._sched.active_slots():
+            slot.request.handle._fail(err)
+            self._sched.release(slot)
+        for req in pending:
+            req.handle._fail(err)
+        while True:
+            item = self._queue.get(timeout=0)
+            if item is EMPTY or item is CLOSED:
+                break
+            item.handle._fail(err)
+        self._queue.close()

@@ -1,0 +1,80 @@
+"""Multi-tenant serving: several model snapshots on one chip.
+
+The "millions of users" deployment rarely serves ONE model: a fleet serves
+the fp32 flagship next to int8-quantized variants (``model.quantize()``)
+and per-tenant fine-tunes. Each snapshot gets its own
+:class:`~bigdl_tpu.serving.engine.ServingEngine` — own slot grid, own KV
+cache, own admission queue — and they time-share the chip naturally: every
+engine's programs are tiny static-shape dispatches, so XLA interleaves them
+without any cross-engine scheduling. Quantized snapshots serve through the
+SAME engine code because ``quantize()`` replaces Linear layers but leaves
+the attention stack (and therefore the decode cache) intact.
+
+This wrapper is deliberately thin: routing by snapshot name, shared
+lifecycle. Engine-level knobs (slots, buckets, SLO wait) are per snapshot —
+a latency-critical tenant can run ``admit_wait_ms=0`` next to a bulk tenant
+batching aggressively.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu.serving.engine import ServingEngine
+from bigdl_tpu.serving.request import RequestHandle
+
+
+class SnapshotServer:
+    """Route requests to named model snapshots, each behind its own
+    continuous-batching engine.
+
+    ``models``: ``{name: model}`` — any mix of native and quantized modules.
+    ``engine_kwargs``: either kwargs applied to every engine, or overridden
+    per snapshot via ``per_model={name: {...}}``.
+    """
+
+    def __init__(self, models: dict, max_len: int,
+                 per_model: Optional[dict] = None, **engine_kwargs):
+        if not models:
+            raise ValueError("models must name at least one snapshot")
+        per_model = per_model or {}
+        unknown = set(per_model) - set(models)
+        if unknown:
+            raise ValueError(f"per_model names unknown snapshots: "
+                             f"{sorted(unknown)}")
+        self._engines: dict[str, ServingEngine] = {}
+        for name, model in models.items():
+            kw = dict(engine_kwargs)
+            kw.update(per_model.get(name, {}))
+            kw.setdefault("max_len", max_len)
+            self._engines[name] = ServingEngine(model, name=name, **kw)
+
+    @property
+    def snapshots(self) -> tuple:
+        return tuple(self._engines)
+
+    def engine(self, snapshot: str) -> ServingEngine:
+        return self._engines[snapshot]
+
+    def submit(self, snapshot: str, prompt, max_new_tokens: int,
+               request_id=None) -> RequestHandle:
+        eng = self._engines.get(snapshot)
+        if eng is None:
+            raise KeyError(
+                f"unknown snapshot {snapshot!r}; serving "
+                f"{sorted(self._engines)}")
+        return eng.submit(prompt, max_new_tokens, request_id=request_id)
+
+    def stats(self) -> dict:
+        return {name: eng.stats() for name, eng in self._engines.items()}
+
+    def shutdown(self, wait: bool = True) -> None:
+        for eng in self._engines.values():
+            eng.shutdown(wait=wait)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

@@ -1,0 +1,117 @@
+"""Slot scheduler + static-shape bucket grid for continuous batching.
+
+Two facts make the engine's device programs compile exactly once:
+
+1. The decode batch is a FIXED grid of ``num_slots`` cache rows ("slots").
+   Requests come and go; the batch shape never changes. A finished
+   sequence's row is reset and handed to the next waiting request
+   mid-flight (slot recycling, the Orca/vLLM idea) — the other rows never
+   notice.
+2. Prompts prefill at one of a small set of static lengths (the bucket
+   grid): a prompt is right-padded up to the smallest bucket that fits, so
+   every distinct prompt length reuses one of ``len(buckets)`` compiled
+   prefill programs instead of compiling its own. Pad positions are never
+   attended (the slot's depth is the TRUE length) and are overwritten as
+   the sequence decodes.
+
+The scheduler here is deliberately host-only bookkeeping — which request
+occupies which slot — so it can be unit-tested without a device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from bigdl_tpu.serving.request import Request
+
+
+def default_buckets(max_len: int, lo: int = 16) -> tuple[int, ...]:
+    """Doubling prefill-length grid ``lo, 2·lo, …`` capped at ``max_len``
+    (always included), e.g. ``max_len=100 → (16, 32, 64, 100)``. Doubling
+    bounds pad waste at 2× while keeping the compile count logarithmic."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    buckets = []
+    b = lo
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return tuple(buckets)
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> Optional[int]:
+    """Smallest bucket >= n, or None when nothing fits."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return None
+
+
+class Slot:
+    """One decode-batch row: which request owns it and the last token fed."""
+
+    __slots__ = ("index", "request", "last_token")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.request: Optional[Request] = None
+        self.last_token: int = 0
+
+
+class SlotScheduler:
+    """Host bookkeeping for the fixed slot grid: admission into free rows,
+    release-and-recycle on finish. FIFO over freed slots so recycling is
+    deterministic under test."""
+
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self._slots = [Slot(i) for i in range(num_slots)]
+        self._free = list(range(num_slots))
+        self._ever_used: set[int] = set()
+        self._recycles = 0   # admissions into a row a finished request vacated
+
+    # ------------------------------------------------------------- queries
+    def has_free(self) -> bool:
+        return bool(self._free)
+
+    def any_active(self) -> bool:
+        return len(self._free) < self.num_slots
+
+    @property
+    def active_count(self) -> int:
+        return self.num_slots - len(self._free)
+
+    @property
+    def recycles(self) -> int:
+        return self._recycles
+
+    def active_slots(self) -> list[Slot]:
+        return [s for s in self._slots if s.request is not None]
+
+    def slot(self, index: int) -> Slot:
+        return self._slots[index]
+
+    # ----------------------------------------------------------- lifecycle
+    def admit(self, request: Request) -> Slot:
+        """Claim the oldest-freed slot for ``request``."""
+        if not self._free:
+            raise RuntimeError("no free slot (caller must check has_free())")
+        slot = self._slots[self._free.pop(0)]
+        slot.request = request
+        slot.last_token = 0
+        if slot.index in self._ever_used:
+            self._recycles += 1     # a finished sequence's row, reassigned
+        self._ever_used.add(slot.index)
+        return slot
+
+    def release(self, slot: Slot) -> None:
+        """Finish ``slot``'s request and free the row: it is immediately
+        admissible to the next waiting request — no drain-and-refill."""
+        if slot.request is None:
+            raise RuntimeError(f"slot {slot.index} is already free")
+        slot.request = None
+        slot.last_token = 0
+        self._free.append(slot.index)

@@ -1,0 +1,86 @@
+"""Shared event-aware bounded queues — the hand-off primitive of BOTH host
+data planes.
+
+Born in the prefetch feed (PR 3): a producer blocked on a full queue must wake
+the instant ``close()`` fires instead of busy-polling a put-timeout, so
+mid-epoch breaks cost microseconds and an idle full queue burns zero wakeups.
+The serving request plane (``bigdl_tpu/serving``) needs the same primitive with
+one generalization: a consumer that polls (``get(timeout=...)``) between decode
+ticks — the engine drains arrivals without ever sleeping on an empty queue
+while sequences are in flight.
+
+Sentinels instead of exceptions on the hot path: ``get`` returns ``CLOSED``
+once the queue is closed and drained, and ``EMPTY`` when a bounded wait ran
+out — both are identity-checked by callers, never raised.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+#: returned by ``get`` once the queue is closed and drained
+CLOSED = object()
+#: returned by ``get(timeout=...)`` when the wait expired with no item
+EMPTY = object()
+
+
+class ClosableQueue:
+    """Bounded FIFO whose blocked ``put``/``get`` wake immediately on
+    ``close()`` — the event-aware replacement for ``queue.Queue`` put-timeout
+    polling. ``put`` returns False (item dropped) once closed; ``get`` returns
+    :data:`CLOSED` once closed and drained, and :data:`EMPTY` when a bounded
+    ``timeout`` expires first (``timeout=0`` is a non-blocking poll)."""
+
+    def __init__(self, maxsize: int):
+        self._maxsize = maxsize
+        self._items: deque = deque()
+        lock = threading.Lock()
+        self._not_full = threading.Condition(lock)
+        self._not_empty = threading.Condition(lock)
+        self._closed = False
+
+    def put(self, item) -> bool:
+        with self._not_full:
+            while len(self._items) >= self._maxsize and not self._closed:
+                self._not_full.wait()
+            if self._closed:
+                return False
+            self._items.append(item)
+            self._not_empty.notify()
+            return True
+
+    def get(self, timeout: float | None = None):
+        with self._not_empty:
+            if timeout is None:
+                while not self._items and not self._closed:
+                    self._not_empty.wait()
+            else:
+                deadline = time.monotonic() + timeout
+                while not self._items and not self._closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return EMPTY
+                    self._not_empty.wait(remaining)
+            if not self._items:
+                return CLOSED
+            item = self._items.popleft()
+            self._not_full.notify()
+            return item
+
+    def qsize(self) -> int:
+        with self._not_empty:
+            return len(self._items)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        """Drop buffered items, wake every waiter. Idempotent."""
+        with self._not_full:
+            self._closed = True
+            self._items.clear()
+            self._not_full.notify_all()
+            self._not_empty.notify_all()

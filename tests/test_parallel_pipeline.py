@@ -327,17 +327,8 @@ class TestExecutorReuse:
 
 # ------------------------------------------------- event-aware prefetch close
 class TestPrefetchCloseLatency:
-    def test_close_wakes_blocked_producer_immediately(self):
-        from bigdl_tpu.dataset.prefetch import PrefetchingFeed
-        feed = PrefetchingFeed(lambda: iter(range(1000)), lambda b: b, depth=1)
-        it = iter(feed)
-        next(it)
-        time.sleep(0.05)   # let the producer fill the queue and block in put
-        t0 = time.perf_counter()
-        feed.close()
-        dt = time.perf_counter() - t0
-        # condition-notify wake: no 100 ms poll tick, no JOIN_TIMEOUT
-        assert dt < 0.09, f"close took {dt * 1e3:.0f} ms"
+    # the close()-wake-latency test moved to tests/test_serving.py with the
+    # queue's extraction into utils/queues (shared with the serving plane)
 
     def test_exception_still_surfaces(self):
         from bigdl_tpu.dataset.prefetch import PrefetchingFeed

@@ -1,0 +1,412 @@
+"""Online serving engine (bigdl_tpu/serving): continuous batching over the
+KV-cached decode path.
+
+The load-bearing contract: batched continuous-decode greedy output is
+BITWISE-identical to per-request decode — any per-slot position, mask,
+bucket-padding, or slot-recycle bug breaks token equality against the
+offline ``nn.greedy_generate`` oracle. Plus the request plane (the shared
+``ClosableQueue``), the host-only slot scheduler, and the per-slot cache
+primitives (``reset_decode_slot``/``assign_cache_slot``) underneath it all.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.models.transformerlm import TransformerLM
+from bigdl_tpu.serving import (
+    EngineShutdown, ServingEngine, SlotScheduler, SnapshotServer,
+    default_buckets, pick_bucket,
+)
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 50
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """One tiny causal LM for the whole module — engines over the same
+    instance share compiled programs via the module's apply cache."""
+    return TransformerLM(VOCAB, embed_dim=16, num_heads=2, num_layers=2,
+                         max_len=48).evaluate()
+
+
+def _prompt(seed, n):
+    return np.random.default_rng(seed).integers(0, VOCAB, (n,)).astype(np.int32)
+
+
+def _oracle(model, prompt, steps):
+    """Offline single-request greedy decode — the bitwise reference."""
+    return np.asarray(
+        nn.greedy_generate(model, jnp.asarray(prompt)[None, :], steps))[0]
+
+
+# ---------------------------------------------------- request-plane queue
+class TestRequestPlaneQueue:
+    """utils/queues.ClosableQueue — shared by the prefetch feed and the
+    serving admission queue."""
+
+    def test_close_wakes_blocked_producer_immediately(self):
+        # moved from test_parallel_pipeline with the queue's extraction into
+        # utils/queues: the feed-side close() latency contract rides the
+        # shared primitive now
+        from bigdl_tpu.dataset.prefetch import PrefetchingFeed
+        feed = PrefetchingFeed(lambda: iter(range(1000)), lambda b: b, depth=1)
+        it = iter(feed)
+        next(it)
+        time.sleep(0.05)   # let the producer fill the queue and block in put
+        t0 = time.perf_counter()
+        feed.close()
+        dt = time.perf_counter() - t0
+        # condition-notify wake: no 100 ms poll tick, no JOIN_TIMEOUT
+        assert dt < 0.09, f"close took {dt * 1e3:.0f} ms"
+
+    def test_get_timeout_returns_empty_sentinel(self):
+        from bigdl_tpu.utils.queues import EMPTY, ClosableQueue
+        q = ClosableQueue(4)
+        t0 = time.perf_counter()
+        assert q.get(timeout=0) is EMPTY          # non-blocking poll
+        assert q.get(timeout=0.02) is EMPTY       # bounded wait
+        assert time.perf_counter() - t0 < 1.0
+        q.put("x")
+        assert q.get(timeout=0) == "x"
+
+    def test_close_wakes_blocked_get(self):
+        from bigdl_tpu.utils.queues import CLOSED, ClosableQueue
+        q = ClosableQueue(4)
+        out = []
+        t = threading.Thread(target=lambda: out.append(q.get()), daemon=True)
+        t.start()
+        time.sleep(0.05)
+        t0 = time.perf_counter()
+        q.close()
+        t.join(timeout=2)
+        assert not t.is_alive()
+        assert time.perf_counter() - t0 < 0.09
+        assert out == [CLOSED]
+
+    def test_put_after_close_is_dropped(self):
+        from bigdl_tpu.utils.queues import CLOSED, ClosableQueue
+        q = ClosableQueue(2)
+        assert q.put(1)
+        q.close()
+        assert not q.put(2)
+        assert q.get() is CLOSED   # close drops buffered items too
+        assert q.closed
+
+
+# -------------------------------------------------------- bucket grid math
+class TestBuckets:
+    def test_default_buckets_double_and_cap(self):
+        assert default_buckets(100) == (16, 32, 64, 100)
+        assert default_buckets(64) == (16, 32, 64)
+        assert default_buckets(8) == (8,)
+
+    def test_pick_bucket_smallest_fit(self):
+        assert pick_bucket(5, (8, 16)) == 8
+        assert pick_bucket(8, (8, 16)) == 8
+        assert pick_bucket(9, (8, 16)) == 16
+        assert pick_bucket(17, (8, 16)) is None
+
+    def test_engine_rejects_unservable_requests(self, lm):
+        eng = ServingEngine(lm, max_len=48, slots=2, buckets=(8,))
+        with pytest.raises(ValueError, match="bucket"):
+            eng.submit(_prompt(0, 9), 4)        # longer than largest bucket
+        with pytest.raises(ValueError, match="max_len"):
+            eng.submit(_prompt(0, 8), 41)       # 8 + 41 > 48
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit(_prompt(0, 4), 0)
+        eng.shutdown()
+
+    def test_engine_validates_bucket_grid(self, lm):
+        with pytest.raises(ValueError, match="buckets"):
+            ServingEngine(lm, max_len=48, slots=2, buckets=(8, 64))  # > max_len
+
+
+# ------------------------------------------------------ host slot scheduler
+class TestSlotScheduler:
+    def _req(self, i):
+        from bigdl_tpu.serving.request import Request
+        return Request(i, np.asarray([1, 2], np.int32), 4)
+
+    def test_admit_release_recycle_accounting(self):
+        s = SlotScheduler(2)
+        a = s.admit(self._req(0))
+        b = s.admit(self._req(1))
+        assert not s.has_free() and s.active_count == 2
+        assert s.recycles == 0          # first occupancy is not a recycle
+        s.release(a)
+        c = s.admit(self._req(2))
+        assert c.index == a.index       # oldest-freed row reassigned
+        assert s.recycles == 1
+        s.release(b)
+        s.release(c)
+        assert s.active_count == 0 and s.has_free()
+
+    def test_release_free_slot_raises(self):
+        s = SlotScheduler(1)
+        slot = s.admit(self._req(0))
+        s.release(slot)
+        with pytest.raises(RuntimeError, match="already free"):
+            s.release(slot)
+
+    def test_admit_without_free_raises(self):
+        s = SlotScheduler(1)
+        s.admit(self._req(0))
+        with pytest.raises(RuntimeError, match="no free slot"):
+            s.admit(self._req(1))
+
+
+# ------------------------------------------------- per-slot cache primitives
+class TestPerSlotCache:
+    def test_per_slot_stepwise_logits_match_full_forward(self, lm):
+        prompt = np.random.default_rng(3).integers(0, VOCAB, (3, 6)).astype(np.int32)
+        full = np.asarray(lm.forward(jnp.asarray(prompt)))
+        params = lm.get_params()
+        state = nn.install_decode_cache(lm, 3, 12, per_slot=True)
+        nn.clear_decode_cache(lm)
+        for t in range(6):
+            logp, state = lm.apply(params, state,
+                                   jnp.asarray(prompt[:, t:t + 1]),
+                                   training=False, rng=None)
+            np.testing.assert_allclose(np.asarray(logp)[:, 0], full[:, t],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_chunked_prefill_matches_full_forward(self, lm):
+        """The serving engine's one-program prompt absorption: a t>1 chunk
+        through the cached path equals the uncached full forward."""
+        prompt = np.random.default_rng(4).integers(0, VOCAB, (1, 7)).astype(np.int32)
+        full = np.asarray(lm.forward(jnp.asarray(prompt)))
+        params = lm.get_params()
+        state = nn.install_decode_cache(lm, 1, 12, per_slot=True)
+        nn.clear_decode_cache(lm)
+        logits, state = lm.apply(params, state, jnp.asarray(prompt),
+                                 training=False, rng=None)
+        np.testing.assert_allclose(np.asarray(logits), full,
+                                   rtol=1e-4, atol=1e-5)
+        # the cache sits at depth 7 on every attention row
+        flat = jax.tree_util.tree_leaves_with_path(state)
+        poses = [leaf for path, leaf in flat
+                 if getattr(path[-1], "key", None) == "pos"]
+        assert poses and all(int(p[0]) == 7 for p in poses)
+
+    def test_reset_slot_leaves_other_rows_bitwise_untouched(self, lm):
+        """Wiping one slot mid-decode must not perturb the other row's
+        tokens — the no-drain-and-refill guarantee."""
+        params = lm.get_params()
+        prompt = np.random.default_rng(5).integers(0, VOCAB, (2,)).astype(np.int32)
+        st_a = nn.install_decode_cache(lm, 2, 12, per_slot=True)
+        nn.clear_decode_cache(lm)
+        st_b = jax.tree_util.tree_map(lambda x: x, st_a)
+        cur_a = cur_b = jnp.asarray(prompt)
+        seq_a, seq_b = [], []
+        for i in range(8):
+            la, st_a = lm.apply(params, st_a, cur_a[:, None],
+                                training=False, rng=None)
+            lb, st_b = lm.apply(params, st_b, cur_b[:, None],
+                                training=False, rng=None)
+            na = jnp.argmax(la[:, 0, :], -1).astype(jnp.int32)
+            nb = jnp.argmax(lb[:, 0, :], -1).astype(jnp.int32)
+            seq_a.append(np.asarray(na))
+            seq_b.append(np.asarray(nb))
+            if i == 3:
+                st_b = nn.reset_decode_slot(st_b, 1)   # recycle row 1
+                nb = nb.at[1].set(0)
+            cur_a, cur_b = na, nb
+        np.testing.assert_array_equal(np.stack(seq_a)[:, 0],
+                                      np.stack(seq_b)[:, 0])
+
+    def test_assign_slot_continues_bitwise_equal_to_greedy(self, lm):
+        """Prefill a prompt in a batch-1 cache, scatter it into slot 1 of a
+        batch-3 grid, decode on — tokens equal the offline greedy path."""
+        params = lm.get_params()
+        prompt = _prompt(6, 5)
+        oracle = _oracle(lm, prompt, 7)
+        pre = nn.install_decode_cache(lm, 1, 16, per_slot=True)
+        nn.clear_decode_cache(lm)
+        dec = nn.install_decode_cache(lm, 3, 16, per_slot=True)
+        nn.clear_decode_cache(lm)
+        padded = np.zeros((1, 8), np.int32)      # bucket-8 right padding
+        padded[0, :5] = prompt
+        logits, pre = lm.apply(params, pre, jnp.asarray(padded),
+                               training=False, rng=None)
+        first = int(np.asarray(jnp.argmax(logits[0, 4])))
+        assert first == oracle[5]
+        dec = nn.assign_cache_slot(dec, pre, 1, pos=5)
+        toks, cur = [first], jnp.zeros((3,), jnp.int32).at[1].set(first)
+        for _ in range(6):
+            logp, dec = lm.apply(params, dec, cur[:, None],
+                                 training=False, rng=None)
+            cur = jnp.argmax(logp[:, 0, :], -1).astype(jnp.int32)
+            toks.append(int(cur[1]))
+        np.testing.assert_array_equal(np.asarray(toks), oracle[5:])
+
+    def test_scalar_cache_refuses_slot_reset(self, lm):
+        """The pre-existing full-batch-only limitation now fails loudly
+        instead of silently corrupting a shared position counter."""
+        state = nn.install_decode_cache(lm, 2, 8)      # scalar positions
+        nn.clear_decode_cache(lm)
+        with pytest.raises(ValueError, match="per_slot"):
+            nn.reset_decode_slot(state, 0)
+
+    def test_assign_rejects_mismatched_source(self, lm):
+        dst = nn.install_decode_cache(lm, 2, 8, per_slot=True)
+        nn.clear_decode_cache(lm)
+        src_wide = nn.install_decode_cache(lm, 2, 8, per_slot=True)
+        nn.clear_decode_cache(lm)
+        with pytest.raises(ValueError, match="batch-1"):
+            nn.assign_cache_slot(dst, src_wide, 0)
+        src_short = nn.install_decode_cache(lm, 1, 6, per_slot=True)
+        nn.clear_decode_cache(lm)
+        with pytest.raises(ValueError, match="max_len"):
+            nn.assign_cache_slot(dst, src_short, 0)
+
+
+# ------------------------------------------------------ continuous batching
+class TestContinuousBatching:
+    STEPS = 10
+    PLENS = (3, 7, 12, 5)
+
+    def test_batched_equals_per_request_bitwise(self, lm):
+        """Four concurrent requests through three slots (so one rides a
+        recycled row) — every output bitwise-equals the offline
+        single-request greedy decode."""
+        prompts = [_prompt(10 + i, n) for i, n in enumerate(self.PLENS)]
+        oracles = [_oracle(lm, p, self.STEPS) for p in prompts]
+        with ServingEngine(lm, max_len=48, slots=3, buckets=(8, 16)) as eng:
+            handles = [eng.submit(p, self.STEPS) for p in prompts]
+            results = [h.result(timeout=180) for h in handles]
+            stats = eng.stats()
+        for r, o in zip(results, oracles):
+            np.testing.assert_array_equal(r.tokens, o)
+        assert stats["slot_recycles"] >= 1
+        assert stats["compiled_programs"] <= stats["program_grid_bound"]
+
+    def test_bucket_padding_invariance(self, lm):
+        """The same prompt served through different bucket grids (pad 5→8
+        vs 5→16) decodes the same tokens: pad positions are never attended."""
+        prompt = _prompt(20, 5)
+        outs = []
+        for buckets in ((8,), (16,), (8, 16)):
+            with ServingEngine(lm, max_len=48, slots=2,
+                               buckets=buckets) as eng:
+                outs.append(eng.submit(prompt, self.STEPS)
+                            .result(timeout=180).tokens)
+        np.testing.assert_array_equal(outs[0], outs[1])
+        np.testing.assert_array_equal(outs[0], outs[2])
+
+    def test_slot_recycling_randomized_arrivals(self, lm):
+        """Many requests with randomized lengths/budgets and staggered
+        arrival over few slots: every sequence must equal its per-request
+        serve, and rows must actually recycle mid-flight."""
+        rng = np.random.default_rng(42)
+        reqs = [(_prompt(100 + i, int(rng.integers(2, 15))),
+                 int(rng.integers(1, 9))) for i in range(12)]
+        # per-request baseline: same engine config, one request at a time
+        with ServingEngine(lm, max_len=48, slots=3, buckets=(8, 16)) as solo:
+            baseline = [solo.submit(p, m).result(timeout=180).tokens
+                        for p, m in reqs]
+        with ServingEngine(lm, max_len=48, slots=3, buckets=(8, 16)) as eng:
+            handles = []
+            for p, m in reqs:
+                handles.append(eng.submit(p, m))
+                if rng.random() < 0.4:
+                    time.sleep(0.002)    # stagger some arrivals mid-flight
+            results = [h.result(timeout=180) for h in handles]
+            stats = eng.stats()
+        for r, base in zip(results, baseline):
+            np.testing.assert_array_equal(r.tokens, base)
+        assert stats["slot_recycles"] >= len(reqs) - 3
+        assert stats["compiled_programs"] <= stats["program_grid_bound"]
+        assert stats["completed"] == len(reqs)
+
+    def test_eos_finishes_early_and_recycles(self, lm):
+        """eos_id set to a token the greedy path actually emits: the engine
+        must stop there (finish_reason='eos') instead of decoding to the
+        length cap."""
+        prompt = _prompt(10, 3)                      # same shape as oracle key
+        oracle = _oracle(lm, prompt, self.STEPS)
+        eos = int(oracle[3 + 4])                     # 5th generated token
+        with ServingEngine(lm, max_len=48, slots=3, buckets=(8, 16),
+                           eos_id=eos) as eng:
+            r = eng.submit(prompt, self.STEPS).result(timeout=180)
+        assert r.finish_reason == "eos"
+        assert r.n_generated <= 5
+        assert int(r.tokens[-1]) == eos
+        np.testing.assert_array_equal(r.tokens, oracle[:3 + r.n_generated])
+
+    def test_admit_wait_slo_knob_delays_first_token(self, lm):
+        """admit_wait_ms is the batch-fill-vs-TTFT trade: an idle engine
+        with a lone request must linger that long before prefilling."""
+        prompt = _prompt(10, 3)
+        with ServingEngine(lm, max_len=48, slots=3, buckets=(8, 16)) as warm:
+            warm.submit(prompt, 2).result(timeout=180)   # compile programs
+        with ServingEngine(lm, max_len=48, slots=3, buckets=(8, 16),
+                           admit_wait_ms=150) as eng:
+            r = eng.submit(prompt, 2).result(timeout=180)
+        assert r.ttft_s >= 0.10, f"SLO wait ignored: ttft={r.ttft_s:.3f}s"
+
+    def test_metrics_publish_through_registry(self, lm):
+        from bigdl_tpu.obs.registry import registry
+        registry.reset()
+        prompts = [_prompt(10 + i, n) for i, n in enumerate(self.PLENS)]
+        with ServingEngine(lm, max_len=48, slots=3, buckets=(8, 16)) as eng:
+            for h in [eng.submit(p, self.STEPS) for p in prompts]:
+                h.result(timeout=180)
+        snap = registry.snapshot()
+        assert snap["counters"]["serving/requests"] == len(prompts)
+        assert snap["counters"]["serving/completed"] == len(prompts)
+        for h in ("serving/ttft_ms", "serving/tpot_ms",
+                  "serving/queue_wait_ms", "serving/e2e_ms"):
+            assert snap["histograms"][h]["p99"] is not None, h
+        assert snap["histograms"]["serving/ttft_ms"]["count"] == len(prompts)
+
+    def test_shutdown_aborts_outstanding_and_rejects_new(self, lm):
+        eng = ServingEngine(lm, max_len=48, slots=3, buckets=(8, 16))
+        h = eng.submit(_prompt(10, 3), self.STEPS)
+        h.result(timeout=180)
+        eng.shutdown()
+        with pytest.raises(EngineShutdown):
+            eng.submit(_prompt(11, 3), 2)
+        assert not any(t.name.startswith("bigdl-serve") and t.is_alive()
+                       for t in threading.enumerate())
+
+
+# -------------------------------------------- quantized + multi-tenant path
+class TestSnapshots:
+    def test_int8_snapshot_serves_bitwise_vs_its_own_greedy(self, lm):
+        q = lm.quantize(mode="weight_only").evaluate()
+        prompt = _prompt(30, 6)
+        oracle = _oracle(q, prompt, 8)
+        with ServingEngine(q, max_len=48, slots=2, buckets=(8,)) as eng:
+            r = eng.submit(prompt, 8).result(timeout=180)
+        np.testing.assert_array_equal(r.tokens, oracle)
+
+    def test_multitenant_snapshots_round_robin(self, lm):
+        q = lm.quantize(mode="weight_only").evaluate()
+        prompt = _prompt(31, 6)
+        with SnapshotServer({"fp32": lm, "int8": q}, max_len=48,
+                            slots=2, buckets=(8,)) as srv:
+            hs = {name: srv.submit(name, prompt, 6)
+                  for name in ("fp32", "int8")}
+            out = {name: h.result(timeout=180) for name, h in hs.items()}
+            assert set(srv.stats()) == {"fp32", "int8"}
+        np.testing.assert_array_equal(out["fp32"].tokens,
+                                      _oracle(lm, prompt, 6))
+        np.testing.assert_array_equal(out["int8"].tokens,
+                                      _oracle(q, prompt, 6))
+
+    def test_unknown_snapshot_rejected(self, lm):
+        with SnapshotServer({"a": lm}, max_len=48, slots=2,
+                            buckets=(8,)) as srv:
+            with pytest.raises(KeyError, match="unknown snapshot"):
+                srv.submit("b", _prompt(0, 3), 2)
+        with pytest.raises(ValueError, match="per_model"):
+            SnapshotServer({"a": lm}, max_len=48, per_model={"zz": {}})

@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -41,6 +41,14 @@ t1-kernels:
 # serving-engine work.
 t1-serving:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serving --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Serving-plane fault injection only (docs/robustness.md "Serving"): engine-
+# thread crash + supervisor respawn with bitwise recovery, per-slot non-finite
+# guard, prefill faults, decode stalls vs deadlines/watchdog, wedged-shutdown
+# detection. Unmarked-slow, so `make t1` runs these too; this is the fast
+# inner loop for serving-robustness work.
+t1-serving-faults:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serving_faults --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
 dist:
 	bash make-dist.sh

@@ -92,6 +92,13 @@ class HangWatchdog:
         self._last = time.perf_counter()
         self._dumped = False
 
+    def disarm(self) -> None:
+        """Return to the not-yet-armed state. The serving engine disarms
+        while idle (no active slots): a quiet engine waiting on arrivals is
+        not a hang — only decode-loop silence with work in flight is."""
+        self._last = None
+        self._dumped = False
+
     # ------------------------------------------------------------- monitor
     def _limit(self) -> Optional[float]:
         limits = []

@@ -8,7 +8,10 @@ per (prefill-bucket | decode | assign) grid point; per-request TTFT and
 per-token latency publish through the obs metric registry.
 """
 
-from bigdl_tpu.serving.engine import EngineShutdown, ServingEngine
+from bigdl_tpu.serving.engine import (
+    EngineOverloaded, EngineShutdown, EngineShutdownTimeout,
+    NonFiniteLogitsError, RequestTimeout, ServingEngine,
+)
 from bigdl_tpu.serving.multitenant import SnapshotServer
 from bigdl_tpu.serving.request import (
     FINISH_EOS, FINISH_LENGTH, CompletedRequest, RequestHandle,
@@ -18,7 +21,9 @@ from bigdl_tpu.serving.scheduler import (
 )
 
 __all__ = [
-    "CompletedRequest", "EngineShutdown", "FINISH_EOS", "FINISH_LENGTH",
-    "RequestHandle", "ServingEngine", "SlotScheduler", "SnapshotServer",
+    "CompletedRequest", "EngineOverloaded", "EngineShutdown",
+    "EngineShutdownTimeout", "FINISH_EOS", "FINISH_LENGTH",
+    "NonFiniteLogitsError", "RequestHandle", "RequestTimeout",
+    "ServingEngine", "SlotScheduler", "SnapshotServer",
     "default_buckets", "pick_bucket",
 ]

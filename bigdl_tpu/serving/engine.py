@@ -22,6 +22,37 @@ programs:
   more arrivals before the first prefill — trades batch fill (throughput)
   against TTFT. 0 (default) = serve immediately.
 
+And a failure story (docs/robustness.md, "Serving"):
+
+- **Deadlines** (``submit(..., deadline_ms=)`` / BIGDL_SERVE_DEADLINE_MS):
+  an expired request fails with :class:`RequestTimeout` — checked while
+  queued, at admission, and after every decode tick; an expired slot is
+  recycled immediately instead of burning decode steps on a dead SLA.
+- **Overload control** (BIGDL_SERVE_OVERLOAD=block|shed|degrade): ``block``
+  (default) backpressures ``submit`` on the bounded queue; ``shed`` rejects
+  with :class:`EngineOverloaded` (carrying queue depth + a token-rate-based
+  wait estimate) instead of queueing work it cannot finish in time;
+  ``degrade`` halves ``max_new_tokens`` under pressure so every client gets
+  a shorter answer instead of some getting none.
+- **Crash recovery**: a supervisor thread respawns a dead decode loop under
+  BIGDL_SERVE_CRASH_BUDGET, rebuilds the slot grid, and re-prefills every
+  in-flight request from its prompt + already-emitted tokens — callers see
+  added latency, never a lost future, and the tokens stay bitwise-identical
+  (the chunked-prefill == full-forward invariant).
+- **Non-finite logit guard**: every program also returns per-row finiteness;
+  a poisoned slot fails ITS request with :class:`NonFiniteLogitsError`, is
+  reset before reuse, and co-batched slots never notice.
+- **Graceful drain** (``shutdown(drain=True)`` / SIGTERM via
+  :meth:`ServingEngine.install_signal_drain`): stop admission, finish
+  in-flight sequences up to BIGDL_SERVE_DRAIN_S, abort the rest.
+- **Health** (``stats()["health"]``: starting/ready/degraded/draining/dead)
+  published as the ``serving/health`` gauge, with the obs hang watchdog
+  armed on decode-loop silence while work is in flight.
+
+Fault sites ``serve_prefill`` / ``serve_decode`` / ``serve_thread`` /
+``serve_stall`` (``utils/faults.py``) make every path above deterministic
+under test, and each recovery action is a ``Robustness/serving_*`` event.
+
 Per-request latency lands in the obs metric registry (``serving/ttft_ms``,
 ``serving/tpot_ms``, ``serving/queue_wait_ms``, ``serving/e2e_ms``
 histograms): p50/p99 TTFT and time-per-token are one ``registry.snapshot()``
@@ -36,6 +67,7 @@ intact — see ``serving/multitenant.py`` for several snapshots on one chip.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -44,6 +76,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from bigdl_tpu.obs import trace
+from bigdl_tpu.obs import watchdog as obs_watchdog
 from bigdl_tpu.obs.registry import registry
 from bigdl_tpu.serving.request import (
     FINISH_EOS, FINISH_LENGTH, Request, RequestHandle,
@@ -51,7 +84,12 @@ from bigdl_tpu.serving.request import (
 from bigdl_tpu.serving.scheduler import (
     SlotScheduler, default_buckets, pick_bucket,
 )
+from bigdl_tpu.utils import faults
+from bigdl_tpu.utils.faults import FaultError, check_fault, fault_point
 from bigdl_tpu.utils.queues import CLOSED, EMPTY, ClosableQueue
+from bigdl_tpu.utils.robustness import events
+
+logger = logging.getLogger("bigdl_tpu.serving")
 
 
 def _env_int(name: str, default: int) -> int:
@@ -64,7 +102,46 @@ def _parse_buckets(spec: str) -> tuple[int, ...]:
 
 class EngineShutdown(RuntimeError):
     """Raised from ``RequestHandle.result()`` for requests the engine could
-    not finish (shutdown or engine-thread failure)."""
+    not finish (shutdown or engine-thread failure), and from ``submit`` once
+    the engine is shut down or draining."""
+
+
+class RequestTimeout(RuntimeError):
+    """The request's deadline (``deadline_ms``) passed before it finished —
+    while queued, at admission, or mid-decode. The slot (if any) was
+    recycled immediately."""
+
+
+class EngineOverloaded(RuntimeError):
+    """``submit`` rejected under BIGDL_SERVE_OVERLOAD=shed: the backlog is
+    at capacity, or the token-rate estimate says the request cannot meet its
+    deadline. Carries ``queue_depth`` and ``est_wait_s`` so clients can back
+    off or retry elsewhere."""
+
+    def __init__(self, msg: str, queue_depth: int, est_wait_s: float):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+        self.est_wait_s = est_wait_s
+
+
+class EngineShutdownTimeout(RuntimeError):
+    """``shutdown(wait=True)`` gave up waiting for the engine thread — the
+    thread is LEAKED, not silently forgotten. The message carries the
+    stack + open-span dump of the wedged thread."""
+
+
+class NonFiniteLogitsError(RuntimeError):
+    """The per-slot finiteness guard tripped: this request's logits went
+    NaN/Inf (poisoned weights, numeric blowup, or an injected
+    ``serve_decode=nonfinite`` fault). Only this request fails; its slot is
+    reset before reuse and co-batched slots are unaffected."""
+
+
+#: stats()["health"] states, published numerically as the serving/health gauge
+_HEALTH_CODE = {"starting": 0, "ready": 1, "degraded": 2, "draining": 3,
+                "dead": 4}
+
+_OVERLOAD_MODES = ("block", "shed", "degrade")
 
 
 class ServingEngine:
@@ -81,6 +158,16 @@ class ServingEngine:
     ``eos_id``: optional stop token (per engine; None = length-capped only).
     ``admit_wait_ms``: idle batch-fill wait, the SLO knob
     (BIGDL_SERVE_ADMIT_WAIT_MS, default 0).
+    ``deadline_ms``: default per-request deadline
+    (BIGDL_SERVE_DEADLINE_MS; 0/unset = none).
+    ``overload``: admission policy under pressure
+    (BIGDL_SERVE_OVERLOAD=block|shed|degrade, default block).
+    ``crash_budget``: engine-thread respawns before giving up
+    (BIGDL_SERVE_CRASH_BUDGET, default 2).
+    ``drain_s``: default drain deadline for ``shutdown(drain=True)``
+    (BIGDL_SERVE_DRAIN_S, default 30).
+    ``watchdog``: a :class:`~bigdl_tpu.obs.watchdog.HangWatchdog` to arm on
+    decode-loop silence (default: built from BIGDL_WATCHDOG_S, often None).
     """
 
     def __init__(self, model, max_len: int, slots: Optional[int] = None,
@@ -88,6 +175,11 @@ class ServingEngine:
                  eos_id: Optional[int] = None,
                  admit_wait_ms: Optional[float] = None,
                  queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 overload: Optional[str] = None,
+                 crash_budget: Optional[int] = None,
+                 drain_s: Optional[float] = None,
+                 watchdog: Optional["obs_watchdog.HangWatchdog"] = None,
                  dtype=None, name: str = "serve"):
         import jax.numpy as jnp
 
@@ -113,6 +205,18 @@ class ServingEngine:
                 "BIGDL_SERVE_ADMIT_WAIT_MS", "0"))
         if queue_depth is None:
             queue_depth = _env_int("BIGDL_SERVE_QUEUE_DEPTH", 256)
+        if deadline_ms is None:
+            deadline_ms = float(os.environ.get("BIGDL_SERVE_DEADLINE_MS", "0"))
+        if overload is None:
+            overload = os.environ.get("BIGDL_SERVE_OVERLOAD", "block")
+        if overload not in _OVERLOAD_MODES:
+            raise ValueError(
+                f"overload must be one of {_OVERLOAD_MODES}, got {overload!r}"
+                f" (BIGDL_SERVE_OVERLOAD)")
+        if crash_budget is None:
+            crash_budget = _env_int("BIGDL_SERVE_CRASH_BUDGET", 2)
+        if drain_s is None:
+            drain_s = float(os.environ.get("BIGDL_SERVE_DRAIN_S", "30"))
         self._model = model
         self._nn = nn
         self.name = name
@@ -121,6 +225,12 @@ class ServingEngine:
         self.buckets = buckets
         self.eos_id = eos_id
         self.admit_wait_s = admit_wait_ms / 1000.0
+        self.queue_depth = int(queue_depth)
+        self.default_deadline_s: Optional[float] = (
+            deadline_ms / 1000.0 if deadline_ms and deadline_ms > 0 else None)
+        self.overload = overload
+        self.crash_budget = int(crash_budget)
+        self.drain_s = float(drain_s)
         self._dtype = jnp.float32 if dtype is None else dtype
         self._params = model.get_params()
         # functional cache states: install → capture → clear, so the module
@@ -138,9 +248,26 @@ class ServingEngine:
         self._submitted = 0
         self._completed = 0
         self._start_lock = threading.Lock()
-        self._thread: Optional[threading.Thread] = None
+        self._thread: Optional[threading.Thread] = None   # supervisor
+        self._worker: Optional[threading.Thread] = None   # decode loop
         self._stop = threading.Event()
+        self._drain = threading.Event()
+        self._drain_deadline = 0.0
         self._failure: Optional[BaseException] = None
+        self._pending: list[Request] = []
+        self._backlog = 0                 # submitted, not yet in a slot
+        self._backlog_lock = threading.Lock()
+        self._respawns = 0
+        self._timeouts = 0
+        self._shed = 0
+        self._degraded_admits = 0
+        self._poisoned = 0
+        self._rate_tps = 0.0              # EWMA decode tokens/s (all slots)
+        self._tok_per_req = 0.0           # EWMA generated tokens per request
+        self._watchdog = (watchdog if watchdog is not None
+                          else obs_watchdog.from_env())
+        self._health = "starting"
+        registry.gauge("serving/health").set(_HEALTH_CODE["starting"])
 
     # ------------------------------------------------------------ programs
     def _fn(self, key, build):
@@ -162,7 +289,8 @@ class ServingEngine:
         return jnp.dtype(self._dtype).name
 
     def _prefill(self, params, state, tokens):
-        """(1, Lb) tokens → ((1, Lb) greedy next-token ids, filled cache)."""
+        """(1, Lb) tokens → ((1, Lb) greedy next-token ids, all-finite flag,
+        filled cache)."""
         import jax.numpy as jnp
 
         lb = tokens.shape[1]
@@ -172,13 +300,17 @@ class ServingEngine:
             def run(params, state, tokens):
                 logits, st = self._model.apply(params, state, tokens,
                                                training=False, rng=None)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), st
+                ok = jnp.isfinite(logits).all()
+                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                        ok, st)
             return run
 
         return self._fn(key, build)(params, state, tokens)
 
     def _decode(self, params, state, tok):
-        """One continuous-batch tick: (S,) last tokens → (S,) next tokens."""
+        """One continuous-batch tick: (S,) last tokens → ((S,) next tokens,
+        (S,) per-slot all-finite flags) — the non-finite guard rides the
+        same program, so the guard costs no extra dispatch."""
         import jax.numpy as jnp
 
         key = ("serve_decode", self.slots, self.max_len, self._dtype_name())
@@ -187,8 +319,9 @@ class ServingEngine:
             def run(params, state, tok):
                 logits, st = self._model.apply(params, state, tok[:, None],
                                                training=False, rng=None)
-                return (jnp.argmax(logits[:, 0, :], axis=-1)
-                        .astype(jnp.int32), st)
+                row = logits[:, 0, :]
+                ok = jnp.isfinite(row).all(axis=-1)
+                return (jnp.argmax(row, axis=-1).astype(jnp.int32), ok, st)
             return run
 
         return self._fn(key, build)(params, state, tok)
@@ -206,12 +339,28 @@ class ServingEngine:
 
         return self._fn(key, build)(dst, src, slot, pos)
 
+    def _reset_row(self, state, slot):
+        """Wipe one poisoned cache row (K/V + position) before the slot is
+        reused. Fault-path only — never compiled on a clean run, so the
+        clean-run program bound stays ``len(buckets) + 2``."""
+        key = ("serve_reset", self.slots, self.max_len, self._dtype_name())
+        nn = self._nn
+
+        def build():
+            def run(state, slot):
+                return nn.reset_decode_slot(state, slot)
+            return run
+
+        return self._fn(key, build)(state, slot)
+
     # ------------------------------------------------------------- clients
-    def submit(self, prompt, max_new_tokens: int,
-               request_id=None) -> RequestHandle:
+    def submit(self, prompt, max_new_tokens: int, request_id=None,
+               deadline_ms: Optional[float] = None) -> RequestHandle:
         """Enqueue one request; returns immediately with a handle. Raises
         ``ValueError`` for requests that can never fit (cache length or
-        bucket grid) and ``EngineShutdown`` after :meth:`shutdown`."""
+        bucket grid), ``EngineShutdown`` after :meth:`shutdown`, and
+        ``EngineOverloaded`` under shed-mode pressure. ``deadline_ms``
+        overrides the engine default (0 = no deadline)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
@@ -227,37 +376,168 @@ class ServingEngine:
                 f"prompt_len {prompt.size} exceeds the largest prefill "
                 f"bucket {self.buckets[-1]}; widen buckets= "
                 f"(or BIGDL_SERVE_BUCKETS)")
+        if deadline_ms is None:
+            deadline_s = self.default_deadline_s
+        else:
+            deadline_s = deadline_ms / 1000.0 if deadline_ms > 0 else None
+
+        if self.overload == "shed":
+            depth = self._backlog
+            est = self.estimated_wait_s()
+            if depth >= self.queue_depth or (
+                    deadline_s is not None and est > deadline_s):
+                self._reject_overloaded(depth, est)
+        elif self.overload == "degrade":
+            if self._backlog >= self.slots:
+                halved = max(1, max_new_tokens // 2)
+                if halved < max_new_tokens:
+                    self._degraded_admits += 1
+                    registry.counter("serving/degraded_admits").inc()
+                    events.record("serving_degraded", engine=self.name,
+                                  max_new_tokens=halved,
+                                  requested=max_new_tokens,
+                                  backlog=self._backlog)
+                    max_new_tokens = halved
+
         if request_id is None:
             request_id = self._submitted
-        req = Request(request_id, prompt, max_new_tokens)
+        req = Request(request_id, prompt, max_new_tokens,
+                      deadline_s=deadline_s)
         self.start()
-        if not self._queue.put(req):
-            raise EngineShutdown(f"engine {self.name!r} is shut down")
+        with self._backlog_lock:
+            self._backlog += 1
+        if self.overload == "shed":
+            ok = self._queue.try_put(req)
+        else:
+            ok = self._queue.put(req)
+        if not ok:
+            self._backlog_dec()
+            if self._queue.closed:
+                raise EngineShutdown(f"engine {self.name!r} is shut down")
+            self._reject_overloaded(self._backlog, self.estimated_wait_s())
         self._submitted += 1
         registry.counter("serving/requests").inc()
         return req.handle
 
+    def _reject_overloaded(self, depth: int, est: float) -> None:
+        self._shed += 1
+        registry.counter("serving/shed").inc()
+        events.record("serving_shed", engine=self.name, queue_depth=depth,
+                      est_wait_s=round(est, 4))
+        raise EngineOverloaded(
+            f"engine {self.name!r} overloaded: backlog {depth} "
+            f"(queue_depth {self.queue_depth}), estimated wait "
+            f"{est * 1e3:.0f} ms", queue_depth=depth, est_wait_s=est)
+
+    def estimated_wait_s(self) -> float:
+        """Backlog drain estimate from the decode token-rate EWMA: backlog ×
+        mean tokens/request ÷ aggregate tokens/s. 0 before any rate sample —
+        shed never fires on the deadline rule until the engine has served."""
+        rate = self._rate_tps
+        if rate <= 0.0:
+            return 0.0
+        tpr = self._tok_per_req if self._tok_per_req > 0 else 1.0
+        return self._backlog * tpr / rate
+
+    def _backlog_dec(self) -> None:
+        with self._backlog_lock:
+            if self._backlog > 0:
+                self._backlog -= 1
+
     def start(self) -> "ServingEngine":
-        """Start the engine thread (idempotent; ``submit`` calls it)."""
+        """Start the supervisor + engine thread (idempotent; ``submit``
+        calls it)."""
         with self._start_lock:
             if self._thread is None:
-                if self._stop.is_set():
+                if self._stop.is_set() or self._drain.is_set():
                     raise EngineShutdown(
                         f"engine {self.name!r} is shut down")
+                if self._watchdog is not None:
+                    self._watchdog.start()
                 self._thread = threading.Thread(
-                    target=self._loop, name=f"bigdl-serve-{self.name}",
-                    daemon=True)
+                    target=self._supervise,
+                    name=f"bigdl-serve-{self.name}", daemon=True)
                 self._thread.start()
         return self
 
-    def shutdown(self, wait: bool = True, timeout: float = 30.0) -> None:
-        """Stop accepting requests, wake the engine thread, abort anything
-        unfinished (their handles raise :class:`EngineShutdown`)."""
-        self._stop.set()
-        self._queue.close()
+    def install_signal_drain(self) -> "ServingEngine":
+        """Arm SIGTERM → ``shutdown(drain=True, wait=False)``, CHAINING any
+        previously installed handler (the training side's preemption handler
+        keeps working). Call from the main thread (a CPython signal rule).
+        Idempotent per engine is NOT attempted — call once."""
+        import signal
+
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _handler(signum, frame):
+            logger.warning("SIGTERM: draining serving engine %r", self.name)
+            self.shutdown(drain=True, wait=False)
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+
+        signal.signal(signal.SIGTERM, _handler)
+        return self
+
+    def shutdown(self, wait: bool = True, timeout: float = 30.0,
+                 drain: bool = False,
+                 drain_timeout: Optional[float] = None) -> None:
+        """Stop accepting requests and bring the engine down.
+
+        ``drain=False`` (default): abort everything unfinished — their
+        handles raise :class:`EngineShutdown`. ``drain=True``: finish
+        in-flight sequences first, up to ``drain_timeout`` seconds
+        (default ``drain_s`` / BIGDL_SERVE_DRAIN_S); queued-but-unadmitted
+        requests and anything still running at the deadline are aborted.
+
+        ``wait=True`` joins the engine thread and raises
+        :class:`EngineShutdownTimeout` — with a thread-stack + open-span
+        dump — if it is still alive after ``timeout`` seconds, instead of
+        silently leaking it."""
+        if drain and not self._stop.is_set() and not self._drain.is_set():
+            if drain_timeout is None:
+                drain_timeout = self.drain_s
+            self._drain_deadline = time.perf_counter() + drain_timeout
+            self._drain.set()
+            self._set_health("draining")
+            # close WITHOUT dropping: a submit racing this close lands its
+            # request in the queue, and the drain loop must find and abort
+            # it — drop-on-close would strand that future forever
+            self._queue.close(drain=True)
+            events.record("serving_drain", engine=self.name,
+                          in_flight=self._sched.active_count,
+                          timeout_s=drain_timeout)
+            if self._thread is None:   # never started: nothing to drain
+                self._stop.set()
+                self._set_health("dead")
+        else:
+            self._stop.set()
+            self._queue.close(drain=True)
         t = self._thread
-        if wait and t is not None and t is not threading.current_thread():
-            t.join(timeout=timeout)
+        if wait and t is not None and t is not threading.current_thread() \
+                and t is not self._worker:
+            budget = timeout + (drain_timeout if drain and drain_timeout
+                                else 0.0)
+            t.join(timeout=budget)
+            if t.is_alive():
+                stacks = obs_watchdog.HangWatchdog.thread_stacks()
+                spans = trace.open_spans()
+                lines = [f"engine {self.name!r} thread still alive "
+                         f"{budget:.1f}s after shutdown — LEAKED"]
+                for label, entries in spans.items():
+                    chain = " > ".join(
+                        f"{e['name']} ({e['age_ms']:.0f}ms)"
+                        for e in entries)
+                    lines.append(f"open spans [{label}]: {chain}")
+                for label, stack in stacks.items():
+                    if label.startswith("bigdl-serve"):
+                        lines.append(f"--- thread {label} ---")
+                        lines.append(stack.rstrip())
+                msg = "\n".join(lines)
+                logger.error("%s", msg)
+                events.record("serving_shutdown_timeout", engine=self.name,
+                              timeout_s=budget)
+                raise EngineShutdownTimeout(msg)
 
     def __enter__(self):
         return self
@@ -268,8 +548,9 @@ class ServingEngine:
 
     def stats(self) -> dict:
         """Engine-side ledger: compiled-program count (the bucket-reuse
-        proof), slot recycles, completion counts. Latency percentiles live
-        in the obs registry (``serving/*`` histograms)."""
+        proof), slot recycles, completion counts, health + robustness
+        counters. Latency percentiles live in the obs registry
+        (``serving/*`` histograms)."""
         return {
             "name": self.name,
             "slots": self.slots,
@@ -282,27 +563,127 @@ class ServingEngine:
             "completed": self._completed,
             "active_slots": self._sched.active_count,
             "queued": self._queue.qsize(),
+            "health": self._health,
+            "overload": self.overload,
+            "backlog": self._backlog,
+            "respawns": self._respawns,
+            "timeouts": self._timeouts,
+            "shed": self._shed,
+            "degraded_admits": self._degraded_admits,
+            "poisoned_slots": self._poisoned,
+            "decode_tps": round(self._rate_tps, 3),
+            "est_wait_s": round(self.estimated_wait_s(), 6),
         }
 
-    # -------------------------------------------------------- engine thread
-    def _loop(self) -> None:
-        pending: list[Request] = []
+    # --------------------------------------------------------------- health
+    def _set_health(self, state: str) -> None:
+        if state == self._health:
+            return
+        self._health = state
+        registry.gauge("serving/health").set(_HEALTH_CODE[state])
+        trace.event("serving_health", engine=self.name, health=state)
+
+    def _update_health(self) -> None:
+        if self._drain.is_set() or self._stop.is_set():
+            return
+        pressure = self._backlog >= self.slots
+        self._set_health(
+            "degraded" if (pressure or self._respawns) else "ready")
+
+    # ---------------------------------------------------------- supervisor
+    def _supervise(self) -> None:
+        """Own the decode-loop thread: respawn it on abnormal death while
+        the crash budget lasts, recovering in-flight requests first. Runs
+        the final abort so no future is ever left unresolved."""
+        budget = self.crash_budget
         try:
-            while not self._stop.is_set():
-                closed = self._gather(pending)
-                while pending and self._sched.has_free() \
-                        and not self._stop.is_set():
-                    self._admit(pending.pop(0))
-                if self._sched.any_active() and not self._stop.is_set():
-                    self._tick()
-                elif closed:
+            while True:
+                w = threading.Thread(
+                    target=self._thread_main,
+                    name=f"bigdl-serve-{self.name}-loop", daemon=True)
+                self._worker = w
+                w.start()
+                w.join()
+                err = self._failure
+                if err is None or self._stop.is_set():
                     break
+                if budget <= 0:
+                    logger.error(
+                        "engine %r thread died (%s: %s) with the crash "
+                        "budget exhausted; aborting outstanding requests",
+                        self.name, type(err).__name__, err)
+                    events.record("serving_crash_budget_exhausted",
+                                  engine=self.name,
+                                  error=f"{type(err).__name__}: {err}")
+                    break
+                budget -= 1
+                self._respawns += 1
+                registry.counter("serving/thread_respawns").inc()
+                events.record("serving_thread_respawn", engine=self.name,
+                              error=f"{type(err).__name__}: {err}",
+                              budget_left=budget)
+                logger.warning(
+                    "engine %r thread died (%s: %s); respawning "
+                    "(%d respawns, budget left %d)", self.name,
+                    type(err).__name__, err, self._respawns, budget)
+                self._recover()
+                self._failure = None
+        finally:
+            self._stop.set()
+            self._abort_outstanding(self._pending)
+            self._set_health("dead")
+            if self._watchdog is not None:
+                self._watchdog.stop()
+
+    def _thread_main(self) -> None:
+        try:
+            self._loop()
         except BaseException as e:  # noqa: BLE001 — fail handles, not silence
             self._failure = e
             trace.event("serving_engine_failure", engine=self.name,
                         error=f"{type(e).__name__}: {e}")
-        finally:
-            self._abort_outstanding(pending)
+
+    def _recover(self) -> None:
+        """Rebuild device state after a decode-loop death: fresh zeroed slot
+        grid, every in-flight request pushed to the FRONT of pending so the
+        respawned loop re-prefills it from prompt + already-emitted tokens.
+        Re-prefilling the full context reproduces the incremental path
+        bitwise (chunked-prefill == full-forward), so callers see added
+        latency, never different tokens."""
+        nn = self._nn
+        evicted = self._sched.reset()
+        self._dec_state = nn.install_decode_cache(
+            self._model, self.slots, self.max_len, dtype=self._dtype,
+            per_slot=True)
+        nn.clear_decode_cache(self._model)
+        self._pending[:0] = evicted
+        registry.gauge("serving/active_slots").set(0)
+        events.record("serving_recovered", engine=self.name,
+                      requeued=len(evicted), pending=len(self._pending))
+
+    # -------------------------------------------------------- engine thread
+    def _loop(self) -> None:
+        self._set_health("degraded" if self._respawns else "ready")
+        wd = self._watchdog
+        while not self._stop.is_set():
+            fault_point(faults.SITE_SERVE_THREAD)
+            closed = self._gather(self._pending)
+            if self._drain.is_set():
+                self._drain_loop()
+                return
+            now = time.perf_counter()
+            self._expire_pending(now)
+            while self._pending and self._sched.has_free() \
+                    and not self._stop.is_set():
+                self._admit(self._pending.pop(0))
+            self._update_health()
+            if self._sched.any_active() and not self._stop.is_set():
+                self._tick()
+                self._expire_slots()
+            elif closed:
+                break
+            if wd is not None and not self._sched.any_active():
+                wd.disarm()
 
     def _gather(self, pending: list) -> bool:
         """Pull arrivals into ``pending``. Blocks only when the engine is
@@ -334,55 +715,188 @@ class ServingEngine:
                 pending.append(nxt)
         return False
 
+    def _drain_loop(self) -> None:
+        """Graceful drain: abort everything NOT yet in a slot (it never
+        started — EngineShutdown, retryable elsewhere), then keep ticking
+        the in-flight sequences until they finish or the drain deadline
+        passes. The supervisor's final abort covers anything left."""
+        err = EngineShutdown(
+            f"engine {self.name!r} is draining; request was not in flight")
+        for req in self._pending:
+            req.handle._fail(err)
+            self._backlog_dec()
+        self._pending.clear()
+        while True:
+            item = self._queue.get(timeout=0)
+            if item is EMPTY or item is CLOSED:
+                break
+            item.handle._fail(err)
+            self._backlog_dec()
+        while self._sched.any_active() and not self._stop.is_set():
+            if time.perf_counter() >= self._drain_deadline:
+                events.record("serving_drain_deadline", engine=self.name,
+                              aborted=self._sched.active_count)
+                logger.warning(
+                    "engine %r drain deadline passed with %d sequences "
+                    "in flight; aborting them", self.name,
+                    self._sched.active_count)
+                break
+            self._tick()
+            self._expire_slots()
+        if not self._sched.any_active():
+            events.record("serving_drain_complete", engine=self.name)
+        self._stop.set()
+
+    # ------------------------------------------------------------ deadlines
+    def _timeout(self, req: Request, in_slot: bool) -> None:
+        self._timeouts += 1
+        registry.counter("serving/timeouts").inc()
+        events.record("serving_timeout", engine=self.name,
+                      request_id=req.request_id, in_slot=in_slot,
+                      generated=len(req.generated))
+        req.handle._fail(RequestTimeout(
+            f"request {req.request_id} missed its deadline "
+            f"({'mid-decode' if in_slot else 'while queued'}, "
+            f"{len(req.generated)} tokens generated)"))
+        if not in_slot:
+            self._backlog_dec()
+
+    def _expire_pending(self, now: float) -> None:
+        if not self._pending:
+            return
+        keep = []
+        for req in self._pending:
+            if req.expired(now):
+                self._timeout(req, in_slot=False)
+            else:
+                keep.append(req)
+        self._pending[:] = keep
+
+    def _expire_slots(self) -> None:
+        """Recycle slots whose request blew its deadline mid-decode — the
+        row is freed NOW (its stale cache is wiped on reassignment) instead
+        of burning ticks on a request nobody is waiting for."""
+        now = time.perf_counter()
+        released = False
+        for slot in self._sched.active_slots():
+            if slot.request.expired(now):
+                self._timeout(slot.request, in_slot=True)
+                self._sched.release(slot)
+                released = True
+        if released:
+            registry.gauge("serving/active_slots").set(
+                self._sched.active_count)
+
+    # ------------------------------------------------------------ admission
     def _admit(self, req: Request) -> None:
-        """Prefill ``req``'s prompt into a free slot: one bucketed prefill
+        """Prefill ``req``'s context into a free slot: one bucketed prefill
         program, one slot-assign scatter — and the FIRST generated token
-        falls out of the prefill logits (TTFT ends here)."""
+        falls out of the prefill logits (TTFT ends here). On the crash-
+        recovery path the context is prompt + already-emitted tokens, so the
+        re-prefilled slot resumes exactly where the dead loop stopped."""
         import jax.numpy as jnp
 
         recycles_before = self._sched.recycles
         slot = self._sched.admit(req)
         if self._sched.recycles > recycles_before:
             registry.counter("serving/slot_recycles").inc()
-        req.admit_t = time.perf_counter()
-        plen = req.prompt_len
-        lb = pick_bucket(plen, self.buckets)
-        padded = np.zeros((1, lb), np.int32)
-        padded[0, :plen] = req.prompt
-        with trace.span("serve/prefill", {"bucket": lb, "slot": slot.index}):
-            next_all, filled = self._prefill(
-                self._params, self._pre_state0, jnp.asarray(padded))
-            self._dec_state = self._assign(
-                self._dec_state, filled, slot.index, plen)
-            first = int(np.asarray(next_all)[0, plen - 1])
-        req.first_token_t = time.perf_counter()
-        req.generated.append(first)
-        registry.histogram("serving/queue_wait_ms").observe(
-            (req.admit_t - req.submit_t) * 1e3)
-        registry.histogram("serving/ttft_ms").observe(
-            (req.first_token_t - req.submit_t) * 1e3)
-        if self._finished(req, first):
-            self._finish(slot, first)
+        if req.admit_t is None:
+            req.admit_t = time.perf_counter()
+            self._backlog_dec()
+            registry.histogram("serving/queue_wait_ms").observe(
+                (req.admit_t - req.submit_t) * 1e3)
+        if req.generated:
+            ctx = np.concatenate(
+                [req.prompt, np.asarray(req.generated, np.int32)])
         else:
-            slot.last_token = first
+            ctx = req.prompt
+        clen = int(ctx.size)
+        lb = pick_bucket(clen, self.buckets)
+        if lb is None:
+            lb = self.max_len   # recovery-only: context outgrew the grid
+        padded = np.zeros((1, lb), np.int32)
+        padded[0, :clen] = ctx
+        try:
+            fault_point(faults.SITE_SERVE_PREFILL)
+            with trace.span("serve/prefill",
+                            {"bucket": lb, "slot": slot.index}):
+                next_all, ok, filled = self._prefill(
+                    self._params, self._pre_state0, jnp.asarray(padded))
+                if not bool(np.asarray(ok)):
+                    raise NonFiniteLogitsError(
+                        f"non-finite logits prefilling request "
+                        f"{req.request_id}")
+                self._dec_state = self._assign(
+                    self._dec_state, filled, slot.index, clen)
+                nxt = int(np.asarray(next_all)[0, clen - 1])
+        except (FaultError, NonFiniteLogitsError) as e:
+            # this request fails loudly; the decode grid was never touched,
+            # so co-batched slots are unaffected
+            if isinstance(e, NonFiniteLogitsError):
+                self._poisoned += 1
+                registry.counter("serving/poisoned_slots").inc()
+                events.record("serving_poisoned_slot", engine=self.name,
+                              request_id=req.request_id, phase="prefill")
+            else:
+                events.record("serving_prefill_failed", engine=self.name,
+                              request_id=req.request_id, error=str(e))
+            logger.error("engine %r: request %r failed in prefill: %s",
+                         self.name, req.request_id, e)
+            req.handle._fail(e)
+            self._sched.release(slot)
+            registry.gauge("serving/active_slots").set(
+                self._sched.active_count)
+            return
+        if req.first_token_t is None:
+            req.first_token_t = time.perf_counter()
+            registry.histogram("serving/ttft_ms").observe(
+                (req.first_token_t - req.submit_t) * 1e3)
+        req.generated.append(nxt)
+        if self._finished(req, nxt):
+            self._finish(slot, nxt)
+        else:
+            slot.last_token = nxt
         registry.gauge("serving/active_slots").set(self._sched.active_count)
 
+    # --------------------------------------------------------------- decode
     def _tick(self) -> None:
         """One continuous-batch decode step over the whole slot grid. Free
         rows ride along with a dummy token (static shape!); their output is
         ignored and their stale cache is wiped on reassignment."""
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
         active = self._sched.active_slots()
         tok = np.zeros((self.slots,), np.int32)
         for slot in active:
             tok[slot.index] = slot.last_token
+        fault_point(faults.SITE_SERVE_STALL)   # "stall" sleeps right here
         with trace.span("serve/decode_step", {"active": len(active)}):
-            nxt, self._dec_state = self._decode(
+            nxt, ok, self._dec_state = self._decode(
                 self._params, self._dec_state, jnp.asarray(tok))
             nxt = np.asarray(nxt)
+            ok = np.asarray(ok)
+        action = check_fault(faults.SITE_SERVE_DECODE)
+        if action == "nonfinite" and active:
+            # poison the lowest-index active slot: the guard below must fail
+            # exactly that request and leave its co-batched rows untouched
+            ok = ok.copy()
+            ok[active[0].index] = False
+        elif action is not None and action != "nonfinite":
+            raise FaultError(
+                f"injected fault at site {faults.SITE_SERVE_DECODE!r}")
+        dt = time.perf_counter() - t0
+        if dt > 0 and active:
+            inst = len(active) / dt
+            self._rate_tps = (inst if self._rate_tps == 0.0
+                              else 0.8 * self._rate_tps + 0.2 * inst)
+        if self._watchdog is not None:
+            self._watchdog.heartbeat(dt)
         for slot in active:
             req = slot.request
+            if not bool(ok[slot.index]):
+                self._poison(slot)
+                continue
             t = int(nxt[slot.index])
             req.generated.append(t)
             if self._finished(req, t):
@@ -390,6 +904,25 @@ class ServingEngine:
             else:
                 slot.last_token = t
         registry.gauge("serving/active_slots").set(self._sched.active_count)
+
+    def _poison(self, slot) -> None:
+        """Per-slot non-finite guard tripped: fail THIS request, wipe the
+        row before anyone reuses it, keep every other slot decoding."""
+        req = slot.request
+        self._poisoned += 1
+        registry.counter("serving/poisoned_slots").inc()
+        events.record("serving_poisoned_slot", engine=self.name,
+                      request_id=req.request_id, phase="decode",
+                      slot=slot.index)
+        logger.error(
+            "engine %r: non-finite logits in slot %d (request %r); "
+            "failing the request and resetting the row",
+            self.name, slot.index, req.request_id)
+        req.handle._fail(NonFiniteLogitsError(
+            f"non-finite logits decoding request {req.request_id} "
+            f"(slot {slot.index})"))
+        self._dec_state = self._reset_row(self._dec_state, slot.index)
+        self._sched.release(slot)
 
     def _finished(self, req: Request, token: int) -> bool:
         return ((self.eos_id is not None and token == self.eos_id)
@@ -407,6 +940,9 @@ class ServingEngine:
         tpot = result.time_per_token_s()
         if tpot is not None:
             registry.histogram("serving/tpot_ms").observe(tpot * 1e3)
+        n = result.n_generated
+        self._tok_per_req = (float(n) if self._tok_per_req == 0.0
+                             else 0.8 * self._tok_per_req + 0.2 * n)
         self._sched.release(slot)
 
     def _abort_outstanding(self, pending: list) -> None:
@@ -417,9 +953,15 @@ class ServingEngine:
             self._sched.release(slot)
         for req in pending:
             req.handle._fail(err)
+            self._backlog_dec()
+        pending.clear()
+        # the queue was closed with drain=True: items a racing submit
+        # slipped in are still here, and each one's future fails NOW —
+        # drop-on-close used to strand them forever
         while True:
             item = self._queue.get(timeout=0)
             if item is EMPTY or item is CLOSED:
                 break
             item.handle._fail(err)
+            self._backlog_dec()
         self._queue.close()

@@ -57,20 +57,30 @@ class SnapshotServer:
         return self._engines[snapshot]
 
     def submit(self, snapshot: str, prompt, max_new_tokens: int,
-               request_id=None) -> RequestHandle:
+               request_id=None, deadline_ms=None) -> RequestHandle:
         eng = self._engines.get(snapshot)
         if eng is None:
             raise KeyError(
                 f"unknown snapshot {snapshot!r}; serving "
                 f"{sorted(self._engines)}")
-        return eng.submit(prompt, max_new_tokens, request_id=request_id)
+        return eng.submit(prompt, max_new_tokens, request_id=request_id,
+                          deadline_ms=deadline_ms)
 
     def stats(self) -> dict:
         return {name: eng.stats() for name, eng in self._engines.items()}
 
-    def shutdown(self, wait: bool = True) -> None:
-        for eng in self._engines.values():
-            eng.shutdown(wait=wait)
+    def shutdown(self, wait: bool = True, drain: bool = False) -> None:
+        """Per-tenant faults stay per-tenant on the way down too: one
+        engine's :class:`EngineShutdownTimeout` must not leak the others'
+        threads, so every engine is stopped before any error surfaces."""
+        errors = []
+        for name, eng in self._engines.items():
+            try:
+                eng.shutdown(wait=wait, drain=drain)
+            except Exception as e:  # noqa: BLE001 — finish the fleet first
+                errors.append((name, e))
+        if errors:
+            raise errors[0][1]
 
     def __enter__(self):
         return self

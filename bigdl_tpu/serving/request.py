@@ -96,17 +96,29 @@ class Request:
     the engine thread after submission."""
 
     __slots__ = ("request_id", "prompt", "max_new_tokens", "submit_t",
-                 "admit_t", "first_token_t", "generated", "handle")
+                 "admit_t", "first_token_t", "deadline_t", "generated",
+                 "handle")
 
-    def __init__(self, request_id, prompt: np.ndarray, max_new_tokens: int):
+    def __init__(self, request_id, prompt: np.ndarray, max_new_tokens: int,
+                 deadline_s: Optional[float] = None):
         self.request_id = request_id
         self.prompt = prompt                      # np.int32 (prompt_len,)
         self.max_new_tokens = int(max_new_tokens)
         self.submit_t = time.perf_counter()
+        #: absolute perf_counter() time after which the request is expired
+        #: (None = no deadline); enforced by the engine at admission and
+        #: after every decode tick
+        self.deadline_t: Optional[float] = (
+            None if deadline_s is None else self.submit_t + deadline_s)
         self.admit_t: Optional[float] = None
         self.first_token_t: Optional[float] = None
         self.generated: list[int] = []
         self.handle = RequestHandle(self)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline_t is None:
+            return False
+        return (time.perf_counter() if now is None else now) >= self.deadline_t
 
     @property
     def prompt_len(self) -> int:

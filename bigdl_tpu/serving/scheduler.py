@@ -107,6 +107,19 @@ class SlotScheduler:
         self._ever_used.add(slot.index)
         return slot
 
+    def reset(self) -> list[Request]:
+        """Vacate every slot and rebuild the free list in index order —
+        the crash-recovery path, where a respawned engine thread re-prefills
+        the in-flight requests into a fresh cache. Returns the evicted
+        requests (admission order: slot index); recycle counts survive so
+        ``stats()`` stays monotone across a respawn."""
+        evicted = [s.request for s in self._slots if s.request is not None]
+        for s in self._slots:
+            s.request = None
+            s.last_token = 0
+        self._free = list(range(self.num_slots))
+        return evicted
+
     def release(self, slot: Slot) -> None:
         """Finish ``slot``'s request and free the row: it is immediately
         admissible to the next waiting request — no drain-and-refill."""

@@ -29,6 +29,21 @@ site                      where it fires
                           ``BIGDL_FAULT_STALL_S`` seconds (default 2) at
                           iteration N (matched by ``index``), simulating a
                           silent device/feed hang for the obs watchdog suite
+``serve_prefill``         the serving engine's per-request prefill
+                          (``serving/engine.py`` ``_admit``) — ``error`` fails
+                          that one request; other slots keep decoding
+``serve_decode``          the serving engine's decode tick — ``nonfinite``
+                          poisons ONE active slot's logits (the per-slot
+                          guard fails that request, resets the row);
+                          ``error`` crashes the engine thread
+``serve_thread``          the serving engine's loop, polled once per work
+                          iteration — default action ``death`` kills the
+                          engine thread so the supervisor's respawn +
+                          re-prefill recovery can be exercised
+``serve_stall``           the serving engine's decode tick — sleeps
+                          ``BIGDL_FAULT_STALL_S`` seconds (default 2),
+                          simulating a wedged decode loop for the serving
+                          watchdog / deadline suites
 ========================  ====================================================
 
 A plan is a ``;``-separated list of entries ``site@N`` or ``site@N=action``.
@@ -64,6 +79,10 @@ SITE_NONFINITE_LOSS = "nonfinite_loss"
 SITE_SIGTERM = "sigterm"
 SITE_CKPT_WRITE = "ckpt_write"
 SITE_STALL = "stall"
+SITE_SERVE_PREFILL = "serve_prefill"
+SITE_SERVE_DECODE = "serve_decode"
+SITE_SERVE_THREAD = "serve_thread"
+SITE_SERVE_STALL = "serve_stall"
 
 #: sites whose plan entries match the caller-supplied ``index`` (training
 #: iteration) instead of the site's hit counter
@@ -77,10 +96,14 @@ _DEFAULT_ACTION = {
     SITE_SIGTERM: "sigterm",
     SITE_CKPT_WRITE: "torn",
     SITE_STALL: "stall",
+    SITE_SERVE_PREFILL: "error",
+    SITE_SERVE_DECODE: "error",
+    SITE_SERVE_THREAD: "death",
+    SITE_SERVE_STALL: "stall",
 }
 
 _KNOWN_ACTIONS = frozenset({"error", "death", "nan", "sigterm", "torn",
-                            "kill", "stall"})
+                            "kill", "stall", "nonfinite"})
 
 
 class FaultError(RuntimeError):

@@ -51,6 +51,17 @@ class ClosableQueue:
             self._not_empty.notify()
             return True
 
+    def try_put(self, item) -> bool:
+        """Non-blocking put: False when the queue is full OR closed (callers
+        that must tell the two apart check :attr:`closed` — the serving
+        engine's shed-mode admission does exactly that)."""
+        with self._not_full:
+            if self._closed or len(self._items) >= self._maxsize:
+                return False
+            self._items.append(item)
+            self._not_empty.notify()
+            return True
+
     def get(self, timeout: float | None = None):
         with self._not_empty:
             if timeout is None:
@@ -77,10 +88,19 @@ class ClosableQueue:
     def closed(self) -> bool:
         return self._closed
 
-    def close(self) -> None:
-        """Drop buffered items, wake every waiter. Idempotent."""
+    def close(self, drain: bool = False) -> None:
+        """Close the queue and wake every waiter. Idempotent.
+
+        ``drain=False`` (default) drops buffered items — the prefetch feed's
+        mid-epoch break, where unconsumed batches are garbage. ``drain=True``
+        RETAINS them so the consumer can ``get(timeout=0)`` each one out and
+        dispose of it deliberately — the serving shutdown path needs this, or
+        a ``submit`` racing ``close`` strands its future forever (the item
+        lands in the deque an instant before ``clear()`` and nobody ever
+        fails its handle)."""
         with self._not_full:
             self._closed = True
-            self._items.clear()
+            if not drain:
+                self._items.clear()
             self._not_full.notify_all()
             self._not_empty.notify_all()

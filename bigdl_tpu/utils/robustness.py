@@ -24,7 +24,16 @@ Event kinds in use (free-form strings; these are the conventions):
 - ``resume`` — ``optimize(resume="auto")`` restored a run from disk;
 - ``ckpt_quarantined`` — a torn/corrupt checkpoint file was renamed aside
   and an older version used instead;
-- ``fault_injected`` — a scripted fault from ``utils/faults.py`` fired.
+- ``fault_injected`` — a scripted fault from ``utils/faults.py`` fired;
+- ``serving_*`` — serving-plane recovery actions
+  (``serving/engine.py``): ``serving_thread_respawn`` /
+  ``serving_recovered`` (decode-loop crash absorbed by the crash budget),
+  ``serving_crash_budget_exhausted``, ``serving_timeout`` (a request
+  missed its deadline), ``serving_shed`` / ``serving_degraded`` (overload
+  admission control), ``serving_poisoned_slot`` (per-slot non-finite
+  guard), ``serving_drain`` / ``serving_drain_complete`` /
+  ``serving_drain_deadline`` (graceful drain), ``serving_prefill_failed``,
+  and ``serving_shutdown_timeout`` (a leaked engine thread).
 """
 
 from __future__ import annotations

@@ -21,8 +21,9 @@ import jax.numpy as jnp
 from bigdl_tpu import nn
 from bigdl_tpu.models.transformerlm import TransformerLM
 from bigdl_tpu.serving import (
-    EngineShutdown, ServingEngine, SlotScheduler, SnapshotServer,
-    default_buckets, pick_bucket,
+    EngineOverloaded, EngineShutdown, NonFiniteLogitsError, RequestTimeout,
+    ServingEngine, SlotScheduler, SnapshotServer, default_buckets,
+    pick_bucket,
 )
 
 pytestmark = pytest.mark.serving
@@ -46,6 +47,17 @@ def _oracle(model, prompt, steps):
     """Offline single-request greedy decode — the bitwise reference."""
     return np.asarray(
         nn.greedy_generate(model, jnp.asarray(prompt)[None, :], steps))[0]
+
+
+def _wait_active(eng, n, timeout=60):
+    """Poll until ``n`` slots are occupied — the deterministic barrier for
+    overload/drain tests that need requests pinned in flight."""
+    deadline = time.perf_counter() + timeout
+    while eng.stats()["active_slots"] < n:
+        if time.perf_counter() > deadline:
+            raise AssertionError(
+                f"never reached {n} active slots: {eng.stats()}")
+        time.sleep(0.005)
 
 
 # ---------------------------------------------------- request-plane queue
@@ -99,6 +111,31 @@ class TestRequestPlaneQueue:
         q.close()
         assert not q.put(2)
         assert q.get() is CLOSED   # close drops buffered items too
+        assert q.closed
+
+    def test_close_drain_retains_buffered_items(self):
+        """The serving shutdown path: a submit racing close lands its item
+        in the deque, and close(drain=True) must keep it visible so the
+        abort sweep can fail its future — drop-on-close stranded it."""
+        from bigdl_tpu.utils.queues import CLOSED, ClosableQueue
+        q = ClosableQueue(4)
+        q.put("a")
+        q.put("b")
+        q.close(drain=True)
+        assert not q.put("c")          # admission is still closed
+        assert q.get(timeout=0) == "a"
+        assert q.get(timeout=0) == "b"
+        assert q.get(timeout=0) is CLOSED
+
+    def test_try_put_nonblocking_full_and_closed(self):
+        from bigdl_tpu.utils.queues import ClosableQueue
+        q = ClosableQueue(1)
+        assert q.try_put(1)
+        assert not q.try_put(2)        # full: no block, no item
+        assert not q.closed
+        assert q.get(timeout=0) == 1
+        q.close()
+        assert not q.try_put(3)        # closed: caller checks q.closed
         assert q.closed
 
 
@@ -410,3 +447,214 @@ class TestSnapshots:
                 srv.submit("b", _prompt(0, 3), 2)
         with pytest.raises(ValueError, match="per_model"):
             SnapshotServer({"a": lm}, max_len=48, per_model={"zz": {}})
+
+
+# ------------------------------------------------- deadlines and overload
+class TestDeadlinesAndOverload:
+    def test_queue_wait_deadline_times_out(self, lm):
+        """slots=1 + a long head-of-line request: a 1 ms-deadline follower
+        must fail with RequestTimeout while still queued; the head request
+        is untouched."""
+        from bigdl_tpu.utils.robustness import events
+        prompt = _prompt(40, 4)
+        oracle = _oracle(lm, prompt, 20)
+        with ServingEngine(lm, max_len=48, slots=1, buckets=(8,)) as eng:
+            head = eng.submit(prompt, 20)
+            late = eng.submit(_prompt(41, 4), 4, deadline_ms=1)
+            with pytest.raises(RequestTimeout, match="while queued"):
+                late.result(timeout=60)
+            np.testing.assert_array_equal(head.result(timeout=180).tokens,
+                                          oracle)
+            assert eng.stats()["timeouts"] == 1
+        assert events.counts().get("serving_timeout", 0) >= 1
+
+    def test_shed_rejects_with_depth_and_estimate(self, lm):
+        """overload=shed + queue_depth=2 + slots=1: with the slot busy and
+        two requests backed up, the next submit must be rejected at the
+        door with EngineOverloaded carrying the backlog depth."""
+        with ServingEngine(lm, max_len=48, slots=1, buckets=(8,),
+                           queue_depth=2, overload="shed") as eng:
+            head = eng.submit(_prompt(50, 4), 24)
+            _wait_active(eng, 1)     # head owns the slot; the rest back up
+            backed = [eng.submit(_prompt(51 + i, 4), 4) for i in range(2)]
+            with pytest.raises(EngineOverloaded) as ei:
+                eng.submit(_prompt(59, 4), 4)
+            assert ei.value.queue_depth >= 2
+            assert ei.value.est_wait_s >= 0.0
+            assert head.result(timeout=180).n_generated == 24
+            for h in backed:
+                assert h.result(timeout=180).n_generated == 4
+            stats = eng.stats()
+            assert stats["shed"] == 1 and stats["overload"] == "shed"
+
+    def test_degrade_halves_token_budget_under_pressure(self, lm):
+        """overload=degrade: once the backlog reaches the slot count, new
+        admissions get half their requested max_new_tokens — shorter
+        answers for everyone instead of none for some."""
+        with ServingEngine(lm, max_len=48, slots=1, buckets=(8,),
+                           overload="degrade") as eng:
+            head = eng.submit(_prompt(60, 4), 24)
+            _wait_active(eng, 1)
+            second = eng.submit(_prompt(61, 4), 8)   # backlog 0 → full size
+            third = eng.submit(_prompt(62, 4), 8)    # backlog 1 ≥ slots → 4
+            assert head.result(timeout=180).n_generated == 24
+            assert second.result(timeout=180).n_generated == 8
+            assert third.result(timeout=180).n_generated == 4
+            assert eng.stats()["degraded_admits"] == 1
+
+    def test_per_request_deadline_zero_disables_default(self, lm):
+        """deadline_ms=0 on submit overrides an engine-wide default off."""
+        with ServingEngine(lm, max_len=48, slots=2, buckets=(8,),
+                           deadline_ms=30_000) as eng:
+            r = eng.submit(_prompt(63, 4), 4, deadline_ms=0).result(
+                timeout=180)
+            assert r.n_generated == 4
+
+
+# ------------------------------------------------------------ drain + race
+class TestDrainAndShutdown:
+    def test_graceful_drain_finishes_in_flight_rejects_rest(self, lm):
+        """shutdown(drain=True) under load: in-flight sequences finish
+        bitwise-complete, queued-but-unadmitted requests abort with
+        EngineShutdown, and late submits are rejected deterministically."""
+        from bigdl_tpu.utils.robustness import events
+        prompts = [_prompt(70 + i, 4) for i in range(2)]
+        oracles = [_oracle(lm, p, 12) for p in prompts]
+        eng = ServingEngine(lm, max_len=48, slots=2, buckets=(8,))
+        in_flight = [eng.submit(p, 12) for p in prompts]
+        _wait_active(eng, 2)
+        queued = [eng.submit(_prompt(80 + i, 4), 12) for i in range(2)]
+        eng.shutdown(drain=True, timeout=120)
+        for h, o in zip(in_flight, oracles):
+            np.testing.assert_array_equal(h.result(timeout=5).tokens, o)
+        for h in queued:
+            with pytest.raises(EngineShutdown):
+                h.result(timeout=5)
+        with pytest.raises(EngineShutdown):
+            eng.submit(_prompt(90, 4), 2)
+        assert eng.stats()["health"] == "dead"
+        counts = events.counts()
+        assert counts.get("serving_drain", 0) >= 1
+        assert counts.get("serving_drain_complete", 0) >= 1
+
+    def test_drain_deadline_aborts_leftovers(self, lm):
+        """A drain that cannot finish in time still terminates: in-flight
+        work past the drain deadline aborts with EngineShutdown."""
+        from bigdl_tpu.utils.robustness import events
+        eng = ServingEngine(lm, max_len=48, slots=1, buckets=(8,))
+        h = eng.submit(_prompt(75, 4), 40)
+        _wait_active(eng, 1)
+        eng.shutdown(drain=True, drain_timeout=0.001, timeout=120)
+        with pytest.raises(EngineShutdown):
+            h.result(timeout=5)
+        assert events.counts().get("serving_drain_deadline", 0) >= 1
+        assert eng.stats()["health"] == "dead"
+
+    def test_submit_shutdown_race_strands_no_future(self, lm):
+        """satellite: a submit racing shutdown must never strand a future —
+        every handle handed out resolves (result or EngineShutdown), and
+        post-close submits raise EngineShutdown deterministically."""
+        eng = ServingEngine(lm, max_len=48, slots=2, buckets=(8,))
+        handles, stop_submitting = [], threading.Event()
+
+        def spam():
+            i = 0
+            while not stop_submitting.is_set():
+                try:
+                    handles.append(eng.submit(_prompt(200 + i, 3), 2))
+                except EngineShutdown:
+                    break
+                i += 1
+
+        t = threading.Thread(target=spam, daemon=True)
+        t.start()
+        time.sleep(0.25)            # engine mid-flight, submits streaming
+        eng.shutdown()
+        stop_submitting.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert handles, "race test submitted nothing"
+        for h in handles:           # every future resolves — none stranded
+            try:
+                h.result(timeout=30)
+            except EngineShutdown:
+                pass
+        with pytest.raises(EngineShutdown):
+            eng.submit(_prompt(1, 3), 2)
+
+    def test_health_states_progress(self, lm):
+        eng = ServingEngine(lm, max_len=48, slots=2, buckets=(8,))
+        assert eng.stats()["health"] == "starting"
+        h = eng.submit(_prompt(91, 4), 4)
+        h.result(timeout=180)
+        deadline = time.perf_counter() + 30
+        while eng.stats()["health"] == "starting":
+            if time.perf_counter() > deadline:
+                break
+            time.sleep(0.005)
+        assert eng.stats()["health"] in ("ready", "degraded")
+        eng.shutdown()
+        assert eng.stats()["health"] == "dead"
+
+
+# ------------------------------------------- multi-tenant fault isolation
+class TestTenantIsolationUnderFaults:
+    """One tenant's poisoned or crashing snapshot must not affect another
+    tenant's correctness — the randomized-arrival baseline pattern, with
+    one tenant sabotaged."""
+
+    def test_poisoned_tenant_does_not_affect_neighbor(self, lm):
+        """Tenant 'bad' serves NaN-poisoned params: its requests fail with
+        NonFiniteLogitsError via the finiteness guard; tenant 'good'
+        stays bitwise-identical to its solo baseline."""
+        rng = np.random.default_rng(7)
+        reqs = [(_prompt(300 + i, int(rng.integers(2, 8))),
+                 int(rng.integers(2, 6))) for i in range(6)]
+        with ServingEngine(lm, max_len=48, slots=2, buckets=(8,)) as solo:
+            baseline = [solo.submit(p, m).result(timeout=180).tokens
+                        for p, m in reqs]
+        bad_lm = TransformerLM(VOCAB, embed_dim=16, num_heads=2,
+                               num_layers=2, max_len=48).evaluate()
+        with SnapshotServer({"good": lm, "bad": bad_lm}, max_len=48,
+                            slots=2, buckets=(8,)) as srv:
+            srv.engine("bad")._params = jax.tree_util.tree_map(
+                lambda x: jnp.full_like(x, jnp.nan),
+                srv.engine("bad")._params)
+            good_hs, bad_hs = [], []
+            for i, (p, m) in enumerate(reqs):
+                good_hs.append(srv.submit("good", p, m))
+                bad_hs.append(srv.submit("bad", p, m))
+                if rng.random() < 0.4:
+                    time.sleep(0.002)
+            for h, base in zip(good_hs, baseline):
+                np.testing.assert_array_equal(h.result(timeout=180).tokens,
+                                              base)
+            for h in bad_hs:
+                with pytest.raises(NonFiniteLogitsError):
+                    h.result(timeout=180)
+            assert srv.stats()["bad"]["poisoned_slots"] == len(reqs)
+            assert srv.stats()["good"]["poisoned_slots"] == 0
+
+    def test_crashing_tenant_does_not_affect_neighbor(self, lm):
+        """serve_thread@1 kills tenant 'flaky's engine thread (it starts
+        first and polls the site); tenant 'steady' starts after the entry
+        fired and serves its baseline bitwise while 'flaky' recovers."""
+        from bigdl_tpu.utils.faults import inject_faults
+        prompt = _prompt(310, 5)
+        base_steady = _oracle(lm, prompt, 6)
+        flaky_lm = TransformerLM(VOCAB, embed_dim=16, num_heads=2,
+                                 num_layers=2, max_len=48).evaluate()
+        base_flaky = _oracle(flaky_lm, prompt, 6)
+        with inject_faults("serve_thread@1") as plan:
+            with SnapshotServer({"steady": lm, "flaky": flaky_lm},
+                                max_len=48, slots=2, buckets=(8,)) as srv:
+                fh = srv.submit("flaky", prompt, 6)    # starts flaky's loop
+                fh.result(timeout=180)                 # respawned + served
+                sh = srv.submit("steady", prompt, 6)
+                np.testing.assert_array_equal(sh.result(timeout=180).tokens,
+                                              base_steady)
+                np.testing.assert_array_equal(fh.result(timeout=5).tokens,
+                                              base_flaky)
+                assert srv.stats()["flaky"]["respawns"] == 1
+                assert srv.stats()["steady"]["respawns"] == 0
+            assert plan.unfired() == []

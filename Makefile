@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -50,6 +50,15 @@ t1-serving:
 t1-serving-faults:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serving_faults --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
+# Streaming-data-plane suite only (docs/performance.md "Streaming & sample
+# cache"): window-shuffle determinism + worker-count order invariance,
+# checkpointable stream position (mid-epoch SIGTERM resume bitwise), per-host
+# shard(), decoded-sample cache build/warm-read/quarantine + cache_read/
+# cache_write fault sites. Unmarked-slow, so `make t1` runs these too; this
+# target is the fast inner loop for data-plane work.
+t1-streaming:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m streaming --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
 dist:
 	bash make-dist.sh
 
@@ -67,6 +76,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --model lenet --obs-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --kernel-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --serving-bench --no-compare-dtypes --no-streamed
+	JAX_PLATFORMS=cpu python bench.py --stream-bench --no-compare-dtypes --no-streamed
 
 # Host input-pipeline leg (decode→augment→stack on a synthetic image folder):
 # pipeline_images_per_sec at BIGDL_DATA_WORKERS 0/1/4/auto + per-stage ms.

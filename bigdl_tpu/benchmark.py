@@ -770,6 +770,105 @@ def _measure_pipeline(batch: int) -> dict:
     }
 
 
+def _measure_stream_bench(batch: int) -> dict:
+    """Streaming-data-plane leg: a synthetic image folder is packed into
+    ``BIGDL_STREAM_SHARDS`` ``.bdlrec`` shards, then streamed through
+    ``DataSet.stream_shards`` (window shuffle + decoded-sample cache) twice —
+    the COLD epoch decodes every record and builds the cache, the WARM epoch
+    serves it back from the mmap. The published gate: warm ≥ 3× cold, with
+    the ``decode`` stage absent from warm-epoch ``feed_stats`` (the ``cache``
+    stage takes its place). Host-only — no accelerator is touched."""
+    import shutil
+    import tempfile
+
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.image_folder import write_synthetic_image_folder
+    from bigdl_tpu.dataset.profiling import feed_stats, stage_deltas_ms
+    from bigdl_tpu.dataset.recordio import write_image_records
+    from bigdl_tpu.dataset.sample import SampleToMiniBatch
+    from bigdl_tpu.obs.registry import registry as obs_registry
+    from bigdl_tpu.transform.vision.image import (
+        ChannelNormalize, ImageFrameToSample, MatToTensor, Resize,
+    )
+    from bigdl_tpu.utils.random_generator import RandomGenerator
+
+    n_images = int(os.environ.get("BIGDL_BENCH_STREAM_IMAGES", "512"))
+    n_shards = int(os.environ.get("BIGDL_STREAM_SHARDS", "4"))
+    size = 128
+    tmp = tempfile.mkdtemp(prefix="bigdl-stream-bench-")
+    try:
+        img_root = os.path.join(tmp, "images")
+        write_synthetic_image_folder(img_root, n_classes=4,
+                                     n_per_class=max(n_images // 4, 1),
+                                     size=size)
+        shards = write_image_records(img_root, os.path.join(tmp, "shard"),
+                                     shards=n_shards)
+        cache_dir = os.path.join(tmp, "sample-cache")
+
+        RandomGenerator.set_seed(42)
+        # the cache stores DECODED + FUSED-TRANSFORM outputs: the whole
+        # deterministic per-image chain (decode→resize→normalize→to-tensor→
+        # Sample) runs inside the stream decoder, so a warm epoch replays
+        # finished Samples from the mmap and only batch stacking remains.
+        # (Random augments must stay OUTSIDE a cached decoder — caching
+        # would freeze their draws.)
+        from bigdl_tpu.dataset.recordio import image_record_decoder
+        pre = [Resize(112, 112),
+               ChannelNormalize((123.0, 117.0, 104.0), (58.4, 57.1, 57.4)),
+               MatToTensor()]
+
+        def decode_to_sample(payload):
+            f = image_record_decoder(payload)
+            for t in pre:
+                f = t.transform_feature(f)
+            return ImageFrameToSample._to_sample(f)
+
+        ds = (DataSet.stream_shards(shards, decoder=decode_to_sample,
+                                    num_workers=4,
+                                    cache=True, cache_dir=cache_dir)
+              >> SampleToMiniBatch(batch, pad_last=False))
+        ds.shuffle()
+
+        def epoch() -> tuple[float, dict]:
+            snap = feed_stats.snapshot()
+            n = 0
+            t0 = time.perf_counter()
+            for b in ds.data(train=True):
+                n += b.valid
+                b.recycle()
+            dt = time.perf_counter() - t0
+            stages = {s: round(d["ms"], 3)
+                      for s, d in stage_deltas_ms(snap).items()}
+            return (n / dt if dt > 0 else 0.0), stages
+
+        hits0 = obs_registry.counter("feed/cache_hit").value
+        cold_ips, cold_stages = epoch()     # decodes + builds the cache
+        warm_ips, warm_stages = epoch()     # served from the mmap
+        cache_hits = obs_registry.counter("feed/cache_hit").value - hits0
+        cache_bytes = obs_registry.counter("feed/cache_bytes").value
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "value": round(warm_ips, 1),
+        "unit": "images/sec",
+        "batch": batch,
+        "n_images": n_images,
+        "n_shards": n_shards,
+        "image_size": size,
+        "cpu_count": os.cpu_count(),
+        "stream_images_per_sec_cold": round(cold_ips, 1),
+        "stream_images_per_sec_warm": round(warm_ips, 1),
+        "cache_speedup": round(warm_ips / cold_ips, 3) if cold_ips else None,
+        "cache_hits": cache_hits,
+        "cache_bytes": cache_bytes,
+        # the acceptance signal: a warm epoch must never touch the decode pool
+        "decode_absent_warm": "decode" not in warm_stages,
+        "stage_ms_cold": cold_stages,
+        "stage_ms_warm": warm_stages,
+    }
+
+
 def _measure_obs(batch: int, iters: int) -> dict:
     """Observability-overhead leg (CPU LeNet smoke): the SAME training loop
     with the span tracer off vs on, plus a validity check of the artifacts
@@ -1566,6 +1665,7 @@ def run_orchestrator(args) -> None:
     """Always prints one JSON line and exits 0 — degraded runs carry a reason."""
     # tolerate hand-built Namespaces (tests/drivers) predating these flags
     pipeline_bench = getattr(args, "pipeline_bench", False)
+    stream_bench = getattr(args, "stream_bench", False)
     obs_bench = getattr(args, "obs_bench", False)
     kernel_bench = getattr(args, "kernel_bench", False)
     precision_bench = getattr(args, "precision_bench", False)
@@ -1588,6 +1688,8 @@ def run_orchestrator(args) -> None:
         worker_argv.append("--eval-bench")
     if pipeline_bench:
         worker_argv.append("--pipeline-bench")
+    if stream_bench:
+        worker_argv.append("--stream-bench")
     if obs_bench:
         worker_argv.append("--obs-bench")
     if kernel_bench:
@@ -1623,7 +1725,8 @@ def run_orchestrator(args) -> None:
                     and not args.int8_infer and not args.serving \
                     and not args.decode_infer and not args.ablate \
                     and not args.eval_bench and not pipeline_bench \
-                    and not obs_bench and not kernel_bench \
+                    and not stream_bench and not obs_bench \
+                    and not kernel_bench \
                     and not precision_bench and not serving_bench:
                 # the comparison leg only feeds the ratio — skip its streamed
                 # measurement (it would be discarded)
@@ -1661,8 +1764,8 @@ def run_orchestrator(args) -> None:
         attempts.append(f"probe: {probe_err}")
 
     if args.int8_infer or args.serving or args.decode_infer or args.ablate \
-            or args.eval_bench or pipeline_bench or obs_bench \
-            or kernel_bench or precision_bench or serving_bench:
+            or args.eval_bench or pipeline_bench or stream_bench \
+            or obs_bench or kernel_bench or precision_bench or serving_bench:
         # a LeNet training number would not answer an inference-path request:
         # fail loudly with the metric the caller asked for
         kind = ("int8_vs_bf16_infer" if args.int8_infer
@@ -1670,6 +1773,7 @@ def run_orchestrator(args) -> None:
                 else "decode_infer" if args.decode_infer
                 else "eval_throughput" if args.eval_bench
                 else "input_pipeline" if pipeline_bench
+                else "stream_pipeline" if stream_bench
                 else "obs_overhead" if obs_bench
                 else "kernel_bench" if kernel_bench
                 else "precision_bench" if precision_bench
@@ -1764,6 +1868,12 @@ def main(argv=None):
                    help="host input-pipeline leg: decode→augment→stack "
                         "images/sec on a synthetic image folder at "
                         "BIGDL_DATA_WORKERS 0/1/4/auto, with per-stage ms")
+    p.add_argument("--stream-bench", dest="stream_bench",
+                   action="store_true",
+                   help="streaming data-plane leg: sharded record stream "
+                        "with the decoded-sample cache — cold (decode + "
+                        "cache build) vs warm (mmap) epoch images/sec, "
+                        "cache_speedup, per-stage ms")
     p.add_argument("--obs-bench", dest="obs_bench", action="store_true",
                    help="observability-overhead leg: CPU LeNet images/sec "
                         "with the span tracer off vs on (gate: <3% "
@@ -1821,6 +1931,11 @@ def _run_worker_modes(args) -> int:
     elif args.pipeline_bench:
         res = _measure_pipeline(min(args.batch, 32))
         res["metric"] = "input_pipeline_images_per_sec"
+        res["vs_baseline"] = None
+        print(json.dumps(res))
+    elif getattr(args, "stream_bench", False):
+        res = _measure_stream_bench(min(args.batch, 32))
+        res["metric"] = "stream_pipeline_images_per_sec"
         res["vs_baseline"] = None
         print(json.dumps(res))
     elif getattr(args, "obs_bench", False):

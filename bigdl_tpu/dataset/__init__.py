@@ -5,6 +5,8 @@ from bigdl_tpu.dataset.dataset import (
 from bigdl_tpu.dataset.parallel import ParallelTransformer, data_workers, plan_stages
 from bigdl_tpu.dataset.profiling import feed_stats, stage_deltas_ms
 from bigdl_tpu.dataset.sample import MiniBatch, Sample, SampleToMiniBatch
+from bigdl_tpu.dataset.sample_cache import CacheCorruptError, SampleCache
+from bigdl_tpu.dataset.streaming import StreamingDataSet
 from bigdl_tpu.dataset.transformer import (
     ChainedTransformer, FusedTransformer, Identity, MapTransformer, Transformer,
     flatten_chain, fuse_chain, sample_index_scope,

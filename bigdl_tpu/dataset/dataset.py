@@ -138,6 +138,21 @@ class DataSet:
                                  num_workers=num_workers,
                                  distributed=distributed)
 
+    @staticmethod
+    def stream_shards(paths, decoder=None, shuffle_window=None,
+                      num_workers: int = 8, cache: Optional[bool] = None,
+                      cache_dir: Optional[str] = None,
+                      distributed: bool = False) -> AbstractDataSet:
+        """Sharded record stream (dataset/streaming.py): ``.bdlrec`` or
+        uncompressed ``.tar`` shard lists with deterministic window shuffle,
+        a checkpointable iterator position, per-host ``shard()`` assignment,
+        and the decoded-sample mmap cache."""
+        from bigdl_tpu.dataset.streaming import StreamingDataSet
+        return StreamingDataSet(paths, decoder=decoder,
+                                shuffle_window=shuffle_window,
+                                num_workers=num_workers, cache=cache,
+                                cache_dir=cache_dir, distributed=distributed)
+
 
 def is_distributed(dataset: AbstractDataSet) -> bool:
     if isinstance(dataset, DistributedDataSet):

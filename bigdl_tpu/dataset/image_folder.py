@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import os
 import time
-from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional, Sequence
 
@@ -26,7 +25,7 @@ import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.profiling import STAGE_DECODE, feed_stats
-from bigdl_tpu.dataset.resilience import SKIPPED, run_guarded
+from bigdl_tpu.dataset.resilience import run_guarded
 from bigdl_tpu.obs import trace
 from bigdl_tpu.utils.faults import SITE_DECODE, fault_point
 from bigdl_tpu.utils.random_generator import RandomGenerator
@@ -40,12 +39,19 @@ class ImageFolderDataSet(AbstractDataSet):
 
     def __init__(self, root: str, num_workers: int = 8,
                  extensions: Sequence[str] = _EXTENSIONS,
-                 one_based: bool = False, distributed: bool = False):
+                 one_based: bool = False, distributed: bool = False,
+                 cache: Optional[bool] = None, cache_dir: Optional[str] = None):
         if not os.path.isdir(root):
             raise FileNotFoundError(f"image folder root not found: {root}")
         self.root = root
         self.num_workers = max(int(num_workers), 1)
         self.distributed = distributed
+        # decoded-sample cache (dataset/sample_cache.py): None defers to
+        # BIGDL_SAMPLE_CACHE; the SampleCache instance persists across epochs
+        # so CRC verification happens once and quarantine sticks
+        self._cache_enabled = cache
+        self._cache_dir = cache_dir
+        self._cache = None
         exts = tuple(e.lower() for e in extensions)
         self.classes = sorted(
             d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
@@ -116,27 +122,32 @@ class ImageFolderDataSet(AbstractDataSet):
         # unreadable image can skip/retry instead of killing the decode pool
         return run_guarded("decode", self._decode_one, item)
 
+    def _cache_obj(self):
+        from bigdl_tpu.dataset import sample_cache
+        if self._cache is None and self._cache_enabled is not False:
+            enabled = (sample_cache.cache_enabled()
+                       if self._cache_enabled is None else True)
+            if enabled:
+                default_dir = os.path.join(self.root, ".bigdl-sample-cache")
+                self._cache = sample_cache.SampleCache(
+                    sample_cache.cache_dir(self._cache_dir or default_dir),
+                    sample_cache.fingerprint(
+                        ("image_folder.v1", self.root, tuple(self._items))),
+                    len(self._items))
+        return self._cache
+
     def data(self, train: bool) -> Iterator:
-        # sliding window of decode futures: bounded memory, preserved order,
-        # decode parallelism = num_workers; the pool outlives the epoch
-        ex = self._executor()
-        window: deque = deque()
-        try:
-            depth = self.num_workers * 2
-            for i in self._order:
-                window.append(ex.submit(self._decode, self._items[i]))
-                if len(window) >= depth:
-                    out = window.popleft().result()
-                    if out is not SKIPPED:
-                        yield out
-            while window:
-                out = window.popleft().result()
-                if out is not SKIPPED:
-                    yield out
-        finally:
-            # abandoned mid-epoch: cancel queued decodes, keep the pool
-            for f in window:
-                f.cancel()
+        # cache-aware iteration (dataset/sample_cache.py): a committed cache
+        # serves the whole epoch via mmap and the decode pool is never
+        # created; otherwise the classic sliding window of decode futures
+        # (bounded memory, preserved order), building the cache as it goes
+        from bigdl_tpu.dataset.sample_cache import cached_data_iter
+
+        def submit(i):
+            return self._executor().submit(self._decode, self._items[i])
+
+        yield from cached_data_iter((int(i) for i in self._order), submit,
+                                    self._cache_obj(), self.num_workers * 2)
 
 
 def write_synthetic_image_folder(root: str, n_classes: int = 4,

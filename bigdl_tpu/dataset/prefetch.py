@@ -84,6 +84,11 @@ class PrefetchingFeed:
         self._stop: threading.Event | None = None
         self._thread: threading.Thread | None = None
         self._leaked_thread: threading.Thread | None = None
+        #: groups handed to the consumer THIS epoch — the feed-position the
+        #: streamed-resume machinery records (items parked in the queue or
+        #: in flight in the producer are deliberately NOT counted: resume
+        #: replays from what the training loop actually consumed)
+        self.delivered = 0
 
     # ------------------------------------------------------------- producer
     def _grouped(self, it):
@@ -139,9 +144,12 @@ class PrefetchingFeed:
                 "iterator is blocking indefinitely.", leaked.name)
         elif leaked is not None:
             self._leaked_thread = None  # it eventually finished; forget it
+        self.delivered = 0
         if self.depth == 0:
             for batch in self._grouped(self.make_iter()):
-                yield batch, self.put_fn(batch)
+                placed = self.put_fn(batch)
+                self.delivered += 1
+                yield batch, placed
             return
         self._stop = threading.Event()
         self._queue = _ClosableQueue(maxsize=self.depth)
@@ -160,9 +168,16 @@ class PrefetchingFeed:
                     # (trainer retry/divisibility contracts depend on it); the
                     # producer traceback is already attached to the object
                     raise item
+                self.delivered += 1
                 yield item
         finally:
             self.close()
+
+    def position(self) -> dict:
+        """Feed position for checkpoint payloads / diagnostics: how many
+        groups (batches, or ``window``-sized lists) the consumer pulled this
+        epoch. Multiply by ``window`` for a batch-granular upper bound."""
+        return {"delivered": self.delivered, "window": self.window}
 
     def close(self) -> None:
         if self._stop is not None:

@@ -27,6 +27,10 @@ from bigdl_tpu.obs.registry import registry as _obs_registry
 STAGE_DECODE = "decode"
 STAGE_AUGMENT = "augment"
 STAGE_STACK = "stack"
+#: mmap read from the decoded-sample cache (dataset/sample_cache.py) — a
+#: warm epoch reports here INSTEAD of decode, so the attribution log shows
+#: the cache taking over rather than decode going quietly near-zero
+STAGE_CACHE = "cache"
 
 
 class FeedStageStats:

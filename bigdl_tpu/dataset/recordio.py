@@ -29,7 +29,6 @@ import os
 import struct
 import time
 import zlib
-from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Iterator, Optional, Sequence
 
@@ -37,7 +36,7 @@ import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.profiling import STAGE_DECODE, feed_stats
-from bigdl_tpu.dataset.resilience import SKIPPED, run_guarded
+from bigdl_tpu.dataset.resilience import run_guarded
 from bigdl_tpu.obs import trace
 from bigdl_tpu.utils.faults import SITE_DECODE, fault_point
 from bigdl_tpu.utils.random_generator import RandomGenerator
@@ -119,13 +118,20 @@ class RecordFileDataSet(AbstractDataSet):
 
     def __init__(self, paths: Sequence[str] | str,
                  decoder: Callable[[bytes], object],
-                 num_workers: int = 8, distributed: bool = False):
+                 num_workers: int = 8, distributed: bool = False,
+                 cache: Optional[bool] = None,
+                 cache_dir: Optional[str] = None):
         self.paths = [paths] if isinstance(paths, str) else list(paths)
         if not self.paths:
             raise ValueError("no record files given")
         self.decoder = decoder
         self.num_workers = max(int(num_workers), 1)
         self.distributed = distributed
+        # decoded-sample cache (dataset/sample_cache.py): None defers to
+        # BIGDL_SAMPLE_CACHE; instance persists across epochs
+        self._cache_enabled = cache
+        self._cache_dir = cache_dir
+        self._cache = None
         # global index: (file idx, offset, length)
         self._index: list[tuple[int, int, int]] = []
         for fi, p in enumerate(self.paths):
@@ -200,25 +206,36 @@ class RecordFileDataSet(AbstractDataSet):
         # undecodable record can skip/retry instead of killing the feed
         return run_guarded("decode", self._load_one, i)
 
+    def _cache_obj(self):
+        from bigdl_tpu.dataset import sample_cache
+        if self._cache is None and self._cache_enabled is not False:
+            enabled = (sample_cache.cache_enabled()
+                       if self._cache_enabled is None else True)
+            if enabled:
+                default_dir = os.path.join(
+                    os.path.dirname(os.path.abspath(self.paths[0])),
+                    ".bigdl-sample-cache")
+                material = ("recordio.v1", tuple(self.paths),
+                            tuple(os.path.getsize(p) for p in self.paths),
+                            len(self._index),
+                            getattr(self.decoder, "__qualname__",
+                                    type(self.decoder).__name__))
+                self._cache = sample_cache.SampleCache(
+                    sample_cache.cache_dir(self._cache_dir or default_dir),
+                    sample_cache.fingerprint(material), len(self._index))
+        return self._cache
+
     def data(self, train: bool) -> Iterator:
-        ex = self._executor()
-        window: deque = deque()
-        try:
-            depth = self.num_workers * 2
-            for i in self._order:
-                window.append(ex.submit(self._load, int(i)))
-                if len(window) >= depth:
-                    out = window.popleft().result()
-                    if out is not SKIPPED:
-                        yield out
-            while window:
-                out = window.popleft().result()
-                if out is not SKIPPED:
-                    yield out
-        finally:
-            # abandoned mid-epoch: cancel queued reads, keep the pool
-            for f in window:
-                f.cancel()
+        # cache-aware iteration (dataset/sample_cache.py): a committed cache
+        # serves the epoch via mmap without touching the decode pool;
+        # otherwise the sliding-window decode path builds the cache
+        from bigdl_tpu.dataset.sample_cache import cached_data_iter
+
+        def submit(i):
+            return self._executor().submit(self._load, int(i))
+
+        yield from cached_data_iter((int(i) for i in self._order), submit,
+                                    self._cache_obj(), self.num_workers * 2)
 
 
 # ------------------------------------------------------------- image packing

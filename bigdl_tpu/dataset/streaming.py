@@ -1,0 +1,404 @@
+"""Streaming data plane — sharded record streams with window shuffle and a
+checkpointable iterator position.
+
+At dataset scale the feed cannot hold an in-memory epoch order over every
+record, and at pod scale each host must read only its slice. This module is
+the webdataset-style answer (tf.data's lesson, PAPERS.md 2101.12127): the
+dataset is a LIST OF SHARDS — packed ``.bdlrec`` record files
+(``dataset/recordio.py``) or plain uncompressed ``.tar`` archives — scanned
+once at open into per-shard (offset, length) indices and read with
+``os.pread`` (positioned reads, thread-safe on a shared fd).
+
+**Window shuffle.** A true global permutation needs the whole index in one
+array; a stream gets the standard approximation instead: interleave records
+round-robin from the shards (shard ORDER itself permuted per epoch), fill a
+bounded window of ``BIGDL_SHUFFLE_WINDOW`` slots, and for every further
+record draw a deterministic index into the window, yield the occupant, and
+replace it. The draw sequence comes from a per-epoch seed pulled from the
+global ``RandomGenerator`` inside ``shuffle()`` — so epoch order is a pure
+function of (seed, epoch), reproducible run-to-run, and IDENTICAL for any
+``BIGDL_DATA_WORKERS`` setting because the order is produced here in the
+single driving generator, upstream of the parallel transform engine.
+
+**Checkpointable position.** The whole iterator state — per-shard cursors,
+round-robin pointer, window contents, RNG bit-generator state, emitted
+count — is explicit and serializable (:meth:`_IndexStream.state`). The
+trainer snapshots :meth:`StreamingDataSet.stream_state` at epoch start into
+the checkpoint payload, so ``optimize(resume="auto")`` after a mid-epoch
+SIGTERM rebuilds the exact stream and replays to the exact batch — bitwise
+resume over a stream, not just over an in-memory epoch order.
+:meth:`position_after` / :meth:`data_from` expose the same state for direct
+consumers that want to seek without replaying record IO.
+
+**Per-host sharding.** :meth:`shard` returns this dataset restricted to
+``shards[host_index::host_count]`` — the multi-host hook (GSPMD, ROADMAP
+item 2): every host constructs the same shard list, then takes its slice.
+
+Decoded records flow through the same cache-aware iteration driver as the
+other sources (``dataset/sample_cache.py``): the first epoch decodes and
+writes the cache, later epochs mmap it and the decode pool is never built.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.profiling import STAGE_DECODE, feed_stats
+from bigdl_tpu.dataset.resilience import run_guarded
+from bigdl_tpu.obs import trace
+from bigdl_tpu.utils.faults import SITE_DECODE, fault_point
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+
+def shuffle_window(default: int = 256) -> int:
+    """``BIGDL_SHUFFLE_WINDOW``: window-shuffle buffer size in records.
+    ``<= 1`` disables shuffling within the stream (pure shard interleave —
+    shard ORDER is still permuted per epoch)."""
+    raw = os.environ.get("BIGDL_SHUFFLE_WINDOW", "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _scan_tar(path: str) -> list[tuple[int, int]]:
+    """One pass over an UNCOMPRESSED tar → [(offset, length)] per regular
+    member, in archive order (the webdataset layout: one member per record).
+    Compression is rejected — random ``pread`` access needs flat bytes."""
+    index = []
+    with tarfile.open(path, "r:") as tf:  # "r:" = no compression accepted
+        for m in tf:
+            if m.isfile():
+                index.append((m.offset_data, m.size))
+    return index
+
+
+def _scan_shard(path: str) -> tuple[str, list[tuple[int, int]]]:
+    """(kind, [(offset, length)]) for one shard file, by extension."""
+    if path.endswith(".tar"):
+        return "tar", _scan_tar(path)
+    from bigdl_tpu.dataset.recordio import _scan_index
+    return "bdlrec", _scan_index(path)
+
+
+class _IndexStream:
+    """The order-producing heart of the stream: round-robin shard interleave
+    feeding a bounded shuffle window, with every piece of state explicit so
+    a position can be captured, serialized, and rebuilt exactly.
+
+    State: per-shard cursors, the active-shard list + round-robin pointer,
+    the window (global record ids), the numpy bit-generator state, and the
+    emitted count. ``state()``/``from_state()`` round-trip all of it.
+    """
+
+    def __init__(self, counts: Sequence[int], bases: Sequence[int],
+                 order: Sequence[int], window_size: int, seed: int):
+        self._counts = [int(c) for c in counts]
+        self._bases = [int(b) for b in bases]
+        self.order = [int(s) for s in order]
+        self.window_size = max(int(window_size), 0)
+        # fresh stream: all non-empty shards active in epoch order
+        self._cursors = {s: 0 for s in self.order}
+        self._active = [s for s in self.order if self._counts[s] > 0]
+        self._rr = 0
+        self._window: list[int] = []
+        self._rng = np.random.default_rng(int(seed) & 0x7FFFFFFFFFFFFFFF)
+        self.emitted = 0
+
+    # ------------------------------------------------------------- iterate
+    def __iter__(self) -> "_IndexStream":
+        return self
+
+    def _pull(self) -> int:
+        """Next record id from the shard interleave (round-robin, one record
+        per shard visit; exhausted shards drop out keeping the rotation)."""
+        s = self._active[self._rr]
+        c = self._cursors[s]
+        gid = self._bases[s] + c
+        self._cursors[s] = c + 1
+        if c + 1 >= self._counts[s]:
+            self._active.pop(self._rr)
+            if self._rr >= len(self._active):
+                self._rr = 0
+        else:
+            self._rr += 1
+            if self._rr >= len(self._active):
+                self._rr = 0
+        return gid
+
+    def __next__(self) -> int:
+        while self._active:
+            gid = self._pull()
+            if self.window_size <= 1:
+                self.emitted += 1
+                return gid
+            if len(self._window) < self.window_size:
+                self._window.append(gid)  # filling — nothing to emit yet
+                continue
+            j = int(self._rng.integers(0, self.window_size))
+            out, self._window[j] = self._window[j], gid
+            self.emitted += 1
+            return out
+        if self._window:  # drain: shards exhausted, window empties randomly
+            j = int(self._rng.integers(0, len(self._window)))
+            self.emitted += 1
+            return self._window.pop(j)
+        raise StopIteration
+
+    # --------------------------------------------------------------- state
+    def state(self) -> dict:
+        return {
+            "cursors": dict(self._cursors),
+            "active": list(self._active),
+            "rr": self._rr,
+            "window": list(self._window),
+            "rng": self._rng.bit_generator.state,
+            "emitted": self.emitted,
+            "order": list(self.order),
+            "window_size": self.window_size,
+        }
+
+    @classmethod
+    def from_state(cls, counts: Sequence[int], bases: Sequence[int],
+                   state: dict) -> "_IndexStream":
+        st = cls(counts, bases, state["order"], state["window_size"], 0)
+        st._cursors = {int(k): int(v) for k, v in state["cursors"].items()}
+        st._active = [int(s) for s in state["active"]]
+        st._rr = int(state["rr"])
+        st._window = [int(g) for g in state["window"]]
+        st._rng.bit_generator.state = state["rng"]
+        st.emitted = int(state["emitted"])
+        return st
+
+
+class StreamingDataSet(AbstractDataSet):
+    """Sharded record stream with deterministic window shuffle, resumable
+    position, per-host shard assignment, and cache-aware decoding.
+
+    ``paths``: ``.bdlrec`` and/or uncompressed ``.tar`` shard files.
+    ``decoder``: payload bytes → record (default: the recordio image decoder
+    yielding ImageFeature). ``shuffle_window``: records buffered for the
+    window shuffle (None → ``BIGDL_SHUFFLE_WINDOW``, default 256).
+    ``cache``: None defers to ``BIGDL_SAMPLE_CACHE``.
+    """
+
+    def __init__(self, paths: Sequence[str] | str,
+                 decoder: Optional[Callable[[bytes], object]] = None,
+                 shuffle_window: Optional[int] = None,
+                 num_workers: int = 8,
+                 cache: Optional[bool] = None,
+                 cache_dir: Optional[str] = None,
+                 distributed: bool = False):
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        if not self.paths:
+            raise ValueError("no stream shards given")
+        if decoder is None:
+            from bigdl_tpu.dataset.recordio import image_record_decoder
+            decoder = image_record_decoder
+        self.decoder = decoder
+        self.shuffle_window = shuffle_window
+        self.num_workers = max(int(num_workers), 1)
+        self.distributed = distributed
+        self._kinds: list[str] = []
+        self._indices: list[list[tuple[int, int]]] = []
+        self._bases: list[int] = []
+        n = 0
+        for p in self.paths:
+            kind, idx = _scan_shard(p)
+            self._kinds.append(kind)
+            self._indices.append(idx)
+            self._bases.append(n)
+            n += len(idx)
+        self._n = n
+        if n == 0:
+            raise ValueError(f"no records in stream shards {self.paths}")
+        # shard-granular epoch order: the existing trainer resume machinery
+        # snapshots/restores `_order` generically, so keeping the shard
+        # permutation here means streamed runs ride the same rails
+        self._order = np.arange(len(self.paths))
+        self._epoch_seed = 0
+        self._fds: dict[int, int] = {}
+        self._ex: Optional[ThreadPoolExecutor] = None
+        self._cache_enabled = cache
+        self._cache_dir = cache_dir
+        self._cache = None
+
+    # ------------------------------------------------------------ basics
+    def size(self) -> int:
+        return self._n
+
+    def shuffle(self) -> None:
+        """Permute the shard visit order AND draw this epoch's window-shuffle
+        seed — both from the global ``RandomGenerator``, so the trainer's
+        post-shuffle RNG snapshot covers every draw and a resumed run
+        replays them exactly."""
+        rng = RandomGenerator.numpy()
+        self._order = self._order[rng.permutation(len(self._order))]
+        self._epoch_seed = int(rng.integers(0, 2 ** 31 - 1))
+
+    def close(self) -> None:
+        if self._ex is not None:
+            self._ex.shutdown(wait=False, cancel_futures=True)
+            self._ex = None
+        for fd in self._fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._fds.clear()
+        if self._cache is not None:
+            self._cache.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # ----------------------------------------------------------- sharding
+    def shard(self, host_index: int, host_count: int) -> "StreamingDataSet":
+        """This dataset restricted to ``paths[host_index::host_count]`` — the
+        per-host assignment hook for multi-host input. Every host builds the
+        same full shard list, then takes its strided slice; shard counts
+        should be ≥ hosts and ideally a multiple (equal per-host work)."""
+        if host_count < 1 or not (0 <= host_index < host_count):
+            raise ValueError(
+                f"invalid shard({host_index}, {host_count})")
+        mine = self.paths[host_index::host_count]
+        if not mine:
+            raise ValueError(
+                f"host {host_index}/{host_count} got no shards from "
+                f"{len(self.paths)} files — write more shards than hosts")
+        return StreamingDataSet(
+            mine, decoder=self.decoder, shuffle_window=self.shuffle_window,
+            num_workers=self.num_workers, cache=self._cache_enabled,
+            cache_dir=self._cache_dir, distributed=self.distributed)
+
+    # ------------------------------------------------------------- reading
+    def _executor(self) -> ThreadPoolExecutor:
+        if self._ex is None:
+            self._ex = ThreadPoolExecutor(self.num_workers,
+                                          thread_name_prefix="bigdl-stream")
+        return self._ex
+
+    def _fd(self, si: int) -> int:
+        fd = self._fds.get(si)
+        if fd is None:
+            fd = os.open(self.paths[si], os.O_RDONLY)
+            self._fds[si] = fd
+        return fd
+
+    def _locate(self, gid: int) -> tuple[int, int]:
+        """global record id → (shard index, record index within shard)."""
+        si = int(np.searchsorted(self._bases, gid, side="right")) - 1
+        return si, gid - self._bases[si]
+
+    def _read(self, gid: int) -> bytes:
+        si, ri = self._locate(gid)
+        if self._kinds[si] == "bdlrec":
+            # payload preceded by len|crc — reuse recordio's verified read
+            import struct
+            import zlib
+            from bigdl_tpu.dataset.recordio import _REC, RecordIOError
+            off, ln = self._indices[si][ri]
+            rec = os.pread(self._fd(si), _REC.size + ln, off)
+            length, crc = _REC.unpack(rec[:_REC.size])
+            payload = rec[_REC.size:]
+            if len(payload) != length or zlib.crc32(payload) != crc:
+                raise RecordIOError(
+                    f"{self.paths[si]}: corrupt record @ {off} (crc mismatch)")
+            return payload
+        off, ln = self._indices[si][ri]
+        return os.pread(self._fd(si), ln, off)
+
+    def _load_one(self, gid: int):
+        fault_point(SITE_DECODE)  # scripted decode failure, if any
+        t0 = time.perf_counter()
+        with trace.span("feed/decode"):
+            out = self.decoder(self._read(gid))
+        feed_stats.add(STAGE_DECODE, time.perf_counter() - t0)
+        return out
+
+    def _load(self, gid: int):
+        # corrupt-sample policy (BIGDL_BAD_SAMPLE_POLICY) applies per record
+        return run_guarded("decode", self._load_one, gid)
+
+    # -------------------------------------------------------------- cache
+    def _cache_obj(self):
+        from bigdl_tpu.dataset import sample_cache
+        if self._cache is None and self._cache_enabled is not False:
+            enabled = (sample_cache.cache_enabled()
+                       if self._cache_enabled is None else True)
+            if enabled:
+                default_dir = os.path.join(
+                    os.path.dirname(os.path.abspath(self.paths[0])),
+                    ".bigdl-sample-cache")
+                material = ("stream.v1", tuple(self.paths),
+                            tuple(os.path.getsize(p) for p in self.paths),
+                            self._n,
+                            getattr(self.decoder, "__qualname__",
+                                    type(self.decoder).__name__))
+                self._cache = sample_cache.SampleCache(
+                    sample_cache.cache_dir(self._cache_dir or default_dir),
+                    sample_cache.fingerprint(material), self._n)
+        return self._cache
+
+    # ------------------------------------------------------------ position
+    def stream_state(self) -> dict:
+        """Epoch-start stream identity for the checkpoint payload: with the
+        shard order and epoch seed pinned, the whole epoch's record order is
+        a pure function — a resumed process rebuilds it exactly even though
+        its own ``shuffle()`` never ran."""
+        return {"order": [int(s) for s in self._order],
+                "epoch_seed": int(self._epoch_seed),
+                "window": self._window_size()}
+
+    def restore_stream_state(self, state: dict) -> None:
+        self._order = np.asarray([int(s) for s in state["order"]])
+        self._epoch_seed = int(state["epoch_seed"])
+
+    def _window_size(self) -> int:
+        return (shuffle_window() if self.shuffle_window is None
+                else int(self.shuffle_window))
+
+    def _fresh_stream(self) -> _IndexStream:
+        counts = [len(ix) for ix in self._indices]
+        return _IndexStream(counts, self._bases, list(self._order),
+                            self._window_size(), self._epoch_seed)
+
+    def position_after(self, n: int) -> dict:
+        """The exact iterator state after ``n`` records of this epoch — index
+        math only, no record IO, no decode. Feed it to :meth:`data_from` to
+        seek."""
+        st = self._fresh_stream()
+        for _ in range(int(n)):
+            next(st)
+        return st.state()
+
+    def data_from(self, position: dict, train: bool = True) -> Iterator:
+        """Resume the epoch from a :meth:`position_after` /
+        :meth:`_IndexStream.state` capture: yields exactly the records an
+        uninterrupted epoch would have yielded from that point on."""
+        counts = [len(ix) for ix in self._indices]
+        stream = _IndexStream.from_state(counts, self._bases, position)
+        return self._drive(stream)
+
+    # ---------------------------------------------------------------- data
+    def _drive(self, stream: _IndexStream) -> Iterator:
+        from bigdl_tpu.dataset.sample_cache import cached_data_iter
+
+        def submit(gid):
+            return self._executor().submit(self._load, gid)
+
+        return cached_data_iter(stream, submit, self._cache_obj(),
+                                self.num_workers * 2)
+
+    def data(self, train: bool) -> Iterator:
+        return self._drive(self._fresh_stream())

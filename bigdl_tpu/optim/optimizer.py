@@ -233,6 +233,7 @@ class Optimizer:
         self._epoch_batches = 0
         self._epoch_rng: Optional[dict] = None
         self._epoch_order = None
+        self._epoch_stream: Optional[dict] = None
         self._resume_feed: Optional[dict] = None
         self._resume_base_rng = None
         # hang watchdog (obs/watchdog.py, BIGDL_WATCHDOG_S): owned per
@@ -1171,6 +1172,16 @@ class Optimizer:
         order = getattr(self._feed_base(), "_order", None)
         return None if order is None else np.array(order, copy=True)
 
+    def _capture_stream_state(self):
+        """Epoch-start stream identity of a streaming base dataset
+        (``StreamingDataSet.stream_state``: shard order + epoch seed), or
+        None for in-memory sources. A fresh process restoring mid-epoch has
+        never run this epoch's ``shuffle()``, so the checkpoint must carry
+        the stream's epoch identity explicitly — the RNG snapshot alone
+        reproduces future draws, not the seed already drawn."""
+        fn = getattr(self._feed_base(), "stream_state", None)
+        return fn() if callable(fn) else None
+
     def _resume_info(self, state, neval_next: int) -> dict:
         """Everything beyond params/slots that bitwise mid-epoch resume
         needs: the absolute feed position inside the current epoch, the RNG
@@ -1193,6 +1204,9 @@ class Optimizer:
             "epoch_rng": (self._epoch_rng if mid_epoch
                           else RandomGenerator.state_dict()),
             "epoch_order": self._epoch_order,
+            # streamed feeds: shard order + window-shuffle seed of the epoch
+            # in flight (boundary checkpoints re-derive both via shuffle())
+            "stream": self._epoch_stream if mid_epoch else None,
             "base_rng": (None if base_rng is None
                          else np.asarray(jax.device_get(base_rng))),
         }
@@ -1392,6 +1406,13 @@ class Optimizer:
                     order = resume_feed.get("epoch_order")
                     if order is not None and hasattr(base, "_order"):
                         base._order = np.array(order, copy=True)
+                    # streamed feed: reinstall the interrupted epoch's stream
+                    # identity (shard order + window-shuffle seed) — this
+                    # process never ran that epoch's shuffle()
+                    stream = resume_feed.get("stream")
+                    if stream is not None and hasattr(base,
+                                                      "restore_stream_state"):
+                        base.restore_stream_state(stream)
                     skip = int(resume_feed.get("feed_pos", 0))
                     self._epoch_rng = resume_feed.get("epoch_rng")
                     self._epoch_order = self._base_order_copy()
@@ -1406,6 +1427,7 @@ class Optimizer:
                 self.dataset.shuffle()
                 self._epoch_rng = RandomGenerator.state_dict()
                 self._epoch_order = self._base_order_copy()
+            self._epoch_stream = self._capture_stream_state()
             self._epoch_batches = skip
             # a fully-consumed epoch resumed at its tail legitimately yields
             # no further batches

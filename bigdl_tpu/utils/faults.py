@@ -44,6 +44,13 @@ site                      where it fires
                           ``BIGDL_FAULT_STALL_S`` seconds (default 2),
                           simulating a wedged decode loop for the serving
                           watchdog / deadline suites
+``cache_read``            a decoded-sample-cache mmap read
+                          (``dataset/sample_cache.py``) — any action makes
+                          the read report corruption, firing the
+                          quarantine-and-redecode fallback
+``cache_write``           a decoded-sample-cache build write — fails that
+                          write, abandoning the build (training continues
+                          uncached)
 ========================  ====================================================
 
 A plan is a ``;``-separated list of entries ``site@N`` or ``site@N=action``.
@@ -83,6 +90,8 @@ SITE_SERVE_PREFILL = "serve_prefill"
 SITE_SERVE_DECODE = "serve_decode"
 SITE_SERVE_THREAD = "serve_thread"
 SITE_SERVE_STALL = "serve_stall"
+SITE_CACHE_READ = "cache_read"
+SITE_CACHE_WRITE = "cache_write"
 
 #: sites whose plan entries match the caller-supplied ``index`` (training
 #: iteration) instead of the site's hit counter
@@ -100,6 +109,8 @@ _DEFAULT_ACTION = {
     SITE_SERVE_DECODE: "error",
     SITE_SERVE_THREAD: "death",
     SITE_SERVE_STALL: "stall",
+    SITE_CACHE_READ: "error",
+    SITE_CACHE_WRITE: "error",
 }
 
 _KNOWN_ACTIONS = frozenset({"error", "death", "nan", "sigterm", "torn",

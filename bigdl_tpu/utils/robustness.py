@@ -25,6 +25,10 @@ Event kinds in use (free-form strings; these are the conventions):
 - ``ckpt_quarantined`` — a torn/corrupt checkpoint file was renamed aside
   and an older version used instead;
 - ``fault_injected`` — a scripted fault from ``utils/faults.py`` fired;
+- ``cache_fallback`` — a corrupt/truncated decoded-sample cache was
+  quarantined as ``*.corrupt`` and the epoch fell back to live decode
+  (``dataset/sample_cache.py``); ``cache_write_failed`` — a cache build was
+  abandoned mid-epoch (write error) and training continued uncached;
 - ``serving_*`` — serving-plane recovery actions
   (``serving/engine.py``): ``serving_thread_respawn`` /
   ``serving_recovered`` (decode-loop crash absorbed by the crash budget),

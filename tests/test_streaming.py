@@ -1,0 +1,437 @@
+"""Streaming data plane suite (`make t1-streaming`).
+
+Pins the contracts of `dataset/streaming.py` + `dataset/sample_cache.py`:
+
+- window-shuffle order is a pure function of (shard order, epoch seed) —
+  deterministic, a permutation, and IDENTICAL across
+  ``BIGDL_DATA_WORKERS`` ∈ {0, 1, 4} (the order is produced upstream of the
+  parallel transform engine);
+- the iterator position is fully serializable: ``position_after(n)`` +
+  ``data_from(pos)`` reproduce the uninterrupted tail exactly, including in
+  the end-of-epoch drain region;
+- ``shard(host_index, host_count)`` yields disjoint per-host record sets
+  whose union is the whole dataset;
+- the decoded-sample cache commits only complete builds, serves warm epochs
+  bitwise-identical to live decode with the ``decode`` stage replaced by a
+  ``cache`` stage in feed_stats, and answers ANY integrity failure (bit
+  flip, truncation, scripted ``cache_read`` fault) with quarantine +
+  ``cache_fallback`` event + live-decode fallback — never a crash;
+- mid-epoch streamed resume: SIGTERM inside epoch 2 of a 3-epoch streamed
+  run resumes via ``optimize(resume="auto")`` bitwise-identical to the
+  uninterrupted run, with the cache enabled (warm replay).
+"""
+
+import os
+import struct
+import tarfile
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu import Engine, nn
+from bigdl_tpu.dataset.dataset import DataSet, TransformedDataSet
+from bigdl_tpu.dataset.profiling import feed_stats, stage_deltas_ms
+from bigdl_tpu.dataset.recordio import RecordWriter
+from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
+from bigdl_tpu.dataset.sample_cache import (
+    CacheCorruptError, SampleCache, cached_data_iter, decode_record,
+    encode_record, fingerprint,
+)
+from bigdl_tpu.dataset.streaming import StreamingDataSet, _IndexStream
+from bigdl_tpu.dataset.transformer import MapTransformer
+from bigdl_tpu.obs.registry import registry as obs_registry
+from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+from bigdl_tpu.optim.optimizer import TrainingPreempted
+from bigdl_tpu.utils import faults
+from bigdl_tpu.utils.random_generator import RandomGenerator
+from bigdl_tpu.utils.robustness import events
+
+pytestmark = pytest.mark.streaming
+
+
+def _params_equal(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+
+
+def _decode_id_sample(payload: bytes) -> Sample:
+    """Record id → deterministic Sample whose label IS the record id (order
+    assertions read the label stream)."""
+    (i,) = struct.unpack("<I", payload[:4])
+    rng = np.random.default_rng(1000 + i)
+    return Sample(rng.normal(size=(4, 4)).astype(np.float32), np.int32(i))
+
+
+def _decode_lenet_sample(payload: bytes) -> Sample:
+    """Record id → deterministic LeNet-shaped Sample (28×28, class 0-9)."""
+    (i,) = struct.unpack("<I", payload[:4])
+    rng = np.random.default_rng(2000 + i)
+    return Sample(rng.normal(size=(28, 28)).astype(np.float32),
+                  np.int32(i % 10))
+
+
+def _write_shards(dirpath, n=32, shards=4):
+    """n records (payload = u32 record id) round-robined over shard files."""
+    paths = [str(dirpath / f"part.{s:05d}.bdlrec") for s in range(shards)]
+    writers = [RecordWriter(p) for p in paths]
+    try:
+        for i in range(n):
+            writers[i % shards].write(struct.pack("<I", i))
+    finally:
+        for w in writers:
+            w.close()
+    return paths
+
+
+def _labels(ds) -> list:
+    return [int(np.asarray(s.label[0])) for s in ds.data(train=True)]
+
+
+# ------------------------------------------------------------- index stream
+class TestIndexStream:
+    COUNTS, BASES = [4, 4, 4, 4], [0, 4, 8, 12]
+
+    def _stream(self, window, seed=123, order=(2, 0, 3, 1)):
+        return _IndexStream(self.COUNTS, self.BASES, list(order), window,
+                            seed)
+
+    @pytest.mark.parametrize("window", [0, 1, 4, 64])
+    def test_deterministic_permutation(self, window):
+        a, b = list(self._stream(window)), list(self._stream(window))
+        assert a == b
+        assert sorted(a) == list(range(16))
+
+    def test_window_leq_one_is_pure_interleave(self):
+        st = _IndexStream([2, 2], [0, 2], [1, 0], 0, 7)
+        assert list(st) == [2, 0, 3, 1]
+
+    def test_window_actually_shuffles(self):
+        interleave = list(self._stream(0))
+        shuffled = list(self._stream(8))
+        assert sorted(shuffled) == sorted(interleave)
+        assert shuffled != interleave
+
+    def test_seed_changes_order(self):
+        assert list(self._stream(8, seed=1)) != list(self._stream(8, seed=2))
+
+    @pytest.mark.parametrize("skip", [3, 7, 13])
+    def test_state_roundtrip_resumes_tail(self, skip):
+        # skip=13 of 16 lands in the drain region (shards exhausted, the
+        # window emptying by random pops) — state must cover that too
+        st = self._stream(6, seed=99)
+        for _ in range(skip):
+            next(st)
+        state = st.state()
+        tail = list(st)
+        resumed = _IndexStream.from_state(self.COUNTS, self.BASES, state)
+        assert list(resumed) == tail
+
+    def test_emitted_counts(self):
+        st = self._stream(6)
+        next(st), next(st)
+        assert st.emitted == 2
+
+
+# -------------------------------------------------------- streaming dataset
+class TestStreamingDataSet:
+    def test_epoch_yields_every_record_once(self, tmp_path):
+        paths = _write_shards(tmp_path, n=32, shards=4)
+        ds = StreamingDataSet(paths, decoder=_decode_id_sample,
+                              shuffle_window=8, num_workers=2, cache=False)
+        assert ds.size() == 32
+        assert sorted(_labels(ds)) == list(range(32))
+
+    def test_order_identical_across_data_workers(self, tmp_path, monkeypatch):
+        """The satellite pin: W ∈ {0, 1, 4} transform workers see the SAME
+        record order — the stream produces it upstream of the engine."""
+        paths = _write_shards(tmp_path, n=32, shards=4)
+        orders = {}
+        for w in (0, 1, 4):
+            monkeypatch.setenv("BIGDL_DATA_WORKERS", str(w))
+            RandomGenerator.set_seed(5)
+            ds = (StreamingDataSet(paths, decoder=_decode_id_sample,
+                                   shuffle_window=8, num_workers=2,
+                                   cache=False)
+                  >> MapTransformer(lambda s: s))
+            assert isinstance(ds, TransformedDataSet)
+            ds.shuffle()
+            orders[w] = _labels(ds)
+        assert sorted(orders[0]) == list(range(32))
+        assert orders[0] == orders[1] == orders[4]
+
+    def test_shuffle_draws_fresh_epoch_order(self, tmp_path):
+        paths = _write_shards(tmp_path, n=32, shards=4)
+        RandomGenerator.set_seed(3)
+        ds = StreamingDataSet(paths, decoder=_decode_id_sample,
+                              shuffle_window=8, cache=False)
+        ds.shuffle()
+        e1 = _labels(ds)
+        ds.shuffle()
+        e2 = _labels(ds)
+        assert sorted(e1) == sorted(e2) and e1 != e2
+
+    def test_stream_state_restores_epoch_in_fresh_process(self, tmp_path):
+        """stream_state()/restore_stream_state(): a dataset that never ran
+        this epoch's shuffle() reproduces its exact order — the mid-epoch
+        resume contract."""
+        paths = _write_shards(tmp_path, n=32, shards=4)
+        RandomGenerator.set_seed(9)
+        ds = StreamingDataSet(paths, decoder=_decode_id_sample,
+                              shuffle_window=8, cache=False)
+        ds.shuffle()
+        state = ds.stream_state()
+        order = _labels(ds)
+        fresh = StreamingDataSet(paths, decoder=_decode_id_sample,
+                                 shuffle_window=8, cache=False)
+        fresh.restore_stream_state(state)
+        assert _labels(fresh) == order
+
+    @pytest.mark.parametrize("skip", [5, 11, 29])
+    def test_position_after_and_data_from(self, tmp_path, skip):
+        paths = _write_shards(tmp_path, n=32, shards=4)
+        RandomGenerator.set_seed(4)
+        ds = StreamingDataSet(paths, decoder=_decode_id_sample,
+                              shuffle_window=8, cache=False)
+        ds.shuffle()
+        full = _labels(ds)
+        pos = ds.position_after(skip)
+        tail = [int(np.asarray(s.label[0]))
+                for s in ds.data_from(pos, train=True)]
+        assert tail == full[skip:]
+
+    def test_tar_shards(self, tmp_path):
+        tars = []
+        for s in range(2):
+            p = tmp_path / f"shard{s}.tar"
+            with tarfile.open(p, "w") as tf:
+                for i in range(4):
+                    fp = tmp_path / f"m{s}_{i}.bin"
+                    fp.write_bytes(struct.pack("<I", s * 4 + i))
+                    tf.add(str(fp), arcname=f"m{i}.bin")
+            tars.append(str(p))
+        ds = StreamingDataSet(tars, decoder=_decode_id_sample,
+                              shuffle_window=0, cache=False)
+        assert sorted(_labels(ds)) == list(range(8))
+
+    def test_shard_assignment_disjoint_union(self, tmp_path):
+        paths = _write_shards(tmp_path, n=32, shards=4)
+        ds = StreamingDataSet(paths, decoder=_decode_id_sample, cache=False)
+        parts = [ds.shard(h, 2) for h in range(2)]
+        seen = [frozenset(_labels(p)) for p in parts]
+        assert seen[0] & seen[1] == frozenset()
+        assert seen[0] | seen[1] == frozenset(range(32))
+        with pytest.raises(ValueError):
+            ds.shard(2, 2)
+        with pytest.raises(ValueError):
+            ds.shard(5, 4)  # host_index out of range
+        with pytest.raises(ValueError):
+            StreamingDataSet(paths[:1], decoder=_decode_id_sample,
+                             cache=False).shard(1, 2)
+
+
+# ------------------------------------------------------------- sample cache
+class TestSampleCache:
+    def _ds(self, tmp_path, **kw):
+        paths = _write_shards(tmp_path, n=16, shards=2)
+        kw.setdefault("cache", True)
+        kw.setdefault("cache_dir", str(tmp_path / "cache"))
+        return StreamingDataSet(paths, decoder=_decode_id_sample,
+                                shuffle_window=4, num_workers=2, **kw)
+
+    def test_warm_epoch_bitwise_and_stage_swap(self, tmp_path):
+        ds = self._ds(tmp_path)
+        hits0 = obs_registry.counter("feed/cache_hit").value
+        cold = list(ds.data(train=True))
+        assert obs_registry.counter("feed/cache_hit").value == hits0
+        snap = feed_stats.snapshot()
+        warm = list(ds.data(train=True))
+        stages = stage_deltas_ms(snap)
+        # the satellite pin: cache-served samples report a `cache` stage,
+        # decode drops out entirely
+        assert "decode" not in stages
+        assert stages["cache"]["count"] == 16
+        assert obs_registry.counter("feed/cache_hit").value == hits0 + 16
+        assert obs_registry.counter("feed/cache_bytes").value > 0
+        for a, b in zip(cold, warm):
+            assert np.array_equal(a.feature[0], b.feature[0])
+            assert np.array_equal(a.label[0], b.label[0])
+
+    def test_fresh_dataset_reads_committed_cache(self, tmp_path):
+        ds = self._ds(tmp_path)
+        cold = list(ds.data(train=True))
+        ds2 = self._ds(tmp_path)
+        snap = feed_stats.snapshot()
+        warm = list(ds2.data(train=True))
+        assert "decode" not in stage_deltas_ms(snap)
+        for a, b in zip(cold, warm):
+            assert np.array_equal(a.feature[0], b.feature[0])
+
+    def test_abandoned_epoch_commits_nothing(self, tmp_path):
+        ds = self._ds(tmp_path)
+        it = ds.data(train=True)
+        for _ in range(5):
+            next(it)
+        it.close()
+        cdir = str(tmp_path / "cache")
+        assert not [f for f in os.listdir(cdir)
+                    if f.endswith((".data", ".idx"))]
+
+    def test_bit_flip_quarantines_and_falls_back(self, tmp_path):
+        ds = self._ds(tmp_path)
+        cold = list(ds.data(train=True))
+        cdir = tmp_path / "cache"
+        data_file = next(f for f in os.listdir(cdir) if f.endswith(".data"))
+        raw = bytearray((cdir / data_file).read_bytes())
+        raw[37] ^= 0xFF
+        (cdir / data_file).write_bytes(bytes(raw))
+        snap = events.snapshot()
+        ds2 = self._ds(tmp_path)
+        again = list(ds2.data(train=True))
+        assert events.deltas(snap).get("cache_fallback") == 1
+        assert any(f.endswith(".corrupt") for f in os.listdir(cdir))
+        assert len(again) == 16
+        for a, b in zip(cold, again):
+            assert np.array_equal(a.feature[0], b.feature[0])
+
+    def test_truncation_quarantines(self, tmp_path):
+        ds = self._ds(tmp_path)
+        list(ds.data(train=True))
+        cdir = tmp_path / "cache"
+        data_file = next(f for f in os.listdir(cdir) if f.endswith(".data"))
+        raw = (cdir / data_file).read_bytes()
+        (cdir / data_file).write_bytes(raw[: len(raw) // 2])  # short mmap
+        snap = events.snapshot()
+        ds2 = self._ds(tmp_path)
+        assert len(list(ds2.data(train=True))) == 16
+        assert events.deltas(snap).get("cache_fallback") == 1
+
+    def test_cache_read_fault_site(self, tmp_path):
+        """The scripted corruption pin: a cache_read fault mid-epoch fires
+        quarantine-and-redecode — records already yielded stay valid, the
+        rest decode live, nothing crashes."""
+        ds = self._ds(tmp_path)
+        cold = list(ds.data(train=True))
+        ds2 = self._ds(tmp_path)
+        snap = events.snapshot()
+        with faults.inject_faults("cache_read@3") as plan:
+            again = list(ds2.data(train=True))
+        assert plan.unfired() == []
+        assert events.deltas(snap).get("cache_fallback") == 1
+        assert len(again) == 16
+        for a, b in zip(cold, again):
+            assert np.array_equal(a.feature[0], b.feature[0])
+
+    def test_cache_write_fault_abandons_build(self, tmp_path):
+        ds = self._ds(tmp_path)
+        snap = events.snapshot()
+        with faults.inject_faults("cache_write@2") as plan:
+            out = list(ds.data(train=True))
+        assert plan.unfired() == []
+        assert len(out) == 16
+        assert events.deltas(snap).get("cache_write_failed") == 1
+        cdir = str(tmp_path / "cache")
+        assert not [f for f in os.listdir(cdir)
+                    if f.endswith((".data", ".idx"))]
+
+    def test_codec_roundtrip(self):
+        s = Sample([np.arange(6, dtype=np.float32).reshape(2, 3),
+                    np.ones(2, np.int64)], np.int32(3))
+        arrays, meta = encode_record(s)
+        back = decode_record([a.copy() for a in arrays], meta)
+        assert len(back.feature) == 2 and len(back.label) == 1
+        assert np.array_equal(back.feature[0], s.feature[0])
+        assert back.feature[1].dtype == np.int64
+        assert np.array_equal(back.label[0], s.label[0])
+
+    def test_fingerprint_distinguishes_datasets(self):
+        assert fingerprint(("a", 1)) != fingerprint(("a", 2))
+
+    def test_cached_iter_without_cache_matches_plain(self, tmp_path):
+        paths = _write_shards(tmp_path, n=16, shards=2)
+        ds = StreamingDataSet(paths, decoder=_decode_id_sample,
+                              shuffle_window=4, cache=False)
+        assert sorted(_labels(ds)) == list(range(16))
+
+
+# -------------------------------------------------------- mid-epoch resume
+class TestStreamedResume:
+    def test_sigterm_in_epoch2_resumes_bitwise(self, tmp_path):
+        """The tentpole acceptance pin: SIGTERM inside epoch 2 of a 3-epoch
+        STREAMED run (window shuffle + sample cache on) resumes via
+        ``optimize(resume='auto')`` bitwise-identical to the uninterrupted
+        run. 32 records / batch 8 → 4 iterations per epoch; max_iteration(12)
+        = 3 epochs; sigterm@6 lands mid-epoch-2; the epoch-1-built cache
+        makes the resumed replay a warm-mmap replay."""
+        shard_dir = tmp_path / "shards"
+        shard_dir.mkdir()
+        paths = _write_shards(shard_dir, n=32, shards=4)
+        cache_dir = str(tmp_path / "cache")
+
+        def lenet_opt(ckpt=None):
+            from bigdl_tpu.models.lenet.lenet5 import LeNet5
+            Engine.reset()
+            RandomGenerator.set_seed(1)
+            Engine.init(seed=7)
+            data = (StreamingDataSet(paths, decoder=_decode_lenet_sample,
+                                     shuffle_window=8, num_workers=2,
+                                     cache=True, cache_dir=cache_dir)
+                    >> SampleToMiniBatch(8))
+            opt = (LocalOptimizer(LeNet5(10), data, nn.ClassNLLCriterion())
+                   .set_optim_method(SGD(learningrate=0.05))
+                   .set_end_when(Trigger.max_iteration(12)))
+            if ckpt is not None:
+                opt.set_checkpoint(str(ckpt), Trigger.several_iteration(3))
+            return opt
+
+        ref_params = lenet_opt().optimize().get_params()
+
+        snap = events.snapshot()
+        ckpt_dir = tmp_path / "ckpt"
+        ckpt_dir.mkdir()
+        opt = lenet_opt(ckpt_dir)
+        with pytest.raises(TrainingPreempted):
+            with faults.inject_faults("sigterm@6"):
+                opt.optimize()
+        assert events.deltas(snap).get("preemption") == 1
+
+        opt2 = lenet_opt(ckpt_dir)
+        resumed = opt2.optimize(resume="auto").get_params()
+        assert opt2.state["neval"] >= 12
+        assert _params_equal(ref_params, resumed)
+
+    def test_epoch_boundary_resume_is_bitwise(self, tmp_path):
+        """Checkpoint at an epoch boundary (iteration 4 of 4-batch epochs):
+        the resumed run re-runs shuffle() from the restored RNG — the stream
+        epoch seed draw replays too."""
+        shard_dir = tmp_path / "shards"
+        shard_dir.mkdir()
+        paths = _write_shards(shard_dir, n=32, shards=4)
+
+        def lenet_opt(ckpt=None):
+            from bigdl_tpu.models.lenet.lenet5 import LeNet5
+            Engine.reset()
+            RandomGenerator.set_seed(1)
+            Engine.init(seed=7)
+            data = (StreamingDataSet(paths, decoder=_decode_lenet_sample,
+                                     shuffle_window=8, num_workers=2,
+                                     cache=False)
+                    >> SampleToMiniBatch(8))
+            opt = (LocalOptimizer(LeNet5(10), data, nn.ClassNLLCriterion())
+                   .set_optim_method(SGD(learningrate=0.05))
+                   .set_end_when(Trigger.max_iteration(12)))
+            if ckpt is not None:
+                opt.set_checkpoint(str(ckpt), Trigger.several_iteration(4))
+            return opt
+
+        ref_params = lenet_opt().optimize().get_params()
+        ckpt_dir = tmp_path / "ckpt"
+        ckpt_dir.mkdir()
+        opt = lenet_opt(ckpt_dir)
+        with pytest.raises(TrainingPreempted):
+            with faults.inject_faults("sigterm@9"):
+                opt.optimize()
+        opt2 = lenet_opt(ckpt_dir)
+        resumed = opt2.optimize(resume="auto").get_params()
+        assert _params_equal(ref_params, resumed)

@@ -19,9 +19,14 @@ t1-faults:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Observability suite only (docs/observability.md): span tracer Chrome-trace
-# export, JSONL event log + `bigdl-tpu diag` round trip, metric registry,
-# hang-watchdog stall dumps, zero-cost disabled path. Unmarked-slow, so
-# `make t1` runs these too; this is the fast inner loop for obs work.
+# export, JSONL event log + `bigdl-tpu diag` round trip, metric registry
+# (incl. snapshot tear-resistance under concurrent observers), /metrics
+# exporter (Prometheus round trip, endpoint concurrency, per-tenant labels,
+# zero-alloc when BIGDL_METRICS_PORT unset), request trace-ID propagation +
+# tail sampling + `diag --trace`, MFU gauge consistency, SLO breach →
+# serving-health transitions, hang-watchdog stall dumps with in-flight
+# request context, zero-cost disabled paths. Unmarked-slow, so `make t1`
+# runs these too; this is the fast inner loop for obs work.
 t1-obs:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 

@@ -28,16 +28,10 @@ import subprocess
 import sys
 import time
 
-# chip peak bf16 FLOP/s by device_kind substring (public spec sheets)
-_PEAK_FLOPS = [
-    ("v6", 918e12),        # Trillium
-    ("v5p", 459e12),
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
+# chip peak bf16 FLOP/s by device_kind substring — single-sourced from the
+# always-on MFU accounting (obs/mfu.py) so the bench and the live train/mfu
+# gauge can never disagree about a chip's peak
+from bigdl_tpu.obs.mfu import PEAK_FLOPS as _PEAK_FLOPS  # noqa: E402
 
 # Analytic training-step FLOPs per unit (image/word/token): forward FLOPs x3
 # for fwd+bwd. Forward numbers from XLA cost analysis of the jitted forward on
@@ -172,11 +166,8 @@ _DEFAULT_BATCH = {"resnet50": 256, "lenet": 256, "inception": 256,
 
 
 def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    for sub, peak in _PEAK_FLOPS:
-        if sub in kind:
-            return peak
-    return None
+    from bigdl_tpu.obs import mfu
+    return mfu.peak_flops_for(device_kind)
 
 
 # HBM bandwidth by chip (roofline denominator for the ablation leg);
@@ -912,9 +903,62 @@ def _measure_obs(batch: int, iters: int) -> dict:
         dt = time.perf_counter() - t0
         return batch * iters / dt
 
+    def exporter_leg() -> dict:
+        """The SAME untraced loop with the /metrics endpoint live and a
+        client scraping it at 1 Hz (10-15x a real Prometheus interval;
+        back-to-back scraping with no think time would measure single-core
+        GIL contention, not the endpoint) — scrape-under-load cost, plus
+        validity of what the scraper saw (parseable Prometheus text
+        carrying the train metrics and the live MFU gauge)."""
+        import threading
+        import urllib.request
+
+        from bigdl_tpu.obs import exporter
+
+        exp = exporter.MetricsExporter(0).start()
+        stop_evt = threading.Event()
+        scrapes = [0]
+        last_body = [""]
+        err = [None]
+
+        def spam():
+            url = exp.url + "/metrics"
+            while not stop_evt.is_set():
+                try:
+                    with urllib.request.urlopen(url, timeout=5) as r:
+                        last_body[0] = r.read().decode("utf-8")
+                    scrapes[0] += 1
+                except Exception as e:  # noqa: BLE001 — reported below
+                    err[0] = f"{type(e).__name__}: {e}"
+                stop_evt.wait(1.0)
+
+        th = threading.Thread(target=spam, daemon=True)
+        th.start()
+        try:
+            ips = leg(False)
+        finally:
+            stop_evt.set()
+            th.join(timeout=5)
+            exp.stop()
+        parsed = {}
+        parse_ok = False
+        try:
+            parsed = exporter.parse_metrics(last_body[0])
+            parse_ok = bool(parsed)
+        except ValueError:
+            parse_ok = False
+        return {"ips": ips, "scrapes": scrapes[0], "error": err[0],
+                "parse_ok": parse_ok,
+                "has_train_metrics": any(k.startswith("bigdl_train_")
+                                         for k in parsed),
+                "has_mfu_gauge": any(
+                    k in ("bigdl_train_mfu",
+                          "bigdl_train_model_flops_per_sec")
+                    for k in parsed)}
+
     try:
         off_a = leg(False)
-        traced_ips = leg(True)
+        traced_a = leg(True)
         # artifact validity while the traced run's buffers are still live
         chrome = trace.export_chrome()
         with open(chrome) as f:
@@ -924,14 +968,27 @@ def _measure_obs(batch: int, iters: int) -> dict:
         jsonl = trace.jsonl_path()
         kinds = {e.get("kind") for e in trace.read_events(jsonl)}
         trace.reset()
+        exp_a = exporter_leg()
+        # second round of all three legs, interleaved: this box's sustained
+        # throughput drifts by double-digit percent over a process lifetime
+        # (shared CPU), so a gate comparing one early leg against one late
+        # leg measures the drift, not the tracer — best-of-two PER LEG
+        # compares best case against best case and cancels it
         off_b = leg(False)
+        traced_b = leg(True)
+        trace.reset()
+        exp_b = exporter_leg()
     finally:
         trace.reset()
         shutil.rmtree(tmp, ignore_errors=True)
-    # best-of-two untraced legs: the gate must measure the tracer, not an
-    # unlucky scheduler hiccup in one reference run
     off_ips = max(off_a, off_b)
+    traced_ips = max(traced_a, traced_b)
+    exp_ips = max(exp_a["ips"], exp_b["ips"])
+    exp_leg = exp_a if (exp_a["parse_ok"] and exp_a["error"] is None) \
+        else exp_b
+    exp_leg["scrapes"] = exp_a["scrapes"] + exp_b["scrapes"]
     overhead = max(0.0, 1.0 - traced_ips / off_ips) if off_ips else 0.0
+    exp_overhead = max(0.0, 1.0 - exp_ips / off_ips) if off_ips else 0.0
     return {
         "value": round(traced_ips, 1),
         "unit": "images/sec",
@@ -946,6 +1003,16 @@ def _measure_obs(batch: int, iters: int) -> dict:
         "trace_threads": n_threads,
         "trace_valid": bool(span_events) and n_threads >= 2,
         "jsonl_has_run_report": "run_report" in kinds,
+        # exporter-overhead leg: scraping /metrics during the run must stay
+        # under the same <3% gate as the tracer
+        "exporter_images_per_sec": round(exp_ips, 1),
+        "exporter_scrapes": exp_leg["scrapes"],
+        "exporter_overhead_pct": round(100.0 * exp_overhead, 2),
+        "exporter_overhead_ok": exp_overhead < 0.03,
+        "exporter_scrape_valid": bool(exp_leg["parse_ok"]
+                                      and exp_leg["has_train_metrics"]
+                                      and exp_leg["error"] is None),
+        "exporter_has_mfu_gauge": exp_leg["has_mfu_gauge"],
     }
 
 
@@ -1514,6 +1581,40 @@ def _measure_ablation(model_name: str, batch: int, iters: int) -> dict:
     return out
 
 
+def _obs_record() -> dict:
+    """End-of-leg observability snapshot embedded in every bench record.
+
+    ``BENCH_*.json`` lines carry the metric registry (counters, gauges,
+    compacted histogram stats) and the live MFU accounting, so stage
+    timings and model-FLOPs utilisation ride along automatically — on the
+    degraded path too, where the snapshot shows how far the leg got before
+    it fell over."""
+    from bigdl_tpu.obs import mfu
+    from bigdl_tpu.obs.registry import registry
+
+    def _r(v):
+        # 4 significant digits: compact for both huge flops/s and tiny MFU
+        return float(f"{v:.4g}") if isinstance(v, float) else v
+
+    snap = registry.snapshot()
+    mstats = mfu.stats()
+    out = {
+        "counters": dict(sorted(snap["counters"].items())),
+        "gauges": {k: _r(v) for k, v in sorted(snap["gauges"].items())},
+        "histograms": {
+            name: {k: _r(v) for k, v in h.items()}
+            for name, h in sorted(snap["histograms"].items())},
+        "mfu": {
+            "peak_flops": _r(mstats.get("peak_flops")),
+            "flops_per_sec": {k: _r(v) for k, v in
+                              sorted(mstats["flops_per_sec"].items())},
+        },
+    }
+    if "mfu" in mstats:
+        out["mfu"]["mfu"] = {k: _r(v) for k, v in sorted(mstats["mfu"].items())}
+    return out
+
+
 def run_worker(args) -> None:
     """The measured child process: ONE dtype, one JSON line, exit.
 
@@ -1583,6 +1684,7 @@ def run_worker(args) -> None:
             line["streamed_feed_wait_ms"] = round(sres["feed_wait_ms"], 2)
         except Exception as e:
             line["streamed_leg_error"] = f"{type(e).__name__}: {e}"[:300]
+    line["obs"] = _obs_record()
     print(json.dumps(line))
 
 
@@ -1658,6 +1760,11 @@ def _emit(record: dict, model: str) -> None:
     lkg = last_known_good_tpu(model)
     if lkg is not None:
         record["last_known_good_tpu"] = lkg
+    # degraded-record contract (PR 6, extended): the obs snapshot rides along.
+    # A child-produced result keeps the child's end-of-leg snapshot; a record
+    # built here gets the orchestrator's (usually near-empty — itself a signal
+    # that the leg died before measuring anything).
+    record.setdefault("obs", _obs_record())
     print(json.dumps(record))
 
 
@@ -1912,62 +2019,54 @@ def _run_worker_modes(args) -> int:
         res = _measure_int8_infer(args.model, args.batch,
                                   max(args.iters, 10))
         res["metric"] = f"{args.model}_int8_vs_bf16_infer"
-        print(json.dumps(res))
     elif args.serving:
         res = _measure_serving(args.model, args.batch,
                                max(args.iters // 4, 3))
         res["metric"] = f"{args.model}_serving"
-        print(json.dumps(res))
     elif args.decode_infer:
         res = _measure_decode_infer(min(args.batch, 16))
         res["metric"] = "transformerlm_decode_infer"
         res["vs_baseline"] = None
-        print(json.dumps(res))
     elif args.eval_bench:
         res = _measure_eval(args.model, args.batch, max(args.iters // 4, 3))
         res["metric"] = f"{args.model}_eval_throughput"
         res["vs_baseline"] = None
-        print(json.dumps(res))
     elif args.pipeline_bench:
         res = _measure_pipeline(min(args.batch, 32))
         res["metric"] = "input_pipeline_images_per_sec"
         res["vs_baseline"] = None
-        print(json.dumps(res))
     elif getattr(args, "stream_bench", False):
         res = _measure_stream_bench(min(args.batch, 32))
         res["metric"] = "stream_pipeline_images_per_sec"
         res["vs_baseline"] = None
-        print(json.dumps(res))
     elif getattr(args, "obs_bench", False):
         res = _measure_obs(min(args.batch, 128), args.iters)
         res["metric"] = "lenet_obs_overhead"
         res["vs_baseline"] = None
-        print(json.dumps(res))
     elif getattr(args, "kernel_bench", False):
         res = _measure_kernel_bench(min(args.batch, 64),
                                     max(args.iters // 2, 8))
         res["metric"] = "kernel_bench"
         res["vs_baseline"] = None
-        print(json.dumps(res))
     elif getattr(args, "precision_bench", False):
         res = _measure_precision(args.model, args.batch,
                                  max(args.iters // 2, 8))
         res["metric"] = f"{args.model}_precision_bench"
         res["vs_baseline"] = None
-        print(json.dumps(res))
     elif getattr(args, "serving_bench", False):
         res = _measure_serving_bench()
         res["metric"] = "transformerlm_serving_engine"
         res["vs_baseline"] = None
-        print(json.dumps(res))
     elif args.ablate:
         res = _measure_ablation(args.model, args.batch,
                                 max(args.iters // 2, 8))
         res["metric"] = f"{args.model}_step_ablation"
         res["vs_baseline"] = None
-        print(json.dumps(res))
     else:
-        run_worker(args)
+        run_worker(args)  # attaches its own end-of-leg obs snapshot
+        return 0
+    res["obs"] = _obs_record()
+    print(json.dumps(res))
     return 0
 
 

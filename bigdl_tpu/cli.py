@@ -95,11 +95,27 @@ def _launch_multihost(args) -> int:
     return next((rc for rc in rcs if rc), 0)
 
 
-def _run_diag(path: str) -> int:
+def _format_trace(ev: dict) -> str:
+    """One tail-sampled request trace as an indented span tree."""
+    lines = [f"trace {ev.get('trace_id')}  request {ev.get('request_id')}  "
+             f"engine {ev.get('engine')}  e2e {ev.get('e2e_ms')}ms  "
+             f"generated {ev.get('n_generated')}  "
+             f"finish={ev.get('finish')}"]
+    for span in ev.get("spans") or []:
+        lines.append(f"  {span.get('name', '?'):<14} "
+                     f"start {span.get('start_ms'):>10}ms  "
+                     f"dur {span.get('dur_ms'):>10}ms")
+    return "\n".join(lines)
+
+
+def _run_diag(path: str, trace_id=None) -> int:
     """Re-render the unified run report from a saved JSONL event log
     (``BIGDL_OBS_LOG``): the LAST ``run_report`` record renders through the
     same formatter the trainer used, so the text matches the live run's
-    byte-for-byte. Watchdog dumps in the log are summarized on stderr."""
+    byte-for-byte. Watchdog dumps and tail-sampled request traces in the log
+    are summarized on stderr. With ``trace_id``, skip the report and print
+    the matching ``request_trace`` span tree instead (matches the trace ID
+    or the request ID — whichever the operator has in hand)."""
     from bigdl_tpu.obs import report as obs_report
     from bigdl_tpu.obs import trace
 
@@ -108,6 +124,19 @@ def _run_diag(path: str) -> int:
     except OSError as e:
         print(f"diag: cannot read {path}: {e}", file=sys.stderr)
         return 1
+    traces = [ev for ev in events if ev.get("kind") == "request_trace"]
+    if trace_id is not None:
+        hits = [ev for ev in traces
+                if ev.get("trace_id") == trace_id
+                or ev.get("request_id") == trace_id]
+        if not hits:
+            print(f"diag: no request_trace matching {trace_id!r} in {path} "
+                  f"({len(traces)} traced request(s) in the log)",
+                  file=sys.stderr)
+            return 1
+        for ev in hits:
+            print(_format_trace(ev))
+        return 0
     report = None
     dumps = 0
     kinds: dict = {}
@@ -127,7 +156,117 @@ def _run_diag(path: str) -> int:
         print(f"diag: {dumps} watchdog dump(s) in the log — the run stalled; "
               f"thread stacks are in the watchdog_dump records",
               file=sys.stderr)
+    if traces:
+        slowest = sorted(traces, key=lambda ev: ev.get("e2e_ms") or 0.0,
+                         reverse=True)[:3]
+        print(f"diag: {len(traces)} tail-sampled request trace(s); slowest:",
+              file=sys.stderr)
+        for ev in slowest:
+            print(f"diag:   trace {ev.get('trace_id')} "
+                  f"e2e {ev.get('e2e_ms')}ms finish={ev.get('finish')} "
+                  f"(--trace {ev.get('trace_id')} for the span tree)",
+                  file=sys.stderr)
     return 0
+
+
+def _render_top(metrics: dict, health=None) -> str:
+    """Pure renderer for ``bigdl-tpu top``: one dashboard frame from a
+    parsed ``/metrics`` scrape (``exporter.parse_metrics``) and an optional
+    ``/healthz`` payload. Kept side-effect-free so tests can feed it
+    canned scrapes."""
+    import re
+
+    def g(name, fmt="{:.4g}", default="-"):
+        v = metrics.get(name)
+        return fmt.format(v) if v is not None else default
+
+    status = (health or {}).get("status", "?")
+    wds = (health or {}).get("watchdogs") or []
+    armed = sum(1 for w in wds if w.get("armed"))
+    head = f"bigdl-tpu top — status {status}"
+    if wds:
+        head += f" · watchdogs {armed}/{len(wds)} armed"
+    slo = (health or {}).get("slo") or {}
+    if slo.get("active"):
+        head += " · SLO BREACH " + ",".join(
+            sorted(b.get("rule", "?") for b in slo["active"]))
+    lines = [head]
+    lines.append(
+        "  train   mfu " + g("bigdl_train_mfu")
+        + "   flops/s " + g("bigdl_train_model_flops_per_sec", "{:.3g}")
+        + "   throughput " + g("bigdl_train_throughput", "{:.1f}")
+        + "   step p50 " + g('bigdl_train_step_wall{quantile="0.5"}', "{:.4g}")
+        + "s   stalls " + g("bigdl_train_feed_stall_total", "{:.0f}", "0"))
+    lines.append(
+        "  serve   flops/s " + g("bigdl_serve_model_flops_per_sec", "{:.3g}")
+        + "   mfu " + g("bigdl_serve_mfu")
+        + "   ttft p99 " + g('bigdl_serving_ttft_ms{quantile="0.99"}', "{:.1f}")
+        + "ms   e2e p99 " + g('bigdl_serving_e2e_ms{quantile="0.99"}', "{:.1f}")
+        + "ms")
+    tenants: dict = {}
+    pat = re.compile(r'^bigdl_serving_tenant_(\w+)\{tenant="([^"]*)"\}$')
+    for key, val in metrics.items():
+        m = pat.match(key)
+        if m:
+            tenants.setdefault(m.group(2), {})[m.group(1)] = val
+    if tenants:
+        lines.append("  tenants")
+        engs = (health or {}).get("engines") or {}
+        for name in sorted(tenants):
+            t = tenants[name]
+            state = engs.get(name, {}).get("health", "?")
+            if t.get("slo_degraded"):
+                state += "/SLO"
+            lines.append(
+                f"    {name:<12} {state:<10}"
+                f" backlog {t.get('backlog', 0):.0f}"
+                f" active {t.get('active_slots', 0):.0f}"
+                f" done {t.get('completed', 0):.0f}"
+                f" timeouts {t.get('timeouts', 0):.0f}"
+                f" shed {t.get('shed', 0):.0f}"
+                f" tps {t.get('decode_tps', 0):.1f}")
+    return "\n".join(lines)
+
+
+def _run_top(args) -> int:
+    """Live terminal dashboard over the metrics endpoint: scrape
+    ``/metrics`` + ``/healthz`` every ``--interval`` seconds and render one
+    frame per poll (``--once`` for scripts)."""
+    import json
+    import time
+    import urllib.error
+    import urllib.request
+
+    from bigdl_tpu.obs import exporter
+
+    base = f"http://{args.host}:{args.port}"
+    first = True
+    while True:
+        try:
+            with urllib.request.urlopen(base + "/metrics", timeout=3.0) as r:
+                metrics = exporter.parse_metrics(r.read().decode())
+        except Exception as e:  # noqa: BLE001 — any scrape failure is fatal
+            print(f"top: cannot scrape {base}/metrics: {e}", file=sys.stderr)
+            return 1
+        health = None
+        try:
+            with urllib.request.urlopen(base + "/healthz", timeout=3.0) as r:
+                health = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            # 503 (an engine died) still carries the JSON body
+            try:
+                health = json.loads(e.read().decode())
+            except Exception:
+                pass
+        except Exception:
+            pass
+        if not first:
+            print()
+        first = False
+        print(_render_top(metrics, health))
+        if args.once:
+            return 0
+        time.sleep(args.interval)
 
 
 def main(argv=None) -> int:
@@ -172,6 +311,21 @@ def main(argv=None) -> int:
         "diag", help="re-render the unified run report from a saved JSONL "
                      "event log (BIGDL_OBS_LOG / docs/observability.md)")
     diag.add_argument("jsonl", help="path to the JSONL event log")
+    diag.add_argument("--trace", default=None, metavar="ID",
+                      help="print the tail-sampled span tree for one request "
+                           "(trace ID or request ID) instead of the report")
+
+    top = sub.add_parser(
+        "top", help="live dashboard over a running process's metrics "
+                    "endpoint (/metrics + /healthz; BIGDL_METRICS_PORT)")
+    top.add_argument("--host", default="127.0.0.1")
+    top.add_argument("--port", type=int,
+                     default=int(_os.environ.get("BIGDL_METRICS_PORT") or 0),
+                     help="exporter port (default: $BIGDL_METRICS_PORT)")
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="seconds between frames")
+    top.add_argument("--once", action="store_true",
+                     help="render one frame and exit (for scripts)")
 
     launch = sub.add_parser(
         "launch", help="spawn an N-process jax.distributed training run on "
@@ -189,7 +343,13 @@ def main(argv=None) -> int:
 
     args = p.parse_args(argv)
     if args.command == "diag":
-        return _run_diag(args.jsonl)
+        return _run_diag(args.jsonl, trace_id=args.trace)
+    if args.command == "top":
+        if not args.port:
+            print("top: no exporter port — pass --port or set "
+                  "BIGDL_METRICS_PORT", file=sys.stderr)
+            return 2
+        return _run_top(args)
     if args.command == "train":
         mod, _ = _TRAIN_MAINS[args.model]
         return _run_module(mod, args.rest)

@@ -16,17 +16,28 @@ each with its own accumulator and consumer glue. This package unifies them:
   Python thread stacks plus the open-span tree to stderr and the JSONL log;
 - :mod:`bigdl_tpu.obs.report` — the end-of-run report (step-time
   percentiles, feed-stage attribution, robustness counters, span totals),
-  rendered identically by the trainer and ``bigdl-tpu diag``.
+  rendered identically by the trainer and ``bigdl-tpu diag``;
+- :mod:`bigdl_tpu.obs.exporter` — live ``/metrics`` (Prometheus text) +
+  ``/healthz`` + ``/statusz`` endpoint on ``BIGDL_METRICS_PORT`` (stdlib
+  http.server; zero-alloc no-op when the port is unset);
+- :mod:`bigdl_tpu.obs.mfu` — always-on MFU accounting: per-compiled-program
+  XLA cost-analysis FLOPs feeding live ``train/mfu`` and
+  ``serve/model_flops_per_sec`` gauges against a peak-FLOPs table;
+- :mod:`bigdl_tpu.obs.slo` — SLO monitor over windowed registry percentiles
+  (p99 TTFT, feed-stall rate, throughput floor) whose breach events flip
+  serving health to ``degraded``.
 
 Dependency-free by design: nothing here imports ``optim``/``dataset``/
-``nn``, so every layer of the framework may publish into it.
+``nn``, so every layer of the framework may publish into it (``mfu``
+imports jax lazily; ``slo`` reaches the robustness event rail lazily).
 """
 
 from __future__ import annotations
 
 import os
 
-from bigdl_tpu.obs import registry, report, trace, watchdog
+from bigdl_tpu.obs import exporter, mfu, registry, report, slo, trace, \
+    watchdog
 from bigdl_tpu.obs.registry import registry as metric_registry
 
 
@@ -45,9 +56,12 @@ def describe_config() -> str:
         f" (BIGDL_OBS_LOG={os.environ.get('BIGDL_OBS_LOG', '')!r})",
         f"  watchdog   = {wd + 's hard timeout' if wd else 'off'}"
         f" (BIGDL_WATCHDOG_S)",
+        f"  metrics    = "
+        f"{'port ' + os.environ.get('BIGDL_METRICS_PORT') if os.environ.get('BIGDL_METRICS_PORT', '').strip() else 'off'}"
+        f" (BIGDL_METRICS_PORT)",
     ]
     return "\n".join(lines)
 
 
-__all__ = ["trace", "registry", "watchdog", "report", "metric_registry",
-           "describe_config"]
+__all__ = ["trace", "registry", "watchdog", "report", "exporter", "mfu",
+           "slo", "metric_registry", "describe_config"]

@@ -1,0 +1,317 @@
+"""Live operational endpoint — /metrics, /healthz, /statusz over stdlib http.
+
+One daemon ``ThreadingHTTPServer`` (no third-party deps) turns the passive
+in-process telemetry rails into a scrapeable plane:
+
+- ``/metrics`` — Prometheus text exposition rendered from ONE
+  :meth:`MetricRegistry.snapshot` (counters as ``_total``, histograms as
+  summaries with p50/p95/p99 ``quantile`` labels + ``_sum``/``_count``,
+  gauges as-is), plus per-tenant serving gauges labelled
+  ``{tenant="<engine name>"}`` fed live from each registered
+  ``ServingEngine.stats()`` — the feed the ROADMAP's fleet router scrapes.
+- ``/healthz`` — the serving health state machine per engine, watchdog arm
+  state (armed / disarmed, dump count), and SLO breach state. HTTP 503 when
+  any engine is ``dead``, 200 otherwise — load-balancer-pollable.
+- ``/statusz`` — JSON status: the latest run report (published by the
+  trainer at end of run), MFU accounting, full engine ledgers, SLO state.
+
+The exporter is strictly opt-in: :func:`start_from_env` returns ``None``
+without allocating ANYTHING when ``BIGDL_METRICS_PORT`` is unset — the
+zero-alloc pin is :data:`_SERVERS_CREATED`, mirroring the tracer's
+``_SPANS_CREATED``. Port ``0`` binds an ephemeral port (tests).
+
+Engines register themselves (``register_engine`` on start, ``unregister``
+on supervisor exit); ``SnapshotServer`` registers all its tenants up front
+so the per-tenant rows exist before first traffic. Registration holds weak
+references only — a dropped engine disappears from the endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from bigdl_tpu.obs import mfu
+from bigdl_tpu.obs import watchdog as obs_watchdog
+from bigdl_tpu.obs.registry import registry
+
+#: exporter instances ever constructed — pins the zero-alloc disabled path
+#: (start_from_env with no BIGDL_METRICS_PORT must leave this untouched)
+_SERVERS_CREATED = 0
+
+_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+_STATUS: dict = {}
+_STATUS_LOCK = threading.Lock()
+_ACTIVE: Optional["MetricsExporter"] = None
+_ACTIVE_LOCK = threading.Lock()
+
+#: mirror of the serving health state machine (obs must not import serving)
+_HEALTH_CODE = {"starting": 0, "ready": 1, "degraded": 2, "draining": 3,
+                "dead": 4}
+
+#: numeric ServingEngine.stats() fields exported per tenant
+_TENANT_FIELDS = ("backlog", "queued", "active_slots", "submitted",
+                  "completed", "timeouts", "shed", "respawns",
+                  "poisoned_slots", "slot_recycles", "decode_tps")
+
+
+def register_engine(engine) -> None:
+    """Expose an engine's stats() on /metrics and /healthz (weakly held)."""
+    _ENGINES.add(engine)
+
+
+def unregister_engine(engine) -> None:
+    _ENGINES.discard(engine)
+
+
+def engines() -> list:
+    return list(_ENGINES)
+
+
+def publish_status(key: str, value) -> None:
+    """Publish a JSON-able blob under /statusz (e.g. the end-of-run report)."""
+    with _STATUS_LOCK:
+        _STATUS[key] = value
+
+
+def _san(name: str) -> str:
+    """Registry name → Prometheus metric name: train/step_wall →
+    bigdl_train_step_wall."""
+    return "bigdl_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def render_metrics() -> str:
+    """The /metrics body: one registry snapshot + per-tenant engine gauges."""
+    snap = registry.snapshot()
+    lines = []
+    for name, v in sorted(snap["counters"].items()):
+        m = _san(name) + "_total"
+        lines.append("# TYPE %s counter" % m)
+        lines.append("%s %s" % (m, _fmt(v)))
+    for name, v in sorted(snap["gauges"].items()):
+        m = _san(name)
+        lines.append("# TYPE %s gauge" % m)
+        lines.append("%s %s" % (m, _fmt(v)))
+    for name, h in sorted(snap["histograms"].items()):
+        m = _san(name)
+        lines.append("# TYPE %s summary" % m)
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            if h.get(key) is not None:
+                lines.append('%s{quantile="%s"} %s' % (m, q, _fmt(h[key])))
+        lines.append("%s_sum %s" % (m, _fmt(h["total"])))
+        lines.append("%s_count %s" % (m, _fmt(h["count"])))
+    # per-tenant serving gauges: group by field so each metric name carries
+    # exactly one TYPE line with all tenant label rows under it
+    per_field: dict = {}
+    health_rows = []
+    for eng in engines():
+        try:
+            st = eng.stats()
+        except Exception:
+            continue
+        tenant = str(st.get("name", "?"))
+        for field in _TENANT_FIELDS:
+            v = st.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                per_field.setdefault(field, []).append((tenant, v))
+        health_rows.append((tenant, _HEALTH_CODE.get(st.get("health"), -1),
+                            bool(st.get("slo_degraded"))))
+    for field in sorted(per_field):
+        m = "bigdl_serving_tenant_" + field
+        lines.append("# TYPE %s gauge" % m)
+        for tenant, v in per_field[field]:
+            lines.append('%s{tenant="%s"} %s' % (m, tenant, _fmt(v)))
+    if health_rows:
+        lines.append("# TYPE bigdl_serving_tenant_health gauge")
+        for tenant, code, _ in health_rows:
+            lines.append('bigdl_serving_tenant_health{tenant="%s"} %d'
+                         % (tenant, code))
+        lines.append("# TYPE bigdl_serving_tenant_slo_degraded gauge")
+        for tenant, _, slo in health_rows:
+            lines.append('bigdl_serving_tenant_slo_degraded{tenant="%s"} %d'
+                         % (tenant, 1 if slo else 0))
+    return "\n".join(lines) + "\n"
+
+
+def parse_metrics(text: str) -> dict:
+    """Prometheus text → ``{"name" or 'name{labels}': float}``. The inverse
+    of :func:`render_metrics` for the round-trip test and ``cli top``."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        out[key] = float(val)
+    return out
+
+
+def render_healthz() -> "tuple[int, dict]":
+    """(http status, payload) for /healthz."""
+    engs = {}
+    for eng in engines():
+        try:
+            st = eng.stats()
+        except Exception:
+            continue
+        engs[str(st.get("name", "?"))] = {
+            "health": st.get("health"),
+            "backlog": st.get("backlog"),
+            "active_slots": st.get("active_slots"),
+            "slo_degraded": bool(st.get("slo_degraded")),
+        }
+    states = [e["health"] for e in engs.values()]
+    status = "ok"
+    code = 200
+    if any(s == "dead" for s in states):
+        status, code = "dead", 503
+    elif any(s in ("degraded", "draining") for s in states):
+        status = "degraded"
+    watchdogs = [{"armed": wd.armed, "dumps": wd.dumps, "hard_s": wd.hard_s}
+                 for wd in obs_watchdog.active_watchdogs()]
+    with _STATUS_LOCK:
+        slo = _STATUS.get("slo")
+    return code, {"status": status, "engines": engs, "watchdogs": watchdogs,
+                  "slo": slo, "pid": os.getpid()}
+
+
+def render_statusz() -> dict:
+    """The /statusz payload: run report + MFU + engine ledgers + SLO."""
+    with _STATUS_LOCK:
+        status = dict(_STATUS)
+    engs = {}
+    for eng in engines():
+        try:
+            st = eng.stats()
+        except Exception:
+            continue
+        engs[str(st.get("name", "?"))] = st
+    return {"run_report": status.get("run_report"),
+            "slo": status.get("slo"),
+            "status": status,
+            "mfu": mfu.stats(),
+            "engines": engs}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 (http.server API)
+        try:
+            if self.path.startswith("/metrics"):
+                code = 200
+                body = render_metrics().encode("utf-8")
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.startswith("/healthz"):
+                code, payload = render_healthz()
+                body = json.dumps(payload, default=str).encode("utf-8")
+                ctype = "application/json"
+            elif self.path.startswith("/statusz"):
+                code = 200
+                body = json.dumps(render_statusz(),
+                                  default=str).encode("utf-8")
+                ctype = "application/json"
+            else:
+                code, body = 404, b"not found\n"
+                ctype = "text/plain"
+        except Exception as exc:  # render must never kill the server thread
+            code = 500
+            body = ("exporter error: %s\n" % exc).encode("utf-8")
+            ctype = "text/plain"
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper hung up mid-response
+
+    def log_message(self, fmt, *args):  # silence per-request stderr lines
+        pass
+
+
+class MetricsExporter:
+    """The endpoint server. ``port=0`` binds an ephemeral port (read back
+    from :attr:`port` after :meth:`start`)."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0"):
+        global _SERVERS_CREATED
+        _SERVERS_CREATED += 1
+        self.port = int(port)
+        self.host = host
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsExporter":
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="bigdl-metrics",
+            kwargs={"poll_interval": 0.2}, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def url(self) -> str:
+        return "http://127.0.0.1:%d" % self.port
+
+
+def start_from_env() -> Optional[MetricsExporter]:
+    """Start (once per process) the endpoint when ``BIGDL_METRICS_PORT`` is
+    set; return ``None`` — allocating nothing — when it is not. Safe to call
+    from every entry point (trainer, engine start, cli): idempotent."""
+    raw = os.environ.get("BIGDL_METRICS_PORT", "").strip()
+    if not raw:
+        return None
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            return _ACTIVE
+        try:
+            port = int(raw)
+        except ValueError:
+            raise ValueError(
+                "BIGDL_METRICS_PORT=%r is not an integer port" % raw)
+        _ACTIVE = MetricsExporter(port).start()
+        return _ACTIVE
+
+
+def active() -> Optional[MetricsExporter]:
+    return _ACTIVE
+
+
+def reset() -> None:
+    """Test isolation: stop the active server, drop registrations/status."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            _ACTIVE.stop()
+        _ACTIVE = None
+    _ENGINES.clear()
+    with _STATUS_LOCK:
+        _STATUS.clear()

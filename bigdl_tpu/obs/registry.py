@@ -134,20 +134,26 @@ class MetricRegistry:
         """Plain-data view for delta math and the run report:
         ``{"counters": {name: n}, "gauges": {name: v}, "histograms":
         {name: {count, total, min, max, mean, p50, p95, p99}}}``."""
+        # Every histogram field is captured under the registry lock so a
+        # concurrent observe() can never tear (count, total, min, max, window)
+        # against each other — a snapshot's mean is always total/count of the
+        # SAME instant. Sorting the window copies happens outside the lock.
         with self._lock:
             counters = {k: c.value for k, c in self._counters.items()}
             gauges = {k: g.value for k, g in self._gauges.items()
                       if g.value is not None}
-            hists = list(self._histograms.items())
+            hists = [(name, h.count, h.total, h.min, h.max, tuple(h._window))
+                     for name, h in self._histograms.items() if h.count]
         out_h = {}
-        for name, h in hists:
-            if h.count == 0:
-                continue
-            ps = h.percentiles()
+        for name, count, total, mn, mx, window in hists:
+            vals = sorted(window)
+            n = len(vals)
+            ps = {q: vals[min(n - 1, int(round(q / 100.0 * (n - 1))))]
+                  for q in (50, 95, 99)} if n else {}
             out_h[name] = {
-                "count": h.count, "total": h.total,
-                "min": h.min, "max": h.max,
-                "mean": h.total / h.count,
+                "count": count, "total": total,
+                "min": mn, "max": mx,
+                "mean": total / count,
                 "p50": ps.get(50), "p95": ps.get(95), "p99": ps.get(99),
             }
         return {"counters": counters, "gauges": gauges, "histograms": out_h}

@@ -1,0 +1,220 @@
+"""SLO monitor — windowed-percentile breach detection that gates health.
+
+Watches the metric registry the way an external alerting rule would, but
+in-process and fast enough to flip the serving health state machine before
+a load balancer notices:
+
+- **p99 TTFT** (``serving/ttft_ms`` window p99) > ``BIGDL_SLO_TTFT_MS``
+- **feed-stall rate** (``train/feed_stall`` / ``train/step_wall`` count)
+  > ``BIGDL_SLO_STALL_RATE``
+- **throughput floor** (``train/throughput`` gauge) < ``BIGDL_SLO_MIN_TPS``
+
+Each rule needs a minimum sample count before it can fire (one
+compile-polluted observation must not page anyone). A breach emits a
+``Robustness``-style event (``events.record("slo_breach", ...)`` + the
+``slo/breaches`` counter + a ``trace.event``) and flips every registered
+serving engine to ``degraded`` via ``set_slo_degraded(True)``; when all
+rules recover, the flag clears and engines return to ``ready`` on their
+next health update. ``/healthz`` and ``/statusz`` surface the state via
+:func:`bigdl_tpu.obs.exporter.publish_status`.
+
+Rules are opt-in per knob (unset = off); :meth:`SLOMonitor.check` is pure
+polling logic (tests drive it directly), :meth:`start` runs it on a daemon
+thread every ``BIGDL_SLO_INTERVAL_S`` seconds. The scripted fault site
+``slo_breach`` (``BIGDL_FAULT_PLAN=slo_breach@1``) injects a synthetic
+breach deterministically — the drill switch for the degrade path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from bigdl_tpu.obs import exporter, trace
+from bigdl_tpu.obs.registry import registry
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from None
+    return v if v > 0 else None
+
+
+class SLOMonitor:
+    """Breach detector over the process registry. Explicit limits win over
+    the ``BIGDL_SLO_*`` environment; a limit of ``None`` disables its rule."""
+
+    def __init__(self, ttft_p99_ms: Optional[float] = None,
+                 stall_rate: Optional[float] = None,
+                 min_tps: Optional[float] = None,
+                 interval_s: Optional[float] = None,
+                 min_count: int = 8):
+        self.ttft_p99_ms = (ttft_p99_ms if ttft_p99_ms is not None
+                            else _env_float("BIGDL_SLO_TTFT_MS"))
+        self.stall_rate = (stall_rate if stall_rate is not None
+                           else _env_float("BIGDL_SLO_STALL_RATE"))
+        self.min_tps = (min_tps if min_tps is not None
+                        else _env_float("BIGDL_SLO_MIN_TPS"))
+        self.interval_s = (interval_s if interval_s is not None
+                           else (_env_float("BIGDL_SLO_INTERVAL_S") or 5.0))
+        self.min_count = min_count
+        self.active: dict = {}      # rule -> current breach dict
+        self.breaches = 0           # total breach transitions (ok -> firing)
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_env(cls) -> Optional["SLOMonitor"]:
+        """A monitor when any ``BIGDL_SLO_*`` rule is configured, else
+        None."""
+        mon = cls()
+        if mon.enabled:
+            return mon
+        return None
+
+    @property
+    def enabled(self) -> bool:
+        return any(v is not None
+                   for v in (self.ttft_p99_ms, self.stall_rate, self.min_tps))
+
+    # ------------------------------------------------------------- checking
+    def _evaluate(self) -> list:
+        """Current rule violations, as ``{rule, value, limit}`` dicts."""
+        snap = registry.snapshot()
+        hists = snap["histograms"]
+        breaches = []
+        if self.ttft_p99_ms is not None:
+            h = hists.get("serving/ttft_ms")
+            if (h and h["count"] >= self.min_count
+                    and h["p99"] is not None and h["p99"] > self.ttft_p99_ms):
+                breaches.append({"rule": "ttft_p99_ms",
+                                 "value": round(h["p99"], 3),
+                                 "limit": self.ttft_p99_ms})
+        if self.stall_rate is not None:
+            steps = hists.get("train/step_wall", {}).get("count", 0)
+            stalls = snap["counters"].get("train/feed_stall", 0)
+            if steps >= self.min_count:
+                rate = stalls / steps
+                if rate > self.stall_rate:
+                    breaches.append({"rule": "feed_stall_rate",
+                                     "value": round(rate, 4),
+                                     "limit": self.stall_rate})
+        if self.min_tps is not None:
+            tps = snap["gauges"].get("train/throughput")
+            if tps is not None and tps < self.min_tps:
+                breaches.append({"rule": "throughput_floor",
+                                 "value": round(tps, 2),
+                                 "limit": self.min_tps})
+        # scripted drill: BIGDL_FAULT_PLAN=slo_breach@N forces a synthetic
+        # breach on the Nth check — exercises the degrade/recover path
+        # deterministically (lazy import: obs must not import utils eagerly)
+        try:
+            from bigdl_tpu.utils import faults
+            if faults.check_fault(faults.SITE_SLO_BREACH) is not None:
+                breaches.append({"rule": "injected", "value": 1,
+                                 "limit": 0})
+        except ImportError:
+            pass
+        return breaches
+
+    def check(self) -> list:
+        """One evaluation round: detect transitions, emit breach events,
+        flip/clear engine SLO degradation, publish state. Returns the rules
+        currently in breach."""
+        current = {b["rule"]: b for b in self._evaluate()}
+        for rule, b in current.items():
+            if rule not in self.active:
+                self.breaches += 1
+                registry.counter("slo/breaches").inc()
+                trace.event("slo_breach", **b)
+                try:  # Robustness-style breach record (lazy: no obs->utils
+                    # import cycle at module load)
+                    from bigdl_tpu.utils.robustness import events
+                    events.record("slo_breach", **b)
+                except Exception:
+                    pass
+        recovered = [r for r in self.active if r not in current]
+        for rule in recovered:
+            trace.event("slo_recovered", rule=rule)
+        self.active = current
+        degraded = bool(current)
+        for eng in exporter.engines():
+            set_flag = getattr(eng, "set_slo_degraded", None)
+            if set_flag is not None:
+                try:
+                    set_flag(degraded)
+                except Exception:
+                    pass
+        exporter.publish_status("slo", self.state())
+        return list(current.values())
+
+    def state(self) -> dict:
+        return {"enabled": self.enabled,
+                "active": list(self.active.values()),
+                "breaches": self.breaches,
+                "limits": {"ttft_p99_ms": self.ttft_p99_ms,
+                           "stall_rate": self.stall_rate,
+                           "min_tps": self.min_tps},
+                "interval_s": self.interval_s}
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "SLOMonitor":
+        if self._thread is None:
+            self._stop_evt.clear()
+            self._thread = threading.Thread(target=self._run,
+                                            name="bigdl-slo", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self.check()
+            except Exception:
+                pass  # the monitor must never take the process down
+
+
+_ACTIVE: Optional[SLOMonitor] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def start_from_env() -> Optional[SLOMonitor]:
+    """Start (once per process) the background monitor when any
+    ``BIGDL_SLO_*`` rule is configured; ``None`` — allocating nothing —
+    otherwise. Idempotent, called from every entry point (trainer start,
+    serving-engine start) the same way the exporter is."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            return _ACTIVE
+        mon = SLOMonitor.from_env()
+        if mon is None:
+            return None
+        _ACTIVE = mon.start()
+        return _ACTIVE
+
+
+def active() -> Optional[SLOMonitor]:
+    return _ACTIVE
+
+
+def reset() -> None:
+    """Test isolation: stop and drop the process-wide monitor."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        mon, _ACTIVE = _ACTIVE, None
+    if mon is not None:
+        mon.stop()

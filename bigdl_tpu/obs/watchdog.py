@@ -20,6 +20,7 @@ import sys
 import threading
 import time
 import traceback
+import weakref
 from collections import deque
 from typing import Callable, Optional
 
@@ -27,6 +28,38 @@ from bigdl_tpu.obs import trace
 
 #: ratio-rule floor — a sub-ms median must not make a 10 ms hiccup "a hang"
 _MIN_LIMIT_S = 0.25
+
+#: running watchdogs (weakly held) — /healthz reads arm state from these
+_ACTIVE: "weakref.WeakSet" = weakref.WeakSet()
+
+#: callables returning a context dict merged into every stall dump; the
+#: serving engine registers one so dumps carry the trace IDs and span trees
+#: of its in-flight requests, not just thread stacks
+_CONTEXT_PROVIDERS: list = []
+
+
+def active_watchdogs() -> list:
+    """Watchdogs whose monitor thread is currently running."""
+    return [wd for wd in _ACTIVE if wd._thread is not None]
+
+
+def add_context_provider(fn: Callable[[], dict]) -> None:
+    """Register a zero-arg callable whose dict is appended to stall dumps
+    (idempotent; provider errors are swallowed at dump time)."""
+    if fn not in _CONTEXT_PROVIDERS:
+        _CONTEXT_PROVIDERS.append(fn)
+
+
+def remove_context_provider(fn: Callable[[], dict]) -> None:
+    try:
+        _CONTEXT_PROVIDERS.remove(fn)
+    except ValueError:
+        pass
+
+
+def clear_context_providers() -> None:
+    """Test isolation."""
+    _CONTEXT_PROVIDERS.clear()
 
 
 def from_env() -> Optional["HangWatchdog"]:
@@ -76,6 +109,7 @@ class HangWatchdog:
         self._thread = threading.Thread(target=self._run,
                                         name="bigdl-watchdog", daemon=True)
         self._thread.start()
+        _ACTIVE.add(self)
 
     def stop(self) -> None:
         self._stop_evt.set()
@@ -83,6 +117,15 @@ class HangWatchdog:
         if t is not None:
             t.join(timeout=5.0)
         self._thread = None
+        _ACTIVE.discard(self)
+
+    @property
+    def armed(self) -> bool:
+        """True once a heartbeat has landed and no disarm() since — i.e. the
+        monitor would flag prolonged silence right now. /healthz surfaces
+        this so "engine idle (disarmed)" and "engine watched" are
+        distinguishable from outside the process."""
+        return self._last is not None
 
     def heartbeat(self, duration_s: Optional[float] = None) -> None:
         """Mark a completed step/window (optionally recording its wall time
@@ -144,6 +187,14 @@ class HangWatchdog:
         open-span tree (empty unless ``BIGDL_TRACE`` is on)."""
         stacks = self.thread_stacks()
         spans = trace.open_spans()
+        contexts = []
+        for provider in list(_CONTEXT_PROVIDERS):
+            try:
+                ctx = provider()
+            except Exception:
+                continue
+            if ctx:
+                contexts.append(ctx)
         lines = [
             "=" * 70,
             f"BIGDL WATCHDOG: no step completed for {elapsed:.1f}s "
@@ -158,6 +209,19 @@ class HangWatchdog:
             lines.append(f"open spans [{label}]: {chain}")
         if not spans:
             lines.append("open spans: none recorded (BIGDL_TRACE off?)")
+        for ctx in contexts:
+            who = ctx.get("engine", ctx.get("name", "?"))
+            lines.append(f"in-flight [{who}] "
+                         f"(health {ctx.get('health', '?')}):")
+            flights = ctx.get("in_flight") or []
+            for f in flights:
+                lines.append(
+                    f"  trace {f.get('trace_id')} request "
+                    f"{f.get('request_id')} slot {f.get('slot')} "
+                    f"generated {f.get('generated')} "
+                    f"age {f.get('age_ms')}ms")
+            if not flights:
+                lines.append("  (no requests in flight)")
         for label, stack in stacks.items():
             lines.append(f"--- thread {label} ---")
             lines.append(stack.rstrip())
@@ -166,6 +230,6 @@ class HangWatchdog:
         print(text, file=sys.stderr, flush=True)
         trace.event("watchdog_dump", elapsed_s=round(elapsed, 3),
                     limit_s=round(limit, 3), threads=stacks,
-                    open_spans=spans)
+                    open_spans=spans, contexts=contexts)
         if self.sink is not None:
             self.sink(text)

@@ -37,8 +37,11 @@ from bigdl_tpu.dataset.dataset import (
 from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.nn.abstractnn import AbstractModule
 from bigdl_tpu.nn.criterion import AbstractCriterion
+from bigdl_tpu.obs import exporter as obs_exporter
+from bigdl_tpu.obs import mfu as obs_mfu
 from bigdl_tpu.obs import registry as obs_registry
 from bigdl_tpu.obs import report as obs_report
+from bigdl_tpu.obs import slo as obs_slo
 from bigdl_tpu.obs import trace
 from bigdl_tpu.obs import watchdog as obs_watchdog
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
@@ -94,6 +97,15 @@ class NonFiniteLossError(RuntimeError):
         self.iteration = iteration
 
 _PUT_ALIASES_HOST: Optional[bool] = None
+
+
+def _batch_sig(*trees) -> tuple:
+    """Hashable (shape, dtype) signature of pytrees of arrays, for the
+    per-program FLOPs memo — multi-input models feed tuples of tensors, so
+    the key cannot assume a bare ``.shape``."""
+    return tuple((tuple(x.shape), str(x.dtype)) if hasattr(x, "shape")
+                 else repr(x)
+                 for x in jax.tree_util.tree_leaves(trees))
 
 
 def _device_put_may_alias() -> bool:
@@ -1320,6 +1332,15 @@ class Optimizer:
         reg = obs_registry.registry
         reg_snap0 = reg.snapshot()
         step_hist = reg.histogram("train/step_wall")
+        # live plane: bring up the /metrics endpoint and the SLO monitor
+        # (both no-ops unless their BIGDL_* knobs are set) and the
+        # per-program FLOPs memo behind the always-on MFU gauges (one ~ms
+        # cost-analysis per compiled program, cached for the Optimizer's
+        # lifetime)
+        obs_exporter.start_from_env()
+        obs_slo.start_from_env()
+        if not hasattr(self, "_flops_memo"):
+            self._flops_memo = {}
         rob_snap0 = getattr(self, "_rob_snap0", None)
         if rob_snap0 is None:  # _optimize_impl called outside optimize()
             rob_snap0 = events.snapshot()
@@ -1542,8 +1563,19 @@ class Optimizer:
                         if fired and self._preempt is not None:
                             self._preempt.wait(1.0)
                         state["neval"] += 1
+                        # window-program FLOPs for the MFU gauge: lowered once
+                        # per (program, shape) from NEW-tree avals (the old
+                        # params/mstate/ostate buffers were donated into the
+                        # dispatch above and must not be touched)
+                        wf_key = ("window", cdt, scales_key, k,
+                                  _batch_sig(inp, target))
+                        if wf_key not in self._flops_memo:
+                            self._flops_memo[wf_key] = obs_mfu.program_flops(
+                                window_fn, params, mstate, ostate, step_idx0,
+                                inp, target, base_rng)
                         now = time.perf_counter()
-                        self._obs_step(now - iter_mark, k, step_hist)
+                        self._obs_step(now - iter_mark, k, step_hist,
+                                       flops=self._flops_memo[wf_key])
                         iter_mark = now
                         if self._preempt_requested():
                             self._do_preempt(params, mstate, ostate, state,
@@ -1630,8 +1662,15 @@ class Optimizer:
                                 is not None and self._preempt is not None:
                             self._preempt.wait(1.0)
                         state["neval"] += 1
+                        sf_key = ("step", cdt, scales_key,
+                                  _batch_sig(inp, target))
+                        if sf_key not in self._flops_memo:
+                            self._flops_memo[sf_key] = obs_mfu.program_flops(
+                                step_fn, params, mstate, ostate, step_idx,
+                                inp, target, base_rng)
                         now = time.perf_counter()
-                        self._obs_step(now - iter_mark, 1, step_hist)
+                        self._obs_step(now - iter_mark, 1, step_hist,
+                                       flops=self._flops_memo[sf_key])
                         iter_mark = now
                         if self._preempt_requested():
                             self._do_preempt(params, mstate, ostate, state,
@@ -1687,6 +1726,7 @@ class Optimizer:
         state["run_report"] = run_report
         logger.info("run report:\n%s", obs_report.format_report(run_report))
         trace.event("run_report", report=run_report)
+        obs_exporter.publish_status("run_report", run_report)
         chrome = trace.export_chrome()
         if chrome is not None:
             logger.info("chrome trace written: %s (event log: %s)",
@@ -1717,14 +1757,17 @@ class Optimizer:
         return out
 
     # ------------------------------------------------------- observability
-    def _obs_step(self, wall_s: float, k: int, step_hist) -> None:
+    def _obs_step(self, wall_s: float, k: int, step_hist,
+                  flops: Optional[float] = None) -> None:
         """Per-step observability bookkeeping at a step/window boundary:
         record the per-step wall time (window wall / k) into the rolling
-        ``train/step_wall`` histogram and heartbeat the hang watchdog with
-        the whole dispatch unit's duration."""
+        ``train/step_wall`` histogram, feed the dispatch unit's model FLOPs
+        into the live ``train/mfu`` accounting, and heartbeat the hang
+        watchdog with the whole dispatch unit's duration."""
         per = wall_s / k
         for _ in range(k):
             step_hist.observe(per)
+        obs_mfu.note("train", flops, wall_s)
         wd = self._watchdog
         if wd is not None:
             wd.heartbeat(wall_s)

@@ -75,6 +75,9 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from bigdl_tpu.obs import exporter as obs_exporter
+from bigdl_tpu.obs import mfu as obs_mfu
+from bigdl_tpu.obs import slo as obs_slo
 from bigdl_tpu.obs import trace
 from bigdl_tpu.obs import watchdog as obs_watchdog
 from bigdl_tpu.obs.registry import registry
@@ -267,6 +270,14 @@ class ServingEngine:
         self._watchdog = (watchdog if watchdog is not None
                           else obs_watchdog.from_env())
         self._health = "starting"
+        self._slo_degraded = False        # set by obs.slo.SLOMonitor
+        self._prog_flops: dict = {}       # program key -> FLOPs (or None)
+        self._decode_flops: Optional[float] = None
+        self._last_prefill_flops: Optional[float] = None
+        # tail-sampling fraction: persist full span trees for the slowest
+        # BIGDL_TRACE_SAMPLE fraction of requests (>= 1.0 = all, 0 = none)
+        self._trace_sample = float(
+            os.environ.get("BIGDL_TRACE_SAMPLE", "0.05"))
         registry.gauge("serving/health").set(_HEALTH_CODE["starting"])
 
     # ------------------------------------------------------------ programs
@@ -305,7 +316,13 @@ class ServingEngine:
                         ok, st)
             return run
 
-        return self._fn(key, build)(params, state, tokens)
+        fn = self._fn(key, build)
+        out = fn(params, state, tokens)
+        if key not in self._prog_flops:   # once per bucket, post-compile
+            self._prog_flops[key] = obs_mfu.program_flops(
+                fn, params, state, tokens)
+        self._last_prefill_flops = self._prog_flops[key]
+        return out
 
     def _decode(self, params, state, tok):
         """One continuous-batch tick: (S,) last tokens → ((S,) next tokens,
@@ -324,7 +341,13 @@ class ServingEngine:
                 return (jnp.argmax(row, axis=-1).astype(jnp.int32), ok, st)
             return run
 
-        return self._fn(key, build)(params, state, tok)
+        fn = self._fn(key, build)
+        out = fn(params, state, tok)
+        if key not in self._prog_flops:   # once, after the first real call
+            self._prog_flops[key] = obs_mfu.program_flops(
+                fn, params, state, tok)
+        self._decode_flops = self._prog_flops[key]
+        return out
 
     def _assign(self, dst, src, slot, pos):
         """Scatter a prefilled batch-1 cache into decode row ``slot`` with
@@ -454,6 +477,13 @@ class ServingEngine:
                         f"engine {self.name!r} is shut down")
                 if self._watchdog is not None:
                     self._watchdog.start()
+                # live-plane wiring: the endpoint (if configured) sees this
+                # engine's stats() per tenant, and watchdog stall dumps gain
+                # the trace IDs of whatever this engine has in flight
+                obs_exporter.start_from_env()
+                obs_slo.start_from_env()
+                obs_exporter.register_engine(self)
+                obs_watchdog.add_context_provider(self._watchdog_context)
                 self._thread = threading.Thread(
                     target=self._supervise,
                     name=f"bigdl-serve-{self.name}", daemon=True)
@@ -573,6 +603,7 @@ class ServingEngine:
             "poisoned_slots": self._poisoned,
             "decode_tps": round(self._rate_tps, 3),
             "est_wait_s": round(self.estimated_wait_s(), 6),
+            "slo_degraded": self._slo_degraded,
         }
 
     # --------------------------------------------------------------- health
@@ -588,7 +619,34 @@ class ServingEngine:
             return
         pressure = self._backlog >= self.slots
         self._set_health(
-            "degraded" if (pressure or self._respawns) else "ready")
+            "degraded" if (pressure or self._respawns
+                           or self._slo_degraded) else "ready")
+
+    def set_slo_degraded(self, flag: bool) -> None:
+        """SLO-monitor hook (obs/slo.py): a breach forces health to
+        ``degraded`` until the rules recover. Safe from any thread — health
+        writes are a gauge set + event, and the decode loop re-evaluates
+        every iteration anyway."""
+        flag = bool(flag)
+        if flag == self._slo_degraded:
+            return
+        self._slo_degraded = flag
+        if self._thread is not None:
+            self._update_health()
+
+    def _watchdog_context(self) -> dict:
+        """Stall-dump context: the trace IDs + progress of every in-flight
+        request, so a wedged decode loop names WHICH requests are stuck."""
+        now = time.perf_counter()
+        inflight = []
+        for slot in self._sched.active_slots():
+            r = slot.request
+            inflight.append({
+                "trace_id": r.trace_id, "request_id": r.request_id,
+                "slot": slot.index, "generated": len(r.generated),
+                "age_ms": round((now - r.submit_t) * 1e3, 1)})
+        return {"engine": self.name, "health": self._health,
+                "in_flight": inflight}
 
     # ---------------------------------------------------------- supervisor
     def _supervise(self) -> None:
@@ -632,6 +690,7 @@ class ServingEngine:
             self._stop.set()
             self._abort_outstanding(self._pending)
             self._set_health("dead")
+            obs_watchdog.remove_context_provider(self._watchdog_context)
             if self._watchdog is not None:
                 self._watchdog.stop()
 
@@ -752,12 +811,13 @@ class ServingEngine:
         self._timeouts += 1
         registry.counter("serving/timeouts").inc()
         events.record("serving_timeout", engine=self.name,
-                      request_id=req.request_id, in_slot=in_slot,
-                      generated=len(req.generated))
+                      request_id=req.request_id, trace_id=req.trace_id,
+                      in_slot=in_slot, generated=len(req.generated))
         req.handle._fail(RequestTimeout(
             f"request {req.request_id} missed its deadline "
             f"({'mid-decode' if in_slot else 'while queued'}, "
-            f"{len(req.generated)} tokens generated)"))
+            f"{len(req.generated)} tokens generated) "
+            f"[trace {req.trace_id}]"))
         if not in_slot:
             self._backlog_dec()
 
@@ -818,17 +878,21 @@ class ServingEngine:
         padded[0, :clen] = ctx
         try:
             fault_point(faults.SITE_SERVE_PREFILL)
+            pre_t0 = time.perf_counter()
             with trace.span("serve/prefill",
-                            {"bucket": lb, "slot": slot.index}):
+                            {"bucket": lb, "slot": slot.index,
+                             "trace_id": req.trace_id}):
                 next_all, ok, filled = self._prefill(
                     self._params, self._pre_state0, jnp.asarray(padded))
                 if not bool(np.asarray(ok)):
                     raise NonFiniteLogitsError(
                         f"non-finite logits prefilling request "
-                        f"{req.request_id}")
+                        f"{req.request_id} [trace {req.trace_id}]")
                 self._dec_state = self._assign(
                     self._dec_state, filled, slot.index, clen)
                 nxt = int(np.asarray(next_all)[0, clen - 1])
+            obs_mfu.note("serve", self._last_prefill_flops,
+                         time.perf_counter() - pre_t0)
         except (FaultError, NonFiniteLogitsError) as e:
             # this request fails loudly; the decode grid was never touched,
             # so co-batched slots are unaffected
@@ -836,10 +900,12 @@ class ServingEngine:
                 self._poisoned += 1
                 registry.counter("serving/poisoned_slots").inc()
                 events.record("serving_poisoned_slot", engine=self.name,
-                              request_id=req.request_id, phase="prefill")
+                              request_id=req.request_id,
+                              trace_id=req.trace_id, phase="prefill")
             else:
                 events.record("serving_prefill_failed", engine=self.name,
-                              request_id=req.request_id, error=str(e))
+                              request_id=req.request_id,
+                              trace_id=req.trace_id, error=str(e))
             logger.error("engine %r: request %r failed in prefill: %s",
                          self.name, req.request_id, e)
             req.handle._fail(e)
@@ -890,6 +956,7 @@ class ServingEngine:
             inst = len(active) / dt
             self._rate_tps = (inst if self._rate_tps == 0.0
                               else 0.8 * self._rate_tps + 0.2 * inst)
+            obs_mfu.note("serve", self._decode_flops, dt)
         if self._watchdog is not None:
             self._watchdog.heartbeat(dt)
         for slot in active:
@@ -912,15 +979,15 @@ class ServingEngine:
         self._poisoned += 1
         registry.counter("serving/poisoned_slots").inc()
         events.record("serving_poisoned_slot", engine=self.name,
-                      request_id=req.request_id, phase="decode",
-                      slot=slot.index)
+                      request_id=req.request_id, trace_id=req.trace_id,
+                      phase="decode", slot=slot.index)
         logger.error(
             "engine %r: non-finite logits in slot %d (request %r); "
             "failing the request and resetting the row",
             self.name, slot.index, req.request_id)
         req.handle._fail(NonFiniteLogitsError(
             f"non-finite logits decoding request {req.request_id} "
-            f"(slot {slot.index})"))
+            f"(slot {slot.index}) [trace {req.trace_id}]"))
         self._dec_state = self._reset_row(self._dec_state, slot.index)
         self._sched.release(slot)
 
@@ -943,7 +1010,49 @@ class ServingEngine:
         n = result.n_generated
         self._tok_per_req = (float(n) if self._tok_per_req == 0.0
                              else 0.8 * self._tok_per_req + 0.2 * n)
+        self._maybe_persist_trace(req, result)
         self._sched.release(slot)
+
+    def _maybe_persist_trace(self, req: Request, result) -> None:
+        """Tail sampling: persist the request's span tree to the JSONL log
+        only when it lands in the slowest ``BIGDL_TRACE_SAMPLE`` fraction of
+        the ``serving/e2e_ms`` window (the request's own observation is
+        already in the window). Keeps the log a gallery of outliers, not a
+        firehose; ``>= 1.0`` persists every request."""
+        if trace.jsonl_path() is None:
+            return
+        frac = self._trace_sample
+        if frac <= 0:
+            return
+        e2e_ms = result.latency_s * 1e3
+        if frac < 1.0:
+            q = max(0.0, min(100.0, 100.0 * (1.0 - frac)))
+            ps = registry.histogram("serving/e2e_ms").percentiles((q,))
+            thr = ps.get(q)
+            if thr is not None and e2e_ms < thr:
+                return
+        t0 = req.submit_t
+
+        def ms(a, b):
+            return round((b - a) * 1e3, 3)
+
+        spans = []
+        if req.admit_t is not None:
+            spans.append({"name": "serve/queue", "start_ms": 0.0,
+                          "dur_ms": ms(t0, req.admit_t)})
+        if req.admit_t is not None and req.first_token_t is not None:
+            spans.append({"name": "serve/prefill",
+                          "start_ms": ms(t0, req.admit_t),
+                          "dur_ms": ms(req.admit_t, req.first_token_t)})
+        if req.first_token_t is not None:
+            end_t = t0 + result.latency_s
+            spans.append({"name": "serve/decode",
+                          "start_ms": ms(t0, req.first_token_t),
+                          "dur_ms": ms(req.first_token_t, end_t)})
+        trace.event("request_trace", trace_id=req.trace_id,
+                    request_id=req.request_id, engine=self.name,
+                    e2e_ms=round(e2e_ms, 3), n_generated=result.n_generated,
+                    finish=result.finish_reason, spans=spans)
 
     def _abort_outstanding(self, pending: list) -> None:
         err = self._failure or EngineShutdown(

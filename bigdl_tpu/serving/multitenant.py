@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from bigdl_tpu.obs import exporter as obs_exporter
 from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.request import RequestHandle
 
@@ -48,6 +49,10 @@ class SnapshotServer:
             kw.update(per_model.get(name, {}))
             kw.setdefault("max_len", max_len)
             self._engines[name] = ServingEngine(model, name=name, **kw)
+            # per-tenant rows on /metrics and /healthz exist from
+            # construction (engines also self-register at start(), but a
+            # tenant that has not seen traffic yet should still be visible)
+            obs_exporter.register_engine(self._engines[name])
 
     @property
     def snapshots(self) -> tuple:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from typing import Optional
 
 import numpy as np
@@ -23,12 +24,16 @@ FINISH_LENGTH = "length"    # max_new_tokens generated
 class CompletedRequest:
     """Immutable result of one served request."""
 
-    __slots__ = ("request_id", "tokens", "prompt_len", "n_generated",
-                 "finish_reason", "queue_wait_s", "ttft_s", "latency_s")
+    __slots__ = ("request_id", "trace_id", "tokens", "prompt_len",
+                 "n_generated", "finish_reason", "queue_wait_s", "ttft_s",
+                 "latency_s")
 
     def __init__(self, request_id, tokens, prompt_len, n_generated,
-                 finish_reason, queue_wait_s, ttft_s, latency_s):
+                 finish_reason, queue_wait_s, ttft_s, latency_s,
+                 trace_id=None):
         self.request_id = request_id
+        #: the request-scoped trace ID (the key into the JSONL span log)
+        self.trace_id = trace_id
         #: full sequence, prompt + generated, np.int32 (prompt_len + n_generated,)
         self.tokens = tokens
         self.prompt_len = prompt_len
@@ -95,13 +100,18 @@ class Request:
     """Engine-internal request record. Mutable fields are touched only by
     the engine thread after submission."""
 
-    __slots__ = ("request_id", "prompt", "max_new_tokens", "submit_t",
-                 "admit_t", "first_token_t", "deadline_t", "generated",
-                 "handle")
+    __slots__ = ("request_id", "trace_id", "prompt", "max_new_tokens",
+                 "submit_t", "admit_t", "first_token_t", "deadline_t",
+                 "generated", "handle")
 
     def __init__(self, request_id, prompt: np.ndarray, max_new_tokens: int,
                  deadline_s: Optional[float] = None):
         self.request_id = request_id
+        #: request-scoped trace ID: stamped at submission, propagated through
+        #: queue → prefill → decode → completion spans, attached to timeout/
+        #: poison errors and watchdog dumps, and the lookup key for
+        #: ``bigdl-tpu diag --trace``
+        self.trace_id = uuid.uuid4().hex[:16]
         self.prompt = prompt                      # np.int32 (prompt_len,)
         self.max_new_tokens = int(max_new_tokens)
         self.submit_t = time.perf_counter()
@@ -136,6 +146,7 @@ class Request:
                           if self.admit_t is not None else None),
             ttft_s=(self.first_token_t - self.submit_t
                     if self.first_token_t is not None else None),
-            latency_s=now - self.submit_t)
+            latency_s=now - self.submit_t,
+            trace_id=self.trace_id)
         self.handle._complete(result)
         return result

@@ -51,6 +51,10 @@ site                      where it fires
 ``cache_write``           a decoded-sample-cache build write — fails that
                           write, abandoning the build (training continues
                           uncached)
+``slo_breach``            the SLO monitor's check round (``obs/slo.py``) —
+                          reports a synthetic breach, flipping registered
+                          serving engines to ``degraded`` and back on the
+                          next clean check: the degrade-path drill switch
 ========================  ====================================================
 
 A plan is a ``;``-separated list of entries ``site@N`` or ``site@N=action``.
@@ -92,6 +96,10 @@ SITE_SERVE_THREAD = "serve_thread"
 SITE_SERVE_STALL = "serve_stall"
 SITE_CACHE_READ = "cache_read"
 SITE_CACHE_WRITE = "cache_write"
+#: SLO-monitor drill: a firing entry makes the next SLOMonitor.check()
+#: report a synthetic breach — exercises the breach → degraded → recovered
+#: path without manufacturing real latency (docs/observability.md)
+SITE_SLO_BREACH = "slo_breach"
 
 #: sites whose plan entries match the caller-supplied ``index`` (training
 #: iteration) instead of the site's hit counter
@@ -111,6 +119,7 @@ _DEFAULT_ACTION = {
     SITE_SERVE_STALL: "stall",
     SITE_CACHE_READ: "error",
     SITE_CACHE_WRITE: "error",
+    SITE_SLO_BREACH: "error",
 }
 
 _KNOWN_ACTIONS = frozenset({"error", "death", "nan", "sigterm", "torn",

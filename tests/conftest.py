@@ -27,7 +27,7 @@ def _fresh_engine():
     """Reset Engine + RNG (and the obs tracer/registry sinks) between tests
     for determinism."""
     yield
-    from bigdl_tpu.obs import trace
+    from bigdl_tpu.obs import exporter, mfu, slo, trace, watchdog
     from bigdl_tpu.obs.registry import registry as obs_registry
     from bigdl_tpu.utils.engine import Engine
     from bigdl_tpu.utils.random_generator import RandomGenerator
@@ -36,3 +36,7 @@ def _fresh_engine():
     RandomGenerator.set_seed(1)
     trace.reset()
     obs_registry.reset()
+    mfu.reset()
+    slo.reset()
+    exporter.reset()
+    watchdog.clear_context_providers()

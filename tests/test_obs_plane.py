@@ -1,0 +1,463 @@
+"""Operational observability plane (`make t1-obs`): the live /metrics
+endpoint, request-scoped trace IDs, always-on MFU accounting, and SLO
+monitors (docs/observability.md).
+
+The load-bearing contracts:
+
+- `registry.snapshot()` never tears a histogram under concurrent observers
+  (total is EXACTLY count x value for a constant stream).
+- `/metrics` is valid Prometheus text that `parse_metrics` round-trips,
+  stays parseable under concurrent scrape spam, and carries per-tenant
+  serving rows for every registered engine.
+- With `BIGDL_METRICS_PORT` unset the exporter allocates NOTHING
+  (`_SERVERS_CREATED` pin, mirroring the tracer's zero-alloc test).
+- A request's trace ID survives admission -> queue -> prefill -> decode ->
+  completion, rides timeout errors, tail-samples its span tree to the
+  JSONL log, and is recoverable via `bigdl-tpu diag --trace <id>`.
+- An SLO breach flips serving health to `degraded` and recovery restores
+  `ready`; the scripted `slo_breach` fault site drills the same path.
+- `mfu.program_flops` agrees with XLA cost analysis asked directly, and
+  the published gauges satisfy mfu x peak == flops/sec.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import Engine, cli, nn
+from bigdl_tpu.dataset import DataSet
+from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
+from bigdl_tpu.models.transformerlm import TransformerLM
+from bigdl_tpu.obs import exporter, mfu, slo, trace, watchdog
+from bigdl_tpu.obs.registry import MetricRegistry, registry as obs_registry
+from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+from bigdl_tpu.serving import RequestTimeout, ServingEngine, SnapshotServer
+from bigdl_tpu.utils import faults
+from bigdl_tpu.utils.random_generator import RandomGenerator
+
+pytestmark = pytest.mark.obs
+
+VOCAB = 50
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return TransformerLM(VOCAB, embed_dim=16, num_heads=2, num_layers=2,
+                         max_len=48).evaluate()
+
+
+def _prompt(seed, n):
+    return np.random.default_rng(seed).integers(0, VOCAB, (n,)).astype(np.int32)
+
+
+def _train(n_iter=8, seed=3):
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                      np.int32(rng.integers(0, 3))) for _ in range(64)]
+    ds = DataSet.array(samples) >> SampleToMiniBatch(16)
+    Engine.reset()
+    RandomGenerator.set_seed(1)
+    Engine.init(seed=seed)
+    model = nn.Sequential().add(nn.Linear(8, 3)).add(nn.LogSoftMax())
+    opt = (LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(learningrate=0.1))
+           .set_end_when(Trigger.max_iteration(n_iter)))
+    opt.optimize()
+    return opt
+
+
+def _wait(pred, timeout=30, what="condition"):
+    deadline = time.perf_counter() + timeout
+    while not pred():
+        if time.perf_counter() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.01)
+
+
+# ----------------------------------------------- registry snapshot tearing
+class TestSnapshotConsistency:
+    def test_snapshot_never_tears_histogram(self):
+        # writers observe the CONSTANT 5.0; any snapshot whose total is not
+        # exactly count * 5.0 mixed fields from two different instants
+        reg = MetricRegistry()
+        stop = threading.Event()
+
+        def writer():
+            h = reg.histogram("t/h")
+            c = reg.counter("t/c")
+            while not stop.is_set():
+                h.observe(5.0)
+                c.inc()
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            bad = []
+            for _ in range(300):
+                snap = reg.snapshot()
+                h = snap["histograms"].get("t/h")
+                if h is None:
+                    continue
+                if h["total"] != h["count"] * 5.0:
+                    bad.append((h["count"], h["total"]))
+                assert h["min"] == h["max"] == 5.0
+                assert h["mean"] == 5.0
+            assert not bad, f"torn snapshots: {bad[:5]}"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+
+
+# -------------------------------------------------- Prometheus exposition
+class TestPrometheusText:
+    def _populate(self):
+        obs_registry.counter("train/feed_stall").inc(3)
+        obs_registry.gauge("train/throughput").set(812.5)
+        h = obs_registry.histogram("train/step_wall")
+        for v in (0.010, 0.012, 0.014, 0.020):
+            h.observe(v)
+
+    def test_render_parse_round_trip(self):
+        self._populate()
+        text = exporter.render_metrics()
+        parsed = exporter.parse_metrics(text)
+        assert parsed["bigdl_train_feed_stall_total"] == 3
+        assert parsed["bigdl_train_throughput"] == 812.5
+        assert parsed["bigdl_train_step_wall_count"] == 4
+        assert parsed["bigdl_train_step_wall_sum"] == pytest.approx(0.056)
+        assert parsed['bigdl_train_step_wall{quantile="0.5"}'] == 0.014
+        assert parsed['bigdl_train_step_wall{quantile="0.99"}'] == 0.020
+
+    def test_line_format_and_unique_type_lines(self):
+        self._populate()
+        text = exporter.render_metrics()
+        assert text.endswith("\n")
+        sample_re = re.compile(
+            r'^[a-zA-Z_][a-zA-Z0-9_]*(\{[^{}]*\})? -?[0-9][0-9a-zA-Z.+-]*$')
+        type_names = []
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                type_names.append(line.split()[2])
+            else:
+                assert sample_re.match(line), f"malformed line: {line!r}"
+        assert len(type_names) == len(set(type_names)), "duplicate TYPE lines"
+
+    def test_per_tenant_rows_from_snapshot_server(self, lm):
+        srv = SnapshotServer({"flag": lm, "cheap": lm}, max_len=48,
+                             slots=2, buckets=(8,))
+        # tenants are visible from CONSTRUCTION, before any traffic
+        parsed = exporter.parse_metrics(exporter.render_metrics())
+        for tenant in ("flag", "cheap"):
+            assert f'bigdl_serving_tenant_health{{tenant="{tenant}"}}' in parsed
+            assert parsed[
+                f'bigdl_serving_tenant_completed{{tenant="{tenant}"}}'] == 0
+        with srv:
+            srv.submit("flag", _prompt(0, 5), 3).result(timeout=120)
+            parsed = exporter.parse_metrics(exporter.render_metrics())
+            assert parsed[
+                'bigdl_serving_tenant_completed{tenant="flag"}'] == 1
+            assert parsed['bigdl_serving_tenant_health{tenant="flag"}'] == 1
+
+
+# -------------------------------------------------------- endpoint server
+class TestEndpoint:
+    def test_concurrent_scrapes_under_spam(self):
+        obs_registry.counter("spam/hits").inc()
+        ex = exporter.MetricsExporter(0).start()
+        try:
+            errors = []
+            bodies = []
+            lock = threading.Lock()
+
+            def scrape():
+                for _ in range(10):
+                    try:
+                        with urllib.request.urlopen(ex.url + "/metrics",
+                                                    timeout=10) as r:
+                            assert r.status == 200
+                            body = r.read().decode()
+                        with lock:
+                            bodies.append(body)
+                    except Exception as e:  # noqa: BLE001
+                        with lock:
+                            errors.append(repr(e))
+
+            threads = [threading.Thread(target=scrape) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors[:3]
+            assert len(bodies) == 60
+            for body in bodies:
+                assert exporter.parse_metrics(body)[
+                    "bigdl_spam_hits_total"] >= 1
+        finally:
+            ex.stop()
+
+    def test_healthz_statusz_and_404(self):
+        exporter.publish_status("run_report", {"steps": 40})
+        ex = exporter.MetricsExporter(0).start()
+        try:
+            with urllib.request.urlopen(ex.url + "/healthz", timeout=10) as r:
+                assert r.status == 200
+                payload = json.loads(r.read().decode())
+            assert payload["status"] == "ok"
+            assert payload["engines"] == {}
+            assert isinstance(payload["watchdogs"], list)
+            with urllib.request.urlopen(ex.url + "/statusz", timeout=10) as r:
+                statusz = json.loads(r.read().decode())
+            assert statusz["run_report"] == {"steps": 40}
+            assert "mfu" in statusz
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(ex.url + "/nope", timeout=10)
+            assert exc.value.code == 404
+        finally:
+            ex.stop()
+
+    def test_zero_alloc_when_port_unset(self, monkeypatch):
+        monkeypatch.delenv("BIGDL_METRICS_PORT", raising=False)
+        created = exporter._SERVERS_CREATED
+        for _ in range(5):
+            assert exporter.start_from_env() is None
+        assert exporter._SERVERS_CREATED == created
+        assert exporter.active() is None
+
+    def test_start_from_env_idempotent(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_METRICS_PORT", "0")
+        a = exporter.start_from_env()
+        b = exporter.start_from_env()
+        assert a is b is exporter.active()
+        with urllib.request.urlopen(a.url + "/metrics", timeout=10) as r:
+            assert r.status == 200
+
+
+# --------------------------------------------------- request-scoped traces
+class TestTraceIDs:
+    def test_trace_id_propagation_spans_and_diag(self, lm, tmp_path,
+                                                 monkeypatch, capsys):
+        monkeypatch.setenv("BIGDL_TRACE_SAMPLE", "1.0")  # persist everything
+        log = str(tmp_path / "events.jsonl")
+        trace.configure(jsonl=log)
+        with ServingEngine(lm, max_len=48, slots=2, buckets=(8,)) as eng:
+            results = [eng.submit(_prompt(i, 5), 3).result(timeout=120)
+                       for i in range(3)]
+        ids = {r.trace_id for r in results}
+        assert len(ids) == 3
+        assert all(re.fullmatch(r"[0-9a-f]{16}", t) for t in ids)
+        traced = {ev["trace_id"]: ev for ev in trace.read_events(log)
+                  if ev["kind"] == "request_trace"}
+        assert ids <= set(traced)
+        for tid in ids:
+            ev = traced[tid]
+            names = [s["name"] for s in ev["spans"]]
+            assert names == ["serve/queue", "serve/prefill", "serve/decode"]
+            for s in ev["spans"]:
+                assert s["dur_ms"] >= 0
+        # the acceptance path: the operator recovers a request by ID
+        tid = sorted(ids)[0]
+        assert cli.main(["diag", log, "--trace", tid]) == 0
+        out = capsys.readouterr().out
+        assert tid in out and "serve/prefill" in out
+        # by request id too, and a miss is rc 1
+        assert cli.main(["diag", log, "--trace",
+                         results[0].request_id]) == 0
+        capsys.readouterr()
+        assert cli.main(["diag", log, "--trace", "deadbeef"]) == 1
+
+    def test_timeout_error_carries_trace_id(self, lm):
+        with ServingEngine(lm, max_len=48, slots=1, buckets=(8,)) as eng:
+            h = eng.submit(_prompt(9, 5), 3, deadline_ms=0.01)
+            with pytest.raises(RequestTimeout) as exc:
+                h.result(timeout=120)
+        assert re.search(r"trace [0-9a-f]{16}", str(exc.value))
+
+
+# ----------------------------------------------------------- SLO monitors
+class TestSLOMonitor:
+    def test_breach_degrades_serving_and_recovers(self, lm):
+        with ServingEngine(lm, max_len=48, slots=2, buckets=(8,)) as eng:
+            eng.submit(_prompt(1, 5), 2).result(timeout=120)
+            _wait(lambda: eng.stats()["health"] == "ready", what="ready")
+            mon = slo.SLOMonitor(ttft_p99_ms=0.001, min_count=1)
+            breached = mon.check()
+            assert [b["rule"] for b in breached] == ["ttft_p99_ms"]
+            assert mon.breaches == 1
+            assert eng.stats()["slo_degraded"] is True
+            _wait(lambda: eng.stats()["health"] == "degraded",
+                  what="degraded health")
+            snap = obs_registry.snapshot()
+            assert snap["counters"]["slo/breaches"] == 1
+            # /healthz reflects the degradation and the breach state
+            code, payload = exporter.render_healthz()
+            assert code == 200 and payload["status"] == "degraded"
+            assert payload["slo"]["active"][0]["rule"] == "ttft_p99_ms"
+            parsed = exporter.parse_metrics(exporter.render_metrics())
+            assert parsed[
+                f'bigdl_serving_tenant_slo_degraded{{tenant="{eng.name}"}}'] == 1
+            # recovery: the offending window clears -> engines return ready
+            obs_registry.reset()
+            assert mon.check() == []
+            assert eng.stats()["slo_degraded"] is False
+            _wait(lambda: eng.stats()["health"] == "ready",
+                  what="recovered health")
+            assert mon.breaches == 1  # transitions, not polls
+
+    def test_injected_fault_site_drills_breach(self):
+        mon = slo.SLOMonitor(min_tps=1.0)  # rule present but not firing
+        with faults.inject_faults("slo_breach@1"):
+            breached = mon.check()
+        assert [b["rule"] for b in breached] == ["injected"]
+        assert mon.check() == []  # entry fires once -> recovered
+
+    def test_from_env_and_background_thread(self, monkeypatch):
+        monkeypatch.delenv("BIGDL_SLO_TTFT_MS", raising=False)
+        assert slo.SLOMonitor.from_env() is None
+        assert slo.start_from_env() is None
+        monkeypatch.setenv("BIGDL_SLO_TTFT_MS", "50")
+        monkeypatch.setenv("BIGDL_SLO_INTERVAL_S", "0.02")
+        mon = slo.start_from_env()
+        assert mon is not None and mon is slo.start_from_env()
+        assert mon.ttft_p99_ms == 50.0
+        h = obs_registry.histogram("serving/ttft_ms")
+        for _ in range(10):
+            h.observe(500.0)
+        _wait(lambda: mon.active, timeout=10, what="background breach")
+        assert mon.active["ttft_p99_ms"]["limit"] == 50.0
+
+
+# ----------------------------------------------------------- MFU accounting
+class TestMFU:
+    def test_program_flops_matches_direct_cost_analysis(self):
+        import jax
+
+        fn = jax.jit(lambda a, b: a @ b)
+        a = np.ones((32, 16), np.float32)
+        b = np.ones((16, 8), np.float32)
+        got = mfu.program_flops(fn, a, b)
+        lowered = fn.lower(jax.ShapeDtypeStruct(a.shape, a.dtype),
+                           jax.ShapeDtypeStruct(b.shape, b.dtype))
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        direct = float(cost["flops"])
+        assert got == pytest.approx(direct)
+        assert got >= 2 * 32 * 16 * 8 * 0.9  # a matmul's arithmetic floor
+
+    def test_gauges_consistent_with_peak(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_PEAK_FLOPS", "1e9")
+        mfu.note("train", 5e8, 1.0)
+        snap = obs_registry.snapshot()
+        fps = snap["gauges"]["train/model_flops_per_sec"]
+        assert fps == pytest.approx(5e8)
+        assert snap["gauges"]["train/mfu"] * mfu.device_peak() \
+            == pytest.approx(fps)
+        st = mfu.stats()
+        assert st["peak_flops"] == 1e9
+        assert st["mfu"]["train"] == pytest.approx(0.5)
+
+    def test_unknown_flops_publish_nothing(self):
+        mfu.note("train", None, 1.0)
+        mfu.note("train", 0.0, 1.0)
+        snap = obs_registry.snapshot()
+        assert "train/model_flops_per_sec" not in snap["gauges"]
+        assert "train/mfu" not in snap["gauges"]
+
+    def test_train_run_publishes_live_mfu_gauges(self, monkeypatch):
+        # end-to-end wiring: a real optimize() loop feeds the EWMA each
+        # dispatch and the statusz surface carries the run report
+        monkeypatch.setenv("BIGDL_PEAK_FLOPS", "1e12")
+        opt = _train(n_iter=8)
+        snap = obs_registry.snapshot()
+        assert snap["gauges"]["train/model_flops_per_sec"] > 0
+        assert 0 < snap["gauges"]["train/mfu"] < 1
+        statusz = exporter.render_statusz()
+        assert statusz["run_report"] is not None
+        assert statusz["run_report"] == opt.state["run_report"]
+        assert statusz["mfu"]["flops_per_sec"]["train"] > 0
+
+
+# ----------------------------------------------------- watchdog integration
+class TestWatchdogPlane:
+    def test_armed_state_and_healthz_listing(self):
+        wd = watchdog.HangWatchdog(hard_s=5.0, poll_s=0.05, sink=lambda s: None)
+        wd.start()
+        try:
+            assert wd.armed is False  # compile phase: no heartbeat yet
+            _, payload = exporter.render_healthz()
+            assert payload["watchdogs"] == [
+                {"armed": False, "dumps": 0, "hard_s": 5.0}]
+            wd.heartbeat(0.01)
+            assert wd.armed is True
+            _, payload = exporter.render_healthz()
+            assert payload["watchdogs"][0]["armed"] is True
+        finally:
+            wd.stop()
+        assert exporter.render_healthz()[1]["watchdogs"] == []
+
+    def test_dump_includes_in_flight_trace_ids(self, lm):
+        dumps = []
+        wd = watchdog.HangWatchdog(hard_s=0.15, poll_s=0.02,
+                                   sink=dumps.append)
+        with ServingEngine(lm, max_len=48, slots=1, buckets=(8,)) as eng:
+            # park one request in flight long enough for the dump to see it
+            h = eng.submit(_prompt(3, 5), 40)
+            _wait(lambda: eng.stats()["active_slots"] == 1, what="in flight")
+            wd.start()
+            try:
+                wd.heartbeat(0.01)
+                _wait(lambda: dumps, timeout=10, what="watchdog dump")
+            finally:
+                wd.stop()
+            text = dumps[0]
+            assert f"in-flight [{eng.name}]" in text
+            m = re.search(r"trace ([0-9a-f]{16})", text)
+            assert m is not None
+            result = h.result(timeout=120)
+            assert m.group(1) == result.trace_id
+
+
+# ------------------------------------------------------------ cli dashboard
+class TestCliTop:
+    def test_render_top_pure(self):
+        metrics = {
+            "bigdl_train_mfu": 0.31,
+            "bigdl_train_model_flops_per_sec": 3.2e12,
+            "bigdl_train_throughput": 1998.2,
+            'bigdl_serving_tenant_backlog{tenant="flag"}': 2.0,
+            'bigdl_serving_tenant_completed{tenant="flag"}': 17.0,
+            'bigdl_serving_tenant_decode_tps{tenant="flag"}': 412.3,
+        }
+        health = {"status": "degraded",
+                  "engines": {"flag": {"health": "degraded"}},
+                  "watchdogs": [{"armed": True}],
+                  "slo": {"active": [{"rule": "ttft_p99_ms"}]}}
+        out = cli._render_top(metrics, health)
+        assert "status degraded" in out
+        assert "SLO BREACH ttft_p99_ms" in out
+        assert "mfu 0.31" in out
+        assert "flag" in out and "done 17" in out and "tps 412.3" in out
+
+    def test_top_once_against_live_exporter(self, capsys):
+        obs_registry.gauge("train/throughput").set(77.0)
+        ex = exporter.MetricsExporter(0).start()
+        try:
+            assert cli.main(["top", "--port", str(ex.port), "--once"]) == 0
+        finally:
+            ex.stop()
+        out = capsys.readouterr().out
+        assert "bigdl-tpu top" in out
+        assert "throughput 77.0" in out
+
+    def test_top_without_port_is_an_error(self, monkeypatch, capsys):
+        monkeypatch.delenv("BIGDL_METRICS_PORT", raising=False)
+        assert cli.main(["top", "--once"]) == 2
+        assert "BIGDL_METRICS_PORT" in capsys.readouterr().err

@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming t1-fleet dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -64,6 +64,15 @@ t1-serving-faults:
 t1-streaming:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m streaming --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
+# Serving-fleet suite only (docs/serving.md "Fleet"): replica router bitwise
+# vs solo engine, zero-lost under scripted replica_down/drain churn, prefix
+# KV-cache pool hit/evict determinism (programs ledger stays flat), and
+# speculative decoding bitwise vs plain greedy at 0% and 100% acceptance.
+# Unmarked-slow, so `make t1` runs these too; this is the fast inner loop
+# for fleet work.
+t1-fleet:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fleet --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
 dist:
 	bash make-dist.sh
 
@@ -81,6 +90,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --model lenet --obs-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --kernel-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --serving-bench --no-compare-dtypes --no-streamed
+	JAX_PLATFORMS=cpu python bench.py --fleet-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --stream-bench --no-compare-dtypes --no-streamed
 
 # Host input-pipeline leg (decode→augment→stack on a synthetic image folder):

@@ -1446,6 +1446,173 @@ def _measure_serving_bench(n_requests: int = 24, slots: int = 8,
     }
 
 
+def _measure_fleet_bench(n_requests: int = 24, replicas: int = 2,
+                         max_new: int = 16) -> dict:
+    """Serving-fleet leg, three questions (docs/serving.md "Fleet"):
+
+    1. **Churn throughput**: sustained req/s through an N-replica
+       :class:`FleetRouter` with a scripted mid-run ``replica_down`` kill
+       (retry-elsewhere recovers every affected request — zero lost) vs the
+       same traffic through one replica.
+    2. **Prefix reuse**: TTFT over shared-prefix traffic with the prefix
+       KV-cache pool warm vs cold — warm hits skip re-prefill, so warm p50
+       TTFT should be well under half of cold.
+    3. **Speculative decode**: tokens/s with the target drafting for
+       itself (acceptance PINNED at 100% — the upper bound of the win) vs
+       plain engine decode, measured acceptance reported.
+
+    A fault plan that does not fully fire, a lost request, or an acceptance
+    off its pin stamps the degraded-record contract instead of passing
+    quietly."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.models.transformerlm import TransformerLM
+    from bigdl_tpu.obs.registry import registry
+    from bigdl_tpu.serving import FleetRouter, ServingEngine
+    from bigdl_tpu.utils.faults import inject_faults
+
+    dev = jax.devices()[0]
+    buckets = (16, 32, 48)
+    max_len = 64 + max_new + 4      # +4: speculative overshoot headroom
+    lm = TransformerLM(1000, embed_dim=64, num_heads=4, num_layers=2,
+                       max_len=max_len).evaluate()
+    rng = np.random.default_rng(0)
+    reqs = [rng.integers(0, 1000, (int(rng.integers(4, 49)),))
+            .astype(np.int32) for _ in range(n_requests)]
+    off_script = []
+
+    def warm(submit):
+        # compile + warm every prefill bucket so timed windows are
+        # compile-free (programs live on the shared model apply cache)
+        for plen in (8, 24, 40):
+            submit(np.arange(plen, dtype=np.int32) % 1000,
+                   max_new).result(timeout=300)
+
+    # ---- leg 1: fleet under churn vs one replica -------------------------
+    with ServingEngine(lm, max_len=max_len, buckets=buckets) as eng:
+        warm(eng.submit)
+        t0 = time.perf_counter()
+        for h in [eng.submit(p, max_new) for p in reqs]:
+            h.result(timeout=300)
+        solo_rps = n_requests / (time.perf_counter() - t0)
+
+    kill_at = n_requests // 2
+    fleet = FleetRouter.replicate(lm, max_len=max_len, replicas=replicas,
+                                  buckets=buckets)
+    try:
+        warm(fleet.submit)
+        with inject_faults(f"replica_down@{kill_at}") as plan:
+            t0 = time.perf_counter()
+            lost = 0
+            for h in [fleet.submit(p, max_new) for p in reqs]:
+                try:
+                    h.result(timeout=300)
+                except Exception:  # noqa: BLE001 — a loss is the metric
+                    lost += 1
+            churn_wall = time.perf_counter() - t0
+            unfired = plan.unfired()
+        churn_rps = n_requests / churn_wall
+        fleet_stats = {k: v for k, v in fleet.stats().items()
+                       if k != "replicas"}
+    finally:
+        fleet.shutdown()
+    if unfired:
+        off_script.append(f"fleet churn plan unfired: {unfired}")
+    if lost:
+        off_script.append(f"fleet churn lost {lost} requests (want 0)")
+
+    # ---- leg 2: shared-prefix TTFT, pool warm vs cold --------------------
+    shared = rng.integers(0, 1000, (40,)).astype(np.int32)
+    tails = [rng.integers(0, 1000, (4,)).astype(np.int32)
+             for _ in range(8)]
+
+    def ttft_p50(pool):
+        with ServingEngine(lm, max_len=max_len, buckets=buckets,
+                           prefix_pool=pool, prefix_chunk=8) as eng:
+            warm(eng.submit)
+            eng.submit(shared, 1).result(timeout=300)   # pools the prefix
+            registry.reset()
+            for t in tails:
+                eng.submit(np.concatenate([shared, t]),
+                           max_new).result(timeout=300)
+            snap = registry.snapshot()
+            st = eng.stats()
+        h = snap["histograms"].get("serving/ttft_ms", {})
+        return h.get("p50"), st
+    cold_ttft, _ = ttft_p50(pool=0)
+    warm_ttft, pool_stats = ttft_p50(pool=8)
+    prefix_ratio = (round(warm_ttft / cold_ttft, 3)
+                    if warm_ttft and cold_ttft else None)
+    if not pool_stats["prefix_hits"]:
+        off_script.append("prefix leg saw zero pool hits")
+
+    # ---- leg 3: speculative tokens/s at pinned acceptance ----------------
+    from bigdl_tpu.serving.speculative import SpeculativeDecoder
+    spec_prompt = np.stack([rng.integers(0, 1000, (8,)) for _ in range(4)]
+                           ).astype(np.int32)
+    decode_len = 32
+
+    from bigdl_tpu import nn as _nn
+    _ = _nn.greedy_generate(lm, spec_prompt, decode_len)      # compile
+    t0 = time.perf_counter()
+    _ = _nn.greedy_generate(lm, spec_prompt, decode_len)
+    plain_tps = 4 * decode_len / (time.perf_counter() - t0)
+
+    sd = SpeculativeDecoder(lm, lm, spec_tokens=4)
+    sd.generate(spec_prompt, decode_len)                      # compile
+    sd = SpeculativeDecoder(lm, lm, spec_tokens=4)
+    t0 = time.perf_counter()
+    sd.generate(spec_prompt, decode_len)
+    spec_tps = 4 * decode_len / (time.perf_counter() - t0)
+    acceptance = sd.stats()["acceptance_rate"]
+    if acceptance != 1.0:
+        off_script.append(
+            f"self-draft acceptance {acceptance} (want 1.0)")
+
+    record_extra = {}
+    if off_script:
+        reason = "fleet bench off-script: " + "; ".join(off_script)
+        print(f"bench: DEGRADED RUN — {reason}", file=sys.stderr)
+        record_extra = {"degraded": True, "probe_error": reason}
+    return {
+        "value": round(churn_rps, 2),
+        "unit": "req/sec",
+        "n_requests": n_requests,
+        "replicas": replicas,
+        "max_new_tokens": max_new,
+        "buckets": list(buckets),
+        # leg 1 — churn
+        "fleet_requests_per_sec_churn": round(churn_rps, 2),
+        "solo_requests_per_sec": round(solo_rps, 2),
+        "churn_vs_solo": (round(churn_rps / solo_rps, 2)
+                          if solo_rps else None),
+        "fault_plan": f"replica_down@{kill_at}",
+        "fault_plan_fired": not unfired,
+        "requests_lost": lost,
+        "fleet_retries": fleet_stats["retries"],
+        "fleet_replica_downs": fleet_stats["replica_downs"],
+        # leg 2 — prefix reuse
+        "ttft_ms_p50_cold": (round(cold_ttft, 2)
+                             if cold_ttft is not None else None),
+        "ttft_ms_p50_warm": (round(warm_ttft, 2)
+                             if warm_ttft is not None else None),
+        "warm_cold_ttft_ratio": prefix_ratio,
+        "prefix_hits": pool_stats["prefix_hits"],
+        "prefix_tokens_saved": pool_stats["prefix_tokens_saved"],
+        # leg 3 — speculative decode
+        "spec_tokens_per_sec": round(spec_tps, 1),
+        "plain_tokens_per_sec": round(plain_tps, 1),
+        "spec_vs_plain": (round(spec_tps / plain_tps, 2)
+                          if plain_tps else None),
+        "spec_acceptance": acceptance,
+        "spec_k": 4,
+        "device_kind": dev.device_kind,
+        "platform": dev.platform,
+        **record_extra,
+    }
+
+
 def _measure_ablation(model_name: str, batch: int, iters: int) -> dict:
     """Step-time attribution (the committed profile analysis): time the full
     compiled train step and its sub-programs — forward-only, forward+backward,
@@ -1777,6 +1944,7 @@ def run_orchestrator(args) -> None:
     kernel_bench = getattr(args, "kernel_bench", False)
     precision_bench = getattr(args, "precision_bench", False)
     serving_bench = getattr(args, "serving_bench", False)
+    fleet_bench = getattr(args, "fleet_bench", False)
     worker_argv = ["--run", "--model", args.model, "--batch", str(args.batch),
                    "--iters", str(args.iters), "--warmup", str(args.warmup),
                    "--dtype", args.dtype]
@@ -1805,6 +1973,8 @@ def run_orchestrator(args) -> None:
         worker_argv.append("--precision-bench")
     if serving_bench:
         worker_argv.append("--serving-bench")
+    if fleet_bench:
+        worker_argv.append("--fleet-bench")
     env = dict(os.environ)
     # Fast-fail: one cheap bounded probe decides whether the accelerator
     # backend answers AT ALL before any full measurement attempt is allowed
@@ -1834,7 +2004,8 @@ def run_orchestrator(args) -> None:
                     and not args.eval_bench and not pipeline_bench \
                     and not stream_bench and not obs_bench \
                     and not kernel_bench \
-                    and not precision_bench and not serving_bench:
+                    and not precision_bench and not serving_bench \
+                    and not fleet_bench:
                 # the comparison leg only feeds the ratio — skip its streamed
                 # measurement (it would be discarded)
                 cmp_argv = ["--run", "--model", args.model,
@@ -1872,7 +2043,8 @@ def run_orchestrator(args) -> None:
 
     if args.int8_infer or args.serving or args.decode_infer or args.ablate \
             or args.eval_bench or pipeline_bench or stream_bench \
-            or obs_bench or kernel_bench or precision_bench or serving_bench:
+            or obs_bench or kernel_bench or precision_bench \
+            or serving_bench or fleet_bench:
         # a LeNet training number would not answer an inference-path request:
         # fail loudly with the metric the caller asked for
         kind = ("int8_vs_bf16_infer" if args.int8_infer
@@ -1885,6 +2057,7 @@ def run_orchestrator(args) -> None:
                 else "kernel_bench" if kernel_bench
                 else "precision_bench" if precision_bench
                 else "serving_engine" if serving_bench
+                else "serving_fleet" if fleet_bench
                 else "step_ablation")
         record = {
             "metric": f"{args.model}_{kind}",
@@ -2001,6 +2174,13 @@ def main(argv=None):
                         "sustained req/s vs the one-request-at-a-time "
                         "baseline, TTFT/per-token p50/p99, compile-count "
                         "assertion proving prefill-bucket reuse")
+    p.add_argument("--fleet-bench", dest="fleet_bench",
+                   action="store_true",
+                   help="serving-fleet leg: N-replica router req/s under "
+                        "scripted replica_down churn (zero lost) vs one "
+                        "replica, shared-prefix TTFT with the prefix "
+                        "KV-cache pool warm vs cold, speculative-decode "
+                        "tokens/s at pinned 100% acceptance vs plain")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
     args = p.parse_args(argv)
@@ -2056,6 +2236,10 @@ def _run_worker_modes(args) -> int:
     elif getattr(args, "serving_bench", False):
         res = _measure_serving_bench()
         res["metric"] = "transformerlm_serving_engine"
+        res["vs_baseline"] = None
+    elif getattr(args, "fleet_bench", False):
+        res = _measure_fleet_bench()
+        res["metric"] = "transformerlm_serving_fleet"
         res["vs_baseline"] = None
     elif args.ablate:
         res = _measure_ablation(args.model, args.batch,

@@ -225,6 +225,46 @@ def _render_top(metrics: dict, health=None) -> str:
                 f" timeouts {t.get('timeouts', 0):.0f}"
                 f" shed {t.get('shed', 0):.0f}"
                 f" tps {t.get('decode_tps', 0):.1f}")
+    fleets: dict = {}
+    fpat = re.compile(r'^bigdl_fleet_(\w+)\{fleet="([^"]*)"\}$')
+    rpat = re.compile(
+        r'^bigdl_fleet_replica_(\w+)\{fleet="([^"]*)",replica="([^"]*)"\}$')
+    for key, val in metrics.items():
+        m = fpat.match(key)
+        if m:
+            fleets.setdefault(m.group(2), {"replicas": {}})[m.group(1)] = val
+    replicas: dict = {}
+    for key, val in metrics.items():
+        m = rpat.match(key)
+        if m:
+            replicas.setdefault(
+                (m.group(2), m.group(3)), {})[m.group(1)] = val
+    for (fname, rname), r in replicas.items():
+        fleets.setdefault(fname, {"replicas": {}})["replicas"][rname] = r
+    if fleets:
+        hfleets = (health or {}).get("fleets") or {}
+        for fname in sorted(fleets):
+            f = fleets[fname]
+            lines.append(
+                f"  fleet {fname}"
+                f" · healthy {f.get('healthy_replicas', 0):.0f}"
+                f"/{len(f['replicas']) or f.get('healthy_replicas', 0):.0f}"
+                f" · dispatched {f.get('dispatched', 0):.0f}"
+                f" retries {f.get('retries', 0):.0f}"
+                f" downs {f.get('replica_downs', 0):.0f}"
+                f" rejected {f.get('rejected', 0):.0f}")
+            hreps = (hfleets.get(fname) or {}).get("replicas") or {}
+            for rname in sorted(f["replicas"]):
+                r = f["replicas"][rname]
+                state = hreps.get(rname, "?")
+                lines.append(
+                    f"    {rname:<12} {state:<10}"
+                    f" queue {r.get('queue_depth', 0):.0f}"
+                    f" active {r.get('active_slots', 0):.0f}"
+                    f" done {r.get('completed', 0):.0f}"
+                    f" shed {r.get('shed', 0):.0f}"
+                    f" wait {r.get('est_wait_ms', 0):.0f}ms"
+                    f" tps {r.get('decode_rate', 0):.1f}")
     return "\n".join(lines)
 
 

@@ -8,10 +8,16 @@ in-process telemetry rails into a scrapeable plane:
   summaries with p50/p95/p99 ``quantile`` labels + ``_sum``/``_count``,
   gauges as-is), plus per-tenant serving gauges labelled
   ``{tenant="<engine name>"}`` fed live from each registered
-  ``ServingEngine.stats()`` — the feed the ROADMAP's fleet router scrapes.
+  ``ServingEngine.stats()`` — the feed the fleet router dispatches off —
+  and, per registered :class:`~bigdl_tpu.serving.fleet.FleetRouter`,
+  router counters ``{fleet=...}`` plus per-replica load/health gauges
+  ``{fleet=...,replica=...}``.
 - ``/healthz`` — the serving health state machine per engine, watchdog arm
-  state (armed / disarmed, dump count), and SLO breach state. HTTP 503 when
-  any engine is ``dead``, 200 otherwise — load-balancer-pollable.
+  state (armed / disarmed, dump count), SLO breach state, and per-fleet
+  replica health. HTTP 503 when any engine is ``dead``, 200 otherwise —
+  load-balancer-pollable. A dead REPLICA whose fleet still has a healthy
+  peer degrades the fleet instead of 503ing the process: the router is
+  routing around it, which is the design working, not an outage.
 - ``/statusz`` — JSON status: the latest run report (published by the
   trainer at end of run), MFU accounting, full engine ledgers, SLO state.
 
@@ -45,6 +51,7 @@ from bigdl_tpu.obs.registry import registry
 _SERVERS_CREATED = 0
 
 _ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+_FLEETS: "weakref.WeakSet" = weakref.WeakSet()
 _STATUS: dict = {}
 _STATUS_LOCK = threading.Lock()
 _ACTIVE: Optional["MetricsExporter"] = None
@@ -57,7 +64,18 @@ _HEALTH_CODE = {"starting": 0, "ready": 1, "degraded": 2, "draining": 3,
 #: numeric ServingEngine.stats() fields exported per tenant
 _TENANT_FIELDS = ("backlog", "queued", "active_slots", "submitted",
                   "completed", "timeouts", "shed", "respawns",
-                  "poisoned_slots", "slot_recycles", "decode_tps")
+                  "poisoned_slots", "slot_recycles", "decode_tps",
+                  "queue_depth", "decode_rate", "est_wait_ms",
+                  "prefix_hits", "prefix_tokens_saved", "spec_acceptance")
+
+#: numeric per-replica fields exported under {fleet=...,replica=...} — the
+#: router's own dispatch signal, scrapeable by external load balancers
+_REPLICA_FIELDS = ("queue_depth", "active_slots", "est_wait_ms",
+                   "decode_rate", "completed", "shed")
+
+#: numeric FleetRouter.stats() counters exported under {fleet=...}
+_FLEET_FIELDS = ("healthy_replicas", "dispatched", "retries",
+                 "replica_downs", "rejected")
 
 
 def register_engine(engine) -> None:
@@ -71,6 +89,20 @@ def unregister_engine(engine) -> None:
 
 def engines() -> list:
     return list(_ENGINES)
+
+
+def register_fleet(fleet) -> None:
+    """Expose a FleetRouter's stats() — router counters and per-replica
+    gauges — on /metrics, /healthz, /statusz (weakly held)."""
+    _FLEETS.add(fleet)
+
+
+def unregister_fleet(fleet) -> None:
+    _FLEETS.discard(fleet)
+
+
+def fleets() -> list:
+    return list(_FLEETS)
 
 
 def publish_status(key: str, value) -> None:
@@ -143,6 +175,44 @@ def render_metrics() -> str:
         for tenant, _, slo in health_rows:
             lines.append('bigdl_serving_tenant_slo_degraded{tenant="%s"} %d'
                          % (tenant, 1 if slo else 0))
+    # fleet router counters {fleet=...} + per-replica gauges
+    # {fleet=...,replica=...}: same group-by-field layout as tenants
+    fleet_rows: dict = {}
+    rep_rows: dict = {}
+    rep_health: list = []
+    for fl in fleets():
+        try:
+            st = fl.stats()
+        except Exception:
+            continue
+        fname = str(st.get("name", "?"))
+        for field in _FLEET_FIELDS:
+            v = st.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                fleet_rows.setdefault(field, []).append((fname, v))
+        for rname, rst in sorted(st.get("replicas", {}).items()):
+            for field in _REPLICA_FIELDS:
+                v = rst.get(field)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    rep_rows.setdefault(field, []).append((fname, rname, v))
+            rep_health.append(
+                (fname, rname, _HEALTH_CODE.get(rst.get("health"), -1)))
+    for field in sorted(fleet_rows):
+        m = "bigdl_fleet_" + field
+        lines.append("# TYPE %s gauge" % m)
+        for fname, v in fleet_rows[field]:
+            lines.append('%s{fleet="%s"} %s' % (m, fname, _fmt(v)))
+    for field in sorted(rep_rows):
+        m = "bigdl_fleet_replica_" + field
+        lines.append("# TYPE %s gauge" % m)
+        for fname, rname, v in rep_rows[field]:
+            lines.append('%s{fleet="%s",replica="%s"} %s'
+                         % (m, fname, rname, _fmt(v)))
+    if rep_health:
+        lines.append("# TYPE bigdl_fleet_replica_health gauge")
+        for fname, rname, code in rep_health:
+            lines.append('bigdl_fleet_replica_health{fleet="%s",'
+                         'replica="%s"} %d' % (fname, rname, code))
     return "\n".join(lines) + "\n"
 
 
@@ -160,7 +230,10 @@ def parse_metrics(text: str) -> dict:
 
 
 def render_healthz() -> "tuple[int, dict]":
-    """(http status, payload) for /healthz."""
+    """(http status, payload) for /healthz. A dead engine 503s the process
+    UNLESS it is a fleet replica with a healthy peer — the router is
+    routing around it (the fleet block below shows which), so the process
+    still serves."""
     engs = {}
     for eng in engines():
         try:
@@ -173,19 +246,39 @@ def render_healthz() -> "tuple[int, dict]":
             "active_slots": st.get("active_slots"),
             "slo_degraded": bool(st.get("slo_degraded")),
         }
-    states = [e["health"] for e in engs.values()]
+    fleet_block = {}
+    covered: set = set()   # replica names whose fleet still has a healthy peer
+    for fl in fleets():
+        try:
+            st = fl.stats()
+        except Exception:
+            continue
+        reps = {rn: rs.get("health")
+                for rn, rs in st.get("replicas", {}).items()}
+        healthy = int(st.get("healthy_replicas", 0))
+        fleet_block[str(st.get("name", "?"))] = {
+            "replicas": reps, "healthy_replicas": healthy}
+        if healthy > 0:
+            covered.update(reps)
+    states = [(name, e["health"]) for name, e in engs.items()]
+    # fleet replicas count even when the engine never started (lazy start
+    # means it never self-registered) — the fleet block is the only place
+    # such a replica's death is visible
+    fleet_states = [(rn, h) for fb in fleet_block.values()
+                    for rn, h in fb["replicas"].items()]
     status = "ok"
     code = 200
-    if any(s == "dead" for s in states):
+    if any(s == "dead" and name not in covered for name, s in states):
         status, code = "dead", 503
-    elif any(s in ("degraded", "draining") for s in states):
+    elif any(s in ("dead", "degraded", "draining")
+             for _, s in states + fleet_states):
         status = "degraded"
     watchdogs = [{"armed": wd.armed, "dumps": wd.dumps, "hard_s": wd.hard_s}
                  for wd in obs_watchdog.active_watchdogs()]
     with _STATUS_LOCK:
         slo = _STATUS.get("slo")
-    return code, {"status": status, "engines": engs, "watchdogs": watchdogs,
-                  "slo": slo, "pid": os.getpid()}
+    return code, {"status": status, "engines": engs, "fleets": fleet_block,
+                  "watchdogs": watchdogs, "slo": slo, "pid": os.getpid()}
 
 
 def render_statusz() -> dict:
@@ -199,11 +292,19 @@ def render_statusz() -> dict:
         except Exception:
             continue
         engs[str(st.get("name", "?"))] = st
+    fls = {}
+    for fl in fleets():
+        try:
+            st = fl.stats()
+        except Exception:
+            continue
+        fls[str(st.get("name", "?"))] = st
     return {"run_report": status.get("run_report"),
             "slo": status.get("slo"),
             "status": status,
             "mfu": mfu.stats(),
-            "engines": engs}
+            "engines": engs,
+            "fleets": fls}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -313,5 +414,6 @@ def reset() -> None:
             _ACTIVE.stop()
         _ACTIVE = None
     _ENGINES.clear()
+    _FLEETS.clear()
     with _STATUS_LOCK:
         _STATUS.clear()

@@ -6,24 +6,36 @@ decode batch with per-slot cache depths, recycling a finished sequence's
 KV-cache row to the next waiting request mid-flight. Programs compile once
 per (prefill-bucket | decode | assign) grid point; per-request TTFT and
 per-token latency publish through the obs metric registry.
+
+The fleet layer multiplies that engine: :class:`FleetRouter` dispatches
+least-loaded over N replicas with retry-elsewhere (``serving/fleet.py``),
+:class:`PrefixPool` lets shared prompt prefixes skip re-prefill
+(``serving/prefix_cache.py``), and :class:`SpeculativeDecoder` /
+``ServingEngine(draft_model=...)`` run greedy speculative decoding with
+bitwise-identical output (``serving/speculative.py``).
 """
 
 from bigdl_tpu.serving.engine import (
     EngineOverloaded, EngineShutdown, EngineShutdownTimeout,
     NonFiniteLogitsError, RequestTimeout, ServingEngine,
 )
+from bigdl_tpu.serving.fleet import FleetExhausted, FleetHandle, FleetRouter
 from bigdl_tpu.serving.multitenant import SnapshotServer
+from bigdl_tpu.serving.prefix_cache import PrefixEntry, PrefixPool
 from bigdl_tpu.serving.request import (
     FINISH_EOS, FINISH_LENGTH, CompletedRequest, RequestHandle,
 )
 from bigdl_tpu.serving.scheduler import (
-    SlotScheduler, default_buckets, pick_bucket,
+    SlotScheduler, default_buckets, pick_bucket, pick_seed_bucket,
 )
+from bigdl_tpu.serving.speculative import SpeculativeDecoder
 
 __all__ = [
     "CompletedRequest", "EngineOverloaded", "EngineShutdown",
     "EngineShutdownTimeout", "FINISH_EOS", "FINISH_LENGTH",
-    "NonFiniteLogitsError", "RequestHandle", "RequestTimeout",
-    "ServingEngine", "SlotScheduler", "SnapshotServer",
-    "default_buckets", "pick_bucket",
+    "FleetExhausted", "FleetHandle", "FleetRouter",
+    "NonFiniteLogitsError", "PrefixEntry", "PrefixPool", "RequestHandle",
+    "RequestTimeout", "ServingEngine", "SlotScheduler", "SnapshotServer",
+    "SpeculativeDecoder", "default_buckets", "pick_bucket",
+    "pick_seed_bucket",
 ]

@@ -81,11 +81,15 @@ from bigdl_tpu.obs import slo as obs_slo
 from bigdl_tpu.obs import trace
 from bigdl_tpu.obs import watchdog as obs_watchdog
 from bigdl_tpu.obs.registry import registry
+from bigdl_tpu.serving.prefix_cache import PrefixPool
 from bigdl_tpu.serving.request import (
     FINISH_EOS, FINISH_LENGTH, Request, RequestHandle,
 )
 from bigdl_tpu.serving.scheduler import (
-    SlotScheduler, default_buckets, pick_bucket,
+    SlotScheduler, default_buckets, pick_bucket, pick_seed_bucket,
+)
+from bigdl_tpu.serving.speculative import (
+    build_spec_prefill, build_spec_step,
 )
 from bigdl_tpu.utils import faults
 from bigdl_tpu.utils.faults import FaultError, check_fault, fault_point
@@ -118,13 +122,18 @@ class RequestTimeout(RuntimeError):
 class EngineOverloaded(RuntimeError):
     """``submit`` rejected under BIGDL_SERVE_OVERLOAD=shed: the backlog is
     at capacity, or the token-rate estimate says the request cannot meet its
-    deadline. Carries ``queue_depth`` and ``est_wait_s`` so clients can back
-    off or retry elsewhere."""
+    deadline. Carries the same machine-readable load triple ``stats()``
+    publishes — ``queue_depth`` / ``decode_rate`` / ``est_wait_ms`` (plus
+    the legacy ``est_wait_s``) — so the fleet router and external load
+    balancers dispatch off data, not exception strings."""
 
-    def __init__(self, msg: str, queue_depth: int, est_wait_s: float):
+    def __init__(self, msg: str, queue_depth: int, est_wait_s: float,
+                 decode_rate: float = 0.0):
         super().__init__(msg)
         self.queue_depth = queue_depth
         self.est_wait_s = est_wait_s
+        self.est_wait_ms = est_wait_s * 1e3
+        self.decode_rate = decode_rate
 
 
 class EngineShutdownTimeout(RuntimeError):
@@ -171,6 +180,16 @@ class ServingEngine:
     (BIGDL_SERVE_DRAIN_S, default 30).
     ``watchdog``: a :class:`~bigdl_tpu.obs.watchdog.HangWatchdog` to arm on
     decode-loop silence (default: built from BIGDL_WATCHDOG_S, often None).
+    ``draft_model``: a small proposer LM over the same vocabulary — turns
+    every decode tick into a speculative draft-verify round emitting 1..k+1
+    tokens (``serving/speculative.py``), bitwise-identical output;
+    ``spec_tokens`` is k (BIGDL_SPEC_TOKENS, default 4). With a draft, each
+    request additionally needs ``prompt_len + max_new_tokens + spec_tokens
+    <= max_len`` of cache headroom.
+    ``prefix_pool``: entries of resident prefilled-prefix cache
+    (``serving/prefix_cache.py``; BIGDL_PREFIX_POOL, default 0 = off) with
+    ``prefix_chunk``-aligned keys (BIGDL_PREFIX_CHUNK, default 16) — shared
+    prompt prefixes then seed new slots instead of re-prefilling.
     """
 
     def __init__(self, model, max_len: int, slots: Optional[int] = None,
@@ -183,6 +202,9 @@ class ServingEngine:
                  crash_budget: Optional[int] = None,
                  drain_s: Optional[float] = None,
                  watchdog: Optional["obs_watchdog.HangWatchdog"] = None,
+                 draft_model=None, spec_tokens: Optional[int] = None,
+                 prefix_pool: Optional[int] = None,
+                 prefix_chunk: Optional[int] = None,
                  dtype=None, name: str = "serve"):
         import jax.numpy as jnp
 
@@ -220,6 +242,17 @@ class ServingEngine:
             crash_budget = _env_int("BIGDL_SERVE_CRASH_BUDGET", 2)
         if drain_s is None:
             drain_s = float(os.environ.get("BIGDL_SERVE_DRAIN_S", "30"))
+        if spec_tokens is None:
+            spec_tokens = (_env_int("BIGDL_SPEC_TOKENS", 4)
+                           if draft_model is not None else 0)
+        if draft_model is not None and spec_tokens < 1:
+            raise ValueError(
+                f"spec_tokens must be >= 1 with a draft model, "
+                f"got {spec_tokens}")
+        if prefix_pool is None:
+            prefix_pool = _env_int("BIGDL_PREFIX_POOL", 0)
+        if prefix_chunk is None:
+            prefix_chunk = _env_int("BIGDL_PREFIX_CHUNK", 16)
         self._model = model
         self._nn = nn
         self.name = name
@@ -244,6 +277,29 @@ class ServingEngine:
         self._pre_state0 = nn.install_decode_cache(
             model, 1, self.max_len, dtype=self._dtype, per_slot=True)
         nn.clear_decode_cache(model)
+        # speculative decoding: the draft model gets a MIRROR slot grid +
+        # batch-1 prefill state so both caches move through admission,
+        # decode, and recovery in lock-step (serving/speculative.py)
+        self._draft = draft_model
+        self._spec = int(spec_tokens) if draft_model is not None else 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        if draft_model is not None:
+            self._params_d = draft_model.get_params()
+            self._dec_state_d = nn.install_decode_cache(
+                draft_model, self.slots, self.max_len, dtype=self._dtype,
+                per_slot=True)
+            nn.clear_decode_cache(draft_model)
+            self._pre_state0_d = nn.install_decode_cache(
+                draft_model, 1, self.max_len, dtype=self._dtype,
+                per_slot=True)
+            nn.clear_decode_cache(draft_model)
+        else:
+            self._params_d = None
+            self._dec_state_d = None
+            self._pre_state0_d = None
+        self._prefix = (PrefixPool(prefix_pool, prefix_chunk)
+                        if prefix_pool and prefix_pool > 0 else None)
 
         self._queue: ClosableQueue = ClosableQueue(queue_depth)
         self._sched = SlotScheduler(self.slots)
@@ -324,6 +380,40 @@ class ServingEngine:
         self._last_prefill_flops = self._prog_flops[key]
         return out
 
+    def _prefill_spec(self, state, state_d, tokens):
+        """Speculative form of :meth:`_prefill`: ONE fused program per
+        bucket runs the target prefill AND fills the draft's cache from the
+        same tokens, so speculation adds no ledger entries — the per-bucket
+        prefill key simply becomes the fused one."""
+        lb = tokens.shape[1]
+        key = ("serve_prefill_spec", id(self._draft), lb, self.max_len,
+               self._dtype_name())
+        fn = self._fn(key, lambda: build_spec_prefill(
+            self._model, self._draft))
+        out = fn(self._params, self._params_d, state, state_d, tokens)
+        if key not in self._prog_flops:
+            self._prog_flops[key] = obs_mfu.program_flops(
+                fn, self._params, self._params_d, state, state_d, tokens)
+        self._last_prefill_flops = self._prog_flops[key]
+        return out
+
+    def _spec_step(self, tok):
+        """One draft-propose / chunk-verify / accept / rewind round over
+        the whole slot grid — the speculative engine's single decode
+        program (replaces ``serve_decode`` in the ledger)."""
+        key = ("serve_spec_step", id(self._draft), self.slots, self.max_len,
+               self._spec, self._dtype_name())
+        fn = self._fn(key, lambda: build_spec_step(
+            self._model, self._draft, self._spec))
+        out = fn(self._params, self._params_d, self._dec_state,
+                 self._dec_state_d, tok)
+        if key not in self._prog_flops:
+            self._prog_flops[key] = obs_mfu.program_flops(
+                fn, self._params, self._params_d, self._dec_state,
+                self._dec_state_d, tok)
+        self._decode_flops = self._prog_flops[key]
+        return out
+
     def _decode(self, params, state, tok):
         """One continuous-batch tick: (S,) last tokens → ((S,) next tokens,
         (S,) per-slot all-finite flags) — the non-finite guard rides the
@@ -349,50 +439,95 @@ class ServingEngine:
         self._decode_flops = self._prog_flops[key]
         return out
 
-    def _assign(self, dst, src, slot, pos):
-        """Scatter a prefilled batch-1 cache into decode row ``slot`` with
-        TRUE prompt length ``pos`` — one program for every slot index."""
-        key = ("serve_assign", self.slots, self.max_len, self._dtype_name())
+    def _assign(self, states, slot, pos):
+        """Scatter prefilled batch-1 cache(s) into decode row ``slot`` with
+        TRUE prompt length ``pos`` — one program for every slot index.
+        ``states`` is ``(filled,)`` or ``(filled, filled_draft)``; with a
+        draft model the fused program scatters BOTH grids, keeping the
+        ledger at one assign entry."""
         nn = self._nn
+        if self._spec:
+            key = ("serve_assign_spec", id(self._draft), self.slots,
+                   self.max_len, self._dtype_name())
 
-        def build():
-            def run(dst, src, slot, pos):
-                return nn.assign_cache_slot(dst, src, slot, pos=pos)
-            return run
+            def build():
+                def run(dst, src, dst_d, src_d, slot, pos):
+                    return (nn.assign_cache_slot(dst, src, slot, pos=pos),
+                            nn.assign_cache_slot(dst_d, src_d, slot,
+                                                 pos=pos))
+                return run
 
-        return self._fn(key, build)(dst, src, slot, pos)
+            self._dec_state, self._dec_state_d = self._fn(key, build)(
+                self._dec_state, states[0], self._dec_state_d, states[1],
+                slot, pos)
+        else:
+            key = ("serve_assign", self.slots, self.max_len,
+                   self._dtype_name())
 
-    def _reset_row(self, state, slot):
+            def build():
+                def run(dst, src, slot, pos):
+                    return nn.assign_cache_slot(dst, src, slot, pos=pos)
+                return run
+
+            self._dec_state = self._fn(key, build)(
+                self._dec_state, states[0], slot, pos)
+
+    def _reset_row(self, slot):
         """Wipe one poisoned cache row (K/V + position) before the slot is
-        reused. Fault-path only — never compiled on a clean run, so the
-        clean-run program bound stays ``len(buckets) + 2``."""
-        key = ("serve_reset", self.slots, self.max_len, self._dtype_name())
+        reused — both grids when a draft model rides along. Fault-path only
+        — never compiled on a clean run, so the clean-run program bound
+        stays ``len(buckets) + 2``."""
         nn = self._nn
+        if self._spec:
+            key = ("serve_reset_spec", id(self._draft), self.slots,
+                   self.max_len, self._dtype_name())
 
-        def build():
-            def run(state, slot):
-                return nn.reset_decode_slot(state, slot)
-            return run
+            def build():
+                def run(state, state_d, slot):
+                    return (nn.reset_decode_slot(state, slot),
+                            nn.reset_decode_slot(state_d, slot))
+                return run
 
-        return self._fn(key, build)(state, slot)
+            self._dec_state, self._dec_state_d = self._fn(key, build)(
+                self._dec_state, self._dec_state_d, slot)
+        else:
+            key = ("serve_reset", self.slots, self.max_len,
+                   self._dtype_name())
+
+            def build():
+                def run(state, slot):
+                    return nn.reset_decode_slot(state, slot)
+                return run
+
+            self._dec_state = self._fn(key, build)(self._dec_state, slot)
 
     # ------------------------------------------------------------- clients
     def submit(self, prompt, max_new_tokens: int, request_id=None,
-               deadline_ms: Optional[float] = None) -> RequestHandle:
+               deadline_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Enqueue one request; returns immediately with a handle. Raises
         ``ValueError`` for requests that can never fit (cache length or
         bucket grid), ``EngineShutdown`` after :meth:`shutdown`, and
         ``EngineOverloaded`` under shed-mode pressure. ``deadline_ms``
-        overrides the engine default (0 = no deadline)."""
+        overrides the engine default (0 = no deadline). ``trace_id``
+        (optional) reuses a caller-minted trace — the fleet router's
+        retry-elsewhere path, where one trace must follow the request
+        across replicas."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        if prompt.size + max_new_tokens > self.max_len:
+        if prompt.size + max_new_tokens + self._spec > self.max_len:
+            # the spec headroom is a hard bound: a verify chunk writes k+1
+            # cache rows past the current depth, and dynamic_update_slice
+            # CLAMPS out-of-bounds writes onto earlier positions
+            spec_note = (f" + spec_tokens {self._spec}" if self._spec
+                         else "")
             raise ValueError(
-                f"prompt_len {prompt.size} + max_new_tokens {max_new_tokens} "
+                f"prompt_len {prompt.size} + max_new_tokens {max_new_tokens}"
+                f"{spec_note} "
                 f"exceeds the engine's cache length max_len={self.max_len}")
         if pick_bucket(prompt.size, self.buckets) is None:
             raise ValueError(
@@ -425,7 +560,7 @@ class ServingEngine:
         if request_id is None:
             request_id = self._submitted
         req = Request(request_id, prompt, max_new_tokens,
-                      deadline_s=deadline_s)
+                      deadline_s=deadline_s, trace_id=trace_id)
         self.start()
         with self._backlog_lock:
             self._backlog += 1
@@ -450,7 +585,8 @@ class ServingEngine:
         raise EngineOverloaded(
             f"engine {self.name!r} overloaded: backlog {depth} "
             f"(queue_depth {self.queue_depth}), estimated wait "
-            f"{est * 1e3:.0f} ms", queue_depth=depth, est_wait_s=est)
+            f"{est * 1e3:.0f} ms", queue_depth=depth, est_wait_s=est,
+            decode_rate=self._rate_tps)
 
     def estimated_wait_s(self) -> float:
         """Backlog drain estimate from the decode token-rate EWMA: backlog ×
@@ -543,6 +679,11 @@ class ServingEngine:
         else:
             self._stop.set()
             self._queue.close(drain=True)
+            if self._thread is None:
+                # never started (lazy start): no supervisor will ever run
+                # its finally-block, so flip health here — a fleet router
+                # must see this replica as dead, not forever "starting"
+                self._set_health("dead")
         t = self._thread
         if wait and t is not None and t is not threading.current_thread() \
                 and t is not self._worker:
@@ -604,6 +745,30 @@ class ServingEngine:
             "decode_tps": round(self._rate_tps, 3),
             "est_wait_s": round(self.estimated_wait_s(), 6),
             "slo_degraded": self._slo_degraded,
+            # machine-readable load triple — the fleet router's dispatch
+            # signal and the EngineOverloaded payload, same numbers
+            "queue_depth": self._backlog,
+            "decode_rate": round(self._rate_tps, 3),
+            "est_wait_ms": round(self.estimated_wait_s() * 1e3, 3),
+            # speculative decoding (0s when no draft model)
+            "spec_tokens": self._spec,
+            "spec_proposed": self._spec_proposed,
+            "spec_accepted": self._spec_accepted,
+            "spec_acceptance": round(
+                self._spec_accepted / self._spec_proposed, 4)
+            if self._spec_proposed else 0.0,
+            # prefix KV-cache pool (0s when the pool is off; ``is not None``
+            # matters — an EMPTY pool is falsy via __len__ but still counts)
+            "prefix_entries": (len(self._prefix)
+                               if self._prefix is not None else 0),
+            "prefix_hits": (self._prefix.hits
+                            if self._prefix is not None else 0),
+            "prefix_misses": (self._prefix.misses
+                              if self._prefix is not None else 0),
+            "prefix_evictions": (self._prefix.evictions
+                                 if self._prefix is not None else 0),
+            "prefix_tokens_saved": (self._prefix.tokens_saved
+                                    if self._prefix is not None else 0),
         }
 
     # --------------------------------------------------------------- health
@@ -715,6 +880,11 @@ class ServingEngine:
             self._model, self.slots, self.max_len, dtype=self._dtype,
             per_slot=True)
         nn.clear_decode_cache(self._model)
+        if self._draft is not None:
+            self._dec_state_d = nn.install_decode_cache(
+                self._draft, self.slots, self.max_len, dtype=self._dtype,
+                per_slot=True)
+            nn.clear_decode_cache(self._draft)
         self._pending[:0] = evicted
         registry.gauge("serving/active_slots").set(0)
         events.record("serving_recovered", engine=self.name,
@@ -848,14 +1018,70 @@ class ServingEngine:
                 self._sched.active_count)
 
     # ------------------------------------------------------------ admission
+    def _prefill_ctx(self, ctx, clen, hit, req):
+        """Produce the filled batch-1 cache state(s) + first token for a
+        context, via the cheapest path available:
+
+        - exact prefix-pool hit: no device program at all — the pooled
+          state and its stored next-token are the answer;
+        - partial hit: rewrite the pooled state's positions to the matched
+          depth and prefill only the REMAINDER through the same bucket
+          programs (``pick_seed_bucket`` guarantees the write window fits);
+        - miss / pool off: full bucketed prefill, then pool the result.
+
+        Returns ``(next_token, states)`` where ``states`` is ``(filled,)``
+        or ``(filled, filled_draft)`` with a draft model."""
+        import jax.numpy as jnp
+
+        def run_prefill(state, state_d, padded):
+            if self._spec:
+                next_all, ok, filled, filled_d = self._prefill_spec(
+                    state, state_d, jnp.asarray(padded))
+                states = (filled, filled_d)
+            else:
+                next_all, ok, filled = self._prefill(
+                    self._params, state, jnp.asarray(padded))
+                states = (filled,)
+            if not bool(np.asarray(ok)):
+                raise NonFiniteLogitsError(
+                    f"non-finite logits prefilling request "
+                    f"{req.request_id} [trace {req.trace_id}]")
+            return next_all, states
+
+        if hit is not None:
+            entry, c = hit
+            registry.counter("serving/prefix_hits").inc()
+            registry.counter("serving/prefix_tokens_saved").inc(c)
+            if c == clen:
+                self._last_prefill_flops = None   # no program ran
+                return entry.next_token, entry.states
+            seeded = PrefixPool.seeded(entry, c)
+            rem = clen - c
+            lb = pick_seed_bucket(rem, self.buckets, c, self.max_len)
+            padded = np.zeros((1, lb), np.int32)
+            padded[0, :rem] = ctx[c:]
+            next_all, states = run_prefill(
+                seeded[0], seeded[1] if self._spec else None, padded)
+            nxt = int(np.asarray(next_all)[0, rem - 1])
+        else:
+            lb = pick_bucket(clen, self.buckets)
+            if lb is None:
+                lb = self.max_len   # recovery-only: context outgrew grid
+            padded = np.zeros((1, lb), np.int32)
+            padded[0, :clen] = ctx
+            next_all, states = run_prefill(
+                self._pre_state0, self._pre_state0_d, padded)
+            nxt = int(np.asarray(next_all)[0, clen - 1])
+        if self._prefix is not None:
+            self._prefix.insert(ctx, states, nxt)
+        return nxt, states
+
     def _admit(self, req: Request) -> None:
         """Prefill ``req``'s context into a free slot: one bucketed prefill
         program, one slot-assign scatter — and the FIRST generated token
         falls out of the prefill logits (TTFT ends here). On the crash-
         recovery path the context is prompt + already-emitted tokens, so the
         re-prefilled slot resumes exactly where the dead loop stopped."""
-        import jax.numpy as jnp
-
         recycles_before = self._sched.recycles
         slot = self._sched.admit(req)
         if self._sched.recycles > recycles_before:
@@ -874,23 +1100,17 @@ class ServingEngine:
         lb = pick_bucket(clen, self.buckets)
         if lb is None:
             lb = self.max_len   # recovery-only: context outgrew the grid
-        padded = np.zeros((1, lb), np.int32)
-        padded[0, :clen] = ctx
+        hit = (self._prefix.lookup(ctx, self.buckets, self.max_len)
+               if self._prefix is not None else None)
         try:
             fault_point(faults.SITE_SERVE_PREFILL)
             pre_t0 = time.perf_counter()
             with trace.span("serve/prefill",
                             {"bucket": lb, "slot": slot.index,
-                             "trace_id": req.trace_id}):
-                next_all, ok, filled = self._prefill(
-                    self._params, self._pre_state0, jnp.asarray(padded))
-                if not bool(np.asarray(ok)):
-                    raise NonFiniteLogitsError(
-                        f"non-finite logits prefilling request "
-                        f"{req.request_id} [trace {req.trace_id}]")
-                self._dec_state = self._assign(
-                    self._dec_state, filled, slot.index, clen)
-                nxt = int(np.asarray(next_all)[0, clen - 1])
+                             "trace_id": req.trace_id,
+                             "prefix_hit": hit[1] if hit else 0}):
+                nxt, states = self._prefill_ctx(ctx, clen, hit, req)
+                self._assign(states, slot.index, clen)
             obs_mfu.note("serve", self._last_prefill_flops,
                          time.perf_counter() - pre_t0)
         except (FaultError, NonFiniteLogitsError) as e:
@@ -931,6 +1151,9 @@ class ServingEngine:
         ignored and their stale cache is wiped on reassignment."""
         import jax.numpy as jnp
 
+        if self._spec:
+            self._tick_spec()
+            return
         t0 = time.perf_counter()
         active = self._sched.active_slots()
         tok = np.zeros((self.slots,), np.int32)
@@ -972,6 +1195,70 @@ class ServingEngine:
                 slot.last_token = t
         registry.gauge("serving/active_slots").set(self._sched.active_count)
 
+    def _tick_spec(self) -> None:
+        """Speculative decode tick: ONE fused program drafts k proposals
+        per row, verifies them in a single t=k+1 chunked target forward
+        (the last-position-logits invariant IS the verify), accepts the
+        longest agreeing prefix, and rewinds both caches — each active row
+        emits 1..k+1 tokens per tick, bitwise what plain greedy would have
+        emitted. Free rows ride along; their drifting positions only ever
+        touch their own (wiped-on-reassign) cache rows."""
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        active = self._sched.active_slots()
+        tok = np.zeros((self.slots,), np.int32)
+        for slot in active:
+            tok[slot.index] = slot.last_token
+        fault_point(faults.SITE_SERVE_STALL)   # "stall" sleeps right here
+        with trace.span("serve/spec_step",
+                        {"active": len(active), "k": self._spec}):
+            props, greedy, n_acc, ok, self._dec_state, self._dec_state_d = \
+                self._spec_step(jnp.asarray(tok))
+            props = np.asarray(props)
+            greedy = np.asarray(greedy)
+            n_acc = np.asarray(n_acc)
+            ok = np.asarray(ok)
+        action = check_fault(faults.SITE_SERVE_DECODE)
+        if action == "nonfinite" and active:
+            ok = ok.copy()
+            ok[active[0].index] = False
+        elif action is not None and action != "nonfinite":
+            raise FaultError(
+                f"injected fault at site {faults.SITE_SERVE_DECODE!r}")
+        dt = time.perf_counter() - t0
+        if dt > 0 and active:
+            emitted = sum(int(n_acc[s.index]) + 1 for s in active)
+            inst = emitted / dt
+            self._rate_tps = (inst if self._rate_tps == 0.0
+                              else 0.8 * self._rate_tps + 0.2 * inst)
+            obs_mfu.note("serve", self._decode_flops, dt)
+        if self._watchdog is not None:
+            self._watchdog.heartbeat(dt)
+        for slot in active:
+            req = slot.request
+            if not bool(ok[slot.index]):
+                self._poison(slot)
+                continue
+            j = int(n_acc[slot.index])
+            self._spec_proposed += self._spec
+            self._spec_accepted += j
+            # accepted proposals, then the correction token; tokens past a
+            # finish (eos / length cap) are exactly the greedy continuation
+            # and are dropped, matching plain decode's stopping point
+            toks = [int(props[slot.index, i]) for i in range(j)]
+            toks.append(int(greedy[slot.index, j]))
+            finished = False
+            for t in toks:
+                req.generated.append(t)
+                if self._finished(req, t):
+                    self._finish(slot, t)
+                    finished = True
+                    break
+            if not finished:
+                slot.last_token = req.generated[-1]
+        registry.gauge("serving/active_slots").set(self._sched.active_count)
+
     def _poison(self, slot) -> None:
         """Per-slot non-finite guard tripped: fail THIS request, wipe the
         row before anyone reuses it, keep every other slot decoding."""
@@ -988,7 +1275,7 @@ class ServingEngine:
         req.handle._fail(NonFiniteLogitsError(
             f"non-finite logits decoding request {req.request_id} "
             f"(slot {slot.index}) [trace {req.trace_id}]"))
-        self._dec_state = self._reset_row(self._dec_state, slot.index)
+        self._reset_row(slot.index)
         self._sched.release(slot)
 
     def _finished(self, req: Request, token: int) -> bool:

@@ -1,0 +1,374 @@
+"""Fleet router: N serving-engine replicas behind one submit surface.
+
+One hardened :class:`~bigdl_tpu.serving.engine.ServingEngine` saturates one
+chip; the north-star traffic needs many. This router multiplies the engine
+the way the rest of the stack was already shaped for:
+
+- **Registry shape**: replicas live in a ``{name: engine}`` dict, the same
+  shape :class:`~bigdl_tpu.serving.multitenant.SnapshotServer` uses for
+  tenants — ops tooling that walks one walks the other.
+- **Least-loaded dispatch off data**: each candidate's ``stats()`` supplies
+  the machine-readable load triple (``queue_depth`` / ``decode_rate`` /
+  ``est_wait_ms``) and the health state; the router ranks healthy replicas
+  by ``(queue_depth + active_slots, est_wait_ms, name)`` — the trailing
+  name makes ties deterministic under test.
+- **Retry-elsewhere**: PR 8's overload/drain semantics were designed for
+  this caller. ``EngineOverloaded`` and ``EngineShutdown`` (shed, drain,
+  crash-budget-exhausted death) move the request to the next-best replica;
+  a request submitted to the fleet is NEVER lost while at least one replica
+  is healthy. The original ``trace_id`` rides along on every resubmission
+  (``submit(trace_id=)``), so one trace follows the request across hops,
+  and an absolute fleet deadline is re-budgeted to the remaining time at
+  each hop.
+- **Scripted churn**: fault sites ``router_dispatch`` (fail one dispatch
+  attempt) and ``replica_down`` (abruptly kill the replica the router was
+  about to pick, stranding its in-flight work for the retry path to
+  recover) make failover deterministic under test, like every other
+  robustness path (docs/robustness.md).
+
+Replicas typically share ONE model instance — compiled programs live on
+``model._apply_cache``, so N replicas still compile each program once; what
+multiplies is slot-grid memory and (on real hardware) the device each
+engine owns. :func:`FleetRouter.replicate` builds that arrangement.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Optional
+
+from bigdl_tpu.obs import exporter as obs_exporter
+from bigdl_tpu.obs.registry import registry
+from bigdl_tpu.serving.engine import (
+    EngineOverloaded, EngineShutdown, RequestTimeout, ServingEngine,
+    _env_int,
+)
+from bigdl_tpu.utils import faults
+from bigdl_tpu.utils.faults import check_fault, fault_point
+from bigdl_tpu.utils.robustness import events
+
+#: replica health states the router will dispatch to
+_DISPATCHABLE = ("starting", "ready", "degraded")
+
+
+class FleetExhausted(RuntimeError):
+    """No healthy replica could take (or finish) the request: every
+    dispatch candidate was down, draining, or overloaded. Carries the
+    per-replica errors of the final dispatch round."""
+
+    def __init__(self, msg: str, errors: Optional[dict] = None):
+        super().__init__(msg)
+        self.errors = errors or {}
+
+
+class FleetHandle:
+    """Client-side future for one FLEET request. Wraps the current
+    replica's :class:`RequestHandle` and transparently re-dispatches to
+    another replica when the holding replica sheds, drains, or dies —
+    ``result()`` only raises once no healthy replica remains (or the
+    error is non-retryable: bad request, missed deadline, poisoned
+    logits)."""
+
+    def __init__(self, router: "FleetRouter", prompt, max_new_tokens: int,
+                 request_id, deadline_s: Optional[float]):
+        self._router = router
+        self._prompt = prompt
+        self._max_new_tokens = max_new_tokens
+        self.request_id = request_id
+        #: minted ONCE; every resubmission reuses it, so the trace survives
+        #: retry-elsewhere (docs/observability.md)
+        self.trace_id = uuid.uuid4().hex[:16]
+        self._deadline_t: Optional[float] = (
+            time.perf_counter() + deadline_s
+            if deadline_s is not None else None)
+        self._lock = threading.Lock()
+        self._handle = None          # current replica's RequestHandle
+        self._replica: Optional[str] = None
+        self.attempts = 0
+
+    @property
+    def replica(self) -> Optional[str]:
+        """Name of the replica currently holding the request."""
+        return self._replica
+
+    def _bind(self, replica: str, handle) -> None:
+        self._replica = replica
+        self._handle = handle
+        self.attempts += 1
+
+    def remaining_deadline_ms(self) -> Optional[float]:
+        """Milliseconds left of the fleet-level deadline (None = none) —
+        each hop resubmits with the REMAINING budget, not the original."""
+        if self._deadline_t is None:
+            return None
+        return max(0.0, (self._deadline_t - time.perf_counter()) * 1e3)
+
+    def done(self) -> bool:
+        h = self._handle
+        return h is not None and h.done()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the completed request, following it across replicas.
+        Raises :class:`TimeoutError` if ``timeout`` (the WAIT budget, not
+        the request deadline) expires first."""
+        wait_t = (time.perf_counter() + timeout
+                  if timeout is not None else None)
+        while True:
+            h = self._handle
+            try:
+                if wait_t is None:
+                    return h.result()
+                return h.result(max(0.0, wait_t - time.perf_counter()))
+            except TimeoutError:
+                raise
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if not self._router._retryable(self._replica, e):
+                    raise
+                # retry elsewhere: raises the ORIGINAL error when no
+                # healthy replica remains — never a silent loss
+                self._router._redispatch(self, cause=e)
+
+
+class FleetRouter:
+    """Least-loaded request router over a registry of serving replicas.
+
+    ``replicas``: ``{name: ServingEngine}`` (the SnapshotServer registry
+    shape) or a sequence of engines (named by their ``.name``). All
+    replicas must serve the same snapshot for fleet routing to be
+    transparent; that is the caller's contract (use :meth:`replicate`).
+    ``max_retries``: total re-dispatches one request may consume, a backstop
+    against pathological flapping (default ``4 × len(replicas)``)."""
+
+    def __init__(self, replicas, name: str = "fleet",
+                 max_retries: Optional[int] = None):
+        if not isinstance(replicas, dict):
+            replicas = {e.name: e for e in replicas}
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        if len(set(replicas)) != len(replicas):
+            raise ValueError("replica names must be unique")
+        self.name = name
+        self._engines: dict[str, ServingEngine] = dict(replicas)
+        self._lock = threading.Lock()
+        self._dispatched = 0
+        self._retries = 0
+        self._replica_downs = 0
+        self._rejected = 0
+        self.max_retries = (max_retries if max_retries is not None
+                            else 4 * len(replicas))
+        obs_exporter.register_fleet(self)
+
+    # -------------------------------------------------------- construction
+    @classmethod
+    def replicate(cls, model, max_len: int, replicas: Optional[int] = None,
+                  name: str = "fleet", **engine_kwargs) -> "FleetRouter":
+        """Build a fleet of ``replicas`` engines over ONE model instance
+        (BIGDL_FLEET_REPLICAS, default 2). Shared instance = shared
+        ``_apply_cache``: N replicas, each program still compiled once.
+        ``engine_kwargs`` pass through to every :class:`ServingEngine`
+        (slots, buckets, draft_model, prefix_pool, overload, ...)."""
+        if replicas is None:
+            replicas = _env_int("BIGDL_FLEET_REPLICAS", 2)
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        engines = {
+            f"{name}-r{i}": ServingEngine(
+                model, max_len=max_len, name=f"{name}-r{i}",
+                **engine_kwargs)
+            for i in range(replicas)}
+        return cls(engines, name=name)
+
+    # ------------------------------------------------------------- registry
+    @property
+    def replicas(self) -> dict:
+        """Live ``{name: engine}`` registry view (copy)."""
+        return dict(self._engines)
+
+    def engine(self, name: str) -> ServingEngine:
+        return self._engines[name]
+
+    def add_replica(self, name: str, engine: ServingEngine) -> None:
+        """Grow the fleet mid-flight — the next dispatch round sees it."""
+        with self._lock:
+            if name in self._engines:
+                raise ValueError(f"replica {name!r} already registered")
+            self._engines[name] = engine
+
+    def remove_replica(self, name: str, drain: bool = True) -> None:
+        """Take a replica out of rotation; ``drain=True`` lets its
+        in-flight sequences finish (queued-but-unadmitted requests fail
+        with ``EngineShutdown`` and re-route via their FleetHandles)."""
+        with self._lock:
+            eng = self._engines.pop(name)
+        eng.shutdown(wait=False, drain=drain)
+
+    # ------------------------------------------------------------- dispatch
+    def _healthy(self) -> list[str]:
+        return [n for n, e in self._engines.items()
+                if e.stats()["health"] in _DISPATCHABLE]
+
+    def _rank(self, exclude: Optional[str] = None) -> list[tuple]:
+        """Dispatch order: healthy replicas by ``(queue_depth +
+        active_slots, est_wait_ms, name)`` — fewest waiting sequences
+        first, EWMA wait estimate as tiebreak, name for determinism."""
+        order = []
+        for nm, eng in list(self._engines.items()):
+            if nm == exclude:
+                continue
+            st = eng.stats()
+            if st["health"] not in _DISPATCHABLE:
+                continue
+            order.append(((st["queue_depth"] + st["active_slots"],
+                           st["est_wait_ms"], nm), nm, eng))
+        order.sort(key=lambda t: t[0])
+        return [(nm, eng) for _, nm, eng in order]
+
+    def _kill_replica(self, name: str, engine: ServingEngine) -> None:
+        """The ``replica_down`` fault fired for this pick: crash the
+        replica abruptly (no drain — queued AND in-flight futures fail
+        fast) so every request it held must re-route. The zero-lost test
+        drives exactly this path."""
+        self._replica_downs += 1
+        registry.counter("fleet/replica_down").inc()
+        events.record("fleet_replica_down", fleet=self.name, replica=name,
+                      in_flight=engine.stats()["active_slots"])
+        engine.shutdown(wait=False)
+
+    def _dispatch(self, fh: FleetHandle,
+                  exclude: Optional[str] = None) -> None:
+        """Submit ``fh`` to the best healthy replica, walking down the
+        ranking on per-replica rejection. Raises the last per-replica
+        error (or :class:`FleetExhausted`) only when NO candidate took
+        it."""
+        deadline_ms = fh.remaining_deadline_ms()
+        if deadline_ms is not None and deadline_ms <= 0.0:
+            self._rejected += 1
+            raise RequestTimeout(
+                f"fleet {self.name!r}: request {fh.request_id} deadline "
+                f"expired before a replica could take it "
+                f"[trace {fh.trace_id}]")
+        errors: dict[str, BaseException] = {}
+        candidates = self._rank(exclude)
+        for nm, eng in candidates:
+            if check_fault(faults.SITE_REPLICA_DOWN) is not None:
+                self._kill_replica(nm, eng)
+                continue
+            try:
+                fault_point(faults.SITE_ROUTER_DISPATCH)
+                handle = eng.submit(
+                    fh._prompt, fh._max_new_tokens,
+                    request_id=fh.request_id,
+                    deadline_ms=fh.remaining_deadline_ms(),
+                    trace_id=fh.trace_id)
+            except (EngineOverloaded, EngineShutdown,
+                    faults.FaultError) as e:
+                errors[nm] = e
+                continue
+            fh._bind(nm, handle)
+            self._dispatched += 1
+            registry.counter("fleet/dispatch").inc()
+            return
+        self._rejected += 1
+        registry.counter("fleet/rejected").inc()
+        events.record("fleet_exhausted", fleet=self.name,
+                      request_id=fh.request_id, trace_id=fh.trace_id,
+                      tried=[nm for nm, _ in candidates],
+                      errors={nm: type(e).__name__
+                              for nm, e in errors.items()})
+        overloads = [e for e in errors.values()
+                     if isinstance(e, EngineOverloaded)]
+        if overloads and len(overloads) == len(errors) and errors:
+            raise overloads[-1]   # fleet-level shed: back off and retry
+        raise FleetExhausted(
+            f"fleet {self.name!r}: no healthy replica for request "
+            f"{fh.request_id} (tried {len(candidates)}) "
+            f"[trace {fh.trace_id}]", errors)
+
+    def _retryable(self, replica: Optional[str],
+                   err: BaseException) -> bool:
+        """A failed RESULT moves elsewhere when the replica shut down /
+        died (shed, drain, crash budget exhausted — the engine fails
+        outstanding handles with its real failure once the supervisor
+        gives up, so any error from a dead replica re-routes). Bad
+        requests, missed deadlines, and poisoned logits stay failed —
+        another replica would do no better."""
+        if isinstance(err, (ValueError, RequestTimeout)):
+            return False
+        if isinstance(err, (EngineShutdown, EngineOverloaded)):
+            return True
+        eng = self._engines.get(replica) if replica else None
+        return eng is not None and eng.stats()["health"] == "dead"
+
+    def _redispatch(self, fh: FleetHandle, cause: BaseException) -> None:
+        """Move a request whose replica failed it. Serialized per handle;
+        raises ``cause`` when the fleet is exhausted or the retry backstop
+        trips — the caller sees the REAL error, never a bare retry
+        counter."""
+        with fh._lock:
+            if fh.attempts > self.max_retries:
+                raise cause
+            self._retries += 1
+            registry.counter("fleet/retry").inc()
+            events.record("fleet_retry", fleet=self.name,
+                          request_id=fh.request_id, trace_id=fh.trace_id,
+                          from_replica=fh.replica,
+                          cause=type(cause).__name__)
+            try:
+                self._dispatch(fh, exclude=fh.replica)
+            except (FleetExhausted, EngineOverloaded, RequestTimeout):
+                raise cause
+
+    # -------------------------------------------------------------- clients
+    def submit(self, prompt, max_new_tokens: int, request_id=None,
+               deadline_ms: Optional[float] = None) -> FleetHandle:
+        """Dispatch one request to the least-loaded healthy replica.
+        Returns a :class:`FleetHandle` that follows the request across
+        replicas. Raises ``ValueError`` for never-servable requests,
+        ``EngineOverloaded`` when EVERY healthy replica shed it, and
+        :class:`FleetExhausted` when none is healthy. ``deadline_ms`` is a
+        FLEET-level absolute budget: each hop gets the remaining time."""
+        if request_id is None:
+            with self._lock:
+                request_id = f"{self.name}-{self._dispatched}"
+        fh = FleetHandle(self, prompt, max_new_tokens, request_id,
+                         deadline_ms / 1000.0
+                         if deadline_ms and deadline_ms > 0 else None)
+        self._dispatch(fh)
+        return fh
+
+    # ------------------------------------------------------------ lifecycle
+    def stats(self) -> dict:
+        """Router ledger + every replica's ``stats()`` under its name —
+        the ``/metrics`` exporter renders these as ``{replica=...}``
+        gauges."""
+        reps = {nm: eng.stats() for nm, eng in self._engines.items()}
+        return {
+            "name": self.name,
+            "replicas": reps,
+            "healthy_replicas": sum(
+                1 for s in reps.values() if s["health"] in _DISPATCHABLE),
+            "dispatched": self._dispatched,
+            "retries": self._retries,
+            "replica_downs": self._replica_downs,
+            "rejected": self._rejected,
+        }
+
+    def shutdown(self, wait: bool = True, drain: bool = False) -> None:
+        """Bring every replica down (drain semantics per engine)."""
+        errs = []
+        for eng in self._engines.values():
+            try:
+                eng.shutdown(wait=wait, drain=drain)
+            except BaseException as e:  # noqa: BLE001 — shut all down first
+                errs.append(e)
+        if errs:
+            raise errs[0]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

@@ -32,22 +32,35 @@ class SnapshotServer:
     ``models``: ``{name: model}`` — any mix of native and quantized modules.
     ``engine_kwargs``: either kwargs applied to every engine, or overridden
     per snapshot via ``per_model={name: {...}}``.
+    ``draft_models``: optional ``{name: draft}`` — the named tenants serve
+    speculatively (``serving/speculative.py``): a per-tenant draft proposes,
+    that tenant's snapshot verifies, output stays bitwise-identical. A
+    latency-critical tenant can run a draft while its neighbors decode
+    plain.
     """
 
     def __init__(self, models: dict, max_len: int,
-                 per_model: Optional[dict] = None, **engine_kwargs):
+                 per_model: Optional[dict] = None,
+                 draft_models: Optional[dict] = None, **engine_kwargs):
         if not models:
             raise ValueError("models must name at least one snapshot")
         per_model = per_model or {}
+        draft_models = draft_models or {}
         unknown = set(per_model) - set(models)
         if unknown:
             raise ValueError(f"per_model names unknown snapshots: "
+                             f"{sorted(unknown)}")
+        unknown = set(draft_models) - set(models)
+        if unknown:
+            raise ValueError(f"draft_models names unknown snapshots: "
                              f"{sorted(unknown)}")
         self._engines: dict[str, ServingEngine] = {}
         for name, model in models.items():
             kw = dict(engine_kwargs)
             kw.update(per_model.get(name, {}))
             kw.setdefault("max_len", max_len)
+            if name in draft_models:
+                kw.setdefault("draft_model", draft_models[name])
             self._engines[name] = ServingEngine(model, name=name, **kw)
             # per-tenant rows on /metrics and /healthz exist from
             # construction (engines also self-register at start(), but a

@@ -1,0 +1,199 @@
+"""Resident prefix KV-cache pool: shared-prefix traffic skips re-prefill.
+
+Fleet traffic is dominated by shared prompt PREFIXES — a system prompt, a
+few-shot header, a long retrieved document — repeated across thousands of
+requests that differ only in their tail. The engine's cold path pays a full
+bucketed prefill for every one of them. This pool keeps recently-prefilled
+batch-1 cache states resident (the same pytrees ``assign_cache_slot``
+scatters into the decode grid) keyed by content hashes of chunk-aligned
+prompt prefixes, so a new request whose prompt starts with a pooled prefix
+seeds its slot from the pool and prefills only the REMAINDER:
+
+- **Chunk-aligned keys**: an inserted context of length L registers hash
+  keys at every multiple of ``chunk`` up to L, plus L itself — a later
+  prompt that shares the first ``c`` tokens (c chunk-aligned, or exactly L)
+  finds the entry at the LONGEST matching boundary without scanning the
+  pool.
+- **Seeding is a pos rewrite, not a copy**: the pooled state's K/V rows for
+  positions ``< c`` are exactly what a fresh prefill of those tokens would
+  produce; rows ``>= c`` are junk — and harmless, because positions beyond
+  the cache's ``pos`` counter are never attended and are overwritten by the
+  remainder prefill (the SAME invariant bucket right-padding relies on).
+  :meth:`seeded` therefore just rewrites the position leaves to ``c``.
+- **No new programs**: the remainder runs through the engine's existing
+  shape-keyed bucket prefill programs, and an EXACT hit (c == prompt length)
+  skips prefill entirely using the entry's stored next-token — the
+  ``compiled_programs`` ledger stays at ``len(buckets) + 2``.
+- **LRU over entries, capacity in entries**: each entry holds full cache
+  pytrees (per layer: 2 × max_len × kv_heads × head_dim × dtype, times two
+  models when speculative decoding is on), so the budget knob
+  (``BIGDL_PREFIX_POOL``) counts entries, not bytes — see docs/serving.md
+  for sizing arithmetic.
+
+Correctness does not rest on the hash: a candidate hit is verified by exact
+token comparison before use, so a collision degrades to a miss, never to
+wrong tokens. Bitwise token equality of pooled vs cold serving is pinned by
+``tests/test_fleet.py``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.serving.scheduler import pick_seed_bucket
+
+
+def _digest(tokens: np.ndarray) -> bytes:
+    return hashlib.sha1(np.ascontiguousarray(tokens, np.int32)
+                        .tobytes()).digest()
+
+
+class PrefixEntry:
+    """One pooled prefix: the token content, the filled batch-1 cache
+    state(s) — one pytree per model when the engine runs a draft model too —
+    and the greedy next-token after the full context (the exact-hit
+    fast path)."""
+
+    __slots__ = ("tokens", "states", "next_token")
+
+    def __init__(self, tokens: np.ndarray, states: tuple, next_token: int):
+        self.tokens = np.asarray(tokens, np.int32)
+        self.states = tuple(states)
+        self.next_token = int(next_token)
+
+    def __len__(self):
+        return int(self.tokens.size)
+
+
+class PrefixPool:
+    """LRU pool of prefilled prefixes, keyed by chunk-aligned content
+    hashes. Thread-safe out of caution; in practice only the owning engine's
+    decode thread touches it."""
+
+    def __init__(self, capacity: int, chunk: int = 16):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.capacity = int(capacity)
+        self.chunk = int(chunk)
+        # full-length digest -> entry, LRU order (oldest first)
+        self._entries: "OrderedDict[bytes, PrefixEntry]" = OrderedDict()
+        # prefix-boundary digest -> full-length digest of the NEWEST entry
+        # registered at that boundary
+        self._index: dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.tokens_saved = 0
+
+    # -------------------------------------------------------------- lookup
+    def _boundaries(self, n: int) -> list[int]:
+        """Candidate prefix lengths for a context of length ``n``, longest
+        first: n itself (exact hit), then every chunk multiple < n."""
+        bs = [n]
+        b = (n - 1) // self.chunk * self.chunk
+        while b >= self.chunk:
+            bs.append(b)
+            b -= self.chunk
+        return bs
+
+    def lookup(self, ctx: np.ndarray, buckets: Sequence[int],
+               max_len: int) -> Optional[tuple[PrefixEntry, int]]:
+        """Longest pooled prefix of ``ctx`` that is USABLE: either the whole
+        context (exact hit, no prefill needed) or a proper prefix whose
+        remainder fits a bucket starting at that depth
+        (:func:`pick_seed_bucket`). Returns ``(entry, c)`` and refreshes the
+        entry's LRU position, or None (counted as a miss)."""
+        ctx = np.asarray(ctx, np.int32)
+        n = int(ctx.size)
+        with self._lock:
+            for c in self._boundaries(n):
+                key = self._index.get(_digest(ctx[:c]))
+                if key is None:
+                    continue
+                entry = self._entries.get(key)
+                if entry is None or len(entry) < c \
+                        or not np.array_equal(entry.tokens[:c], ctx[:c]):
+                    continue   # hash collision or stale index: treat as miss
+                if c < n and pick_seed_bucket(
+                        n - c, buckets, c, max_len) is None:
+                    continue   # remainder would overflow the cache window
+                self._entries.move_to_end(key)
+                self.hits += 1
+                self.tokens_saved += c
+                return entry, c
+            self.misses += 1
+            return None
+
+    # -------------------------------------------------------------- insert
+    def insert(self, ctx: np.ndarray, states: tuple,
+               next_token: int) -> None:
+        """Pool a just-prefilled context. Contexts shorter than one chunk
+        are not worth an entry. Re-inserting the same tokens refreshes the
+        existing entry; over capacity, the LRU entry is evicted along with
+        its index keys."""
+        ctx = np.asarray(ctx, np.int32)
+        n = int(ctx.size)
+        if n < self.chunk:
+            return
+        full = _digest(ctx)
+        entry = PrefixEntry(ctx, states, next_token)
+        with self._lock:
+            if full in self._entries:
+                self._entries[full] = entry
+                self._entries.move_to_end(full)
+                return
+            self._entries[full] = entry
+            for c in self._boundaries(n):
+                self._index[_digest(ctx[:c])] = full
+            while len(self._entries) > self.capacity:
+                old_key, old = self._entries.popitem(last=False)
+                self.evictions += 1
+                for c in self._boundaries(len(old)):
+                    k = _digest(old.tokens[:c])
+                    if self._index.get(k) == old_key:
+                        del self._index[k]
+
+    # -------------------------------------------------------------- seeding
+    @staticmethod
+    def seeded(entry: PrefixEntry, c: int) -> tuple:
+        """The entry's cache state(s) with every position leaf rewritten to
+        ``c`` — ready for the remainder prefill to continue from depth
+        ``c``. K/V rows beyond ``c`` stay as-is: never attended, and
+        overwritten by the remainder (the bucket-padding invariant)."""
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.nn.incremental import _CACHE_POS_KEYS, _leaf_key
+
+        def g(path, leaf):
+            if _leaf_key(path) in _CACHE_POS_KEYS:
+                return jnp.full(leaf.shape, c, leaf.dtype)
+            return leaf
+
+        return tuple(jax.tree_util.tree_map_with_path(g, s)
+                     for s in entry.states)
+
+    # ---------------------------------------------------------------- misc
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "capacity": self.capacity,
+                "chunk": self.chunk,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "tokens_saved": self.tokens_saved,
+            }

@@ -105,13 +105,16 @@ class Request:
                  "generated", "handle")
 
     def __init__(self, request_id, prompt: np.ndarray, max_new_tokens: int,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         self.request_id = request_id
         #: request-scoped trace ID: stamped at submission, propagated through
         #: queue → prefill → decode → completion spans, attached to timeout/
         #: poison errors and watchdog dumps, and the lookup key for
-        #: ``bigdl-tpu diag --trace``
-        self.trace_id = uuid.uuid4().hex[:16]
+        #: ``bigdl-tpu diag --trace``. A caller-supplied ``trace_id`` (the
+        #: fleet router's retry-elsewhere path) survives resubmission to a
+        #: different replica, so one trace follows the request across hops.
+        self.trace_id = trace_id if trace_id else uuid.uuid4().hex[:16]
         self.prompt = prompt                      # np.int32 (prompt_len,)
         self.max_new_tokens = int(max_new_tokens)
         self.submit_t = time.perf_counter()

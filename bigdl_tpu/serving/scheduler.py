@@ -48,6 +48,21 @@ def pick_bucket(n: int, buckets: Sequence[int]) -> Optional[int]:
     return None
 
 
+def pick_seed_bucket(n: int, buckets: Sequence[int], base: int,
+                     max_len: int) -> Optional[int]:
+    """Smallest bucket >= n whose write window also fits the cache when the
+    prefill starts at depth ``base`` (the prefix-cache seeded path): the
+    padded chunk lands at rows ``base .. base+bucket-1``, and
+    ``lax.dynamic_update_slice`` CLAMPS out-of-bounds starts — an
+    overflowing bucket would silently overwrite the reused prefix rows
+    instead of failing. None when no bucket fits both constraints (the
+    caller falls back to a shorter prefix or a cold full prefill)."""
+    for b in buckets:
+        if b >= n and base + b <= max_len:
+            return b
+    return None
+
+
 class Slot:
     """One decode-batch row: which request owns it and the last token fed."""
 

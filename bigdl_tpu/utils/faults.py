@@ -55,6 +55,15 @@ site                      where it fires
                           reports a synthetic breach, flipping registered
                           serving engines to ``degraded`` and back on the
                           next clean check: the degrade-path drill switch
+``router_dispatch``       the fleet router, just before handing a request to
+                          the replica it picked (``serving/fleet.py``) —
+                          ``error`` fails that dispatch attempt; the router
+                          retries the next-best replica
+``replica_down``          the fleet router's dispatch loop — abruptly kills
+                          the replica it was ABOUT to pick
+                          (``shutdown(wait=False)``), simulating a replica
+                          crash with requests in flight; the router must
+                          re-route them elsewhere with zero losses
 ========================  ====================================================
 
 A plan is a ``;``-separated list of entries ``site@N`` or ``site@N=action``.
@@ -100,6 +109,8 @@ SITE_CACHE_WRITE = "cache_write"
 #: report a synthetic breach — exercises the breach → degraded → recovered
 #: path without manufacturing real latency (docs/observability.md)
 SITE_SLO_BREACH = "slo_breach"
+SITE_ROUTER_DISPATCH = "router_dispatch"
+SITE_REPLICA_DOWN = "replica_down"
 
 #: sites whose plan entries match the caller-supplied ``index`` (training
 #: iteration) instead of the site's hit counter
@@ -120,6 +131,8 @@ _DEFAULT_ACTION = {
     SITE_CACHE_READ: "error",
     SITE_CACHE_WRITE: "error",
     SITE_SLO_BREACH: "error",
+    SITE_ROUTER_DISPATCH: "error",
+    SITE_REPLICA_DOWN: "death",
 }
 
 _KNOWN_ACTIONS = frozenset({"error", "death", "nan", "sigterm", "torn",

@@ -1,0 +1,446 @@
+"""Serving fleet (bigdl_tpu/serving/fleet.py + prefix_cache.py +
+speculative.py): replica router, prefix KV-cache reuse, speculative decoding.
+
+The load-bearing contracts, each pinned bitwise against the offline
+``nn.greedy_generate`` oracle:
+
+- fleet-routed output is identical to a solo engine's — routing is
+  transparent;
+- a request submitted to the fleet is NEVER lost while >= 1 replica is
+  healthy: scripted ``replica_down`` / drain churn re-routes every affected
+  request (``plan.unfired() == []`` proves the script ran);
+- prefix-pool hits skip re-prefill without new programs (the
+  ``compiled_programs`` ledger stays at ``len(buckets) + 2``) and without
+  changing a single token;
+- speculative decoding equals plain greedy at ANY acceptance rate —
+  including 0% (an unrelated draft) and 100% (the target drafting for
+  itself).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.models.transformerlm import TransformerLM
+from bigdl_tpu.serving import (
+    EngineShutdown, FleetExhausted, FleetRouter, PrefixPool, ServingEngine,
+    SnapshotServer, SpeculativeDecoder, pick_seed_bucket,
+)
+from bigdl_tpu.utils import faults
+
+pytestmark = pytest.mark.fleet
+
+VOCAB = 50
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """One tiny causal LM for the whole module — engines over the same
+    instance share compiled programs via the module's apply cache."""
+    return TransformerLM(VOCAB, embed_dim=16, num_heads=2, num_layers=2,
+                         max_len=48).evaluate()
+
+
+@pytest.fixture(scope="module")
+def draft_lm():
+    """A genuinely SMALLER draft (half the width, one layer) — the real
+    speculative arrangement. Its proposals virtually never match the
+    target's greedy choice, which is exactly the 0%-acceptance regime.
+    (Same-architecture drafts are useless here: the conftest RNG reset
+    would hand them the target's exact weights.)"""
+    return TransformerLM(VOCAB, embed_dim=8, num_heads=2, num_layers=1,
+                         max_len=48).evaluate()
+
+
+def _prompt(seed, n):
+    return np.random.default_rng(seed).integers(
+        0, VOCAB, (n,)).astype(np.int32)
+
+
+def _oracle(model, prompt, steps):
+    return np.asarray(
+        nn.greedy_generate(model, jnp.asarray(prompt)[None, :], steps))[0]
+
+
+def _wait_active(eng, n, timeout=60):
+    deadline = time.perf_counter() + timeout
+    while eng.stats()["active_slots"] < n:
+        if time.perf_counter() > deadline:
+            raise AssertionError(
+                f"never reached {n} active slots: {eng.stats()}")
+        time.sleep(0.005)
+
+
+def _wait_healthy(fleet, n, timeout=30):
+    """Health flips to 'dead' on the supervisor thread; poll for it."""
+    deadline = time.perf_counter() + timeout
+    while fleet.stats()["healthy_replicas"] != n:
+        if time.perf_counter() > deadline:
+            raise AssertionError(
+                f"never reached {n} healthy replicas: {fleet.stats()}")
+        time.sleep(0.01)
+
+
+# ----------------------------------------------------------- fleet routing
+class TestFleetRouting:
+    def test_fleet_output_bitwise_equals_solo_engine(self, lm):
+        """The tentpole contract: routing across replicas changes WHERE a
+        request decodes, never WHAT it decodes."""
+        prompts = [_prompt(300 + i, 3 + i % 5) for i in range(8)]
+        oracles = [_oracle(lm, p, 10) for p in prompts]
+        with FleetRouter.replicate(lm, max_len=48, replicas=3,
+                                   buckets=(8,)) as fleet:
+            handles = [fleet.submit(p, 10) for p in prompts]
+            for h, o in zip(handles, oracles):
+                np.testing.assert_array_equal(
+                    h.result(timeout=180).tokens, o)
+            st = fleet.stats()
+            assert st["dispatched"] == 8
+            assert st["healthy_replicas"] == 3
+            assert sorted(st["replicas"]) == [
+                "fleet-r0", "fleet-r1", "fleet-r2"]
+
+    def test_least_loaded_dispatch_spreads_load(self, lm):
+        """With r0's slot pinned by a long request, the next submit must
+        rank r1 first (queue_depth + active_slots)."""
+        with FleetRouter.replicate(lm, max_len=48, replicas=2, slots=1,
+                                   buckets=(8,)) as fleet:
+            head = fleet.submit(_prompt(310, 4), 24)
+            _wait_active(fleet.engine(head.replica), 1)
+            second = fleet.submit(_prompt(311, 4), 4)
+            assert second.replica != head.replica
+            assert head.result(timeout=180).n_generated == 24
+            assert second.result(timeout=180).n_generated == 4
+
+    def test_bad_request_fails_fast_not_retried(self, lm):
+        """A never-servable request (prompt + budget overflows every
+        replica's window) raises ValueError at submit — retrying elsewhere
+        would not help, and must not be attempted."""
+        with FleetRouter.replicate(lm, max_len=48, replicas=2,
+                                   buckets=(8,)) as fleet:
+            with pytest.raises(ValueError):
+                fleet.submit(_prompt(320, 8), 400)
+            assert fleet.stats()["retries"] == 0
+
+    def test_fleet_exhausted_when_no_replica_healthy(self, lm):
+        fleet = FleetRouter.replicate(lm, max_len=48, replicas=2,
+                                      buckets=(8,))
+        fleet.shutdown()
+        _wait_healthy(fleet, 0)
+        with pytest.raises(FleetExhausted):
+            fleet.submit(_prompt(330, 4), 4)
+        assert fleet.stats()["rejected"] == 1
+
+    def test_router_dispatch_fault_walks_to_next_replica(self, lm):
+        """The ``router_dispatch`` site fails ONE dispatch attempt; the
+        router must walk down the ranking and land the request on the next
+        candidate — the client never sees the fault."""
+        plan = faults.parse_plan("router_dispatch@1")
+        prompt = _prompt(340, 4)
+        oracle = _oracle(lm, prompt, 8)
+        with faults.inject_faults(plan):
+            with FleetRouter.replicate(lm, max_len=48, replicas=2,
+                                       buckets=(8,)) as fleet:
+                h = fleet.submit(prompt, 8)
+                np.testing.assert_array_equal(
+                    h.result(timeout=180).tokens, oracle)
+        assert plan.unfired() == []
+
+
+# ---------------------------------------------------------- zero-lost churn
+class TestZeroLostChurn:
+    def test_replica_down_mid_flight_loses_no_request(self, lm):
+        """Abrupt replica kill with a request pinned in its slot AND one
+        backed up in its queue: both futures fail with EngineShutdown on
+        the dead replica and re-dispatch to the survivor — same trace_id,
+        bitwise-identical tokens."""
+        prompts = [_prompt(400 + i, 4) for i in range(3)]
+        oracles = [_oracle(lm, p, 12) for p in prompts]
+        with FleetRouter.replicate(lm, max_len=48, replicas=2, slots=1,
+                                   buckets=(8,)) as fleet:
+            # pin both replicas' single slots
+            heads = [fleet.submit(prompts[0], 12),
+                     fleet.submit(prompts[1], 12)]
+            assert heads[0].replica != heads[1].replica
+            for h in heads:
+                _wait_active(fleet.engine(h.replica), 1)
+            # victim queues behind one of them
+            victim = fleet.submit(prompts[2], 12)
+            doomed = victim.replica
+            traces = [h.trace_id for h in heads] + [victim.trace_id]
+            fleet.engine(doomed).shutdown(wait=False)
+            _wait_healthy(fleet, 1)
+            for h, o, t in zip(heads + [victim], oracles, traces):
+                r = h.result(timeout=180)
+                np.testing.assert_array_equal(r.tokens, o)
+                # the trace id minted at fleet submit survives the hop
+                assert r.trace_id == t
+            st = fleet.stats()
+            assert st["retries"] >= 1
+            retried = [h for h in heads + [victim] if h.attempts > 1]
+            assert retried and all(h.replica != doomed for h in retried)
+
+    def test_scripted_replica_down_fault_site(self, lm):
+        """The ``replica_down`` site kills the replica the router was about
+        to pick; the dispatch walks on and every request still completes
+        bitwise. ``plan.unfired() == []`` proves the churn actually ran."""
+        plan = faults.parse_plan("replica_down@2")
+        prompts = [_prompt(420 + i, 4) for i in range(6)]
+        oracles = [_oracle(lm, p, 8) for p in prompts]
+        with faults.inject_faults(plan):
+            with FleetRouter.replicate(lm, max_len=48, replicas=2,
+                                       buckets=(8,)) as fleet:
+                handles = [fleet.submit(p, 8) for p in prompts]
+                for h, o in zip(handles, oracles):
+                    np.testing.assert_array_equal(
+                        h.result(timeout=180).tokens, o)
+                assert plan.unfired() == []
+                _wait_healthy(fleet, 1)
+                st = fleet.stats()
+                assert st["replica_downs"] == 1
+                assert st["dispatched"] == 6
+
+    def test_drain_remove_reroutes_queued_requests(self, lm):
+        """remove_replica(drain=True): the drained replica finishes its
+        in-flight sequence bitwise-complete; its queued-but-unadmitted
+        request aborts with EngineShutdown and re-routes to a survivor."""
+        prompts = [_prompt(430 + i, 4) for i in range(3)]
+        oracles = [_oracle(lm, p, 12) for p in prompts]
+        with FleetRouter.replicate(lm, max_len=48, replicas=2, slots=1,
+                                   buckets=(8,)) as fleet:
+            heads = [fleet.submit(prompts[0], 12),
+                     fleet.submit(prompts[1], 12)]
+            for h in heads:
+                _wait_active(fleet.engine(h.replica), 1)
+            victim = fleet.submit(prompts[2], 12)
+            fleet.remove_replica(victim.replica, drain=True)
+            for h, o in zip(heads + [victim], oracles):
+                np.testing.assert_array_equal(
+                    h.result(timeout=180).tokens, o)
+            assert len(fleet.replicas) == 1
+
+
+# ------------------------------------------------------------- prefix pool
+class TestPrefixPool:
+    def test_exact_and_partial_hits_are_bitwise_and_ledger_flat(self, lm):
+        """Warm traffic over a shared prefix: exact hit (no program at
+        all), partial hit (remainder-only prefill through the EXISTING
+        bucket programs) — tokens identical to cold, ledger never grows."""
+        base = _prompt(500, 18)
+        ext = np.concatenate([base, np.array([5, 1], np.int32)])
+        cold_base = _oracle(lm, base, 6)
+        cold_ext = _oracle(lm, ext, 6)
+        with ServingEngine(lm, max_len=48, prefix_pool=8,
+                           prefix_chunk=8) as eng:
+            bound = len(eng.buckets) + 2
+            np.testing.assert_array_equal(
+                eng.submit(base, 6).result(timeout=180).tokens, cold_base)
+            # exact hit: same context, pooled next-token, zero prefill
+            np.testing.assert_array_equal(
+                eng.submit(base, 6).result(timeout=180).tokens, cold_base)
+            # partial hit: shares base, new tail seeds at a chunk boundary
+            np.testing.assert_array_equal(
+                eng.submit(ext, 6).result(timeout=180).tokens, cold_ext)
+            st = eng.stats()
+            assert st["prefix_hits"] == 2
+            assert st["prefix_misses"] == 1
+            assert st["prefix_tokens_saved"] >= 18 + 16
+            assert st["compiled_programs"] <= bound
+
+    def test_lru_eviction_is_deterministic(self, lm):
+        """capacity=2: inserting a third distinct prefix evicts the
+        least-recently-used entry, and a repeat of the evicted prompt is a
+        miss (then re-pooled) — hit/evict bookkeeping is exact."""
+        prompts = [_prompt(510 + i, 16) for i in range(3)]
+        oracles = [_oracle(lm, p, 4) for p in prompts]
+        with ServingEngine(lm, max_len=48, prefix_pool=2,
+                           prefix_chunk=8) as eng:
+            for p, o in zip(prompts, oracles):      # 3 misses, 1 eviction
+                np.testing.assert_array_equal(
+                    eng.submit(p, 4).result(timeout=180).tokens, o)
+            st = eng.stats()
+            assert st["prefix_misses"] == 3
+            assert st["prefix_evictions"] == 1
+            assert st["prefix_entries"] == 2
+            # prompts[0] was evicted -> miss; prompts[2] is resident -> hit
+            np.testing.assert_array_equal(
+                eng.submit(prompts[0], 4).result(timeout=180).tokens,
+                oracles[0])
+            np.testing.assert_array_equal(
+                eng.submit(prompts[2], 4).result(timeout=180).tokens,
+                oracles[2])
+            st = eng.stats()
+            assert st["prefix_misses"] == 4
+            assert st["prefix_hits"] == 1
+
+    def test_pool_unit_longest_boundary_wins(self):
+        """Host-only pool mechanics: a context sharing 16 of an entry's 24
+        tokens seeds at the LONGEST chunk boundary (16, not 8), and a
+        diverging context of equal length is a clean miss."""
+        pool = PrefixPool(capacity=4, chunk=8)
+        ctx = np.arange(1, 25, dtype=np.int32)        # 24 tokens
+        pool.insert(ctx, states=(object(),), next_token=7)
+        share16 = np.concatenate(
+            [ctx[:16], np.full(8, 49, np.int32)])
+        hit = pool.lookup(share16, buckets=(8, 16, 32), max_len=64)
+        assert hit is not None and hit[1] == 16
+        exact = pool.lookup(ctx, buckets=(8, 16, 32), max_len=64)
+        assert exact is not None and exact[1] == 24
+        assert exact[0].next_token == 7
+        miss = pool.lookup(np.full(24, 42, np.int32),
+                           buckets=(8, 16, 32), max_len=64)
+        assert miss is None
+        assert pool.stats() == {
+            "entries": 1, "capacity": 4, "chunk": 8, "hits": 2,
+            "misses": 1, "evictions": 0, "tokens_saved": 40}
+
+    def test_pool_unit_hit_needs_seedable_bucket(self):
+        """A partial hit is only usable when the remainder fits a bucket
+        STARTING at the matched depth (`pick_seed_bucket`) — otherwise the
+        cache write would clamp out of bounds, so it must degrade to a
+        miss."""
+        assert pick_seed_bucket(4, (8, 16), base=16, max_len=32) == 8
+        assert pick_seed_bucket(4, (8, 16), base=28, max_len=32) is None
+        pool = PrefixPool(capacity=2, chunk=8)
+        ctx = np.arange(1, 17, dtype=np.int32)
+        pool.insert(ctx, states=(object(),), next_token=3)
+        long_tail = np.concatenate([ctx, np.full(12, 2, np.int32)])
+        # remainder 12 needs a 16-bucket at base 16 -> 32 > max_len 24
+        assert pool.lookup(long_tail, buckets=(8, 16), max_len=24) is None
+
+
+# ------------------------------------------------------ speculative decode
+class TestSpeculativeDecoding:
+    def test_bitwise_at_full_acceptance(self, lm):
+        """Target drafting for itself: every proposal accepted, output
+        bitwise-equal to plain greedy, rounds collapse by ~k."""
+        prompt = np.stack([_prompt(600, 5), _prompt(601, 5)])
+        oracle = np.asarray(nn.greedy_generate(lm, jnp.asarray(prompt), 12))
+        sd = SpeculativeDecoder(lm, lm, spec_tokens=3)
+        np.testing.assert_array_equal(
+            np.asarray(sd.generate(prompt, 12)), oracle)
+        st = sd.stats()
+        assert st["acceptance_rate"] == 1.0
+        assert st["rounds"] < 12   # k+1 tokens per round, not 1
+
+    def test_bitwise_at_zero_acceptance(self, lm, draft_lm):
+        """An unrelated draft proposes garbage: everything is rejected and
+        the correction token (the target's own greedy argmax) still makes
+        the output bitwise-equal to plain greedy — speculation can change
+        SPEED, never tokens."""
+        prompt = _prompt(610, 6)[None, :]
+        oracle = np.asarray(nn.greedy_generate(lm, jnp.asarray(prompt), 12))
+        sd = SpeculativeDecoder(lm, draft_lm, spec_tokens=3)
+        np.testing.assert_array_equal(
+            np.asarray(sd.generate(prompt, 12)), oracle)
+        assert sd.stats()["acceptance_rate"] < 0.5
+
+    def test_eos_truncates_inside_accepted_block(self, lm):
+        """EOS handling: generation stops at the first EOS even when it
+        lands mid-way through an accepted speculative block."""
+        prompt = _prompt(620, 5)[None, :]
+        plain = np.asarray(nn.greedy_generate(lm, jnp.asarray(prompt), 12))
+        eos = int(plain[0, prompt.shape[1] + 4])   # 5th generated token
+        sd = SpeculativeDecoder(lm, lm, spec_tokens=3)
+        out = np.asarray(sd.generate(prompt, 12, eos_id=eos))
+        gen = out[0, prompt.shape[1]:]
+        stop = int(np.argmax(gen == eos))
+        np.testing.assert_array_equal(gen[:stop + 1],
+                                      plain[0, prompt.shape[1]:][:stop + 1])
+
+    def test_engine_with_draft_is_bitwise_and_ledger_flat(self, lm,
+                                                          draft_lm):
+        """The engine's speculative path: continuous batching with a draft
+        model stays bitwise-identical to the solo oracle, and the program
+        ledger keeps the len(buckets)+2 bound (spec programs REPLACE the
+        plain ones, they do not add)."""
+        prompts = [_prompt(630 + i, 3 + i % 4) for i in range(5)]
+        oracles = [_oracle(lm, p, 10) for p in prompts]
+        with ServingEngine(lm, max_len=48, draft_model=draft_lm,
+                           spec_tokens=3, buckets=(8,)) as eng:
+            handles = [eng.submit(p, 10) for p in prompts]
+            for h, o in zip(handles, oracles):
+                np.testing.assert_array_equal(
+                    h.result(timeout=180).tokens, o)
+            st = eng.stats()
+            assert st["compiled_programs"] <= len(eng.buckets) + 2
+            assert st["spec_tokens"] == 3
+            assert st["spec_proposed"] > 0
+            assert 0.0 <= st["spec_acceptance"] <= 1.0
+
+    def test_engine_spec_headroom_rejected_at_submit(self, lm):
+        """Speculative overshoot headroom: prompt + budget + k must fit the
+        cache window, checked at the door (dynamic_update_slice would
+        silently CLAMP a too-deep write otherwise)."""
+        with ServingEngine(lm, max_len=48, draft_model=lm,
+                           spec_tokens=4, buckets=(8,)) as eng:
+            with pytest.raises(ValueError, match="spec_tokens"):
+                eng.submit(_prompt(640, 8), 40)   # 8 + 40 + 4 > 48
+            assert eng.submit(_prompt(641, 4), 40).result(
+                timeout=180).n_generated == 40
+
+    def test_multitenant_draft_models_route_per_tenant(self, lm, draft_lm):
+        """SnapshotServer(draft_models=...): the named tenant decodes
+        speculatively, its neighbor decodes plain, both bitwise."""
+        p = _prompt(650, 4)
+        oracle = _oracle(lm, p, 8)
+        with SnapshotServer({"fast": lm, "plain": lm}, max_len=48,
+                            draft_models={"fast": lm},
+                            buckets=(8,)) as srv:
+            fast = srv.submit("fast", p, 8).result(timeout=180)
+            plain = srv.submit("plain", p, 8).result(timeout=180)
+            np.testing.assert_array_equal(fast.tokens, oracle)
+            np.testing.assert_array_equal(plain.tokens, oracle)
+            assert srv.engine("fast").stats()["spec_tokens"] > 0
+            assert srv.engine("plain").stats()["spec_tokens"] == 0
+
+
+# ------------------------------------------------------------ fleet obs
+class TestFleetObservability:
+    def test_metrics_and_healthz_cover_dead_replica(self, lm):
+        """/metrics grows per-replica {fleet=,replica=} gauges; /healthz
+        reports a dead replica as DEGRADED (not 503) while a healthy peer
+        covers it — the router is routing around the hole."""
+        from bigdl_tpu.obs import exporter
+        plan = faults.parse_plan("replica_down@1")
+        with faults.inject_faults(plan):
+            with FleetRouter.replicate(lm, max_len=48, replicas=2,
+                                       buckets=(8,)) as fleet:
+                h = fleet.submit(_prompt(700, 4), 6)
+                h.result(timeout=180)
+                assert plan.unfired() == []
+                _wait_healthy(fleet, 1)
+                text = exporter.render_metrics()
+                parsed = exporter.parse_metrics(text)
+                assert parsed['bigdl_fleet_healthy_replicas'
+                              '{fleet="fleet"}'] == 1.0
+                assert parsed['bigdl_fleet_replica_completed'
+                              '{fleet="fleet",replica="fleet-r0"}'] >= 0.0
+                health_rows = [k for k in parsed
+                               if k.startswith("bigdl_fleet_replica_health")]
+                assert len(health_rows) == 2
+                code, payload = exporter.render_healthz()
+                assert code == 200
+                assert payload["status"] == "degraded"
+                fl = payload["fleets"]["fleet"]
+                assert fl["healthy_replicas"] == 1
+                assert "dead" in fl["replicas"].values()
+
+    def test_top_renders_fleet_table(self, lm):
+        """`bigdl-tpu top` shows the per-replica fleet table from a canned
+        scrape — the pure renderer contract."""
+        from bigdl_tpu.cli import _render_top
+        from bigdl_tpu.obs import exporter
+        with FleetRouter.replicate(lm, max_len=48, replicas=2,
+                                   buckets=(8,)) as fleet:
+            fleet.submit(_prompt(710, 4), 4).result(timeout=180)
+            parsed = exporter.parse_metrics(exporter.render_metrics())
+            _, payload = exporter.render_healthz()
+            out = _render_top(parsed, payload)
+        assert "fleet fleet" in out
+        assert "fleet-r0" in out and "fleet-r1" in out
+        assert "dispatched 1" in out

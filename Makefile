@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming t1-fleet dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming t1-fleet t1-recsys dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -73,6 +73,16 @@ t1-streaming:
 t1-fleet:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fleet --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
+# Sharded-embedding recsys suite only (docs/performance.md "Sharded
+# embeddings & sparse updates"): sharded-vs-replicated NCF bitwise under the
+# 8-device dryrun mesh, dedup-gather equivalence, sparse-vs-dense optimizer
+# equality per method (touched rows exact, untouched bitwise-unchanged),
+# padding/id-guard satellites, HR/NDCG device folds, sharded-table
+# checkpoint round trip. Unmarked-slow, so `make t1` runs these too; this
+# is the fast inner loop for recsys/embedding work.
+t1-recsys:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m recsys --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
 dist:
 	bash make-dist.sh
 
@@ -92,6 +102,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serving-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --fleet-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --stream-bench --no-compare-dtypes --no-streamed
+	JAX_PLATFORMS=cpu python bench.py --recsys-bench --no-compare-dtypes --no-streamed
 
 # Host input-pipeline leg (decode→augment→stack on a synthetic image folder):
 # pipeline_images_per_sec at BIGDL_DATA_WORKERS 0/1/4/auto + per-stage ms.

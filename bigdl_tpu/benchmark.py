@@ -1613,6 +1613,128 @@ def _measure_fleet_bench(n_requests: int = 24, replicas: int = 2,
     }
 
 
+def _measure_recsys_bench(batch: int = 256, iters: int = 10,
+                          reps: int = 3) -> dict:
+    """Sharded-embedding / recsys leg, three questions (docs/performance.md,
+    "Sharded embeddings & sparse updates"):
+
+    1. **Sparse vs dense step time**: an embedding-dominated train step over
+       a (V, 64) table at V ∈ {1e5, 1e6} on batch-256 zipf ids. The dense
+       baseline is the STRONGEST dense configuration (flat fused update over
+       the full (V, 64) table); the sparse leg is ShardedEmbedding +
+       SparseEmbeddingUpdate (per-row Adagrad on the deduped unique rows).
+       Legs run best-of-interleaved so scheduler noise hits both equally;
+       the headline ratio is dense/sparse step time at V=1e6.
+    2. **Dedup hit-rate** of the zipf traffic — the fraction of gathers the
+       per-batch unique pass eliminates.
+    3. **Ranking serving**: RankingEngine sustained req/s over a small
+       NeuralCF snapshot (the train→rank→serve loop's last leg), with its
+       one-static-shape compile bound.
+    """
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.models.ncf import NeuralCF
+    from bigdl_tpu.optim import Adagrad, Trigger
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.parallel import ShardedEmbedding
+    from bigdl_tpu.serving import RankingEngine
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.reset()
+    Engine.init()   # fp32 — the sparse plan requires full-precision updates
+    dev = Engine.devices()[0]
+    dim = 64
+    rng = np.random.default_rng(0)
+
+    def zipf_batch(v):
+        ids = rng.zipf(1.3, size=batch).astype(np.int64)   # power-law traffic
+        return ((ids - 1) % v + 1).astype(np.int32)
+
+    id_batches = {v: [zipf_batch(v) for _ in range(4)]
+                  for v in (100_000, 1_000_000)}
+
+    def build_opt(v, sparse):
+        table = nn.LookupTable(v, dim)
+        model = ShardedEmbedding(table) if sparse else table
+        batches = [MiniBatch(ids, np.zeros((batch, dim), np.float32))
+                   for ids in id_batches[v]]
+        opt = LocalOptimizer(model, DataSet.array(batches), nn.MSECriterion())
+        opt.set_optim_method(Adagrad(learningrate=0.01))
+        if not sparse:
+            opt.set_flat_update(True)   # strongest dense baseline
+        opt.log_every = 10 ** 9
+        opt.set_end_when(Trigger.max_iteration(2))
+        opt.optimize()   # builds + warms the real compiled step
+        return opt
+
+    def step_ms(opt):
+        ips = _measure_direct_step(opt, batch, iters)
+        return 1e3 * batch / ips
+
+    per_v, plan_ok = {}, True
+    for v in (100_000, 1_000_000):
+        dense_opt = build_opt(v, sparse=False)
+        sparse_opt = build_opt(v, sparse=True)
+        plan_ok = plan_ok and sparse_opt._sparse_plan() is not None
+        dense_t, sparse_t = [], []
+        for _ in range(reps):   # interleaved: noise hits both legs equally
+            dense_t.append(step_ms(dense_opt))
+            sparse_t.append(step_ms(sparse_opt))
+        per_v[v] = (min(dense_t), min(sparse_t))
+
+    # dedup hit-rate of the same traffic (host-side ground truth)
+    uniq = [len(np.unique(ids)) for ids in id_batches[1_000_000]]
+    dedup_hit_rate = 1.0 - sum(uniq) / (len(uniq) * batch)
+
+    # ranking serving leg: small NCF snapshot, 64 coalesced requests
+    n_rank, n_cand = 64, 50
+    ncf = NeuralCF(200, 100, class_num=2)
+    with RankingEngine(ncf, max_candidates=n_cand, max_batch=8) as eng:
+        eng.rank(1, np.arange(1, n_cand + 1), timeout=300)   # compile + warm
+        t0 = time.perf_counter()
+        handles = [eng.submit(u % 200 + 1,
+                              rng.integers(1, 101, size=n_cand))
+                   for u in range(n_rank)]
+        for h in handles:
+            h.result(timeout=300)
+        rank_rps = n_rank / (time.perf_counter() - t0)
+        rank_stats = eng.stats()
+
+    ratios = {v: (d / s if s else None) for v, (d, s) in per_v.items()}
+    record_extra = {}
+    if not plan_ok or (ratios[1_000_000] or 0.0) < 5.0:
+        reason = ("recsys leg off-script: "
+                  + ("sparse plan did not engage" if not plan_ok else
+                     f"sparse speedup {ratios[1_000_000]:.2f}x at V=1e6 "
+                     "(want >= 5x over the dense flat update)"))
+        print(f"bench: DEGRADED RUN — {reason}", file=sys.stderr)
+        record_extra = {"degraded": True, "probe_error": reason}
+    return {
+        "value": round(ratios[1_000_000], 2) if ratios[1_000_000] else None,
+        "unit": "x dense/sparse step time (V=1e6)",
+        "batch": batch,
+        "embed_dim": dim,
+        "iters": iters,
+        "reps": reps,
+        "dense_step_ms_100k": round(per_v[100_000][0], 3),
+        "sparse_step_ms_100k": round(per_v[100_000][1], 3),
+        "sparse_speedup_100k": round(ratios[100_000], 2),
+        "dense_step_ms_1m": round(per_v[1_000_000][0], 3),
+        "sparse_step_ms_1m": round(per_v[1_000_000][1], 3),
+        "sparse_speedup_1m": round(ratios[1_000_000], 2),
+        "dedup_hit_rate": round(dedup_hit_rate, 3),
+        "ranking_requests_per_sec": round(rank_rps, 1),
+        "ranking_mean_batch_fill": round(rank_stats["mean_batch_fill"], 2),
+        "ranking_compiled_programs": rank_stats["compiled_programs"],
+        "device_kind": dev.device_kind,
+        "platform": dev.platform,
+        **record_extra,
+    }
+
+
 def _measure_ablation(model_name: str, batch: int, iters: int) -> dict:
     """Step-time attribution (the committed profile analysis): time the full
     compiled train step and its sub-programs — forward-only, forward+backward,
@@ -1945,6 +2067,7 @@ def run_orchestrator(args) -> None:
     precision_bench = getattr(args, "precision_bench", False)
     serving_bench = getattr(args, "serving_bench", False)
     fleet_bench = getattr(args, "fleet_bench", False)
+    recsys_bench = getattr(args, "recsys_bench", False)
     worker_argv = ["--run", "--model", args.model, "--batch", str(args.batch),
                    "--iters", str(args.iters), "--warmup", str(args.warmup),
                    "--dtype", args.dtype]
@@ -1975,6 +2098,8 @@ def run_orchestrator(args) -> None:
         worker_argv.append("--serving-bench")
     if fleet_bench:
         worker_argv.append("--fleet-bench")
+    if recsys_bench:
+        worker_argv.append("--recsys-bench")
     env = dict(os.environ)
     # Fast-fail: one cheap bounded probe decides whether the accelerator
     # backend answers AT ALL before any full measurement attempt is allowed
@@ -2005,7 +2130,7 @@ def run_orchestrator(args) -> None:
                     and not stream_bench and not obs_bench \
                     and not kernel_bench \
                     and not precision_bench and not serving_bench \
-                    and not fleet_bench:
+                    and not fleet_bench and not recsys_bench:
                 # the comparison leg only feeds the ratio — skip its streamed
                 # measurement (it would be discarded)
                 cmp_argv = ["--run", "--model", args.model,
@@ -2044,7 +2169,7 @@ def run_orchestrator(args) -> None:
     if args.int8_infer or args.serving or args.decode_infer or args.ablate \
             or args.eval_bench or pipeline_bench or stream_bench \
             or obs_bench or kernel_bench or precision_bench \
-            or serving_bench or fleet_bench:
+            or serving_bench or fleet_bench or recsys_bench:
         # a LeNet training number would not answer an inference-path request:
         # fail loudly with the metric the caller asked for
         kind = ("int8_vs_bf16_infer" if args.int8_infer
@@ -2058,6 +2183,7 @@ def run_orchestrator(args) -> None:
                 else "precision_bench" if precision_bench
                 else "serving_engine" if serving_bench
                 else "serving_fleet" if fleet_bench
+                else "recsys_bench" if recsys_bench
                 else "step_ablation")
         record = {
             "metric": f"{args.model}_{kind}",
@@ -2181,6 +2307,12 @@ def main(argv=None):
                         "replica, shared-prefix TTFT with the prefix "
                         "KV-cache pool warm vs cold, speculative-decode "
                         "tokens/s at pinned 100% acceptance vs plain")
+    p.add_argument("--recsys-bench", dest="recsys_bench",
+                   action="store_true",
+                   help="sharded-embedding recsys leg: sparse vs dense "
+                        "(flat-update) step time on a (V, 64) table at "
+                        "V=1e5/1e6 with zipf ids, dedup hit-rate, and "
+                        "RankingEngine req/s on a small NeuralCF")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
     args = p.parse_args(argv)
@@ -2240,6 +2372,10 @@ def _run_worker_modes(args) -> int:
     elif getattr(args, "fleet_bench", False):
         res = _measure_fleet_bench()
         res["metric"] = "transformerlm_serving_fleet"
+        res["vs_baseline"] = None
+    elif getattr(args, "recsys_bench", False):
+        res = _measure_recsys_bench(iters=max(args.iters // 2, 5))
+        res["metric"] = "ncf_recsys_bench"
         res["vs_baseline"] = None
     elif args.ablate:
         res = _measure_ablation(args.model, args.batch,

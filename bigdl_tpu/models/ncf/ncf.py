@@ -20,17 +20,26 @@ from bigdl_tpu import nn
 def NeuralCF(user_count: int, item_count: int, class_num: int = 2,
              user_embed: int = 16, item_embed: int = 16,
              hidden_layers: tuple[int, ...] = (32, 16, 8),
-             mf_embed: int = 8, hash_buckets: int = 0) -> nn.Graph:
+             mf_embed: int = 8, hash_buckets: int = 0,
+             sharded: bool = False) -> nn.Graph:
     """Build NeuMF. ``hash_buckets > 0`` switches both id spaces to the hashing
     trick (``HashBucketEmbedding``) so unbounded ids need no dictionary.
+    ``sharded=True`` wraps every table in ``parallel.ShardedEmbedding``:
+    row-sharded placement over the mesh's ``model`` axis, deduped gathers, and
+    sparse per-row optimizer updates when trained (bitwise-equal forward).
 
     Input: (N, 2) int32 of 1-based (user, item) ids — or raw ids when hashing.
     Output: (N, class_num) log-probabilities.
     """
     def make_embed(count: int, dim: int):
         if hash_buckets > 0:
-            return nn.HashBucketEmbedding(hash_buckets, dim)
-        return nn.LookupTable(count, dim)
+            table = nn.HashBucketEmbedding(hash_buckets, dim)
+        else:
+            table = nn.LookupTable(count, dim)
+        if sharded:
+            from bigdl_tpu.parallel.embedding import ShardedEmbedding
+            return ShardedEmbedding(table)
+        return table
 
     inp = nn.Input()
     user = nn.Select(2, 1).inputs(inp)   # (N,) user ids

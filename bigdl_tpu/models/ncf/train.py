@@ -30,6 +30,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--k", type=int, default=10)
     p.add_argument("--hash-buckets", type=int, default=0,
                    help=">0: use the hashing trick instead of dense vocab")
+    p.add_argument("--sharded", action="store_true",
+                   help="row-shard the embedding tables over the mesh's "
+                        "'model' axis (parallel.ShardedEmbedding): deduped "
+                        "gathers + sparse per-row optimizer updates")
     p.add_argument("--distributed", action="store_true")
     return p
 
@@ -96,14 +100,13 @@ def build_eval_batches(users, pos_items, item_count, neg_num, batch_groups=8,
 def main(argv=None):
     args = build_parser().parse_args(argv)
 
-    import jax.numpy as jnp
-
     from bigdl_tpu import nn
     from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
     from bigdl_tpu.models.ncf import NeuralCF
     from bigdl_tpu.optim import (
         Adam, DistriOptimizer, HitRatio, LocalOptimizer, NDCG, SGD, Trigger,
     )
+    from bigdl_tpu.optim.evaluator import run_device_eval
     from bigdl_tpu.utils.engine import Engine
 
     Engine.init()
@@ -125,7 +128,7 @@ def main(argv=None):
         >> SampleToMiniBatch(args.batch_size)
 
     model = NeuralCF(args.user_count, args.item_count, class_num=2,
-                     hash_buckets=args.hash_buckets)
+                     hash_buckets=args.hash_buckets, sharded=args.sharded)
     cls = DistriOptimizer if args.distributed else LocalOptimizer
     if args.optimizer == "adam":
         method = Adam(learningrate=args.learning_rate)
@@ -146,13 +149,11 @@ def main(argv=None):
     model.evaluate()
     hr = HitRatio(k=args.k, neg_num=args.eval_neg_num)
     ndcg = NDCG(k=args.k, neg_num=args.eval_neg_num)
-    hr_res = ndcg_res = None
-    for b in batches:
-        scores = np.asarray(model.forward(jnp.asarray(b.input)))[:, 1]
-        r1 = hr.apply(scores, b.target, b.valid)
-        r2 = ndcg.apply(scores, b.target, b.valid)
-        hr_res = r1 if hr_res is None else hr_res + r1
-        ndcg_res = r2 if ndcg_res is None else ndcg_res + r2
+    # device-resident eval: HR/NDCG fold into O(1) scalars on device — the
+    # only d2h traffic is the final accumulated pytree, never the logits
+    hr_res, ndcg_res = run_device_eval(
+        model, model.get_params(), model.get_state(),
+        DataSet.array(batches), [hr, ndcg])[0]
     hr_v, n = hr_res.result()
     ndcg_v, _ = ndcg_res.result()
     random_hr = args.k / (args.eval_neg_num + 1)

@@ -9,23 +9,63 @@ emits natively — no sparse-gradient special-casing like Torch's. max-norm reno
 functionally in the forward pass (matching Torch semantics of renorm-before-lookup).
 
 Out-of-range behaviour differs from the reference: the reference raises on bad indices, but
-a jitted gather cannot — JAX *clamps* out-of-bounds indices and wraps negative ones, so an
-off-by-one in user data silently reads a wrong row. Callers can assert ranges host-side;
-``zero_based=True`` is the safest choice for new code.
+a jitted gather *clamps* out-of-bounds indices and wraps negative ones, so an off-by-one in
+user data silently reads a wrong row. ``BIGDL_CHECK_IDS=1`` turns on an explicit guard:
+eager forwards assert host-side (raising ``IndexError`` with the offending range), and
+inside jit the check is emitted through ``jax.experimental.checkify`` whenever a
+functionalizing scope is active (``checkify_ids_scope`` — the Optimizer's
+``set_check_numerics`` step enters it, so ``BIGDL_CHECK_IDS=1 BIGDL_CHECK_NUMERICS=1``
+composes into one checked train step). Traced without such a scope the guard is skipped —
+a bare ``checkify.check`` under plain ``jit`` is a trace error, not a runtime one.
+
+Padding: ``padding_value=None`` (default) disables masking. A numeric value masks the
+embedding of that id to zeros — including id 0 in ``zero_based=True`` mode (the historical
+``!= 0.0`` guard made row 0 unmaskable). 1-based semantics are unchanged bitwise: ids are
+1-based there, so ``padding_value=0`` still means "no padding row" and any non-zero value
+masks the same row as before.
 """
 
 from __future__ import annotations
 
+import contextlib
+import os
+import threading
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu.nn.abstractnn import TensorModule
 from bigdl_tpu.nn.initialization import InitializationMethod, RandomNormal
 
+_IDS_CHECK_SCOPE = threading.local()
+
+
+@contextlib.contextmanager
+def checkify_ids_scope():
+    """While active (per thread), a traced ``BIGDL_CHECK_IDS=1`` guard emits
+    ``checkify.check`` calls — only enter around code that is being
+    functionalized by ``checkify.checkify`` (the checked train step does)."""
+    prev = getattr(_IDS_CHECK_SCOPE, "active", False)
+    _IDS_CHECK_SCOPE.active = True
+    try:
+        yield
+    finally:
+        _IDS_CHECK_SCOPE.active = prev
+
+
+def _ids_scope_active() -> bool:
+    return getattr(_IDS_CHECK_SCOPE, "active", False)
+
+
+def check_ids_enabled() -> bool:
+    return os.environ.get("BIGDL_CHECK_IDS", "0") == "1"
+
 
 class LookupTable(TensorModule):
-    def __init__(self, n_index: int, n_output: int, padding_value: float = 0.0,
+    def __init__(self, n_index: int, n_output: int,
+                 padding_value: Optional[float] = None,
                  max_norm: float = float("inf"), norm_type: float = 2.0,
                  should_scale_grad_by_freq: bool = False,
                  w_init: Optional[InitializationMethod] = None,
@@ -46,22 +86,82 @@ class LookupTable(TensorModule):
                              fan_in=self.n_index, fan_out=self.n_output))}
         self.zero_grad_parameters()
 
-    def apply(self, params, state, input, *, training=False, rng=None):
+    # ---------------------------------------------------------- lookup core
+    # Factored so parallel/embedding.py's ShardedEmbedding can reuse the exact
+    # same id normalization / renorm / padding math on its dedup + sharded
+    # paths (bitwise equality to this layer is a test invariant).
+    def _ids(self, input):
+        """Raw input → 0-based int32 row indices (guarded when enabled)."""
         idx = input.astype(jnp.int32)
         if not self.zero_based:
             idx = idx - 1  # reference/Torch indices are 1-based
-        w = params["weight"]
-        if self.max_norm != float("inf"):
-            norms = jnp.power(
-                jnp.sum(jnp.power(jnp.abs(w), self.norm_type), axis=1, keepdims=True),
-                1.0 / self.norm_type)
-            scale = jnp.minimum(1.0, self.max_norm / (norms + 1e-7))
-            w = w * scale
-        out = w[idx]
-        if self.padding_value != 0.0:
-            pad_idx = int(self.padding_value) - (0 if self.zero_based else 1)
-            out = jnp.where((idx == pad_idx)[..., None], 0.0, out)
-        return out, state
+        if check_ids_enabled():
+            self._guard_ids(idx)
+        return idx
+
+    def _guard_ids(self, idx) -> None:
+        if isinstance(idx, jax.core.Tracer):
+            if _ids_scope_active():
+                from jax.experimental import checkify
+                checkify.check(
+                    jnp.all((idx >= 0) & (idx < self.n_index)),
+                    f"{self!r}: id out of range [0, {self.n_index}) after "
+                    "base adjustment (min={mn}, max={mx})",
+                    mn=jnp.min(idx), mx=jnp.max(idx))
+            return
+        a = np.asarray(idx)
+        if a.size and (int(a.min()) < 0 or int(a.max()) >= self.n_index):
+            raise IndexError(
+                f"{self!r}: ids out of range — after base adjustment indices "
+                f"span [{int(a.min())}, {int(a.max())}] but the table has "
+                f"{self.n_index} rows (valid range [0, {self.n_index})). "
+                "A jitted gather would silently clamp these "
+                "(BIGDL_CHECK_IDS=1 caught it).")
+
+    def _pad_index(self) -> Optional[int]:
+        """Padding row as a 0-based index, or None when masking is off.
+        1-based mode keeps the reference convention that padding_value=0
+        means "no padding" (ids start at 1); zero-based mode can mask row 0."""
+        if self.padding_value is None:
+            return None
+        p = int(self.padding_value)
+        if not self.zero_based:
+            return None if p == 0 else p - 1
+        return p
+
+    def _renorm(self, w):
+        """Full-table max-norm renorm (Torch renorm-before-lookup semantics)."""
+        if self.max_norm == float("inf"):
+            return w
+        norms = jnp.power(
+            jnp.sum(jnp.power(jnp.abs(w), self.norm_type), axis=1, keepdims=True),
+            1.0 / self.norm_type)
+        scale = jnp.minimum(1.0, self.max_norm / (norms + 1e-7))
+        return w * scale
+
+    # renorm is row-local (each row scaled by its own norm), so applying the
+    # identical formula to an already-gathered (U, D) row block is the same
+    # arithmetic per row — what lets deduped gathers renorm U rows, not V
+    _renorm_rows = _renorm
+
+    def _mask_padding(self, out, idx):
+        pad = self._pad_index()
+        if pad is None:
+            return out
+        return jnp.where((idx == pad)[..., None], 0.0, out)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        idx = self._ids(input)
+        out = self._renorm(params["weight"])[idx]
+        return self._mask_padding(out, idx), state
+
+    def forward(self, input):
+        # The jitted apply only ever sees Tracers, where the host-side guard
+        # cannot fire; run the id normalization eagerly on the concrete batch
+        # first so BIGDL_CHECK_IDS=1 raises before the gather clamps.
+        if check_ids_enabled():
+            self._ids(jnp.asarray(input))
+        return super().forward(input)
 
     def __repr__(self):
         return f"LookupTable({self.n_index} -> {self.n_output})"
@@ -81,7 +181,7 @@ class HashBucketEmbedding(LookupTable):
                  w_init: Optional[InitializationMethod] = None):
         super().__init__(n_buckets, n_output, w_init=w_init, zero_based=True)
 
-    def apply(self, params, state, input, *, training=False, rng=None):
+    def _ids(self, input):
         h = input.astype(jnp.uint32)
         # murmur3-style 32-bit finalizer: full avalanche, so every bucket in
         # [0, n_buckets) is reachable for any n_buckets up to 2^32 — a handful
@@ -91,8 +191,7 @@ class HashBucketEmbedding(LookupTable):
         h = h ^ (h >> jnp.uint32(13))
         h = h * jnp.uint32(0xC2B2AE35)
         h = h ^ (h >> jnp.uint32(16))
-        bucket = (h % jnp.uint32(self.n_index)).astype(jnp.int32)
-        return super().apply(params, state, bucket, training=training, rng=rng)
+        return (h % jnp.uint32(self.n_index)).astype(jnp.int32)
 
     def __repr__(self):
         return f"HashBucketEmbedding({self.n_index} buckets -> {self.n_output})"

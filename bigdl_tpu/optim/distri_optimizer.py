@@ -66,10 +66,19 @@ class DistriOptimizer(Optimizer):
             return False
         return True
 
+    def _sparse_embed_ok(self) -> bool:
+        # The sparse wrapper's slot tree ({"dense": ..., "embed": ...}) does
+        # not match the param-path layouts ZeRO-1/FSDP/TP shard slots by, so
+        # sparse embedding updates ride only the replicated-slot (allreduce,
+        # no-TP) configuration; tensor-parallel row-sharded tables keep the
+        # dense update (GSPMD still shards its gather/scatter).
+        return self.parameter_sync == "allreduce" and self.tp_rules is None
+
     def set_parameter_sync(self, mode: str) -> "DistriOptimizer":
         if mode not in self._SYNC_MODES:
             raise ValueError(f"parameter_sync must be one of {self._SYNC_MODES}")
         self.parameter_sync = mode
+        self._sparse_plan_memo = "_unset"
         self._step_cache = None
         return self
 
@@ -79,6 +88,7 @@ class DistriOptimizer(Optimizer):
         PartitionSpecs over the mesh's ``model`` axis. XLA's SPMD partitioner
         splits the matmuls and inserts the activation collectives."""
         self.tp_rules = rules
+        self._sparse_plan_memo = "_unset"
         self._step_cache = None
         return self
 

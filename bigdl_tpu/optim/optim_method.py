@@ -79,6 +79,35 @@ class OptimMethod:
                               params, new_mp, trainable)
         return new_params, new_state
 
+    # ------------------------------------------------- sparse-row protocol
+    # Sparse embedding training (parallel/embedding.py) steps ONLY the rows a
+    # batch gathered: the step hands the method a (U, D) row block of params,
+    # gradients and slots instead of whole leaves. Any purely elementwise
+    # method is row-sliceable for free — the same update formula on a
+    # sub-block of rows IS the dense formula restricted to those rows — so
+    # the default delegates to ``update``. Methods whose state carries
+    # non-param-shaped leaves (SGD's stateful-schedule ``clr``) or path-keyed
+    # routing (``layer_lr_mults``) opt out via ``supports_sparse_update``.
+    #
+    # Semantics are LAZY (torch SparseAdam-style): untouched rows and their
+    # slot rows are bitwise-unchanged — time-decay terms (weight decay,
+    # moment decay) advance only when a row is touched.
+    def supports_sparse_update(self) -> bool:
+        if not self.elementwise_update:
+            return False
+        if getattr(self, "layer_lr_mults", None):
+            return False
+        sched = getattr(self, "learningrate_schedule", None)
+        if sched is not None and getattr(sched, "stateful", False):
+            return False
+        return True
+
+    def sparse_update(self, rows, grad_rows, slot_rows, step):
+        """Update a gathered (U, D) row block: returns (new_rows,
+        new_slot_rows). ``slot_rows`` mirrors ``init_state``'s structure with
+        each slot leaf row-sliced the same way as ``rows``."""
+        return self.update(rows, grad_rows, slot_rows, step)
+
     def get_learning_rate(self, step: int) -> float:
         return 0.0
 

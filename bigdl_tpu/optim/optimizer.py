@@ -25,7 +25,7 @@ import re
 import sys
 import threading
 import time
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -184,6 +184,14 @@ class Optimizer:
         # fused vector kernel instead of per-leaf launches
         self.flat_update: bool = os.environ.get(
             "BIGDL_FLAT_UPDATE", "0") == "1"
+        # sparse embedding updates (set_sparse_embeddings / BIGDL_EMBED_SPARSE):
+        # models containing parallel/embedding.ShardedEmbedding tables step
+        # only the rows each batch gathered (None = auto: on when the model
+        # and method are eligible; "0"/"1" force)
+        _sparse_env = os.environ.get("BIGDL_EMBED_SPARSE", "")
+        self.sparse_embed: Optional[bool] = (
+            None if _sparse_env not in ("0", "1") else _sparse_env == "1")
+        self._sparse_plan_memo: Any = "_unset"
         # Auxiliary-loss convention: modules that declare an ``aux_loss`` leaf
         # in their state (MoE load balancing, parallel/moe.py) get it added to
         # the training objective scaled by this weight. 0.01 is the Switch
@@ -477,10 +485,69 @@ class Optimizer:
         the current sharding configuration?"""
         return True
 
+    def set_sparse_embeddings(self, enabled: bool = True) -> "Optimizer":
+        """Step only the embedding rows each batch gathered, for models whose
+        tables are wrapped in ``parallel/embedding.ShardedEmbedding``: the
+        step differentiates a per-unique-row delta (no dense (V, D) gradient
+        is materialized) and the method's ``sparse_update`` touches only
+        those rows and their optimizer-slot rows — untouched rows stay
+        bitwise-unchanged (lazy semantics). Auto-enabled when eligible;
+        ``set_sparse_embeddings(False)`` forces the dense path."""
+        self.sparse_embed = bool(enabled)
+        self._sparse_plan_memo = "_unset"
+        self._step_cache = self._window_cache = None
+        self._final_ostate = None  # slot layout changes with the wrapper
+        return self
+
+    def _sparse_embed_ok(self) -> bool:
+        """Subclass hook: may sparse embedding updates run under the current
+        sharding configuration?"""
+        return True
+
+    def _sparse_plan(self):
+        """The model's sparse-embedding plan, or None for the dense path.
+        Memoized (and its fallback reason logged once) because the step
+        builder, ostate init and resume-compat checks must all agree."""
+        if self._sparse_plan_memo != "_unset":
+            return self._sparse_plan_memo
+        plan, reason = None, None
+        if self.sparse_embed is not False:
+            from bigdl_tpu.parallel.embedding import build_sparse_plan
+            plan, reason = build_sparse_plan(self.model, self.optim_method)
+            if plan is not None:
+                if self.grad_accum > 1:
+                    plan, reason = None, ("gradient accumulation scans need "
+                                          "a dense gradient carry")
+                elif Engine.compute_dtype() != jnp.float32:
+                    plan, reason = None, "mixed precision casts the gathered rows"
+                elif getattr(self.model, "schedule", None) == "1f1b":
+                    plan, reason = None, "1f1b pipeline owns the train step"
+                elif not self._sparse_embed_ok():
+                    plan, reason = None, ("current parameter_sync/tensor-"
+                                          "parallel configuration")
+        if reason is not None and (self.sparse_embed or plan is None):
+            logger.warning(
+                "sparse embedding updates unavailable (%s); training the "
+                "embedding tables densely", reason)
+        if plan is not None:
+            logger.info("sparse embedding updates active: %r", plan)
+        self._sparse_plan_memo = plan
+        return plan
+
     def _effective_method(self) -> OptimMethod:
         """The method the compiled step actually runs: the configured one,
-        wrapped for flat-vector updates when enabled and eligible."""
+        wrapped for sparse embedding updates and/or flat-vector updates when
+        enabled and eligible (sparse wins — the flat wrapper has no sparse
+        form)."""
         method = self.optim_method
+        plan = self._sparse_plan()
+        if plan is not None:
+            from bigdl_tpu.parallel.embedding import SparseEmbeddingUpdate
+            if self.flat_update:
+                logger.warning(
+                    "BIGDL_FLAT_UPDATE skipped: sparse embedding updates "
+                    "wrap the method first")
+            return SparseEmbeddingUpdate(method, plan)
         if self.flat_update and self._flat_update_ok():
             from bigdl_tpu.kernels.fused_update import (
                 FlatParamUpdate, flat_supported,
@@ -509,6 +576,7 @@ class Optimizer:
         if n_micro != int(n_micro) or int(n_micro) < 1:
             raise ValueError(f"n_micro must be a positive integer, got {n_micro!r}")
         self.grad_accum = int(n_micro)
+        self._sparse_plan_memo = "_unset"  # accum > 1 disables the sparse path
         self._step_cache = self._window_cache = None
         return self
 
@@ -554,6 +622,7 @@ class Optimizer:
 
         model, criterion = self.model, self.criterion
         method = self._effective_method()
+        sparse_plan = self._sparse_plan()
         needs_rng = model.needs_rng()
         aux_w = self.aux_loss_weight
         # per-layer LR multipliers (setScaleW/setScaleB): static constants —
@@ -671,6 +740,36 @@ class Optimizer:
             if remat != "none":
                 loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
             vg = jax.value_and_grad(loss_fn, has_aux=True)
+            if sparse_plan is not None:
+                # Sparse embedding step (parallel/embedding.py): differentiate
+                # a zero per-unique-row delta injected through the module-state
+                # channel — autodiff yields the exact (U, D) row gradient per
+                # table; the table weights themselves sit under stop_gradient
+                # inside ShardedEmbedding.apply, so their dense grads are
+                # exact zeros that mask_embed trims before XLA sees them.
+                def loss_fn_sparse(p_and_d, ms, x, t, rng):
+                    p, deltas = p_and_d
+                    return loss_fn(p, sparse_plan.inject(ms, deltas),
+                                   x, t, rng)
+
+                deltas0 = sparse_plan.zero_deltas(model, params, mstate,
+                                                  inp, rng0)
+                (loss, new_ms), (grads, row_grads) = jax.value_and_grad(
+                    loss_fn_sparse, has_aux=True)(
+                        (params, deltas0), mstate, inp, target, rng0)
+                uids_map, new_ms = sparse_plan.pop_uids(new_ms)
+                grads = sparse_plan.mask_embed(grads)
+                if scale_tree is not None:
+                    # plan entries require scale 1.0 on the table weight, so
+                    # only the dense leaves are scaled (0-size embed leaves
+                    # pass through the map unchanged)
+                    grads = jax.tree_util.tree_map(
+                        lambda g, s: g * s, grads, scale_tree)
+                grads, row_grads = self._clip_grads((grads, row_grads))
+                new_p, new_os = method.sparse_apply(
+                    params, grads, row_grads, uids_map, ostate, step_idx,
+                    trainable_mask)
+                return new_p, new_ms, new_os, loss
             if pipe_fn is not None:
                 # stages are stateless (GPipe contract) → mstate passes
                 # through; frozen leaves stop-gradient through the flat rows
@@ -756,8 +855,14 @@ class Optimizer:
         diverging run is additionally guarded by an explicit finite-loss check."""
         from jax.experimental import checkify
 
+        from bigdl_tpu.nn.embedding import checkify_ids_scope
+
         def step_guarded(*args):
-            new_p, new_ms, new_os, loss = step(*args)
+            # BIGDL_CHECK_IDS composes here: tracing under this scope lets
+            # embedding layers emit their out-of-range checkify.check calls,
+            # which the functionalization below turns into runtime errors
+            with checkify_ids_scope():
+                new_p, new_ms, new_os, loss = step(*args)
             checkify.check(jnp.isfinite(loss),
                            "non-finite loss (divergence): {loss}", loss=loss)
             return new_p, new_ms, new_os, loss

@@ -21,9 +21,11 @@ instead of O(batch x classes) per batch:
   fetched pytree.
 
 ``has_device_fold()`` gates the protocol; methods without a device kernel
-(MeanAveragePrecision's global AP ranking, HitRatio/NDCG's group regrouping)
-keep the host ``apply`` fallback automatically — the evaluator fetches outputs
-only for those.
+(MeanAveragePrecision's global AP ranking) keep the host ``apply`` fallback
+automatically — the evaluator fetches outputs only for those. HitRatio/NDCG
+fold on device for the fixed-group NCF layout (1 positive + neg_num negatives
+contiguous per group): group boundaries are static shapes, so the regrouping
+is a reshape inside the trace.
 """
 
 from __future__ import annotations
@@ -453,6 +455,60 @@ class HitRatio(ValidationMethod):
         hits = float((ranks <= self.k).sum())
         return AccuracyResult(hits, n)
 
+    # ------------------------------------------------- device-fold protocol
+    # The NCF eval layout makes the group regrouping static: batches are built
+    # as whole (1 positive + neg_num negatives) groups, so batch_size % group
+    # is a SHAPE property — checked at trace time with the same refusal as the
+    # host path. Padded tail rows arrive with valid_mask=False; a group counts
+    # only when every row in it is valid (build eval batches group-aligned).
+    def has_device_fold(self) -> bool:
+        return True
+
+    def _device_gains(self, ranks):
+        return (ranks <= self.k).astype(jnp.float32)
+
+    def device_fold(self, out, target, valid_mask):
+        group = self.neg_num + 1
+        scores = jnp.asarray(out)
+        if scores.ndim > 1:
+            # model outputs (N, C) scores per candidate — rank by the LAST
+            # column (NCF's (N, 2) log-probs: column 1 = P(interaction), the
+            # column the host eval loop selects)
+            scores = scores.reshape(scores.shape[0], -1)[:, -1]
+        scores = scores.reshape(-1)
+        labels = jnp.asarray(target).reshape(-1)
+        n = scores.shape[0]
+        if n == 0 or n % group != 0:
+            raise ValueError(
+                f"{self.name}: got {n} scores, not a positive multiple of "
+                f"neg_num+1={group}; evaluate with batch_size a multiple of "
+                f"{group} so every (positive + negatives) group stays within "
+                "one batch")
+        rows = n // group
+        s = scores.reshape(rows, group)
+        l = labels.reshape(rows, group)
+        gvalid = jnp.all(valid_mask.reshape(rows, group), axis=1)
+        pos = jnp.argmax(l, axis=1)
+        pos_score = jnp.take_along_axis(s, pos[:, None], axis=1)[:, 0]
+        ranks = 1 + jnp.sum(s > pos_score[:, None], axis=1)
+        gains = jnp.where(gvalid, self._device_gains(ranks), 0.0)
+        # a valid group with no positive label cannot be scored — count it
+        # here and refuse in finalize (the host path's ValueError, deferred
+        # to the fetch because data values aren't known at trace time)
+        bad = gvalid & ~(jnp.max(l, axis=1) > 0)
+        return (jnp.sum(gains),
+                jnp.sum(gvalid.astype(jnp.int32)),
+                jnp.sum(bad.astype(jnp.int32)))
+
+    def finalize(self, acc) -> ValidationResult:
+        gains, count, bad = acc
+        if int(bad) > 0:
+            raise ValueError(
+                f"{self.name}: found {int(bad)} candidate group(s) with no "
+                "positive label (every label 0); each neg_num+1 group must "
+                "contain exactly one positive item")
+        return AccuracyResult(float(gains), int(count))
+
 
 class NDCG(HitRatio):
     """NDCG@k over the same grouped layout as :class:`HitRatio`: one relevant
@@ -466,3 +522,7 @@ class NDCG(HitRatio):
         ranks, n = self._ranks(output, target, valid)
         gains = np.where(ranks <= self.k, np.log(2.0) / np.log(1.0 + ranks), 0.0)
         return AccuracyResult(float(gains.sum()), n)
+
+    def _device_gains(self, ranks):
+        r = ranks.astype(jnp.float32)
+        return jnp.where(ranks <= self.k, jnp.log(2.0) / jnp.log(1.0 + r), 0.0)

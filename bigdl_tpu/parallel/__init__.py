@@ -7,3 +7,7 @@ from bigdl_tpu.parallel.pipeline import GPipe
 from bigdl_tpu.parallel.tensor_parallel import (
     TPRules, column_parallel, megatron_mlp_rules, row_parallel,
 )
+from bigdl_tpu.parallel.embedding import (
+    ShardedEmbedding, SparseEmbeddingUpdate, build_sparse_plan, dedup_ids,
+    embedding_parallel_rules, find_sharded_embeddings, model_embedding_rules,
+)

@@ -1,0 +1,408 @@
+"""Sharded embedding engine: row-sharded tables, deduped gathers, sparse updates.
+
+The recsys workload (ROADMAP item 2, PAPER.md's NCF/Wide&Deep heritage) is dominated by a
+huge (V, D) embedding table that can neither be replicated nor densely updated: the plain
+``LookupTable`` gather VJP scatter-adds into the FULL weight and the optimizer then steps
+every row — O(table) HBM traffic for O(batch) touched rows. Three composable pieces fix
+the three halves of that:
+
+- :class:`ShardedEmbedding` — wraps a ``LookupTable``/``HashBucketEmbedding`` and places
+  the (V, D) weight ROW-sharded on the ``model`` mesh axis (GSPMD, PAPERS.md 2105.04663)
+  while ids stay ``data``-sharded, the same gather-by-index dispatch shape as
+  ``parallel/moe.py``'s expert routing. Gathers are exact row copies, so the sharded
+  forward/backward is bitwise-equal to the replicated layer.
+- **deduped gathers** — per-batch static-shape ``jnp.unique`` (:func:`dedup_ids`) so a
+  power-law id distribution gathers each hot row once; an inverse map scatters rows back
+  to positions. Padded with the out-of-range sentinel ``V`` so shapes stay static.
+- :class:`SparseEmbeddingUpdate` — an :class:`OptimMethod` wrapper (the sparse sibling of
+  ``kernels/fused_update.FlatParamUpdate``): the train step differentiates a zero
+  per-unique-row **delta** injected through the module-state channel instead of the table
+  weight (the weight itself is gathered under ``stop_gradient``), so autodiff produces an
+  exact (U, D) row-gradient and never materializes a dense (V, D) gradient; the wrapped
+  method's ``sparse_update`` then steps ONLY the touched rows and their slot rows
+  (lazy semantics: untouched rows and slots are bitwise-unchanged).
+
+``build_sparse_plan`` discovers the sharded tables in a model and the Optimizer fuses the
+whole thing into its jitted step (see ``optim/optimizer.py``); ``embedding_parallel_rules``
+/ ``model_embedding_rules`` produce the ``TPRules`` placement for ``DistriOptimizer``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.nn.abstractnn import Container
+from bigdl_tpu.nn.embedding import LookupTable, check_ids_enabled
+from bigdl_tpu.optim.optim_method import OptimMethod, tree_map
+from bigdl_tpu.parallel.tensor_parallel import TPRules
+from bigdl_tpu.utils.engine import Engine
+
+logger = logging.getLogger("bigdl_tpu.parallel")
+
+_DELTA_KEY = "delta"   # injected by the sparse train step (module-state channel)
+_UIDS_KEY = "uids"     # returned by apply in sparse mode; stripped by the step
+
+
+def dedup_ids(flat_ids, n_rows: int):
+    """Static-shape per-batch dedup: ``(uids, inv)`` with ``uids`` the sorted
+    unique ids padded to ``flat_ids.shape`` with the out-of-range sentinel
+    ``n_rows``, and ``inv`` the inverse map (``uids[inv] == flat_ids``).
+    A gather of the sentinel row clamps harmlessly (never referenced by
+    ``inv``); a ``mode="drop"`` scatter drops it."""
+    size = int(flat_ids.shape[0])
+    uids, inv = jnp.unique(flat_ids, size=size, fill_value=n_rows,
+                           return_inverse=True)
+    return uids.astype(jnp.int32), inv.reshape(-1).astype(jnp.int32)
+
+
+def _shard_enabled() -> bool:
+    return os.environ.get("BIGDL_EMBED_SHARD", "1") == "1"
+
+
+def _dedup_enabled() -> bool:
+    return os.environ.get("BIGDL_EMBED_DEDUP", "1") == "1"
+
+
+class ShardedEmbedding(Container):
+    """Row-sharded, dedup-gathering wrapper around a ``LookupTable`` (or
+    ``HashBucketEmbedding``). One child named ``table`` — the param pytree is
+    ``{"table": {"weight": (V, D)}}`` so placement rules and checkpoints
+    address the weight as ``.../table/weight``.
+
+    Forward paths (all bitwise-equal to the wrapped layer's, gathers being
+    exact row copies):
+
+    - plain: full-table renorm + gather (dedup off);
+    - dedup (``BIGDL_EMBED_DEDUP``, default on): gather unique rows once,
+      scatter back by the inverse map — each hot row's HBM read happens once;
+    - sparse-train: when the optimizer injected a ``delta`` into this module's
+      state for the step, rows come from ``stop_gradient(weight)[uids] +
+      delta`` and the batch's ``uids`` ride back through the returned state.
+
+    Under a live mesh whose ``axis`` (default ``model``) is >1 wide and
+    divides V, traced forwards constrain the weight to ``P(axis, None)`` and
+    the leading id axis to ``P("data")`` (``BIGDL_EMBED_SHARD``, default on) —
+    the GSPMD partitioner then keeps the table row-sharded through gather,
+    scatter and optimizer update.
+    """
+
+    def __init__(self, inner: LookupTable, axis: str = "model",
+                 dedup: Optional[bool] = None):
+        if not isinstance(inner, LookupTable):
+            raise TypeError(
+                f"ShardedEmbedding wraps a LookupTable/HashBucketEmbedding, "
+                f"got {type(inner).__name__}")
+        super().__init__(inner)
+        self.axis = axis
+        self.dedup = dedup  # None → BIGDL_EMBED_DEDUP (default on)
+
+    @property
+    def table(self) -> LookupTable:
+        return self.modules[0]
+
+    def named_children(self):
+        return [("table", self.modules[0])]
+
+    def reset(self) -> None:
+        self.modules[0].reset()
+
+    def _dedup_on(self) -> bool:
+        return self.dedup if self.dedup is not None else _dedup_enabled()
+
+    def _constrain(self, w, idx):
+        """GSPMD placement hints (traced values only — eager forwards skip)."""
+        if not _shard_enabled() or not isinstance(w, jax.core.Tracer):
+            return w, idx
+        if not Engine.is_initialized():
+            return w, idx
+        mesh = Engine.mesh()
+        if mesh is None:
+            return w, idx
+        axes = dict(mesh.shape)
+        if axes.get(self.axis, 1) > 1 and w.shape[0] % axes[self.axis] == 0:
+            w = jax.lax.with_sharding_constraint(
+                w, NamedSharding(mesh, P(self.axis, None)))
+        dax = Engine.DATA_AXIS
+        if (axes.get(dax, 1) > 1 and idx.ndim >= 1
+                and idx.shape[0] % axes[dax] == 0):
+            spec = P(dax, *([None] * (idx.ndim - 1)))
+            idx = jax.lax.with_sharding_constraint(
+                idx, NamedSharding(mesh, spec))
+        return w, idx
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        t = self.table
+        tstate = state.get("table", {}) if isinstance(state, dict) else {}
+        w = params["table"]["weight"]
+        idx = t._ids(input)
+        w, idx = self._constrain(w, idx)
+        sparse_mode = isinstance(state, dict) and _DELTA_KEY in state
+        if sparse_mode:
+            flat = idx.reshape(-1)
+            uids, inv = dedup_ids(flat, t.n_index)
+            # the delta trick: the weight is gathered under stop_gradient and a
+            # zero (U, D) delta is added pre-renorm, so grad-wrt-delta IS the
+            # exact dense grad restricted to the unique rows (renorm is
+            # row-local) and no (V, D) gradient is ever materialized
+            rows = jax.lax.stop_gradient(w)[uids]
+            if state[_DELTA_KEY] is not None:
+                rows = rows + state[_DELTA_KEY]
+            rows = t._renorm_rows(rows)
+            out = rows[inv].reshape(idx.shape + (t.n_output,))
+            out = t._mask_padding(out, idx)
+            return out, {"table": tstate, _UIDS_KEY: uids}
+        if self._dedup_on():
+            flat = idx.reshape(-1)
+            uids, inv = dedup_ids(flat, t.n_index)
+            rows = t._renorm_rows(w[uids])
+            out = rows[inv].reshape(idx.shape + (t.n_output,))
+        else:
+            out = t._renorm(w)[idx]
+        return t._mask_padding(out, idx), {"table": tstate}
+
+    def forward(self, input):
+        # mirror LookupTable.forward: the eager entry point runs the host-side
+        # BIGDL_CHECK_IDS guard on the concrete batch before the jitted apply
+        if check_ids_enabled():
+            self.table._ids(jnp.asarray(input))
+        return super().forward(input)
+
+    def __repr__(self):
+        return f"ShardedEmbedding({self.table!r}, axis={self.axis!r})"
+
+
+# --------------------------------------------------------------- placement
+def embedding_parallel_rules(prefix: str = "", axis: str = "model",
+                             rules: Optional[TPRules] = None) -> TPRules:
+    """TPRules placing every ``.../table/weight`` under ``prefix`` row-sharded
+    on ``axis`` (the embedding analog of ``moe.expert_parallel_rules``)."""
+    r = rules if rules is not None else TPRules()
+    pre = f"(^|/){re.escape(prefix)}/" if prefix else "(^|/)"
+    r.add(f"{pre}table/weight$", P(axis, None))
+    return r
+
+
+def model_embedding_rules(model, rules: Optional[TPRules] = None) -> TPRules:
+    """Exact-path TPRules for every :class:`ShardedEmbedding` found in
+    ``model`` (each on its own configured axis)."""
+    r = rules if rules is not None else TPRules()
+    for path, mod in find_sharded_embeddings(model):
+        leaf = "/".join(path + ("table", "weight"))
+        r.add(f"^{re.escape(leaf)}$", P(mod.axis, None))
+    return r
+
+
+def find_sharded_embeddings(model):
+    """All ``(module_path, module)`` ShardedEmbeddings in a module tree, in
+    child order; paths are tuples of child names (Graph children are exec
+    indices)."""
+    found = []
+
+    def walk(m, path):
+        if isinstance(m, ShardedEmbedding):
+            found.append((path, m))
+            return
+        if isinstance(m, Container):
+            for name, child in m.named_children():
+                walk(child, path + (name,))
+
+    walk(model, ())
+    return found
+
+
+# ------------------------------------------------------------ sparse plan
+def _tree_get(tree, path):
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def _tree_set(tree, path, value):
+    if not path:
+        return value
+    new = dict(tree)
+    new[path[0]] = _tree_set(tree[path[0]], path[1:], value)
+    return new
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseEntry:
+    key: str            # joined module path — the stable slot-dict key
+    module_path: tuple  # path to the ShardedEmbedding module
+    n_rows: int
+    n_output: int
+
+    @property
+    def weight_path(self) -> tuple:
+        return self.module_path + ("table", "weight")
+
+
+class SparsePlan:
+    """Which tables train sparsely, plus the pytree surgery the step needs:
+    inject per-table deltas into model state, pop the returned uids, and mask
+    the (dense-zero) embedding-weight gradient leaves to 0-size."""
+
+    def __init__(self, entries):
+        self.entries = list(entries)
+
+    def inject(self, mstate, deltas: dict):
+        for e in self.entries:
+            sub = dict(_tree_get(mstate, e.module_path))
+            sub[_DELTA_KEY] = deltas[e.key]
+            mstate = _tree_set(mstate, e.module_path, sub)
+        return mstate
+
+    def pop_uids(self, mstate):
+        uids = {}
+        for e in self.entries:
+            sub = dict(_tree_get(mstate, e.module_path))
+            uids[e.key] = sub.pop(_UIDS_KEY)
+            mstate = _tree_set(mstate, e.module_path, sub)
+        return uids, mstate
+
+    def mask_embed(self, tree):
+        """Embedding weight leaves → 0-size placeholders (the frozen-leaf
+        trimming idiom): the inner method's dense pass never allocates or
+        touches (V, D) there, but the pytree STRUCTURE is unchanged."""
+        for e in self.entries:
+            leaf = _tree_get(tree, e.weight_path)
+            tree = _tree_set(tree, e.weight_path,
+                             jnp.zeros((0,), jnp.asarray(leaf).dtype))
+        return tree
+
+    def zero_deltas(self, model, params, mstate, inp, rng):
+        """Trace-time probe: abstractly evaluate one forward with ``delta=None``
+        injected to discover each table's static unique-row capacity U (the
+        flattened per-table id count after model wiring), then return zero
+        (U, D) deltas. Pure metadata — runs under ``jax.eval_shape``."""
+        def sds(x):
+            return (None if x is None
+                    else jax.ShapeDtypeStruct(jnp.shape(x), x.dtype))
+        probe_state = self.inject(mstate, {e.key: None for e in self.entries})
+        abstract = jax.eval_shape(
+            lambda p, s, x, r: model.apply(p, s, x, training=True, rng=r)[1],
+            tree_map(sds, params), tree_map(sds, probe_state),
+            tree_map(sds, inp), sds(rng))
+        deltas = {}
+        for e in self.entries:
+            u = _tree_get(abstract, e.module_path)[_UIDS_KEY].shape[0]
+            w = _tree_get(params, e.weight_path)
+            deltas[e.key] = jnp.zeros((u, e.n_output), w.dtype)
+        return deltas
+
+    def __repr__(self):
+        return f"SparsePlan({[e.key for e in self.entries]})"
+
+
+def build_sparse_plan(model, method):
+    """Discover the sparse-trainable tables in ``model`` under ``method``.
+    Returns ``(SparsePlan | None, reason | None)`` — ``reason`` is set when
+    sharded tables exist but cannot train sparsely (the optimizer logs it
+    once and keeps the dense path)."""
+    mods = find_sharded_embeddings(model)
+    if not mods:
+        return None, None
+    if not method.supports_sparse_update():
+        return None, (f"{method!r} does not support sparse_update "
+                      "(stateful schedule / layer_lr_mults / non-elementwise)")
+    if model.has_regularizers():
+        return None, ("model has weight regularizers — their gradient is "
+                      "dense over the table")
+    entries = []
+    for path, m in mods:
+        scale = m.grad_scales()["table"]["weight"]
+        if scale != 1.0:
+            # frozen (0) or grad-scaled tables keep the dense/frozen path
+            continue
+        t = m.table
+        entries.append(SparseEntry(key="/".join(path) or ".",
+                                   module_path=path,
+                                   n_rows=t.n_index, n_output=t.n_output))
+    if not entries:
+        return None, "every sharded table is frozen or grad-scaled"
+    return SparsePlan(entries), None
+
+
+# ------------------------------------------------------- optimizer wrapper
+class SparseEmbeddingUpdate(OptimMethod):
+    """Method wrapper fusing sparse per-row embedding updates with the inner
+    method's dense update over everything else (the sparse sibling of
+    ``kernels/fused_update.FlatParamUpdate``). Slot layout::
+
+        {"dense": inner slots with embed-weight leaves trimmed to 0-size,
+         "embed": {entry.key: inner.init_state(weight)}}   # full (V, D) slots
+
+    Driven by the Optimizer's sparse step through :meth:`sparse_apply`; the
+    plain ``update`` protocol is intentionally unsupported (there is no dense
+    (V, D) gradient to feed it — that is the point)."""
+
+    elementwise_update = False
+
+    def __init__(self, method: OptimMethod, plan: SparsePlan):
+        self.method = method
+        self.plan = plan
+
+    def init_state(self, params) -> dict:
+        return self.init_state_trimmed(params, None)
+
+    def init_state_trimmed(self, params, trainable=None) -> dict:
+        mp = self.plan.mask_embed(params)
+        dense = self.method.init_state_trimmed(mp, trainable)
+        embed = {e.key: self.method.init_state(_tree_get(params, e.weight_path))
+                 for e in self.plan.entries}
+        return {"dense": dense, "embed": embed}
+
+    def update(self, params, grads, state, step):
+        raise RuntimeError(
+            "SparseEmbeddingUpdate is driven by the optimizer's sparse step "
+            "(sparse_apply); it has no dense update form")
+
+    def sparse_apply(self, params, grads, row_grads, uids_map, state, step,
+                     trainable=None):
+        """One optimizer update: the inner method's dense pass over the masked
+        tree, then per-table gather-update-scatter over the unique rows.
+        ``row_grads``/``uids_map`` are ``{entry.key: (U, D) grad / (U,) ids}``
+        from the delta trick; the sentinel id V clamps on gather and drops on
+        scatter, so its (zero-grad) row update is dead code."""
+        mp = self.plan.mask_embed(params)
+        mg = self.plan.mask_embed(grads)
+        new_mp, new_dense = self.method.update_trimmed(
+            mp, mg, state["dense"], step, trainable)
+        new_params = new_mp
+        new_embed = {}
+        for e in self.plan.entries:
+            w = _tree_get(params, e.weight_path)
+            u = uids_map[e.key]
+            slots = state["embed"][e.key]
+            rows = w[u]
+            slot_rows = tree_map(lambda s: s[u], slots)
+            new_rows, new_slot_rows = self.method.sparse_update(
+                rows, row_grads[e.key], slot_rows, step)
+            # NOT unique_indices: the sentinel V repeats in u — but it is
+            # out-of-range, so mode="drop" discards those writes and the
+            # remaining indices are genuinely unique
+            new_w = w.at[u].set(new_rows, mode="drop")
+            new_slots = tree_map(lambda s, nr: s.at[u].set(nr, mode="drop"),
+                                 slots, new_slot_rows)
+            new_params = _tree_set(new_params, e.weight_path, new_w)
+            new_embed[e.key] = new_slots
+        return new_params, {"dense": new_dense, "embed": new_embed}
+
+    def get_learning_rate(self, step: int) -> float:
+        return self.method.get_learning_rate(step)
+
+    def __repr__(self):
+        return f"SparseEmbeddingUpdate({self.method!r}, {self.plan!r})"
+
+
+from bigdl_tpu.utils.serializer import register as _register_serializable  # noqa: E402
+
+_register_serializable(ShardedEmbedding)

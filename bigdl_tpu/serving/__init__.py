@@ -22,6 +22,7 @@ from bigdl_tpu.serving.engine import (
 from bigdl_tpu.serving.fleet import FleetExhausted, FleetHandle, FleetRouter
 from bigdl_tpu.serving.multitenant import SnapshotServer
 from bigdl_tpu.serving.prefix_cache import PrefixEntry, PrefixPool
+from bigdl_tpu.serving.ranking import RankedResult, RankingEngine, RankingHandle
 from bigdl_tpu.serving.request import (
     FINISH_EOS, FINISH_LENGTH, CompletedRequest, RequestHandle,
 )
@@ -34,7 +35,8 @@ __all__ = [
     "CompletedRequest", "EngineOverloaded", "EngineShutdown",
     "EngineShutdownTimeout", "FINISH_EOS", "FINISH_LENGTH",
     "FleetExhausted", "FleetHandle", "FleetRouter",
-    "NonFiniteLogitsError", "PrefixEntry", "PrefixPool", "RequestHandle",
+    "NonFiniteLogitsError", "PrefixEntry", "PrefixPool", "RankedResult",
+    "RankingEngine", "RankingHandle", "RequestHandle",
     "RequestTimeout", "ServingEngine", "SlotScheduler", "SnapshotServer",
     "SpeculativeDecoder", "default_buckets", "pick_bucket",
     "pick_seed_bucket",

@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming t1-fleet t1-recsys dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming t1-fleet t1-recsys t1-elastic dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -83,6 +83,18 @@ t1-fleet:
 t1-recsys:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m recsys --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
+# Elastic-checkpointing suite only (docs/robustness.md "Elastic training"):
+# sharded snapshot→assemble bitwise round trip, manifest-commits-last
+# all-or-nothing (ckpt_async=torn), async-write overlap vs the hard barrier,
+# topology-portable resume (2,4)→(4,) with trajectory equality, keep-last-N
+# skipping in-flight versions, two-writer version agreement, and the
+# host-loss drill (2-process run, one worker SIGKILLed by host_down, the
+# survivor re-execs and resumes on the shrunk topology). Unmarked-slow, so
+# `make t1` runs these too; this target is the fast inner loop for elastic
+# work.
+t1-elastic:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m elastic --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
 dist:
 	bash make-dist.sh
 
@@ -103,6 +115,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --fleet-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --stream-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --recsys-bench --no-compare-dtypes --no-streamed
+	JAX_PLATFORMS=cpu python bench.py --ckpt-bench --no-compare-dtypes --no-streamed
 
 # Host input-pipeline leg (decode→augment→stack on a synthetic image folder):
 # pipeline_images_per_sec at BIGDL_DATA_WORKERS 0/1/4/auto + per-stage ms.

@@ -393,12 +393,20 @@ class Optimizer:
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger,
-                       backend: str = "pickle") -> "Optimizer":
-        """``backend``: "pickle" (default — single file, background-thread
-        write) or "orbax" (orbax-checkpoint AsyncCheckpointer — per-leaf
-        tensorstore layout, async device fetch, the multi-host-ready format)."""
-        if backend not in ("pickle", "orbax"):
-            raise ValueError("checkpoint backend must be 'pickle' or 'orbax'")
+                       backend: Optional[str] = None) -> "Optimizer":
+        """``backend``: "pickle" (single file, background-thread write),
+        "orbax" (orbax-checkpoint AsyncCheckpointer — per-leaf tensorstore
+        layout), or "elastic" (``utils/elastic_ckpt`` — each process writes
+        only the shards it addresses, manifest commits last, resume is
+        topology-portable). None resolves from ``BIGDL_CKPT_SHARDED=1`` →
+        elastic, else pickle."""
+        if backend is None:
+            backend = ("elastic"
+                       if os.environ.get("BIGDL_CKPT_SHARDED", "0") == "1"
+                       else "pickle")
+        if backend not in ("pickle", "orbax", "elastic"):
+            raise ValueError(
+                "checkpoint backend must be 'pickle', 'orbax' or 'elastic'")
         self.checkpoint_path, self.checkpoint_trigger = path, trigger
         self.checkpoint_backend = backend
         return self
@@ -1344,6 +1352,9 @@ class Optimizer:
         if self.checkpoint_backend == "orbax":
             return any(p.startswith("ckpt_orbax") and p.endswith(".meta.json")
                        for p in names)  # committed = meta marker present
+        if self.checkpoint_backend == "elastic":
+            from bigdl_tpu.utils import elastic_ckpt
+            return bool(elastic_ckpt.complete_versions(self.checkpoint_path))
         return any(p.startswith("checkpoint") and p.endswith(".pkl")
                    for p in names)
 
@@ -1661,6 +1672,8 @@ class Optimizer:
                                             boundary=False, pending=pending)
                         for it in range(start_it, start_it + k):
                             faults.fault_point(faults.SITE_STALL, index=it)
+                            faults.fault_point(faults.SITE_HOST_DOWN,
+                                               index=it)
                         fired = any([
                             faults.fault_point(faults.SITE_SIGTERM,
                                                index=it) is not None
@@ -1761,6 +1774,8 @@ class Optimizer:
                         self._fire_triggers(params, mstate, ostate, state,
                                             boundary=False, pending=pending)
                         faults.fault_point(faults.SITE_STALL,
+                                           index=state["neval"])
+                        faults.fault_point(faults.SITE_HOST_DOWN,
                                            index=state["neval"])
                         if faults.fault_point(faults.SITE_SIGTERM,
                                               index=state["neval"]) \
@@ -2127,11 +2142,32 @@ class Optimizer:
         counter already advanced). The payload carries full resume state —
         RNG snapshot, feed position, epoch order — so ``resume="auto"``
         restarts mid-epoch bitwise-identically; the bytes go through
-        ``utils/file.py`` (CRC32 footer, fsync-before-rename)."""
+        ``utils/file.py`` (CRC32 footer, fsync-before-rename).
+
+        ``ckpt/stall_ms`` records how long the TRAINING thread was blocked
+        here — snapshot-only when async (``BIGDL_CKPT_ASYNC``, default on),
+        snapshot+write+fsync when sync — the --ckpt-bench comparison."""
         os.makedirs(self.checkpoint_path, exist_ok=True)
-        if self.checkpoint_backend == "orbax":
-            self._save_checkpoint_orbax(params, mstate, ostate, state)
-            return
+        t0 = time.perf_counter()
+        try:
+            if self.checkpoint_backend == "orbax":
+                self._save_checkpoint_orbax(params, mstate, ostate, state)
+            elif self.checkpoint_backend == "elastic":
+                self._save_checkpoint_elastic(params, mstate, ostate, state,
+                                              neval_next)
+            else:
+                self._save_checkpoint_pickle(params, mstate, ostate, state,
+                                             neval_next)
+        finally:
+            obs_registry.registry.histogram("ckpt/stall_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+
+    @staticmethod
+    def _ckpt_async() -> bool:
+        return os.environ.get("BIGDL_CKPT_ASYNC", "1") != "0"
+
+    def _save_checkpoint_pickle(self, params, mstate, ostate, state,
+                                neval_next: Optional[int] = None) -> None:
         if neval_next is None:
             neval_next = state["neval"] + \
                 (0 if state.get("epoch_finished") else 1)
@@ -2176,7 +2212,8 @@ class Optimizer:
                         import signal
                         os.kill(os.getpid(), signal.SIGKILL)
                 with trace.span("ckpt/write", {"path": path}):
-                    ckpt_file.save(payload, path)
+                    ckpt_file.save_bytes(data, path)
+                obs_registry.registry.counter("ckpt/bytes").inc(len(data))
                 self._prune_old_checkpoints()
                 logger.info("checkpoint written: %s", path)
             except BaseException as e:  # surfaced at the next join
@@ -2186,14 +2223,104 @@ class Optimizer:
         t = threading.Thread(target=_write, name="bigdl-ckpt-writer", daemon=False)
         t.start()
         self._ckpt_thread = t
+        if not self._ckpt_async():
+            self._join_checkpoint_writer()
+
+    def _save_checkpoint_elastic(self, params, mstate, ostate, state,
+                                 neval_next: Optional[int] = None) -> None:
+        """Sharded async save: the ONLY training-thread work is the d2h
+        snapshot of this process's addressable blocks; serialization + fsync
+        + the manifest-coverage rendezvous overlap the next fused window on
+        the writer thread. The join at the top is the hard barrier — at most
+        one write in flight, and the next checkpoint trigger (or an emergency
+        checkpoint) waits for the previous write to land."""
+        from bigdl_tpu.utils import elastic_ckpt
+
+        if neval_next is None:
+            neval_next = state["neval"] + \
+                (0 if state.get("epoch_finished") else 1)
+        self._join_checkpoint_writer()
+        faults.fault_point(faults.SITE_CKPT_D2H)
+        pidx, pcount = jax.process_index(), jax.process_count()
+        with trace.span("ckpt/d2h"):
+            skeleton, leaves, blocks = elastic_ckpt.snapshot_tree(
+                {"params": params, "mstate": mstate, "ostate": ostate},
+                process_index=pidx)
+        meta = {"state": dict(state),
+                "resume": self._resume_info(state, neval_next)}
+        sched = getattr(self.optim_method, "learningrate_schedule", None)
+        if getattr(sched, "stateful", False):
+            meta["sched_state"] = sched.state_dict()
+        minfo = elastic_ckpt.mesh_info(
+            Engine.mesh() if Engine.is_initialized() else None, pcount)
+        dirpath = os.path.join(
+            self.checkpoint_path,
+            elastic_ckpt.version_dirname(int(state["neval"])))
+        sync_timeout = float(
+            os.environ.get("BIGDL_CKPT_SYNC_TIMEOUT", "60"))
+
+        def _write():
+            try:
+                action = faults.check_fault(faults.SITE_CKPT_ASYNC)
+                if action == "stall":
+                    time.sleep(float(
+                        os.environ.get("BIGDL_FAULT_STALL_S", "2")))
+                elif action == "error":
+                    raise faults.FaultError(
+                        "injected elastic checkpoint write failure")
+                t1 = time.perf_counter()
+                with trace.span("ckpt/elastic_write", {"dir": dirpath}):
+                    nbytes = elastic_ckpt.write_shard(dirpath, pidx, blocks)
+                    if action == "torn":
+                        # crash window between snapshot and commit: shards
+                        # are durable but the manifest never lands — the
+                        # version must stay invisible to every loader
+                        logger.warning(
+                            "fault plan: elastic manifest withheld at %s",
+                            dirpath)
+                        return
+                    if pidx == 0:
+                        committed = elastic_ckpt.commit_manifest(
+                            dirpath, skeleton, leaves, minfo, meta,
+                            timeout=sync_timeout)
+                        if committed:
+                            self._prune_old_checkpoints()
+                reg = obs_registry.registry
+                reg.histogram("ckpt/async_write_ms").observe(
+                    (time.perf_counter() - t1) * 1e3)
+                reg.counter("ckpt/bytes").inc(nbytes)
+            except BaseException as e:  # surfaced at the next join
+                self._ckpt_error = e
+
+        import threading
+        t = threading.Thread(target=_write, name="bigdl-ckpt-writer",
+                             daemon=False)
+        t.start()
+        self._ckpt_thread = t
+        if not self._ckpt_async():
+            self._join_checkpoint_writer()
 
     def _prune_old_checkpoints(self) -> None:
-        """Keep-last-N retention (``BIGDL_CKPT_KEEP``) for versioned pickle
+        """Keep-last-N retention (``BIGDL_CKPT_KEEP``) for versioned
         checkpoints; 0 keeps everything. Runs on the writer thread after a
-        successful write, so the newest file is always on disk before any
-        older one is removed. Quarantined ``*.corrupt`` files are pruned with
-        their version."""
+        successful write, so the newest version is always on disk before any
+        older one is removed. Quarantined ``*.corrupt`` entries are pruned
+        with their version. Elastic versions only count once COMPLETE
+        (manifest committed): a manifest-less directory is another process's
+        in-flight write — counting it would shrink the real retention window,
+        deleting it would tear a checkpoint mid-commit."""
         keep = self.ckpt_keep
+        if self.checkpoint_backend == "elastic":
+            if keep <= 0 and self.overwrite_checkpoint:
+                keep = 1  # rolling semantics: latest complete version only
+            if keep <= 0:
+                return
+            from bigdl_tpu.utils import elastic_ckpt
+            complete = elastic_ckpt.complete_versions(self.checkpoint_path)
+            for v in complete[:-keep]:
+                elastic_ckpt.remove_version(
+                    self.checkpoint_path, elastic_ckpt.version_dirname(v))
+            return
         if keep <= 0 or self.overwrite_checkpoint:
             return
         versioned = sorted(
@@ -2340,6 +2467,9 @@ class Optimizer:
                 return
             raise RuntimeError(
                 f"no orbax checkpoint found under {self.checkpoint_path}")
+        if self.checkpoint_backend == "elastic":
+            self._load_latest_checkpoint_elastic()
+            return
         cand = sorted(
             (p for p in os.listdir(self.checkpoint_path)
              if _ckpt_version(p) is not None),
@@ -2379,6 +2509,103 @@ class Optimizer:
             self._apply_resume_info(payload["resume"])
         logger.info("resumed from checkpoint %s at iter %d", name,
                     self.state.get("neval", 0))
+
+    def _load_latest_checkpoint_elastic(self) -> None:
+        """Elastic resume: (1) cross-process AGREEMENT on which version to
+        restore (quorum of newest-complete claims, min wins — every host
+        resumes from the same version even on NFS-style shared dirs); (2)
+        partial version dirs (interrupted writers, dead peers) quarantined
+        ``*.corrupt`` with a ``ckpt_fallback`` event; (3) leaves assembled
+        from shard files — bitwise what was saved; (4) if the topology
+        changed since the save, leaves are re-placed under the CURRENT mesh's
+        rules (``BIGDL_ELASTIC_RESUME=0`` makes a topology mismatch a hard
+        error instead) and an ``elastic_resume`` event records the move."""
+        from bigdl_tpu.utils import elastic_ckpt
+
+        path = self.checkpoint_path
+        pidx, pcount = jax.process_index(), jax.process_count()
+        timeout = float(os.environ.get("BIGDL_CKPT_SYNC_TIMEOUT", "60"))
+        agreed = elastic_ckpt.agree_version(path, pidx, pcount,
+                                            timeout=timeout)
+        if agreed is None:
+            raise RuntimeError(
+                f"no elastic checkpoint found under {path} (no complete "
+                f"version visible to every process)")
+        for dirname in elastic_ckpt.partial_versions(path):
+            full = os.path.join(path, dirname)
+            try:
+                q = elastic_ckpt.quarantine(path, dirname)
+            except OSError:
+                q = "<unremovable>"
+            events.record("ckpt_fallback", path=full,
+                          reason="partial version (no manifest)")
+            logger.error(
+                "partial elastic checkpoint %s quarantined as %s (writer "
+                "died before manifest commit)", full, q)
+        tree = manifest = None
+        version = agreed
+        for v in sorted(
+                (v for v in elastic_ckpt.complete_versions(path)
+                 if v <= agreed), reverse=True):
+            dirpath = os.path.join(path, elastic_ckpt.version_dirname(v))
+            try:
+                tree, spec_tree, manifest = elastic_ckpt.assemble(dirpath)
+                version = v
+                break
+            except CheckpointCorruptError as e:
+                try:
+                    q = elastic_ckpt.quarantine(
+                        path, elastic_ckpt.version_dirname(v))
+                except OSError:
+                    q = "<unremovable>"
+                events.record("ckpt_fallback", path=dirpath, reason=str(e))
+                logger.error(
+                    "corrupt elastic checkpoint %s quarantined as %s (%s); "
+                    "falling back to the previous version", dirpath, q, e)
+        if tree is None:
+            raise RuntimeError(
+                f"no loadable elastic checkpoint under {path} (every "
+                f"candidate failed integrity/coverage checks and was "
+                f"quarantined)")
+        saved = manifest.get("mesh") or {}
+        cur_mesh = Engine.mesh() if Engine.is_initialized() else None
+        now = elastic_ckpt.mesh_info(cur_mesh, pcount)
+        topo_changed = (saved.get("shape") != now.get("shape")
+                        or saved.get("axes") != now.get("axes")
+                        or saved.get("process_count")
+                        != now.get("process_count"))
+        if topo_changed:
+            if os.environ.get("BIGDL_ELASTIC_RESUME", "1") == "0":
+                raise RuntimeError(
+                    f"elastic checkpoint {path}/elastic.{version} was saved "
+                    f"on topology {saved} but the current topology is {now} "
+                    f"— topology-portable resume is disabled "
+                    f"(BIGDL_ELASTIC_RESUME=0)")
+            events.record("elastic_resume", version=int(version),
+                          saved_mesh=saved, new_mesh=now)
+            logger.warning(
+                "elastic resume across topologies: saved on %s, resuming on "
+                "%s — leaves re-placed under the new mesh's rules",
+                saved, now)
+            if cur_mesh is not None:
+                try:
+                    tree = elastic_ckpt.place_tree(tree, spec_tree, cur_mesh)
+                except Exception:
+                    logger.exception(
+                        "elastic re-placement failed; resuming from host "
+                        "arrays (the step's in_shardings will place them)")
+        meta = manifest["meta"]
+        self.model.set_params(tree["params"])
+        self.model.set_state(tree["mstate"])
+        self._resume_ostate = tree["ostate"]
+        self.state = meta["state"]
+        sched = getattr(self.optim_method, "learningrate_schedule", None)
+        if getattr(sched, "stateful", False) and "sched_state" in meta:
+            sched.load_state_dict(meta["sched_state"])
+        if meta.get("resume") is not None:
+            self._apply_resume_info(meta["resume"])
+        logger.info("resumed from elastic checkpoint version %d at iter %d",
+                    version, self.state.get("neval", 0))
 
 
 class LocalOptimizer(Optimizer):

@@ -49,3 +49,44 @@ def zero1_state_sharding(mesh: Mesh, state_tree, axis: str = "data"):
 
     return jax.tree_util.tree_map(
         lambda x: shard_leading_axis(mesh, np.shape(x), axis), state_tree)
+
+
+# -------------------------------------------------- spec export (elastic ckpt)
+def spec_to_tuple(sharding):
+    """A :class:`NamedSharding`'s PartitionSpec as plain nested tuples —
+    the mesh-independent, picklable form elastic checkpoints record per leaf.
+    Anything that is not a NamedSharding (single-device arrays, callback
+    shardings) maps to None, i.e. "replicated / whole array"."""
+    if not isinstance(sharding, NamedSharding):
+        return None
+    return tuple(tuple(e) if isinstance(e, (list, tuple)) else e
+                 for e in tuple(sharding.spec))
+
+
+def adapt_spec(spec, mesh: Mesh, shape) -> P:
+    """Re-target a recorded spec tuple onto ``mesh``: per dimension, keep the
+    axis names that exist on the new mesh AND still divide the dim; everything
+    else degrades to replication. This is what makes a sharded checkpoint
+    topology-portable — a leaf saved row-sharded over a 'model' axis loads
+    replicated on a mesh without one, and a zero1 slot saved over 8 'data'
+    devices re-slices over 4."""
+    if spec is None:
+        return P()
+    sizes = dict(mesh.shape)
+    out = []
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = tuple(entry) if isinstance(entry, (list, tuple)) else (entry,)
+        if not all(a in sizes for a in axes):
+            out.append(None)
+            continue
+        n = int(np.prod([sizes[a] for a in axes]))
+        if dim < len(shape) and shape[dim] % n == 0 and shape[dim] >= n:
+            out.append(entry if isinstance(entry, str) else tuple(axes))
+        else:
+            out.append(None)
+    while out and out[-1] is None:
+        out.pop()  # trailing Nones are implicit
+    return P(*out)

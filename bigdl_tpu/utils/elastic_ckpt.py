@@ -1,0 +1,449 @@
+"""Elastic (sharded, topology-portable) checkpoint format.
+
+The PR 4 pickle backend gathers every leaf to one host and writes one file —
+correct, but pinned: a checkpoint can only be written by the whole fleet and
+only resumed on the same topology. This module is the durability plane for
+elastic training (ROADMAP item 1): GSPMD makes shardings first-class
+annotations on the pytree, so each process persists exactly the leaf *blocks*
+it addresses plus the spec, and resume becomes a reshard onto whatever mesh is
+still alive.
+
+On-disk layout, one directory per checkpoint version::
+
+    <ckpt_path>/elastic.<neval>/
+        shard-0.data     # process 0's blocks   (CRC32 + fsync, utils/file.py)
+        shard-1.data     # process 1's blocks
+        manifest.pkl     # commits LAST, via atomic rename — the version
+                         # exists iff this file does (all-or-nothing, the
+                         # same pairing discipline as PR 9's sample cache)
+
+Each ``shard-<pid>.data`` holds ``{leaf_key: [(block_index, ndarray), ...]}``
+where ``block_index`` is the canonical ``((start, stop), ...)`` of the slice
+the process owns. Ownership dedups replication: for every distinct block of a
+leaf, the owner is the lowest ``process_index`` among the devices holding it
+(`sharding.devices_indices_map`), so replicated leaves are written once and
+zero1/fsdp/row-sharded leaves are written exactly once per slice.
+
+The manifest records the pytree skeleton (containers with array leaves
+replaced by :class:`_LeafRef` markers; non-array leaves ride inline), per-leaf
+shape/dtype/spec, the mesh axes/shape it was written under, and the caller's
+metadata (the full PR 4 resume payload). The writer commits it only once the
+union of durable shard files covers every leaf — a crash before that leaves a
+manifest-less directory that loaders quarantine and skip.
+
+Resume on a *different* topology: :func:`assemble` rebuilds each leaf from
+blocks into one host array (bitwise what was saved), and :func:`place_tree`
+re-places it under the new mesh via :func:`~bigdl_tpu.parallel.sharding
+.adapt_spec` — axes the new mesh lacks degrade to replication, surviving axes
+re-slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import shutil
+import time
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.utils import file as ckpt_file
+from bigdl_tpu.utils.file import CheckpointCorruptError
+
+logger = logging.getLogger("bigdl_tpu.elastic")
+
+MANIFEST = "manifest.pkl"
+_VERSION_RE = re.compile(r"^elastic\.(\d+)$")
+_SHARD_RE = re.compile(r"^shard-(\d+)\.data$")
+
+
+class ElasticCheckpointError(CheckpointCorruptError):
+    """An elastic version directory failed integrity/coverage checks (missing
+    blocks, corrupt shard, bad manifest). Subclasses
+    :class:`CheckpointCorruptError` so quarantine-and-fall-back paths handle
+    both with one except clause."""
+
+
+class _LeafRef:
+    """Skeleton marker standing in for an array leaf, keyed into the shard
+    files' block maps."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def __repr__(self):
+        return f"_LeafRef({self.key!r})"
+
+
+class _SpecLeaf:
+    """Opaque per-leaf spec holder — kept opaque so a tree of these zips
+    against the data tree in ``tree_map`` without the spec tuples being
+    flattened as pytree nodes."""
+
+    __slots__ = ("spec",)
+
+    def __init__(self, spec):
+        self.spec = spec
+
+
+# ------------------------------------------------------------------ paths
+def version_dirname(version: int) -> str:
+    return f"elastic.{int(version)}"
+
+
+def version_of(name: str) -> Optional[int]:
+    m = _VERSION_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def shard_path(dirpath: str, process_index: int) -> str:
+    return os.path.join(dirpath, f"shard-{int(process_index)}.data")
+
+
+def list_versions(path: str) -> dict:
+    """``{version: dirname}`` for every ``elastic.<n>`` directory under
+    ``path`` (quarantined ``*.corrupt`` dirs excluded by the regex)."""
+    if not os.path.isdir(path):
+        return {}
+    out = {}
+    for name in os.listdir(path):
+        v = version_of(name)
+        if v is not None and os.path.isdir(os.path.join(path, name)):
+            out[v] = name
+    return out
+
+
+def complete_versions(path: str) -> list:
+    """Versions whose manifest committed (ascending). Only these exist as
+    checkpoints; anything else is an in-flight or abandoned write."""
+    return sorted(v for v, name in list_versions(path).items()
+                  if os.path.exists(os.path.join(path, name, MANIFEST)))
+
+
+def partial_versions(path: str) -> list:
+    """Dirnames of version dirs WITHOUT a committed manifest."""
+    return [name for v, name in sorted(list_versions(path).items())
+            if not os.path.exists(os.path.join(path, name, MANIFEST))]
+
+
+def quarantine(path: str, dirname: str) -> str:
+    """Rename a bad version directory aside as ``<dir>.corrupt`` (kept for
+    postmortem, never re-tried — the pickle backend's file-level discipline
+    applied to a directory)."""
+    full = os.path.join(path, dirname)
+    target = full + ".corrupt"
+    n = 0
+    while os.path.exists(target):
+        n += 1
+        target = f"{full}.corrupt.{n}"
+    os.rename(full, target)
+    return target
+
+
+# -------------------------------------------------------------- snapshot
+def _canonical_index(idx, shape) -> tuple:
+    """A shard's index as ``((start, stop), ...)`` — hashable, unambiguous
+    (slice objects with None endpoints are not)."""
+    return tuple(sl.indices(dim)[:2] for sl, dim in zip(idx, shape))
+
+
+def _block_volume(cidx) -> int:
+    v = 1
+    for start, stop in cidx:
+        v *= max(0, stop - start)
+    return v
+
+
+def _leaf_volume(shape) -> int:
+    return int(np.prod(shape)) if shape else 1
+
+
+def _owned_blocks(leaf, process_index: int):
+    """Canonical indices of the blocks THIS process must persist: for each
+    distinct block, owner = min process_index over the devices holding it."""
+    shape = tuple(leaf.shape)
+    owners: dict = {}
+    for dev, idx in leaf.sharding.devices_indices_map(shape).items():
+        c = _canonical_index(idx, shape)
+        p = int(getattr(dev, "process_index", 0))
+        prev = owners.get(c)
+        if prev is None or p < prev:
+            owners[c] = p
+    return {c for c, p in owners.items() if p == int(process_index)}
+
+
+def snapshot_tree(tree, process_index: int = 0):
+    """Device→host snapshot of the blocks ``process_index`` owns.
+
+    Returns ``(skeleton, leaves, blocks)``:
+
+    - ``skeleton``: the same containers with ``jax.Array`` leaves replaced by
+      :class:`_LeafRef`; non-array leaves (host state, ints, numpy) ride
+      inline — they go in the manifest, not shard files;
+    - ``leaves``: ``{key: {"shape", "dtype", "spec"}}`` for every array leaf;
+    - ``blocks``: ``{key: {canonical_index: np.ndarray}}`` — only owned ones.
+
+    This is the only part that touches devices; it runs on the training
+    thread so the snapshot is consistent, and everything after (serialize,
+    fsync, manifest rendezvous) can overlap the next fused window.
+    """
+    import jax
+    from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
+
+    from bigdl_tpu.parallel.sharding import spec_to_tuple
+
+    pairs, treedef = tree_flatten_with_path(tree)
+    leaves: dict = {}
+    blocks: dict = {}
+    skel = []
+    for i, (path, leaf) in enumerate(pairs):
+        if not isinstance(leaf, jax.Array):
+            skel.append(leaf)
+            continue
+        key = f"{i}{keystr(path)}"
+        shape = tuple(leaf.shape)
+        leaves[key] = {"shape": shape, "dtype": np.dtype(leaf.dtype),
+                       "spec": spec_to_tuple(leaf.sharding)}
+        owned = _owned_blocks(leaf, process_index)
+        mine: dict = {}
+        for sh in leaf.addressable_shards:
+            c = _canonical_index(sh.index, shape)
+            if c in owned and c not in mine:
+                mine[c] = np.asarray(sh.data)
+        blocks[key] = mine
+        skel.append(_LeafRef(key))
+    return tree_unflatten(treedef, skel), leaves, blocks
+
+
+# ----------------------------------------------------------------- write
+def write_shard(dirpath: str, process_index: int, blocks: dict) -> int:
+    """Persist this process's blocks as ``shard-<pid>.data`` (CRC32 footer,
+    fsync-before-rename — the PR 4 discipline via ``utils/file.py``).
+    Returns the byte count written (the ``ckpt/bytes`` metric)."""
+    payload = {"format": 1, "process_index": int(process_index),
+               "blocks": {k: sorted(v.items()) for k, v in blocks.items()}}
+    data = ckpt_file.dumps(payload)
+    ckpt_file.save_bytes(data, shard_path(dirpath, process_index))
+    return len(data)
+
+
+def _covered(leaves: dict, seen: dict) -> bool:
+    for key, info in leaves.items():
+        vol = sum(_block_volume(c) for c in seen.get(key, ()))
+        if vol != _leaf_volume(info["shape"]):
+            return False
+    return True
+
+
+def commit_manifest(dirpath: str, skeleton, leaves: dict, mesh: Optional[dict],
+                    meta: dict, timeout: float = 60.0,
+                    poll: float = 0.05) -> bool:
+    """Commit the version once the union of durable, CRC-valid shard files
+    covers every leaf. There is no collective here by design: the writer
+    (process 0) polls the shared directory, so a survivor's emergency
+    checkpoint of fully-replicated leaves commits immediately while a
+    genuinely sharded save with a dead peer never commits — the version stays
+    invisible and loaders fall back to the previous complete one.
+
+    The manifest itself lands via atomic rename: the LAST file of the
+    version, so the directory is all-or-nothing.
+    Returns True iff committed within ``timeout`` seconds."""
+    deadline = time.monotonic() + float(timeout)
+    validated: dict = {}   # shard name -> {leaf_key: set(canonical_index)}
+    shard_names: list = []
+    while True:
+        try:
+            names = sorted(n for n in os.listdir(dirpath) if _SHARD_RE.match(n))
+        except OSError:
+            names = []
+        for name in names:
+            if name in validated:
+                continue
+            try:
+                payload = ckpt_file.load(os.path.join(dirpath, name))
+                validated[name] = {k: {c for c, _ in bl}
+                                   for k, bl in payload["blocks"].items()}
+            except (CheckpointCorruptError, OSError, KeyError):
+                continue  # mid-rename or torn — re-probe next round
+        seen: dict = {}
+        for cover in validated.values():
+            for k, cs in cover.items():
+                seen.setdefault(k, set()).update(cs)
+        shard_names = sorted(validated)
+        if _covered(leaves, seen):
+            break
+        if time.monotonic() > deadline:
+            logger.error(
+                "elastic checkpoint %s: shard coverage incomplete after "
+                "%.1fs (have %s) — manifest NOT committed", dirpath, timeout,
+                shard_names)
+            return False
+        time.sleep(poll)
+    manifest = {"format": 1, "skeleton": skeleton, "leaves": leaves,
+                "mesh": mesh, "meta": meta, "shards": shard_names}
+    ckpt_file.save(manifest, os.path.join(dirpath, MANIFEST))
+    logger.info("elastic checkpoint committed: %s (%d shard files)",
+                dirpath, len(shard_names))
+    return True
+
+
+# ------------------------------------------------------------------ load
+def load_manifest(dirpath: str) -> dict:
+    manifest = ckpt_file.load(os.path.join(dirpath, MANIFEST))
+    if not isinstance(manifest, dict) or "leaves" not in manifest \
+            or "skeleton" not in manifest:
+        raise ElasticCheckpointError(
+            dirpath, f"{dirpath}: manifest is not an elastic manifest")
+    return manifest
+
+
+def assemble(dirpath: str, manifest: Optional[dict] = None):
+    """Rebuild the full host-side pytree of one version from its shard files.
+
+    Returns ``(tree, spec_tree, manifest)`` — ``tree`` has numpy leaves
+    bitwise-equal to what was saved; ``spec_tree`` mirrors it with
+    :class:`_SpecLeaf` holders for :func:`place_tree`. Raises
+    :class:`ElasticCheckpointError` on corrupt/missing shards or coverage
+    gaps, so callers can quarantine the whole version and fall back."""
+    from jax.tree_util import tree_map
+
+    if manifest is None:
+        manifest = load_manifest(dirpath)
+    leaves = manifest["leaves"]
+    data: dict = {}
+    seen: dict = {k: set() for k in leaves}
+    for name in manifest["shards"]:
+        full = os.path.join(dirpath, name)
+        try:
+            payload = ckpt_file.load(full)
+        except OSError as e:
+            raise ElasticCheckpointError(
+                full, f"{full}: manifest-listed shard unreadable: {e}") from e
+        for key, blist in payload["blocks"].items():
+            info = leaves.get(key)
+            if info is None:
+                continue
+            out = data.get(key)
+            if out is None:
+                out = data[key] = np.empty(info["shape"],
+                                           dtype=info["dtype"])
+            for cidx, arr in blist:
+                if cidx in seen[key]:
+                    continue
+                out[tuple(slice(a, b) for a, b in cidx)] = arr
+                seen[key].add(cidx)
+    missing = [k for k, info in leaves.items()
+               if sum(_block_volume(c) for c in seen[k])
+               != _leaf_volume(info["shape"])]
+    if missing:
+        raise ElasticCheckpointError(
+            dirpath,
+            f"{dirpath}: shard files do not cover leaves {missing[:4]}"
+            f"{'...' if len(missing) > 4 else ''}")
+
+    skeleton = manifest["skeleton"]
+    tree = tree_map(
+        lambda x: data[x.key] if isinstance(x, _LeafRef) else x, skeleton)
+    spec_tree = tree_map(
+        lambda x: _SpecLeaf(leaves[x.key]["spec"])
+        if isinstance(x, _LeafRef) else _SpecLeaf(None), skeleton)
+    return tree, spec_tree, manifest
+
+
+def place_tree(tree, spec_tree, mesh):
+    """Re-place assembled leaves under ``mesh``'s rules: each recorded spec is
+    adapted (:func:`~bigdl_tpu.parallel.sharding.adapt_spec` — missing axes
+    and non-divisible dims degrade to replication) and the leaf is
+    ``device_put`` under the resulting NamedSharding. Inline (non-array)
+    leaves pass through untouched."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.tree_util import tree_map
+
+    from bigdl_tpu.parallel.sharding import adapt_spec
+
+    def _place(x, s):
+        if not isinstance(s, _SpecLeaf) or not isinstance(x, np.ndarray):
+            return x
+        spec = adapt_spec(s.spec, mesh, np.shape(x))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return tree_map(_place, tree, spec_tree)
+
+
+def mesh_info(mesh, process_count: int = 1) -> Optional[dict]:
+    """Mesh identity recorded in the manifest (what topology-change detection
+    compares at resume)."""
+    if mesh is None:
+        return {"axes": None, "shape": None,
+                "process_count": int(process_count)}
+    return {"axes": tuple(mesh.axis_names),
+            "shape": tuple(int(s) for s in mesh.devices.shape),
+            "process_count": int(process_count)}
+
+
+# ------------------------------------------------------- version agreement
+def agree_version(path: str, process_index: int, process_count: int,
+                  timeout: float = 60.0, poll: float = 0.05) -> Optional[int]:
+    """Cross-process agreement on WHICH version to resume: every process
+    publishes its newest complete version as a claim file on the shared
+    directory, waits for the full quorum, and takes the MIN — the newest
+    version every host can see. NFS-style close-to-open consistency can make
+    two hosts disagree on "newest" right after a write; the min is the safe
+    meet. A quorum that never forms (dead peer) times out to the local view,
+    which is exactly the shrunk-fleet resume case.
+
+    This is a load-time rendezvous: claims are written on entry and removed
+    on exit, and no saves run concurrently with loads (the optimizer joins
+    its writer first)."""
+    local = complete_versions(path)
+    mine = local[-1] if local else None
+    if process_count <= 1:
+        return mine
+    os.makedirs(path, exist_ok=True)
+    claim = os.path.join(path, f"resume-claim.{int(process_index)}")
+    ckpt_file.save({"version": mine}, claim)
+    deadline = time.monotonic() + float(timeout)
+    agreed = mine
+    while True:
+        claims = {}
+        for i in range(int(process_count)):
+            p = os.path.join(path, f"resume-claim.{i}")
+            try:
+                claims[i] = ckpt_file.load(p)["version"]
+            except (OSError, CheckpointCorruptError, KeyError, TypeError):
+                pass
+        if len(claims) == int(process_count):
+            versions = [v for v in claims.values() if v is not None]
+            agreed = min(versions) if len(versions) == len(claims) else None
+            break
+        if time.monotonic() > deadline:
+            logger.warning(
+                "elastic resume: version quorum incomplete after %.1fs "
+                "(%d/%d claims) — resuming from the local view (version %s)",
+                timeout, len(claims), process_count, mine)
+            break
+        time.sleep(poll)
+    try:
+        os.remove(claim)
+    except OSError:
+        pass
+    return agreed
+
+
+def remove_version(path: str, dirname: str) -> None:
+    """Delete one COMPLETE version directory, manifest first — a crash
+    mid-prune must never leave a manifest pointing at missing shards."""
+    full = os.path.join(path, dirname)
+    try:
+        os.remove(os.path.join(full, MANIFEST))
+    except OSError:
+        pass
+    shutil.rmtree(full, ignore_errors=True)
+    for name in os.listdir(path) if os.path.isdir(path) else ():
+        if name.startswith(dirname + ".corrupt"):
+            shutil.rmtree(os.path.join(path, name), ignore_errors=True)

@@ -65,6 +65,11 @@ class _EngineState:
         self.mesh = None               # default data-parallel Mesh
         self.devices = None
         self.distributed_initialized = False
+        # the jax.distributed client object outlives Engine.reset() — this
+        # flag tracks the CLIENT's lifetime, distributed_initialized tracks
+        # whether THIS Engine config brought it up. reset() clears the
+        # latter only; shutdown_distributed() clears both.
+        self.distributed_client_live = False
         self.auto_initialized = False
         self.lock = threading.Lock()
 
@@ -135,15 +140,40 @@ class Engine:
             cfg.check_singleton = _env("BIGDL_CHECK_SINGLETON", "0") == "1"
 
             if coordinator_address is not None and not _STATE.distributed_initialized:
+                if _STATE.distributed_client_live:
+                    # A previous bring-up's client is still attached (reset()
+                    # clears the init latch but cannot destroy the client).
+                    # Silently skipping here would leave the caller training
+                    # against a coordinator/topology it did NOT ask for.
+                    raise RuntimeError(
+                        "Engine.init: a jax.distributed client from a previous "
+                        "init is still live in this process — call "
+                        "Engine.shutdown_distributed() before re-initializing "
+                        f"with coordinator_address={coordinator_address!r} "
+                        "(elastic recovery: survivors usually re-exec instead)")
                 # Multi-host control plane: replaces the reference's Spark driver/executor
                 # bootstrap (SURVEY.md §5.8) with jax.distributed. Only legal once per
                 # process, so re-inits skip it.
+                if resolved_backend in (None, "cpu"):
+                    # cross-process CPU collectives need the gloo transport;
+                    # JAX_CPU_COLLECTIVES_IMPLEMENTATION is latched when
+                    # jax._src first imports, which site hooks can trigger
+                    # before the caller's env is set — the config API still
+                    # works as long as the backend is not yet initialized
+                    try:
+                        jax.config.update(
+                            "jax_cpu_collectives_implementation",
+                            os.environ.get(
+                                "JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo"))
+                    except Exception:
+                        pass  # backend already up — keep its collectives
                 jax.distributed.initialize(
                     coordinator_address=coordinator_address,
                     num_processes=node_number,
                     process_id=process_id,
                 )
                 _STATE.distributed_initialized = True
+                _STATE.distributed_client_live = True
 
             devices = cls._discover_devices_bounded(cfg.backend)
             cfg.node_number = node_number or jax.process_count()
@@ -300,9 +330,56 @@ class Engine:
         _STATE.config.compute_dtype = dtype
 
     @classmethod
+    def shutdown_distributed(cls, timeout: float | None = None) -> None:
+        """Tear down the ``jax.distributed`` client, bounded by ``timeout``
+        seconds (default ``BIGDL_INIT_TIMEOUT``) — the shutdown barrier can
+        wedge forever when a peer died, which is exactly when survivors need
+        to move on. On a clean (or already-dead) shutdown both distributed
+        flags clear and a later ``Engine.init(coordinator_address=...)`` may
+        bring up a fresh client; on a TIMEOUT the client is considered still
+        live and re-init keeps raising — re-exec the process instead."""
+        if not (_STATE.distributed_initialized
+                or _STATE.distributed_client_live):
+            return
+        import jax
+
+        if timeout is None:
+            timeout = float(_env("BIGDL_INIT_TIMEOUT", "120"))
+        result: dict = {}
+
+        def _worker():
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:
+                result["error"] = e
+
+        t = threading.Thread(target=_worker, name="bigdl-dist-shutdown",
+                             daemon=True)
+        t.start()
+        t.join(timeout)
+        _STATE.distributed_initialized = False
+        if t.is_alive():
+            logger.error(
+                "Engine.shutdown_distributed: jax.distributed.shutdown did "
+                "not complete within %.0fs (dead peer wedging the barrier?) — "
+                "the client is abandoned but still live; re-init in this "
+                "process will refuse. Re-exec to recover cleanly.", timeout)
+            return
+        if "error" in result:
+            # "not running" / mid-teardown errors all mean the same thing for
+            # our bookkeeping: no usable client remains
+            logger.warning("Engine.shutdown_distributed: %r", result["error"])
+        _STATE.distributed_client_live = False
+        logger.info("jax.distributed client shut down")
+
+    @classmethod
     def reset(cls) -> None:
-        """Tear down for tests."""
+        """Tear down for tests. Clears the distributed-init latch so a
+        re-``init`` with a coordinator does not silently skip bring-up — but
+        the CLIENT liveness flag survives (reset cannot destroy the client);
+        re-init while it is live raises, see :meth:`shutdown_distributed`."""
         _STATE.initialized = False
         _STATE.mesh = None
         _STATE.devices = None
+        _STATE.distributed_initialized = False
         _STATE.config = EngineConfig()

@@ -64,6 +64,22 @@ site                      where it fires
                           (``shutdown(wait=False)``), simulating a replica
                           crash with requests in flight; the router must
                           re-route them elsewhere with zero losses
+``ckpt_d2h``              the elastic checkpoint's device→host snapshot on
+                          the TRAINING thread — ``error`` fails the save
+                          (retry loop territory), ``stall`` blocks the loop
+                          (what the ``ckpt/stall_ms`` metric must show)
+``ckpt_async``            the elastic background writer, AFTER the snapshot —
+                          ``torn`` (default) writes the shard files but
+                          withholds the manifest commit, simulating a crash
+                          between snapshot and commit (the version must stay
+                          invisible); ``error`` fails the write (surfaced at
+                          the next join); ``stall`` delays it, pinning the
+                          async overlap in tests
+``host_down``             the trainer's step boundary — SIGKILLs the process
+                          at iteration N (matched by ``index``): the abrupt
+                          host-loss drill (no graceful anything, unlike
+                          ``sigterm``); survivors must resume from the last
+                          durable elastic checkpoint
 ========================  ====================================================
 
 A plan is a ``;``-separated list of entries ``site@N`` or ``site@N=action``.
@@ -111,10 +127,14 @@ SITE_CACHE_WRITE = "cache_write"
 SITE_SLO_BREACH = "slo_breach"
 SITE_ROUTER_DISPATCH = "router_dispatch"
 SITE_REPLICA_DOWN = "replica_down"
+SITE_CKPT_D2H = "ckpt_d2h"
+SITE_CKPT_ASYNC = "ckpt_async"
+SITE_HOST_DOWN = "host_down"
 
 #: sites whose plan entries match the caller-supplied ``index`` (training
 #: iteration) instead of the site's hit counter
-_INDEX_MATCHED = frozenset({SITE_NONFINITE_LOSS, SITE_SIGTERM, SITE_STALL})
+_INDEX_MATCHED = frozenset({SITE_NONFINITE_LOSS, SITE_SIGTERM, SITE_STALL,
+                            SITE_HOST_DOWN})
 
 _DEFAULT_ACTION = {
     SITE_DECODE: "error",
@@ -133,6 +153,9 @@ _DEFAULT_ACTION = {
     SITE_SLO_BREACH: "error",
     SITE_ROUTER_DISPATCH: "error",
     SITE_REPLICA_DOWN: "death",
+    SITE_CKPT_D2H: "error",
+    SITE_CKPT_ASYNC: "torn",
+    SITE_HOST_DOWN: "kill",
 }
 
 _KNOWN_ACTIONS = frozenset({"error", "death", "nan", "sigterm", "torn",

@@ -1,4 +1,4 @@
-"""Worker process for the 2-process jax.distributed test (not a pytest file).
+"""Worker process for the 2-process jax.distributed tests (not a pytest file).
 
 Usage: python multihost_worker.py <coordinator_port> <process_id> <out_file>
 
@@ -6,19 +6,81 @@ Each process exposes 4 virtual CPU devices; together they form the 8-device
 global mesh. Training runs through Engine.init(coordinator_address=...) +
 DistriOptimizer — the real multi-host code path (SURVEY.md §5.8: the analog of
 the reference's Spark cluster attach + DistriOptimizer loop).
+
+Modes (``BIGDL_MH_MODE``):
+
+- unset / ``train`` — the classic 2-process SPMD training run.
+- ``drill`` — the host-loss drill: a 2-process zero1 run writing ELASTIC
+  checkpoints to a shared dir (``BIGDL_MH_CKPT_DIR``). The driver arms
+  ``BIGDL_FAULT_PLAN=host_down@N`` on process 1 (SIGKILL mid-epoch, abrupt —
+  no graceful anything). Process 0 runs a peer watcher (``BIGDL_MH_PEER_PID``)
+  and, the moment the peer dies, re-execs itself in ``drill_resume`` mode —
+  the production elastic-controller move: the surviving host restarts its
+  trainer on the shrunk topology.
+- ``drill_resume`` — single-host (4-device) recovery: re-init Engine WITHOUT a
+  coordinator, verify the restored leaves are bitwise what the 2-process fleet
+  saved, then ``optimize(resume="auto")`` to the end. The out-file records the
+  resume point, the bitwise verdict, and the elastic robustness events.
 """
 
 import json
 import os
 import sys
+import threading
+import time
+
+
+def _ensure_local_devices(n: int) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _build_optimizer(nn, DataSet, SampleToMiniBatch, Sample, SGD, Trigger,
+                     DistriOptimizer, parameter_sync="allreduce"):
+    import numpy as np
+
+    rng = np.random.default_rng(0)  # same data on every process (SPMD contract)
+    samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                      np.int32(rng.integers(0, 3))) for _ in range(64)]
+    data = DataSet.array(samples, distributed=True) >> SampleToMiniBatch(16)
+    model = nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU()) \
+        .add(nn.Linear(16, 3)).add(nn.LogSoftMax())
+    opt = DistriOptimizer(model, data, nn.ClassNLLCriterion(),
+                          parameter_sync=parameter_sync)
+    opt.set_optim_method(SGD(learningrate=0.1, momentum=0.9, dampening=0.0))
+    return opt
+
+
+def _watch_peer(peer_pid: int, argv: list) -> None:
+    """Poll the peer process; on death, re-exec THIS process into the
+    single-host resume phase. exec (not in-process re-init) is deliberate:
+    the dead peer leaves the gloo collectives and the jax.distributed client
+    in an unrecoverable state, and a real elastic controller restarts the
+    trainer binary on the shrunk topology anyway."""
+    env = dict(os.environ)
+    env["BIGDL_MH_MODE"] = "drill_resume"
+    env.pop("BIGDL_FAULT_PLAN", None)
+    while True:
+        try:
+            os.kill(peer_pid, 0)
+        except OSError:
+            sys.stderr.write(
+                f"peer {peer_pid} is gone — re-exec for single-host elastic "
+                f"resume\n")
+            sys.stderr.flush()
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)] + argv, env)
+        time.sleep(0.1)
 
 
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     port, pid, out_file = sys.argv[1], int(sys.argv[2]), sys.argv[3]
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=4").strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    mode = os.environ.get("BIGDL_MH_MODE", "train")
+    _ensure_local_devices(4)
     # cross-process CPU collectives need the gloo transport
     os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
 
@@ -34,6 +96,50 @@ def main() -> None:
     from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
     from bigdl_tpu.utils.engine import Engine
 
+    if mode == "drill_resume":
+        # ---------------- survivor phase: shrunk topology, no coordinator
+        from bigdl_tpu.utils import elastic_ckpt, faults
+        from bigdl_tpu.utils.robustness import events
+
+        ck = os.environ["BIGDL_MH_CKPT_DIR"]
+        iters = int(os.environ.get("BIGDL_MH_ITERS", "8"))
+        Engine.init(backend="cpu", seed=0)
+        assert jax.process_count() == 1
+        snap0 = events.snapshot()
+        opt = _build_optimizer(nn, DataSet, SampleToMiniBatch, Sample, SGD,
+                               Trigger, DistriOptimizer,
+                               parameter_sync="zero1")
+        opt.set_checkpoint(ck, Trigger.several_iteration(2),
+                           backend="elastic")
+        versions = elastic_ckpt.complete_versions(ck)
+        assert versions, f"no durable elastic checkpoint under {ck}"
+        saved_tree, _, _ = elastic_ckpt.assemble(
+            os.path.join(ck, elastic_ckpt.version_dirname(versions[-1])))
+        # restore explicitly so the bitwise check sees pre-training leaves
+        opt._load_latest_checkpoint()
+        restored = jax.tree_util.tree_leaves(opt.model.get_params())
+        saved = jax.tree_util.tree_leaves(saved_tree["params"])
+        bitwise = all(np.array_equal(np.asarray(a), np.asarray(b))
+                      for a, b in zip(restored, saved))
+        resumed_from = int(opt.state["neval"])
+        opt.set_end_when(Trigger.max_iteration(iters))
+        opt.optimize(resume="auto")
+        deltas = events.deltas(snap0)
+        with open(out_file, "w") as f:
+            json.dump({"mode": mode, "process_id": pid,
+                       "resumed_from": resumed_from,
+                       "bitwise_equal": bool(bitwise),
+                       "loss": float(opt.state["loss"]),
+                       "neval": int(opt.state["neval"]),
+                       "versions_seen": versions,
+                       "elastic_resume_events":
+                           int(deltas.get("elastic_resume", 0)),
+                       "resume_events": int(deltas.get("resume", 0)),
+                       "process_count": jax.process_count()}, f)
+        print(f"survivor resumed from iter {resumed_from}: "
+              f"loss={opt.state['loss']}")
+        return
+
     Engine.init(backend="cpu", seed=0,
                 coordinator_address=f"localhost:{port}",
                 node_number=2, process_id=pid)
@@ -42,14 +148,39 @@ def main() -> None:
     assert jax.device_count() == 8, jax.device_count()
     assert Engine.mesh().devices.size == 8
 
-    rng = np.random.default_rng(0)  # same data on every process (SPMD contract)
-    samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
-                      np.int32(rng.integers(0, 3))) for _ in range(64)]
-    data = DataSet.array(samples, distributed=True) >> SampleToMiniBatch(16)
-    model = nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU()) \
-        .add(nn.Linear(16, 3)).add(nn.LogSoftMax())
-    opt = DistriOptimizer(model, data, nn.ClassNLLCriterion())
-    opt.set_optim_method(SGD(learningrate=0.1, momentum=0.9, dampening=0.0))
+    if mode == "drill":
+        # ---------------- fleet phase: elastic checkpoints on a shared dir
+        from bigdl_tpu.utils import faults
+
+        ck = os.environ["BIGDL_MH_CKPT_DIR"]
+        iters = int(os.environ.get("BIGDL_MH_ITERS", "8"))
+        if pid == 0:
+            peer = int(os.environ["BIGDL_MH_PEER_PID"])
+            threading.Thread(target=_watch_peer, args=(peer, sys.argv[1:]),
+                             daemon=True).start()
+        opt = _build_optimizer(nn, DataSet, SampleToMiniBatch, Sample, SGD,
+                               Trigger, DistriOptimizer,
+                               parameter_sync="zero1")
+        opt.set_checkpoint(ck, Trigger.several_iteration(2),
+                           backend="elastic")
+        opt.set_end_when(Trigger.max_iteration(iters))
+        opt.optimize()
+        # only reachable when the host_down plan did NOT fire (process 1's
+        # SIGKILL leaves no out-file; the driver asserts on the -9 exit) —
+        # report what stayed unfired so a mis-armed drill is diagnosable
+        plan = faults.active_plan()
+        with open(out_file, "w") as f:
+            json.dump({"mode": mode, "process_id": pid,
+                       "loss": float(opt.state["loss"]),
+                       "neval": int(opt.state["neval"]),
+                       "unfired": plan.unfired() if plan else [],
+                       "process_count": jax.process_count()}, f)
+        print(f"drill worker {pid}: completed without dying "
+              f"(unfired={plan.unfired() if plan else []})")
+        return
+
+    opt = _build_optimizer(nn, DataSet, SampleToMiniBatch, Sample, SGD,
+                           Trigger, DistriOptimizer)
     opt.set_end_when(Trigger.max_iteration(4))
     opt.optimize()
 

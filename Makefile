@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming t1-fleet t1-recsys t1-elastic dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming t1-fleet t1-recsys t1-elastic t1-promotion dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -95,6 +95,17 @@ t1-recsys:
 t1-elastic:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m elastic --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
+# Promotion-lifecycle suite only (docs/serving.md "Lifecycle"): registry
+# publish/prune/lora-overlay, gate accept/reject (eval crash and NaN metric
+# quarantine the candidate, never the trainer), swap-under-load with bitwise
+# continuity and a pinned program ledger, the scripted bad-promotion →
+# SLO-breach → auto-rollback drill (plan fully fired, served outputs bitwise
+# back to the pre-promotion version), LoRA-delta swaps, SnapshotServer
+# in-place tenant swap, and trainer→registry publication. Unmarked-slow, so
+# `make t1` runs these too; this is the fast inner loop for lifecycle work.
+t1-promotion:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m promotion --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
 dist:
 	bash make-dist.sh
 
@@ -116,6 +127,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --stream-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --recsys-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --ckpt-bench --no-compare-dtypes --no-streamed
+	JAX_PLATFORMS=cpu python bench.py --promotion-bench --no-compare-dtypes --no-streamed
 
 # Host input-pipeline leg (decode→augment→stack on a synthetic image folder):
 # pipeline_images_per_sec at BIGDL_DATA_WORKERS 0/1/4/auto + per-stage ms.

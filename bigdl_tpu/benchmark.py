@@ -1446,6 +1446,168 @@ def _measure_serving_bench(n_requests: int = 24, slots: int = 8,
     }
 
 
+def _measure_promotion_bench(n_requests: int = 24, slots: int = 8,
+                             max_new: int = 16) -> dict:
+    """Promotion-lifecycle leg (docs/serving.md "Lifecycle"), three
+    questions:
+
+    1. **Swap flatness**: sustained req/s and TTFT p99 for a traffic window
+       WITH a mid-window zero-downtime weight promotion vs the same window
+       clean — the swap must drop zero requests, and the program ledger
+       must not grow across it.
+    2. **Gate drill**: a ``promote_eval@1=nonfinite`` fault plan poisons
+       the candidate metric — the gate must reject it (and the plan must
+       fully fire).
+    3. **Rollback wall time**: a scripted bad promotion (NaN weights, gate
+       bypassed) trips the watch-window quality probe; the auto-rollback
+       swap-back is timed, and the post-rollback serving output must be
+       bitwise what the pre-promotion version produced.
+
+    Anything off-script stamps the degraded-record contract instead of
+    passing quietly."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.models.transformerlm import TransformerLM
+    from bigdl_tpu.obs.registry import registry
+    from bigdl_tpu.serving import PromotionController, ServingEngine
+    from bigdl_tpu.utils.faults import inject_faults
+    from bigdl_tpu.utils.model_registry import ModelRegistry
+
+    dev = jax.devices()[0]
+    # the 64 bucket is load-bearing: swap re-prefill replays prompt+emitted
+    # tokens (up to 48+15 = 63), and an unwarmed length would compile
+    # mid-window — exactly the stall this leg exists to rule out
+    buckets = (16, 32, 48, 64)
+    max_len = 64 + max_new
+    lm = TransformerLM(1000, embed_dim=64, num_heads=4, num_layers=2,
+                       max_len=max_len).evaluate()
+    rng = np.random.default_rng(0)
+    reqs = [rng.integers(0, 1000, (int(rng.integers(4, 49)),))
+            .astype(np.int32) for _ in range(n_requests)]
+
+    def tree_map(tree, f):
+        return {k: (tree_map(v, f) if isinstance(v, dict) else f(v))
+                for k, v in tree.items()}
+
+    base = lm.get_params()
+    noise = np.random.default_rng(7)
+    good = tree_map(base, lambda a: np.asarray(a)
+                    + noise.normal(0, 0.02, np.shape(a))
+                    .astype(np.asarray(a).dtype))
+    bad = tree_map(base, lambda a: np.full_like(np.asarray(a), np.nan))
+    reg_dir = tempfile.mkdtemp(prefix="bigdl-promo-bench-")
+    mreg = ModelRegistry(reg_dir, keep=4)
+    v_good = mreg.publish(good, meta={"source": "bench"})
+    v_bad = mreg.publish(bad, meta={"source": "bench"})
+
+    def pct99(snap, name):
+        h = snap["histograms"].get(name, {})
+        return round(h["p99"], 2) if h.get("p99") is not None else None
+
+    probe = np.arange(8, dtype=np.int32) % 1000
+    eng = ServingEngine(lm, max_len=max_len, slots=slots, buckets=buckets)
+    problems = []
+    try:
+        for plen in (8, 24, 40, 56):   # warm every grid point: timed legs
+            warm = np.arange(plen, dtype=np.int32) % 1000   # are compile-free
+            eng.submit(warm, max_new).result(timeout=300)
+        ctrl = PromotionController(
+            mreg, engine=eng, eval_fn=lambda p: 1.0,
+            probe_prompts=[probe], watch_window_s=0.0, poll_s=0.01,
+            rollback_budget=3)
+
+        # clean window
+        registry.reset()
+        t0 = time.perf_counter()
+        for h in [eng.submit(p, max_new) for p in reqs]:
+            h.result(timeout=300)
+        clean_wall = time.perf_counter() - t0
+        clean_snap = registry.snapshot()
+
+        # promotion window: same traffic, v_good swaps in mid-stream
+        progs_before = eng.stats()["compiled_programs"]
+        registry.reset()
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, max_new) for p in reqs]
+        promo = ctrl.promote(v_good, watch=False)
+        dropped = 0
+        for h in handles:
+            try:
+                h.result(timeout=300)
+            except Exception:
+                dropped += 1
+        promo_wall = time.perf_counter() - t0
+        promo_snap = registry.snapshot()
+        progs_after = eng.stats()["compiled_programs"]
+        post_promo = np.asarray(
+            eng.submit(probe, max_new).result(timeout=300).tokens)
+        if dropped:
+            problems.append(f"swap dropped {dropped} requests")
+        if progs_after > progs_before:
+            problems.append(f"program ledger grew across swap "
+                            f"({progs_before} -> {progs_after})")
+
+        # gate drill: poisoned candidate metric must be rejected
+        with inject_faults("promote_eval@1=nonfinite") as plan:
+            ok, _metric, _reason = ctrl.gate(v_bad)
+        if ok or plan.unfired():
+            problems.append(f"gate drill off-script: accepted={ok} "
+                            f"unfired={plan.unfired()}")
+
+        # rollback drill: bad promotion bypassing the gate; the watch
+        # window's quality probe trips on non-finite logits and the
+        # previous version swaps back — timed, then bitwise-checked
+        ctrl.promote(v_bad, gate=False, watch=False)
+        t0 = time.perf_counter()
+        rolled = ctrl.watch(window_s=5.0, poll_s=0.01)
+        rollback_wall = time.perf_counter() - t0
+        post_roll = np.asarray(
+            eng.submit(probe, max_new).result(timeout=300).tokens)
+        if not rolled:
+            problems.append("watch window did not roll back")
+        if not np.array_equal(post_roll, post_promo):
+            problems.append("post-rollback output != pre-promotion output")
+        final_stats = eng.stats()
+    finally:
+        eng.shutdown()
+
+    rps_clean = n_requests / clean_wall
+    rps_promo = n_requests / promo_wall
+    record_extra = {}
+    if problems:
+        reason = "promotion leg off-script: " + "; ".join(problems)
+        print(f"bench: DEGRADED RUN — {reason}", file=sys.stderr)
+        record_extra = {"degraded": True, "probe_error": reason}
+    return {
+        "value": round(rps_promo, 2),
+        "unit": "req/sec",
+        "n_requests": n_requests,
+        "slots": slots,
+        "buckets": list(buckets),
+        "max_new_tokens": max_new,
+        "requests_per_sec_clean": round(rps_clean, 2),
+        "requests_per_sec_promotion": round(rps_promo, 2),
+        "promotion_flatness": (round(rps_promo / rps_clean, 3)
+                               if rps_clean else None),
+        "ttft_ms_p99_clean": pct99(clean_snap, "serving/ttft_ms"),
+        "ttft_ms_p99_promotion": pct99(promo_snap, "serving/ttft_ms"),
+        "swap_ms": round(promo.swap.duration_s * 1e3, 2),
+        "swap_requeued": promo.swap.requeued,
+        "dropped_requests": dropped,
+        "rollback_ms": round(rollback_wall * 1e3, 2),
+        "rollback_bitwise_ok": "post-rollback output != pre-promotion "
+                               "output" not in problems,
+        "compiled_programs": final_stats["compiled_programs"],
+        "served_version": final_stats["model_version"],
+        "device_kind": dev.device_kind,
+        "platform": dev.platform,
+        **record_extra,
+    }
+
+
 def _measure_fleet_bench(n_requests: int = 24, replicas: int = 2,
                          max_new: int = 16) -> dict:
     """Serving-fleet leg, three questions (docs/serving.md "Fleet"):
@@ -2234,6 +2396,7 @@ def run_orchestrator(args) -> None:
     fleet_bench = getattr(args, "fleet_bench", False)
     recsys_bench = getattr(args, "recsys_bench", False)
     ckpt_bench = getattr(args, "ckpt_bench", False)
+    promotion_bench = getattr(args, "promotion_bench", False)
     worker_argv = ["--run", "--model", args.model, "--batch", str(args.batch),
                    "--iters", str(args.iters), "--warmup", str(args.warmup),
                    "--dtype", args.dtype]
@@ -2268,6 +2431,8 @@ def run_orchestrator(args) -> None:
         worker_argv.append("--recsys-bench")
     if ckpt_bench:
         worker_argv.append("--ckpt-bench")
+    if promotion_bench:
+        worker_argv.append("--promotion-bench")
     env = dict(os.environ)
     if ckpt_bench and env.get("JAX_PLATFORMS") == "cpu" \
             and "xla_force_host_platform_device_count" \
@@ -2307,7 +2472,7 @@ def run_orchestrator(args) -> None:
                     and not kernel_bench \
                     and not precision_bench and not serving_bench \
                     and not fleet_bench and not recsys_bench \
-                    and not ckpt_bench:
+                    and not ckpt_bench and not promotion_bench:
                 # the comparison leg only feeds the ratio — skip its streamed
                 # measurement (it would be discarded)
                 cmp_argv = ["--run", "--model", args.model,
@@ -2346,7 +2511,8 @@ def run_orchestrator(args) -> None:
     if args.int8_infer or args.serving or args.decode_infer or args.ablate \
             or args.eval_bench or pipeline_bench or stream_bench \
             or obs_bench or kernel_bench or precision_bench \
-            or serving_bench or fleet_bench or recsys_bench or ckpt_bench:
+            or serving_bench or fleet_bench or recsys_bench or ckpt_bench \
+            or promotion_bench:
         # a LeNet training number would not answer an inference-path request:
         # fail loudly with the metric the caller asked for
         kind = ("int8_vs_bf16_infer" if args.int8_infer
@@ -2362,6 +2528,7 @@ def run_orchestrator(args) -> None:
                 else "serving_fleet" if fleet_bench
                 else "recsys_bench" if recsys_bench
                 else "ckpt_bench" if ckpt_bench
+                else "promotion_bench" if promotion_bench
                 else "step_ablation")
         record = {
             "metric": f"{args.model}_{kind}",
@@ -2498,6 +2665,14 @@ def main(argv=None):
                         "resume-across-topology wall time for a zero1 "
                         "checkpoint restored on a shrunk (8→4) and grown "
                         "(4→8) device mesh")
+    p.add_argument("--promotion-bench", dest="promotion_bench",
+                   action="store_true",
+                   help="promotion-lifecycle leg: sustained req/s + TTFT "
+                        "p99 flatness across a mid-window zero-downtime "
+                        "weight swap (zero dropped, program ledger "
+                        "pinned), gate-rejection drill on a NaN-poisoned "
+                        "candidate, and auto-rollback wall time with a "
+                        "bitwise post-rollback output check")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
     args = p.parse_args(argv)
@@ -2565,6 +2740,10 @@ def _run_worker_modes(args) -> int:
     elif getattr(args, "ckpt_bench", False):
         res = _measure_ckpt_bench()
         res["metric"] = "elastic_ckpt_bench"
+        res["vs_baseline"] = None
+    elif getattr(args, "promotion_bench", False):
+        res = _measure_promotion_bench()
+        res["metric"] = "transformerlm_promotion"
         res["vs_baseline"] = None
     elif args.ablate:
         res = _measure_ablation(args.model, args.batch,

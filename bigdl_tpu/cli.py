@@ -219,6 +219,7 @@ def _render_top(metrics: dict, health=None) -> str:
                 state += "/SLO"
             lines.append(
                 f"    {name:<12} {state:<10}"
+                f" v{t.get('model_version', 0):.0f}"
                 f" backlog {t.get('backlog', 0):.0f}"
                 f" active {t.get('active_slots', 0):.0f}"
                 f" done {t.get('completed', 0):.0f}"

@@ -66,7 +66,8 @@ _TENANT_FIELDS = ("backlog", "queued", "active_slots", "submitted",
                   "completed", "timeouts", "shed", "respawns",
                   "poisoned_slots", "slot_recycles", "decode_tps",
                   "queue_depth", "decode_rate", "est_wait_ms",
-                  "prefix_hits", "prefix_tokens_saved", "spec_acceptance")
+                  "prefix_hits", "prefix_tokens_saved", "spec_acceptance",
+                  "model_version")
 
 #: numeric per-replica fields exported under {fleet=...,replica=...} — the
 #: router's own dispatch signal, scrapeable by external load balancers

@@ -165,6 +165,15 @@ class Optimizer:
         # over_write_checkpoint() opts into a single rolling file.
         self.overwrite_checkpoint: bool = False
         self.checkpoint_backend: str = "pickle"
+        # serving-lifecycle handoff (set_model_registry / BIGDL_REGISTRY_DIR):
+        # each durable checkpoint version additionally publishes its params
+        # subtree to a utils/model_registry.ModelRegistry as a promotion
+        # candidate — on the writer thread, never failing the trainer
+        self.model_registry = None
+        if os.environ.get("BIGDL_REGISTRY_DIR"):
+            from bigdl_tpu.utils.model_registry import ModelRegistry
+            self.model_registry = ModelRegistry(
+                os.environ["BIGDL_REGISTRY_DIR"])
         self.train_summary = None
         self.val_summary = None
         self.summary_trigger: Optional[Trigger] = None
@@ -409,6 +418,18 @@ class Optimizer:
                 "checkpoint backend must be 'pickle', 'orbax' or 'elastic'")
         self.checkpoint_path, self.checkpoint_trigger = path, trigger
         self.checkpoint_backend = backend
+        return self
+
+    def set_model_registry(self, registry) -> "Optimizer":
+        """Publish every durable checkpoint's params to ``registry`` (a
+        :class:`~bigdl_tpu.utils.model_registry.ModelRegistry` or a path) as
+        a serving-lifecycle ``candidate`` version, gated + promoted by
+        ``serving/lifecycle.py``. Publication runs on the checkpoint writer
+        thread; its failures are logged, never raised into training."""
+        if isinstance(registry, str):
+            from bigdl_tpu.utils.model_registry import ModelRegistry
+            registry = ModelRegistry(registry)
+        self.model_registry = registry
         return self
 
     def over_write_checkpoint(self, overwrite: bool = True) -> "Optimizer":
@@ -2215,6 +2236,10 @@ class Optimizer:
                     ckpt_file.save_bytes(data, path)
                 obs_registry.registry.counter("ckpt/bytes").inc(len(data))
                 self._prune_old_checkpoints()
+                # payload["state"] is the eager copy — the live ``state``
+                # dict may have advanced under the async writer
+                self._publish_to_registry(int(payload["state"]["neval"]),
+                                          params=payload["params"])
                 logger.info("checkpoint written: %s", path)
             except BaseException as e:  # surfaced at the next join
                 self._ckpt_error = e
@@ -2253,9 +2278,12 @@ class Optimizer:
             meta["sched_state"] = sched.state_dict()
         minfo = elastic_ckpt.mesh_info(
             Engine.mesh() if Engine.is_initialized() else None, pcount)
+        # captured eagerly: the async writer runs behind the next window,
+        # by which time the training thread has advanced state["neval"]
+        ckpt_version = int(state["neval"])
         dirpath = os.path.join(
             self.checkpoint_path,
-            elastic_ckpt.version_dirname(int(state["neval"])))
+            elastic_ckpt.version_dirname(ckpt_version))
         sync_timeout = float(
             os.environ.get("BIGDL_CKPT_SYNC_TIMEOUT", "60"))
 
@@ -2285,6 +2313,7 @@ class Optimizer:
                             timeout=sync_timeout)
                         if committed:
                             self._prune_old_checkpoints()
+                            self._publish_to_registry(ckpt_version)
                 reg = obs_registry.registry
                 reg.histogram("ckpt/async_write_ms").observe(
                     (time.perf_counter() - t1) * 1e3)
@@ -2299,6 +2328,30 @@ class Optimizer:
         self._ckpt_thread = t
         if not self._ckpt_async():
             self._join_checkpoint_writer()
+
+    def _publish_to_registry(self, version: int, params=None) -> None:
+        """Serving-lifecycle handoff, on the checkpoint WRITER thread: hand
+        the durable version's params to the model registry as a promotion
+        candidate. Registry trouble is logged and dropped — it must never
+        set ``_ckpt_error`` or otherwise reach the training thread (the
+        gate quarantines candidates; the trainer just keeps publishing)."""
+        reg = self.model_registry
+        if reg is None:
+            return
+        try:
+            if params is None:
+                # elastic: re-assemble the manifest-committed version from
+                # disk — registers exactly what a resume would load
+                reg.register_from_elastic(
+                    self.checkpoint_path, version,
+                    meta={"source": "elastic"})
+            elif version not in reg.versions():
+                reg.publish(params, version=version,
+                            meta={"source": self.checkpoint_backend,
+                                  "neval": version})
+        except Exception as e:  # noqa: BLE001 — never into the trainer
+            logger.warning("model registry publication failed (v%s): %s",
+                           version, e)
 
     def _prune_old_checkpoints(self) -> None:
         """Keep-last-N retention (``BIGDL_CKPT_KEEP``) for versioned

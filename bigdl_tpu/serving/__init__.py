@@ -17,9 +17,12 @@ bitwise-identical output (``serving/speculative.py``).
 
 from bigdl_tpu.serving.engine import (
     EngineOverloaded, EngineShutdown, EngineShutdownTimeout,
-    NonFiniteLogitsError, RequestTimeout, ServingEngine,
+    NonFiniteLogitsError, RequestTimeout, ServingEngine, SwapResult,
 )
 from bigdl_tpu.serving.fleet import FleetExhausted, FleetHandle, FleetRouter
+from bigdl_tpu.serving.lifecycle import (
+    PromotionController, PromotionCriterion, PromotionResult,
+)
 from bigdl_tpu.serving.multitenant import SnapshotServer
 from bigdl_tpu.serving.prefix_cache import PrefixEntry, PrefixPool
 from bigdl_tpu.serving.ranking import RankedResult, RankingEngine, RankingHandle
@@ -35,9 +38,10 @@ __all__ = [
     "CompletedRequest", "EngineOverloaded", "EngineShutdown",
     "EngineShutdownTimeout", "FINISH_EOS", "FINISH_LENGTH",
     "FleetExhausted", "FleetHandle", "FleetRouter",
-    "NonFiniteLogitsError", "PrefixEntry", "PrefixPool", "RankedResult",
-    "RankingEngine", "RankingHandle", "RequestHandle",
+    "NonFiniteLogitsError", "PrefixEntry", "PrefixPool",
+    "PromotionController", "PromotionCriterion", "PromotionResult",
+    "RankedResult", "RankingEngine", "RankingHandle", "RequestHandle",
     "RequestTimeout", "ServingEngine", "SlotScheduler", "SnapshotServer",
-    "SpeculativeDecoder", "default_buckets", "pick_bucket",
+    "SpeculativeDecoder", "SwapResult", "default_buckets", "pick_bucket",
     "pick_seed_bucket",
 ]

@@ -156,6 +156,45 @@ _HEALTH_CODE = {"starting": 0, "ready": 1, "degraded": 2, "draining": 3,
 _OVERLOAD_MODES = ("block", "shed", "degrade")
 
 
+class _Wake:
+    """Queue sentinel: wakes an idle engine loop without carrying work —
+    how ``swap_weights`` gets a blocked ``_gather`` back to the step
+    boundary where the pending swap is serviced."""
+
+    def __repr__(self):
+        return "<WAKE>"
+
+
+_WAKE = _Wake()
+
+
+class SwapResult:
+    """What :meth:`ServingEngine.swap_weights` returns: the installed
+    version plus, per in-flight request, how many tokens it had emitted at
+    the swap boundary — the split point of the bitwise contract (tokens
+    before are the OLD weights' verbatim, tokens after are what the NEW
+    weights produce from that prefix)."""
+
+    __slots__ = ("version", "in_flight", "requeued", "duration_s")
+
+    def __init__(self, version, in_flight, requeued, duration_s):
+        self.version = version
+        self.in_flight = in_flight      # {request_id: n_generated_at_swap}
+        self.requeued = requeued
+        self.duration_s = duration_s
+
+
+class _SwapCommand:
+    __slots__ = ("params", "version", "done", "error", "result")
+
+    def __init__(self, params, version):
+        self.params = params
+        self.version = version
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.result: Optional[SwapResult] = None
+
+
 class ServingEngine:
     """Continuous-batching request server over one model snapshot.
 
@@ -334,6 +373,13 @@ class ServingEngine:
         # BIGDL_TRACE_SAMPLE fraction of requests (>= 1.0 = all, 0 = none)
         self._trace_sample = float(
             os.environ.get("BIGDL_TRACE_SAMPLE", "0.05"))
+        # weight-swap plane (serving/lifecycle.py): the served registry
+        # version (0 = the construction-time snapshot, never registered)
+        # and the one-deep command mailbox the engine thread services at
+        # decode-step boundaries
+        self._model_version = 0
+        self._swap_pending: Optional[_SwapCommand] = None
+        self._swap_lock = threading.Lock()
         registry.gauge("serving/health").set(_HEALTH_CODE["starting"])
 
     # ------------------------------------------------------------ programs
@@ -735,6 +781,7 @@ class ServingEngine:
             "active_slots": self._sched.active_count,
             "queued": self._queue.qsize(),
             "health": self._health,
+            "model_version": self._model_version,
             "overload": self.overload,
             "backlog": self._backlog,
             "respawns": self._respawns,
@@ -890,12 +937,171 @@ class ServingEngine:
         events.record("serving_recovered", engine=self.name,
                       requeued=len(evicted), pending=len(self._pending))
 
+    # ----------------------------------------------------------- hot swap
+    def swap_weights(self, params, version: int = 0,
+                     timeout: float = 60.0) -> SwapResult:
+        """Install a new weight snapshot with ZERO dropped requests — the
+        promotion plane's entry point (``serving/lifecycle.py``), callable
+        from any thread.
+
+        No drain: the engine thread pauses at the next decode-step
+        boundary, installs ``params`` (same tree structure/shapes as the
+        current snapshot — anything else raises ``ValueError`` and the old
+        weights keep serving), rebuilds the slot grid, and re-prefills
+        every in-flight sequence from prompt + already-emitted tokens in
+        one chunk — the crash-recovery machinery, so tokens emitted before
+        the swap are preserved verbatim and tokens after are bitwise what
+        the new weights produce from that prefix. The prefill/decode
+        program keys are unchanged (params are jit *arguments*), so
+        ``stats()['compiled_programs']`` does not grow across a swap.
+
+        Returns a :class:`SwapResult`; raises whatever made the swap fail
+        (injected ``promote_swap`` faults included) with the previous
+        weights still serving."""
+        if self._stop.is_set() or self._drain.is_set():
+            raise EngineShutdown(
+                f"engine {self.name!r} is shut down or draining; "
+                f"cannot swap weights")
+        cmd = _SwapCommand(params, int(version))
+        with self._swap_lock:
+            if self._swap_pending is not None:
+                raise RuntimeError(
+                    f"engine {self.name!r}: a weight swap is already in "
+                    f"progress")
+            if self._thread is None:
+                # lazy engine, never started: no decode loop, no in-flight
+                # state — apply synchronously on the caller's thread
+                with self._start_lock:
+                    if self._thread is None:
+                        self._execute_swap(cmd)
+                        if cmd.error is not None:
+                            raise cmd.error
+                        return cmd.result
+            self._swap_pending = cmd
+        self._queue.try_put(_WAKE)   # unblock an idle _gather; full queue
+        #                              is fine — the loop is awake anyway
+        if not cmd.done.wait(timeout):
+            with self._swap_lock:
+                if self._swap_pending is cmd:   # never reached the loop
+                    self._swap_pending = None
+            raise EngineShutdownTimeout(
+                f"engine {self.name!r}: weight swap not serviced within "
+                f"{timeout:.1f}s")
+        if cmd.error is not None:
+            raise cmd.error
+        return cmd.result
+
+    def _check_tree(self, params):
+        """Validate + coerce a candidate tree against the serving snapshot:
+        identical flattened paths, identical shapes, leaves cast to the
+        CURRENT leaf's dtype so the swap can never change the jit signature
+        (a dtype drift would silently grow the program ledger)."""
+        from bigdl_tpu.utils.model_registry import flatten_params
+
+        cur = flatten_params(self._params)
+        new = flatten_params(params)
+        if set(cur) != set(new):
+            missing = sorted(set(cur) - set(new))[:3]
+            extra = sorted(set(new) - set(cur))[:3]
+            raise ValueError(
+                f"engine {self.name!r}: candidate params tree does not "
+                f"match the serving snapshot (missing={missing}, "
+                f"extra={extra})")
+        out = {}
+        for path, leaf in new.items():
+            ref = cur[path]
+            arr = np.asarray(leaf)
+            if tuple(arr.shape) != tuple(ref.shape):
+                raise ValueError(
+                    f"engine {self.name!r}: candidate leaf {path!r} has "
+                    f"shape {tuple(arr.shape)}, serving snapshot has "
+                    f"{tuple(ref.shape)}")
+            out[path] = arr.astype(ref.dtype, copy=False)
+        # rebuild the nested tree in the snapshot's own structure
+        def rebuild(node, prefix=""):
+            if not isinstance(node, dict):
+                return out[prefix]
+            return {k: rebuild(v, f"{prefix}/{k}" if prefix else str(k))
+                    for k, v in node.items()}
+        return rebuild(self._params)
+
+    def _execute_swap(self, cmd: "_SwapCommand") -> None:
+        """The swap itself — runs at a decode-step boundary on the engine
+        thread (or on the caller's thread for a never-started engine). Any
+        failure leaves the previous snapshot fully serving."""
+        nn = self._nn
+        t0 = time.perf_counter()
+        try:
+            fault_point(faults.SITE_PROMOTE_SWAP)
+            new_params = self._check_tree(cmd.params)
+            in_flight = {s.request.request_id: len(s.request.generated)
+                         for s in self._sched.active_slots()}
+            evicted = self._sched.reset()
+            self._params = new_params
+            # fresh zeroed grids: the old rows' KV entries were computed
+            # under the old weights and must not leak into new decodes
+            self._dec_state = nn.install_decode_cache(
+                self._model, self.slots, self.max_len, dtype=self._dtype,
+                per_slot=True)
+            nn.clear_decode_cache(self._model)
+            if self._draft is not None:
+                self._dec_state_d = nn.install_decode_cache(
+                    self._draft, self.slots, self.max_len,
+                    dtype=self._dtype, per_slot=True)
+                nn.clear_decode_cache(self._draft)
+            if self._prefix is not None:
+                self._prefix.clear()   # pooled states encode the old weights
+            self._pending[:0] = evicted
+            registry.gauge("serving/active_slots").set(0)
+            self._model_version = cmd.version
+            registry.gauge("serve/model_version").set(cmd.version)
+            dt = time.perf_counter() - t0
+            events.record("serving_weight_swap", engine=self.name,
+                          version=cmd.version, requeued=len(evicted),
+                          duration_ms=round(dt * 1e3, 3))
+            logger.info(
+                "engine %r: weight swap to v%d (%d in-flight re-prefilled, "
+                "%.1f ms)", self.name, cmd.version, len(evicted), dt * 1e3)
+            cmd.result = SwapResult(cmd.version, in_flight, len(evicted),
+                                    dt)
+        except BaseException as e:  # noqa: BLE001 — fail the WAITER, not us
+            events.record("serving_swap_failed", engine=self.name,
+                          version=cmd.version,
+                          error=f"{type(e).__name__}: {e}")
+            logger.error("engine %r: weight swap to v%d failed: %s — old "
+                         "weights keep serving", self.name, cmd.version, e)
+            cmd.error = e
+        finally:
+            cmd.done.set()
+
+    def _service_swap(self) -> None:
+        with self._swap_lock:
+            cmd, self._swap_pending = self._swap_pending, None
+        if cmd is not None:
+            self._execute_swap(cmd)
+
+    @property
+    def model_version(self) -> int:
+        return self._model_version
+
+    @property
+    def params_snapshot(self):
+        """The currently-serving weight tree (read-only: the promotion
+        controller captures it before the first swap so rollback can
+        restore a construction-time snapshot that was never registered)."""
+        return self._params
+
     # -------------------------------------------------------- engine thread
     def _loop(self) -> None:
         self._set_health("degraded" if self._respawns else "ready")
         wd = self._watchdog
         while not self._stop.is_set():
             fault_point(faults.SITE_SERVE_THREAD)
+            # decode-step boundary: service a pending weight swap before
+            # admitting/ticking — in-flight rows land in _pending and
+            # re-prefill below through the ordinary admission path
+            if self._swap_pending is not None:
+                self._service_swap()
             closed = self._gather(self._pending)
             if self._drain.is_set():
                 self._drain_loop()
@@ -922,10 +1128,14 @@ class ServingEngine:
                 item = self._queue.get(timeout=0)
                 if item is EMPTY or item is CLOSED:
                     return item is CLOSED
+                if isinstance(item, _Wake):
+                    continue
                 pending.append(item)
         item = self._queue.get()      # idle: sleep until traffic or shutdown
         if item is CLOSED:
             return True
+        if isinstance(item, _Wake):
+            return False   # swap wake-up: back to the loop top immediately
         pending.append(item)
         # SLO batch-fill wait: an idle engine lingers admit_wait_s for
         # co-batchable arrivals before paying the first prefill — higher
@@ -941,6 +1151,8 @@ class ServingEngine:
                     break
                 if nxt is CLOSED:
                     return True
+                if isinstance(nxt, _Wake):
+                    break
                 pending.append(nxt)
         return False
 
@@ -959,6 +1171,8 @@ class ServingEngine:
             item = self._queue.get(timeout=0)
             if item is EMPTY or item is CLOSED:
                 break
+            if isinstance(item, _Wake):
+                continue
             item.handle._fail(err)
             self._backlog_dec()
         while self._sched.any_active() and not self._stop.is_set():
@@ -1358,6 +1572,15 @@ class ServingEngine:
             item = self._queue.get(timeout=0)
             if item is EMPTY or item is CLOSED:
                 break
+            if isinstance(item, _Wake):
+                continue
             item.handle._fail(err)
             self._backlog_dec()
         self._queue.close()
+        # a swap whose waiter is still blocked must fail NOW — the loop
+        # that would have serviced it is gone
+        with self._swap_lock:
+            cmd, self._swap_pending = self._swap_pending, None
+        if cmd is not None:
+            cmd.error = err
+            cmd.done.set()

@@ -74,6 +74,22 @@ class SnapshotServer:
     def engine(self, snapshot: str) -> ServingEngine:
         return self._engines[snapshot]
 
+    def update_tenant(self, snapshot: str, params, version: int = 0,
+                      timeout: float = 60.0):
+        """Hot-swap a tenant's weights IN PLACE — the fix for the old
+        replace-the-engine dance, which dropped the tenant's queue and
+        compiled programs. The existing engine (its admission queue, slot
+        grid, and program ledger — ``stats()['compiled_programs']`` pinned
+        unchanged) stays; only the weight snapshot changes, with zero
+        dropped requests (:meth:`ServingEngine.swap_weights`). Returns the
+        engine's :class:`~bigdl_tpu.serving.engine.SwapResult`."""
+        eng = self._engines.get(snapshot)
+        if eng is None:
+            raise KeyError(
+                f"unknown snapshot {snapshot!r}; serving "
+                f"{sorted(self._engines)}")
+        return eng.swap_weights(params, version=version, timeout=timeout)
+
     def submit(self, snapshot: str, prompt, max_new_tokens: int,
                request_id=None, deadline_ms=None) -> RequestHandle:
         eng = self._engines.get(snapshot)

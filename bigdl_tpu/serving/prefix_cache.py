@@ -182,6 +182,14 @@ class PrefixPool:
                      for s in entry.states)
 
     # ---------------------------------------------------------------- misc
+    def clear(self) -> None:
+        """Drop every entry (hit/miss counters survive). The weight-swap
+        path needs this: pooled states encode the weights that prefilled
+        them, so a snapshot swap invalidates the whole pool at once."""
+        with self._lock:
+            self._entries.clear()
+            self._index.clear()
+
     def __len__(self):
         with self._lock:
             return len(self._entries)

@@ -80,6 +80,19 @@ site                      where it fires
                           host-loss drill (no graceful anything, unlike
                           ``sigterm``); survivors must resume from the last
                           durable elastic checkpoint
+``promote_eval``          the promotion gate's candidate evaluation
+                          (``serving/lifecycle.py``) — ``error`` crashes the
+                          eval (candidate quarantined, trainer untouched),
+                          ``nonfinite`` poisons the candidate's metric to
+                          NaN (gate must reject), ``stall`` delays the gate
+``promote_swap``          the serving engine's weight-swap step boundary
+                          (``serving/engine.py`` ``_apply_swap``) — ``error``
+                          fails the swap (old weights keep serving),
+                          ``stall`` delays it mid-pause
+``promote_rollback``      the promotion controller's rollback path, just
+                          before swapping the previous version back —
+                          ``error`` fails the attempt (retried within the
+                          rollback budget), ``stall`` delays it
 ========================  ====================================================
 
 A plan is a ``;``-separated list of entries ``site@N`` or ``site@N=action``.
@@ -130,6 +143,11 @@ SITE_REPLICA_DOWN = "replica_down"
 SITE_CKPT_D2H = "ckpt_d2h"
 SITE_CKPT_ASYNC = "ckpt_async"
 SITE_HOST_DOWN = "host_down"
+#: promotion-lifecycle drills (docs/serving.md "Lifecycle"): gate eval,
+#: zero-downtime weight swap, and the auto-rollback path
+SITE_PROMOTE_EVAL = "promote_eval"
+SITE_PROMOTE_SWAP = "promote_swap"
+SITE_PROMOTE_ROLLBACK = "promote_rollback"
 
 #: sites whose plan entries match the caller-supplied ``index`` (training
 #: iteration) instead of the site's hit counter
@@ -156,6 +174,9 @@ _DEFAULT_ACTION = {
     SITE_CKPT_D2H: "error",
     SITE_CKPT_ASYNC: "torn",
     SITE_HOST_DOWN: "kill",
+    SITE_PROMOTE_EVAL: "error",
+    SITE_PROMOTE_SWAP: "error",
+    SITE_PROMOTE_ROLLBACK: "error",
 }
 
 _KNOWN_ACTIONS = frozenset({"error", "death", "nan", "sigterm", "torn",

@@ -1,0 +1,545 @@
+"""Train→eval→promote→serve lifecycle suite (`make t1-promotion`).
+
+The promotion plane (``serving/lifecycle.py`` + ``utils/model_registry.py``)
+is the handoff between the trainer's durable checkpoint versions and the
+live serving engines. This suite pins its three contracts:
+
+- **gate**: a candidate version is scored before it can serve; a failed or
+  crashed eval (``promote_eval`` drills) quarantines the CANDIDATE
+  (registry status ``rejected`` + ``promotion_rejected`` event), never the
+  trainer;
+- **swap**: promotion hot-swaps weights into the live engine with zero
+  dropped requests and bitwise continuity — tokens emitted before the swap
+  are preserved verbatim, tokens after are exactly what the new weights
+  produce from that context, and ``stats()["compiled_programs"]`` does not
+  grow across the swap. A LoRA candidate ships only adapter deltas and
+  resolves through its base version;
+- **rollback**: a scripted bad promotion (gate bypassed by the drill plan)
+  trips the post-swap watch window (SLO breach or quality-probe failure)
+  and the previous version swaps back through the same path, budget-bounded
+  (``promote_rollback`` consumes attempts), after which served outputs are
+  bitwise what the old weights produced.
+
+Plus the registry substrate (publish/status/prune/lora-overlay) and the
+trainer-side publication hook (``Optimizer.set_model_registry`` /
+``BIGDL_REGISTRY_DIR``): the elastic writer registers each
+manifest-committed version as a ``candidate``.
+"""
+
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.models.transformerlm import TransformerLM
+from bigdl_tpu.obs import exporter as obs_exporter
+from bigdl_tpu.obs.slo import SLOMonitor
+from bigdl_tpu.serving import (
+    PromotionController, PromotionCriterion, ServingEngine, SnapshotServer,
+)
+from bigdl_tpu.utils.faults import inject_faults
+from bigdl_tpu.utils.model_registry import (
+    ModelRegistry, flatten_params, lora_delta,
+)
+
+pytestmark = pytest.mark.promotion
+
+VOCAB = 50
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return TransformerLM(VOCAB, embed_dim=16, num_heads=2, num_layers=2,
+                         max_len=48).evaluate()
+
+
+def _prompt(seed, n):
+    return np.random.default_rng(seed).integers(0, VOCAB, (n,)).astype(np.int32)
+
+
+def _oracle(model, prompt, steps):
+    """Offline single-request greedy decode — the bitwise reference."""
+    return np.asarray(
+        nn.greedy_generate(model, jnp.asarray(prompt)[None, :], steps))[0]
+
+
+def _wait_active(eng, n, timeout=60):
+    deadline = time.perf_counter() + timeout
+    while eng.stats()["active_slots"] < n:
+        if time.perf_counter() > deadline:
+            raise AssertionError(
+                f"never reached {n} active slots: {eng.stats()}")
+        time.sleep(0.005)
+
+
+def _perturb(tree, seed, scale=0.05):
+    """Additive gaussian noise on every leaf. NOT a uniform scale: LayerNorm
+    makes uniformly-scaled weights produce IDENTICAL greedy tokens, which
+    would silently turn every bitwise assertion here into a tautology."""
+    rng = np.random.default_rng(seed)
+
+    def go(node):
+        if isinstance(node, dict):
+            return {k: go(v) for k, v in node.items()}
+        a = np.asarray(node)
+        return a + rng.normal(0, scale, a.shape).astype(a.dtype)
+    return go(tree)
+
+
+def _clone_lm(params, lora_rank=None):
+    """A fresh TransformerLM instance carrying ``params`` — the offline
+    oracle for a weight set the shared engine model does not hold."""
+    m = TransformerLM(VOCAB, embed_dim=16, num_heads=2, num_layers=2,
+                      max_len=48)
+    if lora_rank is not None:
+        nn.apply_lora(m, rank=lora_rank)
+    m.set_params(params)
+    return m.evaluate()
+
+
+def _tree_equal(a, b):
+    fa, fb = flatten_params(a), flatten_params(b)
+    return set(fa) == set(fb) and all(
+        np.array_equal(np.asarray(fa[p]), np.asarray(fb[p])) for p in fa)
+
+
+# --------------------------------------------------------------- registry
+class TestModelRegistry:
+    T = {"layer": {"weight": np.arange(6.0).reshape(2, 3),
+                   "bias": np.zeros(3, np.float32)}}
+
+    def test_publish_status_lifecycle(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path), keep=10)
+        assert reg.versions() == [] and reg.latest() is None
+        v1 = reg.publish(self.T)
+        assert v1 == 1 and reg.versions() == [1]
+        assert reg.status(1)["status"] == "candidate"
+        v2 = reg.publish(_perturb(self.T, 1))
+        assert v2 == 2 and reg.latest() == 2
+        with pytest.raises(ValueError, match="already exists"):
+            reg.publish(self.T, version=2)
+        reg.set_status(2, "promoted", metric=0.9)
+        assert reg.latest("promoted") == 2
+        st = reg.status(2)
+        assert st["metric"] == 0.9
+        assert st["history"][-1]["status"] == "candidate"
+        with pytest.raises(ValueError, match="unknown status"):
+            reg.set_status(2, "shipped")
+        assert _tree_equal(reg.resolve_params(1), self.T)
+        assert reg.status(99)["status"] == "unknown"
+        state = reg.state()
+        assert state["promoted"] == 2
+        assert [row["version"] for row in state["versions"]] == [1, 2]
+
+    def test_lora_artifact_resolves_through_base(self, tmp_path):
+        m = TransformerLM(VOCAB, embed_dim=16, num_heads=2, num_layers=2,
+                          max_len=48)
+        nn.apply_lora(m, rank=2)
+        base = m.get_params()
+        adapters = lora_delta(base)
+        assert adapters, "apply_lora produced no lora_a/lora_b leaves"
+        assert all(p.rsplit("/", 1)[-1] in ("lora_a", "lora_b")
+                   for p in adapters)
+        reg = ModelRegistry(str(tmp_path), keep=10)
+        vb = reg.publish(base)
+        delta = {p: np.asarray(a) + 0.25 for p, a in adapters.items()}
+        vl = reg.publish_lora(delta, base_version=vb)
+        assert reg.load(vl)["kind"] == "lora"
+        tree = reg.resolve_params(vl)
+        flat, flat_base = flatten_params(tree), flatten_params(base)
+        assert set(flat) == set(flat_base)   # same structure as the base
+        for p in flat_base:
+            want = delta[p] if p in delta else flat_base[p]
+            assert np.array_equal(np.asarray(flat[p]), np.asarray(want)), p
+
+    def test_prune_keeps_promoted_newest_and_lora_bases(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path), keep=2)
+        reg.publish(self.T)                       # v1
+        reg.set_status(1, "promoted")
+        for _ in range(4):
+            reg.publish(self.T)                   # v2..v5
+        assert reg.versions() == [1, 5]           # promoted + newest survive
+        vb = reg.publish(self.T)                  # v6: lora base
+        reg.publish_lora({"layer/weight": np.ones((2, 3))}, base_version=vb)
+        for _ in range(3):
+            reg.publish(self.T)                   # v8..v10
+        have = reg.versions()
+        assert 1 in have and have[-1] == 10
+        # a lora base is never pruned out from under a surviving artifact
+        for v in have:
+            bv = reg.load(v).get("base_version")
+            if bv is not None:
+                assert bv in have, f"v{v} references pruned base v{bv}"
+
+
+# -------------------------------------------------------------------- gate
+class TestGate:
+    def _ctrl(self, tmp_path, lm, **kw):
+        reg = ModelRegistry(str(tmp_path), keep=10)
+        eng = ServingEngine(lm, max_len=48, slots=2, buckets=(8, 16))
+        kw.setdefault("eval_fn", lambda p: 0.9)
+        kw.setdefault("watch_window_s", 0.0)
+        return reg, eng, PromotionController(reg, engine=eng, **kw)
+
+    def test_gate_accepts_and_promotes(self, tmp_path, lm):
+        reg, eng, ctrl = self._ctrl(
+            tmp_path, lm, criterion=PromotionCriterion(min_metric=0.5))
+        v = reg.publish(_perturb(lm.get_params(), 1))
+        res = ctrl.promote(v, watch=False)
+        assert res.promoted and res.metric == 0.9
+        assert reg.status(v)["status"] == "promoted"
+        assert ctrl.served_version == v and eng.model_version == v
+        # /statusz carries both the controller and the registry table
+        status = obs_exporter.render_statusz()["status"]
+        assert status["promotion"]["served_version"] == v
+        assert status["registry"]["promoted"] == v
+
+    def test_gate_rejects_below_threshold(self, tmp_path, lm):
+        reg, eng, ctrl = self._ctrl(
+            tmp_path, lm, eval_fn=lambda p: 0.2,
+            criterion=PromotionCriterion(min_metric=0.5))
+        v = reg.publish(lm.get_params())
+        res = ctrl.promote(v, watch=False)
+        assert not res.promoted and "threshold" in res.reason
+        assert reg.status(v)["status"] == "rejected"
+        assert eng.model_version == 0   # old weights keep serving
+
+    def test_nan_poisoned_candidate_quarantined(self, tmp_path, lm):
+        reg, eng, ctrl = self._ctrl(tmp_path, lm)
+        v = reg.publish(lm.get_params())
+        with inject_faults("promote_eval@1=nonfinite") as plan:
+            ok, metric, reason = ctrl.gate(v)
+        assert plan.unfired() == []
+        assert not ok and math.isnan(metric)
+        assert "non-finite" in reason
+        assert reg.status(v)["status"] == "rejected"
+
+    def test_eval_crash_quarantines_candidate_not_trainer(self, tmp_path, lm):
+        reg, eng, ctrl = self._ctrl(tmp_path, lm)
+        v = reg.publish(lm.get_params())
+        with inject_faults("promote_eval@1") as plan:
+            ok, metric, reason = ctrl.gate(v)   # must NOT raise
+        assert plan.unfired() == []
+        assert not ok and metric is None and "eval crashed" in reason
+        assert reg.status(v)["status"] == "rejected"
+        # the trainer side keeps publishing: the registry still accepts
+        assert reg.publish(lm.get_params()) == v + 1
+
+    def test_criterion_rules(self):
+        c = PromotionCriterion(no_regression=True)
+        assert c.accept(0.7, 0.6)[0]
+        assert not c.accept(0.5, 0.6)[0]
+        assert not c.accept(float("nan"), None)[0]
+        assert not c.accept(float("inf"), None)[0]
+        loss = PromotionCriterion(min_metric=1.0, mode="min",
+                                  no_regression=False)
+        assert loss.accept(0.8, None)[0]
+        assert not loss.accept(1.2, None)[0]
+        margin = PromotionCriterion(no_regression=True, margin=0.1)
+        assert margin.accept(0.55, 0.6)[0]       # within the margin
+        assert not margin.accept(0.45, 0.6)[0]
+
+    def test_step_promotes_newest_candidate(self, tmp_path, lm):
+        reg, eng, ctrl = self._ctrl(tmp_path, lm)
+        assert ctrl.step() is None               # nothing published yet
+        reg.publish(_perturb(lm.get_params(), 1))
+        v2 = reg.publish(_perturb(lm.get_params(), 2))
+        res = ctrl.step()
+        assert res is not None and res.version == v2 and res.promoted
+        assert ctrl.step() is None               # nothing newer
+
+    def test_device_evaluator_gate(self, tmp_path, lm):
+        """The no-eval_fn path: the PR 2 device evaluator scores the
+        candidate with the eval model's params swapped in and restored."""
+        from bigdl_tpu.dataset import DataSet
+        from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
+        from bigdl_tpu.optim.validation import Loss
+
+        rng = np.random.default_rng(0)
+        samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                          np.int32(rng.integers(0, 3))) for _ in range(16)]
+        data = DataSet.array(samples) >> SampleToMiniBatch(8)
+        eval_model = nn.Sequential().add(nn.Linear(8, 3)) \
+            .add(nn.LogSoftMax()).evaluate()
+        reg = ModelRegistry(str(tmp_path), keep=10)
+        v = reg.publish(_perturb(eval_model.get_params(), 3))
+        eng = ServingEngine(lm, max_len=48, slots=2, buckets=(8, 16))
+        ctrl = PromotionController(
+            reg, engine=eng, eval_model=eval_model, eval_dataset=data,
+            eval_methods=[Loss(nn.ClassNLLCriterion())],
+            criterion=PromotionCriterion(no_regression=False),
+            watch_window_s=0.0)
+        saved = eval_model.get_params()
+        ok, metric, _reason = ctrl.gate(v)
+        assert ok and metric is not None and math.isfinite(metric)
+        # the eval model's own params were restored after scoring
+        assert _tree_equal(eval_model.get_params(), saved)
+
+
+# ---------------------------------------------------------------- hot swap
+class TestHotSwap:
+    def test_swap_under_load_bitwise_continuity(self, lm):
+        base = lm.get_params()
+        new_params = _perturb(base, 7)
+        new_lm = _clone_lm(new_params)
+        max_new = 24
+        eng = ServingEngine(lm, max_len=48, slots=4, buckets=(8, 32))
+        try:
+            # warm both buckets: re-prefill replays prompt+emitted (6..29
+            # tokens), so an unwarmed bucket would grow the ledger mid-swap
+            eng.submit(_prompt(90, 6), 2).result(timeout=60)
+            eng.submit(_prompt(91, 12), 2).result(timeout=60)
+            progs0 = eng.stats()["compiled_programs"]
+
+            prompts = [_prompt(i, 6) for i in range(8)]
+            oracles_old = [_oracle(lm, p, max_new) for p in prompts]
+            handles = [eng.submit(p, max_new) for p in prompts]
+            _wait_active(eng, 4)
+            swap = eng.swap_weights(new_params, version=5)
+            results = [h.result(timeout=120) for h in handles]  # zero dropped
+            assert swap.version == 5 and swap.requeued >= 1
+            assert eng.stats()["compiled_programs"] == progs0
+            assert eng.stats()["model_version"] == 5
+            for p, ora, r in zip(prompts, oracles_old, results):
+                tokens = np.asarray(r.tokens)
+                n = swap.in_flight.get(r.request_id)
+                if n is None:
+                    # finished before the swap, or started entirely after it
+                    assert (np.array_equal(tokens, ora)
+                            or np.array_equal(tokens,
+                                              _oracle(new_lm, p, max_new)))
+                    continue
+                cut = len(p) + n
+                # pre-swap tokens preserved verbatim ...
+                assert np.array_equal(tokens[:cut], ora[:cut])
+                # ... and the continuation is bitwise what the NEW weights
+                # produce from that context (chunked re-prefill == forward)
+                assert np.array_equal(
+                    tokens, _oracle(new_lm, tokens[:cut], max_new - n))
+        finally:
+            eng.shutdown()
+
+    def test_lora_delta_promotion(self, tmp_path):
+        m = TransformerLM(VOCAB, embed_dim=16, num_heads=2, num_layers=2,
+                          max_len=48)
+        nn.apply_lora(m, rank=2)
+        m.evaluate()
+        base = m.get_params()
+        reg = ModelRegistry(str(tmp_path), keep=10)
+        vb = reg.publish(base)
+        rng = np.random.default_rng(11)
+        delta = {p: np.asarray(a)
+                 + rng.normal(0, 0.3, np.shape(a)).astype(np.asarray(a).dtype)
+                 for p, a in lora_delta(base).items()}
+        vl = reg.publish_lora(delta, base_version=vb)
+        resolved = reg.resolve_params(vl)
+        oracle_lm = _clone_lm(resolved, lora_rank=2)
+        prompt = _prompt(1, 6)
+        eng = ServingEngine(m, max_len=48, slots=2, buckets=(8, 16))
+        try:
+            old = np.asarray(eng.submit(prompt, 8).result(timeout=60).tokens)
+            progs0 = eng.stats()["compiled_programs"]
+            ctrl = PromotionController(reg, engine=eng, eval_fn=lambda p: 1.0,
+                                       watch_window_s=0.0)
+            res = ctrl.promote(vl, watch=False)
+            assert res.promoted and eng.model_version == vl
+            got = np.asarray(eng.submit(prompt, 8).result(timeout=60).tokens)
+            want = _oracle(oracle_lm, prompt, 8)
+            assert np.array_equal(got, want)
+            assert not np.array_equal(got, old), \
+                "lora delta did not change the output — vacuous swap test"
+            assert eng.stats()["compiled_programs"] == progs0
+        finally:
+            eng.shutdown()
+
+    def test_snapshot_server_in_place_tenant_swap(self, lm):
+        srv = SnapshotServer({"a": lm, "b": lm}, max_len=48, slots=2,
+                             buckets=(8, 16))
+        prompt = _prompt(2, 6)
+        new_params = _perturb(lm.get_params(), 13)
+        new_lm = _clone_lm(new_params)
+        try:
+            old = np.asarray(
+                srv.submit("a", prompt, 8).result(timeout=60).tokens)
+            srv.submit("b", prompt, 8).result(timeout=60)
+            progs0 = srv.engine("a").stats()["compiled_programs"]
+            swap = srv.update_tenant("a", new_params, version=3)
+            assert swap.version == 3
+            got_a = np.asarray(
+                srv.submit("a", prompt, 8).result(timeout=60).tokens)
+            got_b = np.asarray(
+                srv.submit("b", prompt, 8).result(timeout=60).tokens)
+            assert np.array_equal(got_a, _oracle(new_lm, prompt, 8))
+            assert np.array_equal(got_b, old)     # neighbor tenant untouched
+            assert srv.engine("a").stats()["compiled_programs"] == progs0
+            assert srv.engine("a").model_version == 3
+            assert srv.engine("b").model_version == 0
+            with pytest.raises(KeyError):
+                srv.update_tenant("nope", new_params)
+        finally:
+            srv.shutdown()
+
+    def test_swap_rejects_mismatched_tree(self, lm):
+        eng = ServingEngine(lm, max_len=48, slots=2, buckets=(8, 16))
+        try:
+            eng.submit(_prompt(3, 6), 2).result(timeout=60)
+            bad = _perturb(lm.get_params(), 1)
+            key = next(iter(bad))
+            wrong = {k: v for k, v in bad.items() if k != key}
+            with pytest.raises(ValueError, match="missing"):
+                eng.swap_weights(wrong, version=9)
+            assert eng.model_version == 0   # old weights keep serving
+        finally:
+            eng.shutdown()
+
+
+# ---------------------------------------------------------------- rollback
+class TestRollbackDrill:
+    def test_scripted_bad_promotion_slo_breach_auto_rollback(self, lm):
+        """The acceptance drill: gate bypassed → bad version serves → SLO
+        breach inside the watch window → auto-rollback, with the first
+        rollback attempt burned by the promote_rollback fault — served
+        outputs end bitwise-identical to the pre-promotion version and the
+        plan is fully fired."""
+        import tempfile
+        probe = _prompt(4, 6)
+        eng = ServingEngine(lm, max_len=48, slots=2, buckets=(8, 16))
+        try:
+            pre = np.asarray(eng.submit(probe, 8).result(timeout=60).tokens)
+            progs0 = eng.stats()["compiled_programs"]
+            reg = ModelRegistry(tempfile.mkdtemp(prefix="bigdl-promo-"),
+                                keep=4)
+            v_bad = reg.publish(_perturb(lm.get_params(), 3))
+            mon = SLOMonitor(interval_s=0.0)
+            ctrl = PromotionController(
+                reg, engine=eng, eval_fn=lambda p: 1.0, slo_monitor=mon,
+                probe_prompts=[probe], watch_window_s=0.5, poll_s=0.01,
+                rollback_budget=3)
+            with inject_faults(
+                    "slo_breach@1;promote_rollback@1") as plan:
+                res = ctrl.promote(v_bad, gate=False)   # scripted bypass
+            assert plan.unfired() == []
+            assert res.promoted and res.rolled_back
+            assert ctrl.rollbacks == 2      # attempt 1 burned by the fault
+            assert reg.status(v_bad)["status"] == "rolled_back"
+            assert ctrl.served_version == 0 and eng.model_version == 0
+            post = np.asarray(eng.submit(probe, 8).result(timeout=60).tokens)
+            assert np.array_equal(post, pre)   # bitwise back on old weights
+            assert eng.stats()["compiled_programs"] == progs0
+        finally:
+            eng.shutdown()
+
+    def test_nonfinite_probe_triggers_rollback(self, lm, tmp_path):
+        """A promotion whose weights produce NaN logits: the quality probe
+        fails non-finite and the watch window swaps the old version back."""
+        probe = _prompt(5, 6)
+        eng = ServingEngine(lm, max_len=48, slots=2, buckets=(8, 16))
+        try:
+            pre = np.asarray(eng.submit(probe, 8).result(timeout=60).tokens)
+            reg = ModelRegistry(str(tmp_path), keep=4)
+
+            def poison(node):
+                if isinstance(node, dict):
+                    return {k: poison(v) for k, v in node.items()}
+                return np.full_like(np.asarray(node), np.nan)
+            v_bad = reg.publish(poison(lm.get_params()))
+            ctrl = PromotionController(
+                reg, engine=eng, eval_fn=lambda p: 1.0,
+                probe_prompts=[probe], watch_window_s=0.5, poll_s=0.01,
+                rollback_budget=3)
+            res = ctrl.promote(v_bad, gate=False, watch=True)
+            assert res.promoted and res.rolled_back
+            assert reg.status(v_bad)["status"] == "rolled_back"
+            post = np.asarray(eng.submit(probe, 8).result(timeout=60).tokens)
+            assert np.array_equal(post, pre)
+        finally:
+            eng.shutdown()
+
+    def test_rollback_budget_exhaustion(self, lm, tmp_path):
+        eng = ServingEngine(lm, max_len=48, slots=2, buckets=(8, 16))
+        try:
+            reg = ModelRegistry(str(tmp_path), keep=4)
+            v = reg.publish(_perturb(lm.get_params(), 3))
+            ctrl = PromotionController(
+                reg, engine=eng, eval_fn=lambda p: 1.0,
+                watch_window_s=0.0, rollback_budget=2)
+            with pytest.raises(RuntimeError, match="nothing to roll back"):
+                ctrl.rollback()
+            ctrl.promote(v, gate=False, watch=False)
+            with inject_faults("promote_rollback@1;promote_rollback@2") \
+                    as plan:
+                with pytest.raises(RuntimeError):
+                    ctrl.rollback("drill")
+            assert plan.unfired() == []
+            assert ctrl.rollbacks == 2
+            # budget spent: the bad version keeps serving
+            assert eng.model_version == v
+        finally:
+            eng.shutdown()
+
+
+# ------------------------------------------------- trainer-side publication
+class TestTrainerPublication:
+    def _opt(self, ckpt_dir, n_iter=2):
+        from bigdl_tpu.dataset import DataSet
+        from bigdl_tpu.dataset.sample import Sample, SampleToMiniBatch
+        from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+        from bigdl_tpu.utils.random_generator import RandomGenerator
+        RandomGenerator.set_seed(3)
+        rng = np.random.default_rng(0)
+        samples = [Sample(rng.normal(size=(8,)).astype(np.float32),
+                          np.int32(rng.integers(0, 3))) for _ in range(32)]
+        data = DataSet.array(samples) >> SampleToMiniBatch(16)
+        model = nn.Sequential().add(nn.Linear(8, 3)).add(nn.LogSoftMax())
+        opt = (LocalOptimizer(model, data, nn.ClassNLLCriterion())
+               .set_optim_method(SGD(learningrate=0.1))
+               .set_end_when(Trigger.max_iteration(n_iter)))
+        opt.set_checkpoint(str(ckpt_dir), Trigger.several_iteration(1),
+                           backend="elastic")
+        return opt
+
+    def test_elastic_writer_registers_candidates(self, tmp_path):
+        from bigdl_tpu.utils import elastic_ckpt
+        ckpt = tmp_path / "ckpt"
+        reg_dir = tmp_path / "registry"
+        opt = self._opt(ckpt)
+        opt.set_model_registry(str(reg_dir))
+        assert opt.model_registry is not None
+        opt.optimize()
+        reg = ModelRegistry(str(reg_dir))
+        have = reg.versions()
+        assert have, "trainer published nothing to the registry"
+        newest = elastic_ckpt.complete_versions(str(ckpt))[-1]
+        assert newest in have
+        assert reg.status(newest)["status"] == "candidate"
+        payload = reg.load(newest)
+        assert payload["meta"]["source"] == "elastic"
+        # registry params bitwise-match the checkpoint's params subtree
+        tree, _spec, _manifest = elastic_ckpt.assemble(
+            os.path.join(str(ckpt), elastic_ckpt.version_dirname(newest)))
+        assert _tree_equal(reg.resolve_params(newest), tree["params"])
+
+    def test_registry_dir_env_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BIGDL_REGISTRY_DIR", str(tmp_path / "reg"))
+        opt = self._opt(tmp_path / "ckpt")
+        assert opt.model_registry is not None
+        assert opt.model_registry.path == str(tmp_path / "reg")
+
+    def test_registry_failure_never_reaches_trainer(self, tmp_path,
+                                                    monkeypatch):
+        """A broken registry (unwritable dir) must log, not raise: the
+        trainer keeps training and checkpointing."""
+        opt = self._opt(tmp_path / "ckpt")
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        monkeypatch.setattr(
+            reg, "register_from_elastic",
+            lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")))
+        opt.set_model_registry(reg)
+        opt.optimize()   # must not raise
+        from bigdl_tpu.utils import elastic_ckpt
+        assert elastic_ckpt.complete_versions(str(tmp_path / "ckpt"))

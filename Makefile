@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming t1-fleet t1-recsys t1-elastic t1-promotion dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming t1-fleet t1-recsys t1-elastic t1-promotion t1-paged dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -106,6 +106,14 @@ t1-elastic:
 t1-promotion:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m promotion --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
+# Paged-serving suite only (docs/serving.md "Paged KV cache & disaggregation"):
+# page-allocator property storms, the paged-vs-slot-grid bitwise A/B trace,
+# pool-exhaustion preemption, the prefill→decode handoff, speculation over
+# paged state, and the BIGDL_KV_PAGED=0 rollback switch. Unmarked-slow, so
+# `make t1` runs these too; this target is the fast inner loop for paging work.
+t1-paged:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m paged --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
 dist:
 	bash make-dist.sh
 
@@ -128,6 +136,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --recsys-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --ckpt-bench --no-compare-dtypes --no-streamed
 	JAX_PLATFORMS=cpu python bench.py --promotion-bench --no-compare-dtypes --no-streamed
+	JAX_PLATFORMS=cpu python bench.py --paging-bench --no-compare-dtypes --no-streamed
 
 # Host input-pipeline leg (decode→augment→stack on a synthetic image folder):
 # pipeline_images_per_sec at BIGDL_DATA_WORKERS 0/1/4/auto + per-stage ms.

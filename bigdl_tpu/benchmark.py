@@ -1775,6 +1775,212 @@ def _measure_fleet_bench(n_requests: int = 24, replicas: int = 2,
     }
 
 
+def _measure_paging_bench(n_requests: int = 24, max_new: int = 16) -> dict:
+    """Paged-serving leg, three questions (docs/serving.md "Paged KV cache
+    & disaggregation"):
+
+    1. **Residency at equal pooled KV bytes**: peak concurrently-resident
+       sequences on a paged engine whose page pool holds EXACTLY the slot
+       grid's KV bytes vs the grid itself — short traffic must pack >= 2x
+       the sequences into the same memory.
+    2. **Same-trace cost**: req/s + p99 TTFT over the serving-bench trace,
+       paged vs grid, with the paged program ledger pinned at
+       ``len(buckets) + 2`` (paging must not melt throughput or compile
+       per-occupancy programs).
+    3. **Disaggregation under burst**: p99 engine TTFT over a prompt burst
+       through a 2-replica fleet, phases ``prefill,decode`` (handoff seeds
+       the decode tier's prefix pool — admission is an exact pool hit) vs
+       the same fleet fully mixed. Disaggregated must beat mixed, with
+       zero lost requests on both.
+
+    A residency ratio under 2x, a busted ledger, a lost request, a
+    zero-handoff disaggregated run, or disaggregated p99 not beating mixed
+    stamps the degraded-record contract instead of passing quietly."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.models.transformerlm import TransformerLM
+    from bigdl_tpu.obs.registry import registry
+    from bigdl_tpu.serving import FleetRouter, ServingEngine
+
+    dev = jax.devices()[0]
+    buckets = (16, 32, 48)
+    max_len = 64 + max_new
+    page_tokens = 16
+    lm = TransformerLM(1000, embed_dim=64, num_heads=4, num_layers=2,
+                       max_len=max_len).evaluate()
+    rng = np.random.default_rng(0)
+    reqs = [rng.integers(0, 1000, (int(rng.integers(4, 49)),))
+            .astype(np.int32) for _ in range(n_requests)]
+    off_script = []
+
+    def warm(submit):
+        # compile + warm every prefill bucket so timed windows are
+        # compile-free (programs live on the shared model apply cache)
+        for plen in (8, 24, 40):
+            submit(np.arange(plen, dtype=np.int32) % 1000,
+                   max_new).result(timeout=300)
+
+    def pct99(snap):
+        h = snap["histograms"].get("serving/ttft_ms", {})
+        return (round(h["p99"], 2) if h.get("p99") is not None else None)
+
+    # ---- leg 1: resident sequences at equal pooled KV bytes --------------
+    grid_slots = 4
+    pool_pages = grid_slots * max_len // page_tokens   # same KV bytes
+    n_short = 2 * grid_slots
+    shorts = [rng.integers(0, 1000, (8,)).astype(np.int32)
+              for _ in range(n_short)]
+
+    def peak_resident(paged):
+        kw = ({"slots": n_short, "pages": pool_pages,
+               "page_tokens": page_tokens} if paged
+              else {"slots": grid_slots})
+        with ServingEngine(lm, max_len=max_len, buckets=buckets,
+                           **kw) as eng:
+            warm(eng.submit)
+            peak, stop = [0], threading.Event()
+
+            def poll():
+                while not stop.is_set():
+                    peak[0] = max(peak[0], eng.stats()["active_slots"])
+                    time.sleep(0.001)
+
+            th = threading.Thread(target=poll, daemon=True)
+            th.start()
+            try:
+                for h in [eng.submit(p, max_new) for p in shorts]:
+                    h.result(timeout=300)
+            finally:
+                stop.set()
+                th.join(timeout=5)
+            return peak[0], eng.stats()
+
+    grid_peak, _ = peak_resident(paged=False)
+    paged_peak, res_stats = peak_resident(paged=True)
+    resident_ratio = (round(paged_peak / grid_peak, 2)
+                      if grid_peak else None)
+    if not resident_ratio or resident_ratio < 2.0:
+        off_script.append(
+            f"residency ratio {resident_ratio} (want >= 2.0) at equal "
+            f"pooled KV bytes ({pool_pages} pages x {page_tokens} tok)")
+    if res_stats["pages_used"]:
+        off_script.append(
+            f"{res_stats['pages_used']} pages still held after drain")
+
+    # ---- leg 2: same trace, paged vs grid --------------------------------
+    def trace_leg(paged):
+        kw = ({"pages": 8 * ((max_len + page_tokens - 1) // page_tokens),
+               "page_tokens": page_tokens} if paged else {})
+        with ServingEngine(lm, max_len=max_len, slots=8, buckets=buckets,
+                           **kw) as eng:
+            warm(eng.submit)
+            registry.reset()
+            t0 = time.perf_counter()
+            for h in [eng.submit(p, max_new) for p in reqs]:
+                h.result(timeout=300)
+            wall = time.perf_counter() - t0
+            return n_requests / wall, registry.snapshot(), eng.stats()
+
+    grid_rps, grid_snap, _ = trace_leg(paged=False)
+    paged_rps, paged_snap, paged_stats = trace_leg(paged=True)
+    grid_bound = len(buckets) + 2
+    if paged_stats["compiled_programs"] > grid_bound:
+        off_script.append(
+            f"paged ledger {paged_stats['compiled_programs']} > "
+            f"{grid_bound}")
+
+    # ---- leg 3: prompt burst, disaggregated vs mixed ---------------------
+    burst = [rng.integers(0, 1000, (40,)).astype(np.int32)
+             for _ in range(12)]
+    burst_new = 8
+
+    def burst_leg(name, phases):
+        kw = ({"prefix_pool": 16, "prefix_chunk": 8}
+              if phases else {})
+        fleet = FleetRouter.replicate(lm, max_len=max_len, replicas=2,
+                                      buckets=buckets, name=name,
+                                      phases=phases, **kw)
+        try:
+            warm(fleet.submit)
+            registry.reset()
+            lost = 0
+            t0 = time.perf_counter()
+            for h in [fleet.submit(p, burst_new) for p in burst]:
+                try:
+                    h.result(timeout=300)
+                except Exception:  # noqa: BLE001 — a loss is the metric
+                    lost += 1
+            wall = time.perf_counter() - t0
+            snap = registry.snapshot()
+            st = {k: v for k, v in fleet.stats().items()
+                  if k != "replicas"}
+        finally:
+            fleet.shutdown()
+        return pct99(snap), lost, len(burst) / wall, st
+
+    mixed_p99, mixed_lost, mixed_rps, _ = burst_leg("pgmix", None)
+    dis_p99, dis_lost, dis_rps, dis_stats = burst_leg(
+        "pgdis", "prefill,decode")
+    if mixed_lost or dis_lost:
+        off_script.append(
+            f"burst lost requests: mixed={mixed_lost} disagg={dis_lost} "
+            f"(want 0)")
+    if not dis_stats["handoffs"]:
+        off_script.append("disaggregated burst saw zero handoffs")
+    if mixed_p99 is not None and dis_p99 is not None \
+            and dis_p99 >= mixed_p99:
+        off_script.append(
+            f"disaggregated TTFT p99 {dis_p99} ms not under mixed "
+            f"{mixed_p99} ms")
+
+    record_extra = {}
+    if off_script:
+        reason = "paging bench off-script: " + "; ".join(off_script)
+        print(f"bench: DEGRADED RUN — {reason}", file=sys.stderr)
+        record_extra = {"degraded": True, "probe_error": reason}
+    return {
+        "value": round(paged_rps, 2),
+        "unit": "req/sec",
+        "n_requests": n_requests,
+        "max_new_tokens": max_new,
+        "buckets": list(buckets),
+        "page_tokens": page_tokens,
+        # leg 1 — residency at equal pooled KV bytes
+        "pool_pages": pool_pages,
+        "grid_slots": grid_slots,
+        "peak_resident_grid": grid_peak,
+        "peak_resident_paged": paged_peak,
+        "resident_ratio": resident_ratio,
+        "page_evictions": res_stats["page_evictions"],
+        # leg 2 — same trace paged vs grid
+        "requests_per_sec_paged": round(paged_rps, 2),
+        "requests_per_sec_grid": round(grid_rps, 2),
+        "paged_vs_grid": (round(paged_rps / grid_rps, 2)
+                          if grid_rps else None),
+        "ttft_ms_p99_paged": pct99(paged_snap),
+        "ttft_ms_p99_grid": pct99(grid_snap),
+        "compiled_programs": paged_stats["compiled_programs"],
+        "program_grid_bound": grid_bound,
+        "compile_count_ok":
+            paged_stats["compiled_programs"] <= grid_bound,
+        # leg 3 — burst TTFT with/without disaggregation
+        "burst_requests": len(burst),
+        "burst_ttft_ms_p99_mixed": mixed_p99,
+        "burst_ttft_ms_p99_disagg": dis_p99,
+        "burst_requests_per_sec_mixed": round(mixed_rps, 2),
+        "burst_requests_per_sec_disagg": round(dis_rps, 2),
+        "handoffs": dis_stats["handoffs"],
+        "handoff_failures": dis_stats["handoff_failures"],
+        "requests_lost": mixed_lost + dis_lost,
+        "device_kind": dev.device_kind,
+        "platform": dev.platform,
+        **record_extra,
+    }
+
+
 def _measure_recsys_bench(batch: int = 256, iters: int = 10,
                           reps: int = 3) -> dict:
     """Sharded-embedding / recsys leg, three questions (docs/performance.md,
@@ -2397,6 +2603,7 @@ def run_orchestrator(args) -> None:
     recsys_bench = getattr(args, "recsys_bench", False)
     ckpt_bench = getattr(args, "ckpt_bench", False)
     promotion_bench = getattr(args, "promotion_bench", False)
+    paging_bench = getattr(args, "paging_bench", False)
     worker_argv = ["--run", "--model", args.model, "--batch", str(args.batch),
                    "--iters", str(args.iters), "--warmup", str(args.warmup),
                    "--dtype", args.dtype]
@@ -2433,6 +2640,8 @@ def run_orchestrator(args) -> None:
         worker_argv.append("--ckpt-bench")
     if promotion_bench:
         worker_argv.append("--promotion-bench")
+    if paging_bench:
+        worker_argv.append("--paging-bench")
     env = dict(os.environ)
     if ckpt_bench and env.get("JAX_PLATFORMS") == "cpu" \
             and "xla_force_host_platform_device_count" \
@@ -2472,7 +2681,8 @@ def run_orchestrator(args) -> None:
                     and not kernel_bench \
                     and not precision_bench and not serving_bench \
                     and not fleet_bench and not recsys_bench \
-                    and not ckpt_bench and not promotion_bench:
+                    and not ckpt_bench and not promotion_bench \
+                    and not paging_bench:
                 # the comparison leg only feeds the ratio — skip its streamed
                 # measurement (it would be discarded)
                 cmp_argv = ["--run", "--model", args.model,
@@ -2512,7 +2722,7 @@ def run_orchestrator(args) -> None:
             or args.eval_bench or pipeline_bench or stream_bench \
             or obs_bench or kernel_bench or precision_bench \
             or serving_bench or fleet_bench or recsys_bench or ckpt_bench \
-            or promotion_bench:
+            or promotion_bench or paging_bench:
         # a LeNet training number would not answer an inference-path request:
         # fail loudly with the metric the caller asked for
         kind = ("int8_vs_bf16_infer" if args.int8_infer
@@ -2529,6 +2739,7 @@ def run_orchestrator(args) -> None:
                 else "recsys_bench" if recsys_bench
                 else "ckpt_bench" if ckpt_bench
                 else "promotion_bench" if promotion_bench
+                else "paging_bench" if paging_bench
                 else "step_ablation")
         record = {
             "metric": f"{args.model}_{kind}",
@@ -2673,6 +2884,15 @@ def main(argv=None):
                         "pinned), gate-rejection drill on a NaN-poisoned "
                         "candidate, and auto-rollback wall time with a "
                         "bitwise post-rollback output check")
+    p.add_argument("--paging-bench", dest="paging_bench",
+                   action="store_true",
+                   help="paged-serving leg: peak resident sequences at "
+                        "equal pooled KV bytes (paged pool vs slot grid, "
+                        "want >= 2x), req/s + p99 TTFT over the same "
+                        "trace with the paged program ledger pinned, and "
+                        "p99 TTFT under a prompt burst through a "
+                        "prefill/decode-disaggregated fleet vs mixed "
+                        "(zero lost requests)")
     p.add_argument("--run", action="store_true",
                    help=argparse.SUPPRESS)  # internal: worker mode
     args = p.parse_args(argv)
@@ -2744,6 +2964,10 @@ def _run_worker_modes(args) -> int:
     elif getattr(args, "promotion_bench", False):
         res = _measure_promotion_bench()
         res["metric"] = "transformerlm_promotion"
+        res["vs_baseline"] = None
+    elif getattr(args, "paging_bench", False):
+        res = _measure_paging_bench()
+        res["metric"] = "transformerlm_paged_serving"
         res["vs_baseline"] = None
     elif args.ablate:
         res = _measure_ablation(args.model, args.batch,

@@ -217,6 +217,12 @@ def _render_top(metrics: dict, health=None) -> str:
             state = engs.get(name, {}).get("health", "?")
             if t.get("slo_degraded"):
                 state += "/SLO"
+            # paged engines report a live used/free page split; slot-grid
+            # engines export 0/0 and render "-"
+            pages = (f"{t.get('pages_used', 0):.0f}"
+                     f"/{t.get('pages_free', 0):.0f}"
+                     if t.get("pages_used", 0) or t.get("pages_free", 0)
+                     else "-")
             lines.append(
                 f"    {name:<12} {state:<10}"
                 f" v{t.get('model_version', 0):.0f}"
@@ -225,7 +231,8 @@ def _render_top(metrics: dict, health=None) -> str:
                 f" done {t.get('completed', 0):.0f}"
                 f" timeouts {t.get('timeouts', 0):.0f}"
                 f" shed {t.get('shed', 0):.0f}"
-                f" tps {t.get('decode_tps', 0):.1f}")
+                f" tps {t.get('decode_tps', 0):.1f}"
+                f" pages {pages}")
     fleets: dict = {}
     fpat = re.compile(r'^bigdl_fleet_(\w+)\{fleet="([^"]*)"\}$')
     rpat = re.compile(

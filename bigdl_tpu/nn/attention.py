@@ -273,6 +273,8 @@ class MultiHeadAttention(TensorModule):
     def apply(self, params, state, input, *, training=False, rng=None):
         b, t, e = input.shape
         q, k, v = self._project_qkv(params, input, b, t)
+        if isinstance(state, dict) and "page_k" in state:
+            return self._paged_decode_step(params, state, q, k, v, b, t, e)
         if isinstance(state, dict) and "cache_k" in state:
             return self._decode_step(params, state, q, k, v, b, t, e)
         if getattr(self, "rope", False):
@@ -360,6 +362,86 @@ class MultiHeadAttention(TensorModule):
         if self.with_bias:
             out = out + params["out_bias"]
         return out, {"cache_k": ck, "cache_v": cv, "pos": pos + t}
+
+    def _paged_decode_step(self, params, state, q, k, v, b, t, e):
+        """Paged KV-cached decode (``serving/paged_cache.py`` puts the page
+        pool in this module's state): write the new K/V THROUGH the page
+        table (physical page ``table[row, pos // page_tokens]``, offset
+        ``pos % page_tokens``), then gather the pool back into the SAME
+        ``(b, kv_heads, max_len, head_dim)`` logical view the slot grid
+        holds — a static-shape gather by page index, so the attention math
+        (RoPE by absolute position, position mask, fused attend) is the
+        per-slot ``_decode_step``'s verbatim and the emitted tokens stay
+        bitwise-identical to the unpaged engine.
+
+        ``t == 1`` is the classic token-by-token decode; ``t > 1`` is the
+        speculative VERIFY chunk (k drafted tokens + 1), written through
+        the table one position at a time with a vectorized (b, t) scatter
+        — its start clamps to ``max_len - t`` exactly like the slot grid's
+        ``dynamic_update_slice``, so a rewound row re-writes the same
+        physical offsets and the spec acceptance stays bitwise the
+        target's. Prompts still prefill on the CONTIGUOUS batch-1 cache
+        and are scattered in page-granularly by ``assign_cache_pages`` — a
+        ragged multi-page prefill through the table would cost a second
+        program shape.
+
+        Free rows riding the static decode batch have table rows pointing
+        at the reserved trash page (physical 0): their writes land where
+        nobody attends, and a long-idle row's drifting ``pos`` clamps onto
+        its LAST table entry — trash again. Unallocated logical pages
+        gather finite junk that the ``kpos <= pos`` mask weights to exactly
+        0.0."""
+        from bigdl_tpu.parallel.ring_attention import full_attention
+
+        pos = state["pos"]
+        if pos.ndim != 1:
+            raise ValueError(
+                "paged decode cache requires per-slot positions "
+                "(install_paged_cache installs them)")
+        table = state["page_table"]                     # (b, W) int32
+        pk, pv = state["page_k"], state["page_v"]
+        ptok = pk.shape[2]
+        w = table.shape[1]
+        lmax = w * ptok
+        if getattr(self, "rope", False):
+            ppos = pos[:, None] + jnp.arange(t)[None, :]        # (b, t)
+            q = rope_rotate(q, ppos, self.rope_base)
+            k = rope_rotate(k, ppos, self.rope_base)
+        if t == 1:
+            lp = jnp.clip(pos // ptok, 0, w - 1)        # logical page (b,)
+            off = pos % ptok                            # in-page offset (b,)
+            phys = jnp.take_along_axis(table, lp[:, None], axis=1)[:, 0]
+            pk = pk.at[phys, :, off, :].set(k[:, :, 0, :])
+            pv = pv.at[phys, :, off, :].set(v[:, :, 0, :])
+        else:
+            # verify chunk: t per-position writes, start clamped so the
+            # window stays in-bounds (the slot grid's update-slice clamp)
+            wpos = (jnp.clip(pos, 0, lmax - t)[:, None]
+                    + jnp.arange(t)[None, :])           # (b, t) absolute
+            lp = wpos // ptok                           # (b, t) logical page
+            off = wpos % ptok                           # (b, t) offset
+            phys = jnp.take_along_axis(table, lp, axis=1)   # (b, t) physical
+            pk = pk.at[phys, :, off, :].set(k.transpose(0, 2, 1, 3))
+            pv = pv.at[phys, :, off, :].set(v.transpose(0, 2, 1, 3))
+        # static-shape gather: (b, W, kv_h, ptok, hd) → the slot-grid view
+        ck = pk[table].transpose(0, 2, 1, 3, 4).reshape(
+            b, pk.shape[1], lmax, pk.shape[3])
+        cv = pv[table].transpose(0, 2, 1, 3, 4).reshape(
+            b, pv.shape[1], lmax, pv.shape[3])
+        kpos = jnp.arange(lmax)
+        qpos = pos[:, None] + jnp.arange(t)[None, :]            # (b, t)
+        kv_mask = kpos[None, None, :] <= qpos[:, :, None]       # (b, t, L)
+        if getattr(self, "window", None) is not None:
+            kv_mask &= kpos[None, None, :] > qpos[:, :, None] - self.window
+        kv_mask = kv_mask[:, None]                              # (b,1,t,L)
+        o = full_attention(q, self._expand_kv(ck), self._expand_kv(cv),
+                           causal=False, kv_mask=kv_mask)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, e)
+        out = o @ self._w(params, "out_weight").T
+        if self.with_bias:
+            out = out + params["out_bias"]
+        return out, {"page_k": pk, "page_v": pv, "page_table": table,
+                     "pos": pos + t}
 
     def __repr__(self):
         gqa = (f", kv_heads={self.kv_heads}"

@@ -110,6 +110,11 @@ def install_decode_cache(model: AbstractModule, batch_size: int,
 _CACHE_ROW_KEYS = ("cache_k", "cache_v")
 _CACHE_POS_KEYS = ("pos", "pos_idx")
 
+#: paged-cache leaf names (``serving/paged_cache.py``). The slot-grid
+#: primitives below REFUSE these loudly: a whole-row reset/assign on a page
+#: pool would corrupt every slot sharing those physical pages.
+_PAGED_KEYS = ("page_k", "page_v", "page_table")
+
 
 def _leaf_key(path):
     return path and getattr(path[-1], "key", None)
@@ -129,6 +134,11 @@ def reset_decode_slot(state: dict, slot) -> dict:
 
     def g(path, leaf):
         key = _leaf_key(path)
+        if key in _PAGED_KEYS:
+            raise ValueError(
+                "reset_decode_slot got a PAGED cache (page pool leaves "
+                "present): a whole-row reset cannot express page-granular "
+                "ownership — use serving.paged_cache.reset_page_slot")
         if key in _CACHE_ROW_KEYS:
             return leaf.at[slot].set(jnp.zeros((), leaf.dtype))
         if key in _CACHE_POS_KEYS:
@@ -160,6 +170,20 @@ def assign_cache_slot(dst_state: dict, src_state: dict, slot,
     Jit-safe with traced ``slot``/``pos``: ONE compiled program performs
     every mid-flight slot assignment regardless of which slot frees up —
     the gather/scatter half of continuous batching."""
+    def _has_paged(node):
+        if isinstance(node, dict):
+            return any(k in _PAGED_KEYS for k in node) \
+                or any(_has_paged(v) for v in node.values())
+        return False
+
+    # checked BEFORE the tree_map: a paged dst and a contiguous src have
+    # different leaf sets, so tree_map would fail with a structure error
+    # instead of naming the real mistake
+    if _has_paged(dst_state):
+        raise ValueError(
+            "assign_cache_slot destination is a PAGED cache: use "
+            "serving.paged_cache.assign_cache_pages to scatter a prefill "
+            "page-granularly")
     slot = jnp.asarray(slot, jnp.int32)
     if pos is not None:
         pos = jnp.asarray(pos, jnp.int32)
@@ -194,7 +218,8 @@ def clear_decode_cache(model: AbstractModule) -> None:
     from bigdl_tpu.models.transformerlm.transformerlm import PositionEmbedding
 
     for mod in _iter_modules(model):
-        if isinstance(mod, MultiHeadAttention) and "cache_k" in mod._state:
+        if isinstance(mod, MultiHeadAttention) and (
+                "cache_k" in mod._state or "page_k" in mod._state):
             mod.set_state({})
         elif isinstance(mod, PositionEmbedding) and "pos_idx" in mod._state:
             mod.set_state({})

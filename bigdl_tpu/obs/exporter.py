@@ -66,17 +66,20 @@ _TENANT_FIELDS = ("backlog", "queued", "active_slots", "submitted",
                   "completed", "timeouts", "shed", "respawns",
                   "poisoned_slots", "slot_recycles", "decode_tps",
                   "queue_depth", "decode_rate", "est_wait_ms",
-                  "prefix_hits", "prefix_tokens_saved", "spec_acceptance",
-                  "model_version")
+                  "prefix_hits", "prefix_tokens_saved", "prefix_bytes",
+                  "spec_acceptance", "model_version", "pages_used",
+                  "pages_free", "free_page_ratio", "page_evictions")
 
 #: numeric per-replica fields exported under {fleet=...,replica=...} — the
 #: router's own dispatch signal, scrapeable by external load balancers
 _REPLICA_FIELDS = ("queue_depth", "active_slots", "est_wait_ms",
-                   "decode_rate", "completed", "shed")
+                   "decode_rate", "completed", "shed", "pages_free",
+                   "free_page_ratio", "prefill_inflight")
 
 #: numeric FleetRouter.stats() counters exported under {fleet=...}
 _FLEET_FIELDS = ("healthy_replicas", "dispatched", "retries",
-                 "replica_downs", "rejected")
+                 "replica_downs", "rejected", "handoffs",
+                 "handoff_failures")
 
 
 def register_engine(engine) -> None:

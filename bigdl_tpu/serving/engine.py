@@ -21,6 +21,15 @@ programs:
 - **SLO knob** (``admit_wait_ms``): on an idle engine, wait this long for
   more arrivals before the first prefill — trades batch fill (throughput)
   against TTFT. 0 (default) = serve immediately.
+- **Paged KV cache** (``pages=`` / BIGDL_KV_PAGES, ``page_tokens=`` /
+  BIGDL_KV_PAGE): swap the per-slot cache rows for a shared page pool
+  + per-slot page tables (``serving/paged_cache.py``) — resident sequences
+  are then bounded by pooled TOKENS, not ``slots × max_len``, so short
+  traffic packs many more concurrent sequences per chip. Decode stays
+  bitwise-identical to the slot grid; pool exhaustion is backpressure
+  (block admission / shed with ``pages_free`` / degrade), never a crash,
+  with the youngest sequence preempted-and-requeued as the last resort so
+  the oldest always progresses.
 
 And a failure story (docs/robustness.md, "Serving"):
 
@@ -81,6 +90,8 @@ from bigdl_tpu.obs import slo as obs_slo
 from bigdl_tpu.obs import trace
 from bigdl_tpu.obs import watchdog as obs_watchdog
 from bigdl_tpu.obs.registry import registry
+from bigdl_tpu.serving import paged_cache
+from bigdl_tpu.serving.paged_cache import TRASH_PAGE, PageAllocator
 from bigdl_tpu.serving.prefix_cache import PrefixPool
 from bigdl_tpu.serving.request import (
     FINISH_EOS, FINISH_LENGTH, Request, RequestHandle,
@@ -128,12 +139,16 @@ class EngineOverloaded(RuntimeError):
     balancers dispatch off data, not exception strings."""
 
     def __init__(self, msg: str, queue_depth: int, est_wait_s: float,
-                 decode_rate: float = 0.0):
+                 decode_rate: float = 0.0,
+                 pages_free: Optional[int] = None):
         super().__init__(msg)
         self.queue_depth = queue_depth
         self.est_wait_s = est_wait_s
         self.est_wait_ms = est_wait_s * 1e3
         self.decode_rate = decode_rate
+        #: paged engines only: free pages at shed time, so a router can
+        #: tell page-pool exhaustion from queue overload (None = unpaged)
+        self.pages_free = pages_free
 
 
 class EngineShutdownTimeout(RuntimeError):
@@ -229,6 +244,17 @@ class ServingEngine:
     (``serving/prefix_cache.py``; BIGDL_PREFIX_POOL, default 0 = off) with
     ``prefix_chunk``-aligned keys (BIGDL_PREFIX_CHUNK, default 16) — shared
     prompt prefixes then seed new slots instead of re-prefilling.
+    ``pages``: size of the shared KV page pool (BIGDL_KV_PAGES, default
+    0 = slot-grid cache). When > 0 the decode cache becomes a paged pool of
+    ``pages`` allocatable ``page_tokens``-token pages per attention layer
+    (``serving/paged_cache.py``); pooled-token residency then bounds
+    concurrency instead of ``slots × max_len``. ``page_tokens`` is the page
+    size (BIGDL_KV_PAGE, default 16; must divide ``max_len``). Paged
+    mode composes with the prefix pool (prefill stays contiguous) and
+    with ``draft_model`` — the speculative verify writes its k+1 chunk
+    through the page table (the target pages; the small draft keeps its
+    slot grid), and ``BIGDL_KV_PAGED=0`` force-disables paging without
+    touching the ``pages``/BIGDL_KV_PAGES setting (the rollback knob).
     """
 
     def __init__(self, model, max_len: int, slots: Optional[int] = None,
@@ -244,6 +270,8 @@ class ServingEngine:
                  draft_model=None, spec_tokens: Optional[int] = None,
                  prefix_pool: Optional[int] = None,
                  prefix_chunk: Optional[int] = None,
+                 pages: Optional[int] = None,
+                 page_tokens: Optional[int] = None,
                  dtype=None, name: str = "serve"):
         import jax.numpy as jnp
 
@@ -292,6 +320,22 @@ class ServingEngine:
             prefix_pool = _env_int("BIGDL_PREFIX_POOL", 0)
         if prefix_chunk is None:
             prefix_chunk = _env_int("BIGDL_PREFIX_CHUNK", 16)
+        if pages is None:
+            pages = _env_int("BIGDL_KV_PAGES", 0)
+        if page_tokens is None:
+            page_tokens = _env_int("BIGDL_KV_PAGE", 16)
+        # BIGDL_KV_PAGED=0 is the fleet-wide rollback switch: it forces the
+        # slot grid even when pages= / BIGDL_KV_PAGES asks for a pool
+        if _env_int("BIGDL_KV_PAGED", 1) == 0:
+            pages = 0
+        self.paged = bool(pages and pages > 0)
+        self.pages = int(pages) if self.paged else 0
+        self.page_tokens = int(page_tokens)
+        if self.paged:
+            # validates page_tokens | max_len; W pages tile one sequence
+            self._page_w = paged_cache.logical_pages(max_len, page_tokens)
+        else:
+            self._page_w = 0
         self._model = model
         self._nn = nn
         self.name = name
@@ -308,11 +352,20 @@ class ServingEngine:
         self.drain_s = float(drain_s)
         self._dtype = jnp.float32 if dtype is None else dtype
         self._params = model.get_params()
+        # paged-mode host bookkeeping: the allocator owns the free list,
+        # _slot_pages maps slot index -> ordered physical page ids, and
+        # _page_table is the HOST-authoritative (slots, W) table injected
+        # into the device state before the next tick whenever it changed
+        self._allocator = (PageAllocator(self.pages) if self.paged
+                           else None)
+        self._slot_pages: list[list[int]] = [[] for _ in range(self.slots)]
+        self._page_table = np.full((self.slots, self._page_w or 1),
+                                   TRASH_PAGE, np.int32)
+        self._table_dirty = False
+        self._page_evictions = 0
         # functional cache states: install → capture → clear, so the module
         # itself stays clean (the cached path branches on the PASSED state)
-        self._dec_state = nn.install_decode_cache(
-            model, self.slots, self.max_len, dtype=self._dtype, per_slot=True)
-        nn.clear_decode_cache(model)
+        self._dec_state = self._install_grid()
         self._pre_state0 = nn.install_decode_cache(
             model, 1, self.max_len, dtype=self._dtype, per_slot=True)
         nn.clear_decode_cache(model)
@@ -337,7 +390,9 @@ class ServingEngine:
             self._params_d = None
             self._dec_state_d = None
             self._pre_state0_d = None
-        self._prefix = (PrefixPool(prefix_pool, prefix_chunk)
+        self._prefix = (PrefixPool(prefix_pool, prefix_chunk,
+                                   page=(self.page_tokens if self.paged
+                                         else None))
                         if prefix_pool and prefix_pool > 0 else None)
 
         self._queue: ClosableQueue = ClosableQueue(queue_depth)
@@ -356,6 +411,7 @@ class ServingEngine:
         self._backlog = 0                 # submitted, not yet in a slot
         self._backlog_lock = threading.Lock()
         self._respawns = 0
+        self._prefill_inflight = 0        # disaggregation exports running
         self._timeouts = 0
         self._shed = 0
         self._degraded_admits = 0
@@ -381,6 +437,127 @@ class ServingEngine:
         self._swap_pending: Optional[_SwapCommand] = None
         self._swap_lock = threading.Lock()
         registry.gauge("serving/health").set(_HEALTH_CODE["starting"])
+        if self.paged:
+            registry.gauge("serve/page_evictions").set(0)
+            self._publish_page_gauges()
+
+    # -------------------------------------------------------------- paging
+    def _install_grid(self):
+        """Fresh zeroed decode grid — paged pool or slot grid — resetting
+        the paging bookkeeping alongside (construction, crash recovery, and
+        weight swap all rebuild through here so host and device state can
+        never drift apart)."""
+        nn = self._nn
+        if self.paged:
+            self._allocator.reset()
+            self._slot_pages = [[] for _ in range(self.slots)]
+            self._page_table[:] = TRASH_PAGE
+            self._table_dirty = False
+            self._publish_page_gauges()
+            state = paged_cache.install_paged_cache(
+                self._model, self.slots, self.max_len, self.pages,
+                self.page_tokens, dtype=self._dtype)
+        else:
+            state = nn.install_decode_cache(
+                self._model, self.slots, self.max_len, dtype=self._dtype,
+                per_slot=True)
+        nn.clear_decode_cache(self._model)
+        return state
+
+    def _publish_page_gauges(self) -> None:
+        registry.gauge("serve/pages_used").set(self._allocator.used_count)
+        registry.gauge("serve/pages_free").set(self._allocator.free_count)
+
+    def _pages_needed(self, depth: int) -> int:
+        """Pages a sequence at ``depth`` needs RESIDENT: its content pages
+        plus the page its next decode write (position ``depth``) lands in —
+        ``depth // page_tokens + 1`` covers both."""
+        return depth // self.page_tokens + 1
+
+    def _pages_row(self, index: int) -> np.ndarray:
+        """Slot ``index``'s (W,) physical-page vector, trash-padded — the
+        traced argument of the paged assign/reset programs."""
+        row = self._slot_pages[index]
+        return np.asarray(
+            row + [TRASH_PAGE] * (self._page_w - len(row)), np.int32)
+
+    def _free_slot_pages(self, index: int) -> None:
+        """Return a slot's pages to the pool and point its table row at
+        trash (finish/timeout/recycle — zero device cost: the freed pages'
+        stale content is masked for the next owner and overwritten as it
+        decodes; only the POISON path scrubs, via ``_reset_row``)."""
+        if not self.paged or not self._slot_pages[index]:
+            return
+        self._allocator.free(self._slot_pages[index])
+        self._slot_pages[index] = []
+        self._page_table[index, :] = TRASH_PAGE
+        self._table_dirty = True
+        self._publish_page_gauges()
+
+    def _sync_page_table(self) -> None:
+        """Push the host-authoritative table to every layer's device copy.
+        MUST run before a decode tick whenever allocation changed: a freed
+        row's stale device table would let its free-riding dummy writes
+        land in pages the allocator already handed to someone else."""
+        import jax.numpy as jnp
+
+        if self._table_dirty:
+            self._dec_state = paged_cache.with_page_table(
+                self._dec_state, jnp.asarray(self._page_table))
+            self._table_dirty = False
+
+    def _ensure_pages(self) -> None:
+        """Grow every active sequence's page list to cover its next write,
+        oldest admission first. On exhaustion the YOUNGEST active sequence
+        is preempted — pages freed, request requeued at the front of
+        pending (the crash-recovery re-prefill path, so its tokens stay
+        bitwise-identical) — guaranteeing the oldest always progresses and
+        a full pool can never deadlock the loop."""
+        active = sorted(self._sched.active_slots(),
+                        key=lambda s: (s.request.admit_t or 0.0, s.index))
+        for slot in active:
+            # a speculative tick writes positions depth .. depth+k (the
+            # verify chunk), so the horizon reserves through the last one
+            while slot.request is not None and \
+                    self._pages_needed(slot.depth + self._spec) \
+                    > len(self._slot_pages[slot.index]):
+                got = self._allocator.alloc(1)
+                if got is not None:
+                    self._slot_pages[slot.index].extend(got)
+                    self._page_table[
+                        slot.index,
+                        len(self._slot_pages[slot.index]) - 1] = got[0]
+                    self._table_dirty = True
+                    continue
+                victims = [s for s in active if s.request is not None]
+                victim = max(victims,
+                             key=lambda s: (s.request.admit_t or 0.0,
+                                            s.index))
+                self._preempt(victim)
+                if victim is slot:
+                    break   # this row WAS the youngest: it yielded
+        self._publish_page_gauges()
+
+    def _preempt(self, slot) -> None:
+        """Evict one active sequence to free its pages: requeued at the
+        front of pending, it re-admits through the ordinary re-prefill
+        path (prompt + already-emitted tokens) with its handle untouched —
+        added latency, never a lost future, never different tokens."""
+        req = slot.request
+        self._page_evictions += 1
+        registry.gauge("serve/page_evictions").set(self._page_evictions)
+        events.record("serving_page_preempt", engine=self.name,
+                      request_id=req.request_id, trace_id=req.trace_id,
+                      slot=slot.index,
+                      pages_freed=len(self._slot_pages[slot.index]),
+                      generated=len(req.generated))
+        logger.warning(
+            "engine %r: page pool exhausted; preempting request %r "
+            "(slot %d, %d pages) to the admission queue", self.name,
+            req.request_id, slot.index, len(self._slot_pages[slot.index]))
+        self._free_slot_pages(slot.index)
+        self._sched.release(slot)
+        self._pending.insert(0, req)
 
     # ------------------------------------------------------------ programs
     def _fn(self, key, build):
@@ -466,7 +643,12 @@ class ServingEngine:
         same program, so the guard costs no extra dispatch."""
         import jax.numpy as jnp
 
-        key = ("serve_decode", self.slots, self.max_len, self._dtype_name())
+        # the paged grid is a DIFFERENT program (page-table gather/scatter
+        # instead of contiguous rows) but still exactly ONE ledger entry
+        key = (("serve_decode_paged", self.slots, self.max_len, self.pages,
+                self.page_tokens, self._dtype_name()) if self.paged else
+               ("serve_decode", self.slots, self.max_len,
+                self._dtype_name()))
 
         def build():
             def run(params, state, tok):
@@ -492,7 +674,41 @@ class ServingEngine:
         draft model the fused program scatters BOTH grids, keeping the
         ledger at one assign entry."""
         nn = self._nn
-        if self._spec:
+        if self.paged and self._spec:
+            # fused: target prefill lands page-granularly, the draft's in
+            # its contiguous slot row — one assign entry in the ledger
+            key = ("serve_assign_paged_spec", id(self._draft), self.slots,
+                   self.max_len, self.pages, self.page_tokens,
+                   self._dtype_name())
+
+            def build():
+                def run(dst, src, pages, dst_d, src_d, slot, pos):
+                    return (paged_cache.assign_cache_pages(
+                                dst, src, pages, slot, pos),
+                            nn.assign_cache_slot(dst_d, src_d, slot,
+                                                 pos=pos))
+                return run
+
+            self._dec_state, self._dec_state_d = self._fn(key, build)(
+                self._dec_state, states[0], self._pages_row(slot),
+                self._dec_state_d, states[1], slot, pos)
+        elif self.paged:
+            # page-granular scatter: the (W,) trash-padded page row is a
+            # traced argument, so ONE program serves every admission no
+            # matter which physical pages the allocator handed out
+            key = ("serve_assign_paged", self.slots, self.max_len,
+                   self.pages, self.page_tokens, self._dtype_name())
+
+            def build():
+                def run(dst, src, pages, slot, pos):
+                    return paged_cache.assign_cache_pages(
+                        dst, src, pages, slot, pos)
+                return run
+
+            self._dec_state = self._fn(key, build)(
+                self._dec_state, states[0], self._pages_row(slot), slot,
+                pos)
+        elif self._spec:
             key = ("serve_assign_spec", id(self._draft), self.slots,
                    self.max_len, self._dtype_name())
 
@@ -524,6 +740,33 @@ class ServingEngine:
         — never compiled on a clean run, so the clean-run program bound
         stays ``len(buckets) + 2``."""
         nn = self._nn
+        if self.paged:
+            # the paged poison path ZEROES the listed pages (not just the
+            # table row): a NaN in a freed page would otherwise ride a
+            # 0-weight × NaN product into the next owner's logits
+            key = ("serve_reset_paged", self.slots, self.max_len,
+                   self.pages, self.page_tokens, self._dtype_name())
+
+            def build():
+                def run(state, pages, slot):
+                    return paged_cache.reset_page_slot(state, pages, slot)
+                return run
+
+            self._dec_state = self._fn(key, build)(
+                self._dec_state, self._pages_row(slot), slot)
+            if self._spec:
+                # the draft rides its own slot grid; scrub its row too
+                dkey = ("serve_reset_paged_draft", id(self._draft),
+                        self.slots, self.max_len, self._dtype_name())
+
+                def dbuild():
+                    def run(state_d, slot):
+                        return nn.reset_decode_slot(state_d, slot)
+                    return run
+
+                self._dec_state_d = self._fn(dkey, dbuild)(
+                    self._dec_state_d, slot)
+            return
         if self._spec:
             key = ("serve_reset_spec", id(self._draft), self.slots,
                    self.max_len, self._dtype_name())
@@ -580,6 +823,19 @@ class ServingEngine:
                 f"prompt_len {prompt.size} exceeds the largest prefill "
                 f"bucket {self.buckets[-1]}; widen buckets= "
                 f"(or BIGDL_SERVE_BUCKETS)")
+        if self.paged:
+            # peak residency: content pages at the deepest decode write
+            # (a speculative round adds its k-deep verify chunk), plus the
+            # page that write lands in — a request needing more than the
+            # WHOLE pool can never run, even alone
+            peak = ((prompt.size + max(max_new_tokens - 2, 0) + self._spec)
+                    // self.page_tokens + 1)
+            if peak > self.pages:
+                raise ValueError(
+                    f"prompt_len {prompt.size} + max_new_tokens "
+                    f"{max_new_tokens} needs {peak} pages of "
+                    f"{self.page_tokens} tokens, but the pool holds only "
+                    f"{self.pages} (BIGDL_KV_PAGES)")
         if deadline_ms is None:
             deadline_s = self.default_deadline_s
         else:
@@ -591,8 +847,18 @@ class ServingEngine:
             if depth >= self.queue_depth or (
                     deadline_s is not None and est > deadline_s):
                 self._reject_overloaded(depth, est)
+            if self.paged and self._allocator.free_count \
+                    < self._pages_needed(int(prompt.size)):
+                # pool exhaustion is backpressure, not a crash: shed NOW
+                # with pages_free so the router can tell page pressure
+                # from queue overload (block mode queues instead, and the
+                # loop's admission gate holds the request until pages free)
+                self._reject_overloaded(
+                    depth, est, pages_free=self._allocator.free_count)
         elif self.overload == "degrade":
-            if self._backlog >= self.slots:
+            if self._backlog >= self.slots or (
+                    self.paged and self._allocator.free_count
+                    < self._pages_needed(int(prompt.size))):
                 halved = max(1, max_new_tokens // 2)
                 if halved < max_new_tokens:
                     self._degraded_admits += 1
@@ -623,16 +889,84 @@ class ServingEngine:
         registry.counter("serving/requests").inc()
         return req.handle
 
-    def _reject_overloaded(self, depth: int, est: float) -> None:
+    def _reject_overloaded(self, depth: int, est: float,
+                           pages_free: Optional[int] = None) -> None:
         self._shed += 1
         registry.counter("serving/shed").inc()
         events.record("serving_shed", engine=self.name, queue_depth=depth,
-                      est_wait_s=round(est, 4))
+                      est_wait_s=round(est, 4), pages_free=pages_free)
+        why = (f"page pool exhausted ({pages_free} pages free)"
+               if pages_free is not None else
+               f"backlog {depth} (queue_depth {self.queue_depth})")
         raise EngineOverloaded(
-            f"engine {self.name!r} overloaded: backlog {depth} "
-            f"(queue_depth {self.queue_depth}), estimated wait "
+            f"engine {self.name!r} overloaded: {why}, estimated wait "
             f"{est * 1e3:.0f} ms", queue_depth=depth, est_wait_s=est,
-            decode_rate=self._rate_tps)
+            decode_rate=self._rate_tps, pages_free=pages_free)
+
+    # ------------------------------------------------- disaggregated prefill
+    def prefill_export(self, prompt) -> tuple:
+        """Run ONE bucketed prefill for ``prompt`` on THIS replica and
+        return ``(next_token, states)`` — the prefill→decode handoff
+        payload of disaggregated serving (``FleetRouter`` phases). Pure
+        functional over the batch-1 prefill state: no slot is claimed, the
+        decode grid is untouched, and it is safe from any thread — a
+        prefill replica serves exports concurrently with (or instead of)
+        its own decode loop. The states are the SAME pytrees the prefix
+        pool stores, so a decode replica absorbs them via
+        :meth:`seed_prefix` with no new device programs."""
+        import jax.numpy as jnp
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        lb = pick_bucket(prompt.size, self.buckets)
+        if lb is None:
+            raise ValueError(
+                f"prompt_len {prompt.size} exceeds the largest prefill "
+                f"bucket {self.buckets[-1]} on engine {self.name!r}")
+        self._prefill_inflight += 1
+        try:
+            padded = np.zeros((1, lb), np.int32)
+            padded[0, :prompt.size] = prompt
+            with trace.span("serve/prefill_export", {"bucket": lb}):
+                if self._spec:
+                    next_all, ok, filled, filled_d = self._prefill_spec(
+                        self._pre_state0, self._pre_state0_d,
+                        jnp.asarray(padded))
+                    states = (filled, filled_d)
+                else:
+                    next_all, ok, filled = self._prefill(
+                        self._params, self._pre_state0,
+                        jnp.asarray(padded))
+                    states = (filled,)
+            if not bool(np.asarray(ok)):
+                raise NonFiniteLogitsError(
+                    f"non-finite logits in prefill_export on engine "
+                    f"{self.name!r}")
+            return int(np.asarray(next_all)[0, prompt.size - 1]), states
+        finally:
+            self._prefill_inflight -= 1
+
+    def seed_prefix(self, prompt, states, next_token: int) -> None:
+        """Absorb a prefill handoff: pool ``states`` under ``prompt`` so
+        the next ``submit`` of that prompt admits through the prefix pool —
+        an EXACT hit runs no device program at all, which is what makes
+        the disaggregated tokens bitwise-identical to single-engine
+        serving. Requires this engine to have a prefix pool
+        (``prefix_pool > 0`` / BIGDL_PREFIX_POOL)."""
+        if self._prefix is None:
+            raise ValueError(
+                f"engine {self.name!r} has no prefix pool (prefix_pool=0 /"
+                f" BIGDL_PREFIX_POOL unset); a decode-phase replica needs "
+                f"one to absorb prefill handoffs")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        want = 2 if self._spec else 1
+        if len(states) != want:
+            raise ValueError(
+                f"engine {self.name!r} expects {want} cache state(s) per "
+                f"handoff, got {len(states)} — prefill and decode replicas "
+                f"must agree on speculative decoding")
+        self._prefix.insert(prompt, tuple(states), int(next_token))
 
     def estimated_wait_s(self) -> float:
         """Backlog drain estimate from the decode token-rate EWMA: backlog ×
@@ -816,6 +1150,28 @@ class ServingEngine:
                                  if self._prefix is not None else 0),
             "prefix_tokens_saved": (self._prefix.tokens_saved
                                     if self._prefix is not None else 0),
+            "prefix_bytes": (self._prefix.stats()["bytes"]
+                             if self._prefix is not None else 0),
+            # paged KV cache (slot-grid engines report paged=False + 0s)
+            "paged": self.paged,
+            "pages_total": self.pages,
+            "page_tokens": self.page_tokens if self.paged else 0,
+            "pages_used": (self._allocator.used_count
+                           if self.paged else 0),
+            "pages_free": (self._allocator.free_count
+                           if self.paged else 0),
+            # memory headroom the queue-depth load triple cannot see (a
+            # short queue on a page-starved replica still stalls): free
+            # pages / pool in paged mode, free slots / grid in legacy —
+            # the router ranks memory-starved replicas last on this
+            "free_page_ratio": round(
+                (self._allocator.free_count / self.pages) if self.paged
+                else ((self.slots - self._sched.active_count)
+                      / self.slots), 4),
+            "page_evictions": self._page_evictions,
+            # disaggregation: prefill_export calls currently running (the
+            # fleet router's prefill-replica load signal)
+            "prefill_inflight": self._prefill_inflight,
         }
 
     # --------------------------------------------------------------- health
@@ -923,10 +1279,7 @@ class ServingEngine:
         latency, never different tokens."""
         nn = self._nn
         evicted = self._sched.reset()
-        self._dec_state = nn.install_decode_cache(
-            self._model, self.slots, self.max_len, dtype=self._dtype,
-            per_slot=True)
-        nn.clear_decode_cache(self._model)
+        self._dec_state = self._install_grid()
         if self._draft is not None:
             self._dec_state_d = nn.install_decode_cache(
                 self._draft, self.slots, self.max_len, dtype=self._dtype,
@@ -1040,10 +1393,7 @@ class ServingEngine:
             self._params = new_params
             # fresh zeroed grids: the old rows' KV entries were computed
             # under the old weights and must not leak into new decodes
-            self._dec_state = nn.install_decode_cache(
-                self._model, self.slots, self.max_len, dtype=self._dtype,
-                per_slot=True)
-            nn.clear_decode_cache(self._model)
+            self._dec_state = self._install_grid()
             if self._draft is not None:
                 self._dec_state_d = nn.install_decode_cache(
                     self._draft, self.slots, self.max_len,
@@ -1110,7 +1460,13 @@ class ServingEngine:
             self._expire_pending(now)
             while self._pending and self._sched.has_free() \
                     and not self._stop.is_set():
-                self._admit(self._pending.pop(0))
+                req = self._pending.pop(0)
+                if not self._admit(req):
+                    # page pool exhausted: head-of-line request waits (block
+                    # semantics) — decode keeps ticking below, finishing
+                    # sequences free pages, and admission retries next loop
+                    self._pending.insert(0, req)
+                    break
             self._update_health()
             if self._sched.any_active() and not self._stop.is_set():
                 self._tick()
@@ -1225,6 +1581,7 @@ class ServingEngine:
         for slot in self._sched.active_slots():
             if slot.request.expired(now):
                 self._timeout(slot.request, in_slot=True)
+                self._free_slot_pages(slot.index)
                 self._sched.release(slot)
                 released = True
         if released:
@@ -1267,8 +1624,10 @@ class ServingEngine:
             registry.counter("serving/prefix_hits").inc()
             registry.counter("serving/prefix_tokens_saved").inc(c)
             if c == clen:
-                self._last_prefill_flops = None   # no program ran
-                return entry.next_token, entry.states
+                self._last_prefill_flops = None   # no compiled program ran
+                # seeded() also restores page-truncated rows to the full
+                # window (the assign scatter needs max_len-shaped leaves)
+                return entry.next_token, PrefixPool.seeded(entry, c)
             seeded = PrefixPool.seeded(entry, c)
             rem = clen - c
             lb = pick_seed_bucket(rem, self.buckets, c, self.max_len)
@@ -1290,27 +1649,52 @@ class ServingEngine:
             self._prefix.insert(ctx, states, nxt)
         return nxt, states
 
-    def _admit(self, req: Request) -> None:
+    def _admit(self, req: Request) -> bool:
         """Prefill ``req``'s context into a free slot: one bucketed prefill
         program, one slot-assign scatter — and the FIRST generated token
         falls out of the prefill logits (TTFT ends here). On the crash-
         recovery path the context is prompt + already-emitted tokens, so the
-        re-prefilled slot resumes exactly where the dead loop stopped."""
-        recycles_before = self._sched.recycles
-        slot = self._sched.admit(req)
-        if self._sched.recycles > recycles_before:
-            registry.counter("serving/slot_recycles").inc()
-        if req.admit_t is None:
-            req.admit_t = time.perf_counter()
-            self._backlog_dec()
-            registry.histogram("serving/queue_wait_ms").observe(
-                (req.admit_t - req.submit_t) * 1e3)
+        re-prefilled slot resumes exactly where the dead loop stopped.
+
+        Returns False ONLY when the page pool cannot back the context right
+        now (paged mode): the request is untouched — the caller requeues it
+        at the head and lets decode free pages. Every other failure fails
+        the request's own handle and returns True."""
         if req.generated:
             ctx = np.concatenate(
                 [req.prompt, np.asarray(req.generated, np.int32)])
         else:
             ctx = req.prompt
         clen = int(ctx.size)
+        pages = None
+        if self.paged:
+            # CONTENT pages only (ceil(clen / page_tokens)): the page the
+            # first decode write lands in is _ensure_pages's job, so the
+            # lifetime-peak allocation matches submit's fit check exactly
+            need = (clen - 1) // self.page_tokens + 1
+            pages = self._allocator.alloc(need)
+            if pages is None:
+                events.record("serving_page_backpressure",
+                              engine=self.name, request_id=req.request_id,
+                              trace_id=req.trace_id, pages_needed=need,
+                              pages_free=self._allocator.free_count)
+                return False
+        recycles_before = self._sched.recycles
+        slot = self._sched.admit(req)
+        if self._sched.recycles > recycles_before:
+            registry.counter("serving/slot_recycles").inc()
+        if self.paged:
+            self._slot_pages[slot.index] = pages
+            self._page_table[slot.index, :] = TRASH_PAGE
+            self._page_table[slot.index, :len(pages)] = pages
+            self._table_dirty = True
+            slot.depth = clen
+            self._publish_page_gauges()
+        if req.admit_t is None:
+            req.admit_t = time.perf_counter()
+            self._backlog_dec()
+            registry.histogram("serving/queue_wait_ms").observe(
+                (req.admit_t - req.submit_t) * 1e3)
         lb = pick_bucket(clen, self.buckets)
         if lb is None:
             lb = self.max_len   # recovery-only: context outgrew the grid
@@ -1343,10 +1727,11 @@ class ServingEngine:
             logger.error("engine %r: request %r failed in prefill: %s",
                          self.name, req.request_id, e)
             req.handle._fail(e)
+            self._free_slot_pages(slot.index)
             self._sched.release(slot)
             registry.gauge("serving/active_slots").set(
                 self._sched.active_count)
-            return
+            return True
         if req.first_token_t is None:
             req.first_token_t = time.perf_counter()
             registry.histogram("serving/ttft_ms").observe(
@@ -1357,6 +1742,7 @@ class ServingEngine:
         else:
             slot.last_token = nxt
         registry.gauge("serving/active_slots").set(self._sched.active_count)
+        return True
 
     # --------------------------------------------------------------- decode
     def _tick(self) -> None:
@@ -1369,6 +1755,15 @@ class ServingEngine:
             self._tick_spec()
             return
         t0 = time.perf_counter()
+        if self.paged:
+            # grow page lists to cover this tick's writes (preempting the
+            # youngest on exhaustion), then push the host table to the
+            # device BEFORE the program runs — a freed row's stale device
+            # table would scribble on someone else's pages
+            self._ensure_pages()
+            if not self._sched.any_active():
+                return
+            self._sync_page_table()
         active = self._sched.active_slots()
         tok = np.zeros((self.slots,), np.int32)
         for slot in active:
@@ -1398,6 +1793,7 @@ class ServingEngine:
             self._watchdog.heartbeat(dt)
         for slot in active:
             req = slot.request
+            slot.depth += 1   # mirrors the device pos advance this tick
             if not bool(ok[slot.index]):
                 self._poison(slot)
                 continue
@@ -1420,6 +1816,14 @@ class ServingEngine:
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
+        if self.paged:
+            # reserve through the verify chunk's deepest write and push the
+            # host table before the fused program runs (same contract as
+            # the plain paged tick)
+            self._ensure_pages()
+            if not self._sched.any_active():
+                return
+            self._sync_page_table()
         active = self._sched.active_slots()
         tok = np.zeros((self.slots,), np.int32)
         for slot in active:
@@ -1455,6 +1859,8 @@ class ServingEngine:
                 self._poison(slot)
                 continue
             j = int(n_acc[slot.index])
+            # the device pos advanced k+1 then rewound k-j: net 1+j rows
+            slot.depth += j + 1
             self._spec_proposed += self._spec
             self._spec_accepted += j
             # accepted proposals, then the correction token; tokens past a
@@ -1489,7 +1895,8 @@ class ServingEngine:
         req.handle._fail(NonFiniteLogitsError(
             f"non-finite logits decoding request {req.request_id} "
             f"(slot {slot.index}) [trace {req.trace_id}]"))
-        self._reset_row(slot.index)
+        self._reset_row(slot.index)   # paged: zeroes the pages themselves
+        self._free_slot_pages(slot.index)
         self._sched.release(slot)
 
     def _finished(self, req: Request, token: int) -> bool:
@@ -1512,6 +1919,7 @@ class ServingEngine:
         self._tok_per_req = (float(n) if self._tok_per_req == 0.0
                              else 0.8 * self._tok_per_req + 0.2 * n)
         self._maybe_persist_trace(req, result)
+        self._free_slot_pages(slot.index)
         self._sched.release(slot)
 
     def _maybe_persist_trace(self, req: Request, result) -> None:
@@ -1560,6 +1968,7 @@ class ServingEngine:
             f"engine {self.name!r} shut down before the request finished")
         for slot in self._sched.active_slots():
             slot.request.handle._fail(err)
+            self._free_slot_pages(slot.index)
             self._sched.release(slot)
         for req in pending:
             req.handle._fail(err)

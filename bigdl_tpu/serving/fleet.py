@@ -25,6 +25,20 @@ the way the rest of the stack was already shaped for:
   about to pick, stranding its in-flight work for the retry path to
   recover) make failover deterministic under test, like every other
   robustness path (docs/robustness.md).
+- **Disaggregated prefill/decode** (``phases=`` / BIGDL_FLEET_PHASE):
+  replicas learn a role — ``prefill`` (runs bucketed prefills, exports the
+  filled cache, never holds decode slots), ``decode`` (absorbs exported
+  prefixes through its prefix pool and runs the decode grid), or ``mixed``
+  (the default: both, the classic colocated engine). With at least one
+  prefill replica, ``submit`` first runs the prompt's prefill on the
+  least-busy prefill replica (``prefill_export``) and seeds it into the
+  target decode replica's :class:`~bigdl_tpu.serving.prefix_cache.
+  PrefixPool` (``seed_prefix``) — admission there is an exact pool hit, so
+  a prompt burst never queues behind (or stalls) in-flight decode ticks,
+  and the tokens are bitwise what a single colocated engine emits. ANY
+  handoff failure falls back to plain dispatch, and when no decode-phase
+  replica is healthy the router dispatches to whatever is — phase churn
+  degrades latency, never loses a request.
 
 Replicas typically share ONE model instance — compiled programs live on
 ``model._apply_cache``, so N replicas still compile each program once; what
@@ -34,6 +48,7 @@ engine owns. :func:`FleetRouter.replicate` builds that arrangement.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -51,6 +66,9 @@ from bigdl_tpu.utils.robustness import events
 
 #: replica health states the router will dispatch to
 _DISPATCHABLE = ("starting", "ready", "degraded")
+
+#: replica roles under disaggregated serving (BIGDL_FLEET_PHASE)
+_PHASES = ("prefill", "decode", "mixed")
 
 
 class FleetExhausted(RuntimeError):
@@ -139,10 +157,15 @@ class FleetRouter:
     replicas must serve the same snapshot for fleet routing to be
     transparent; that is the caller's contract (use :meth:`replicate`).
     ``max_retries``: total re-dispatches one request may consume, a backstop
-    against pathological flapping (default ``4 × len(replicas)``)."""
+    against pathological flapping (default ``4 × len(replicas)``).
+    ``phases``: optional ``{name: "prefill"|"decode"|"mixed"}`` replica
+    roles for disaggregated serving (missing names default to ``mixed``);
+    at least one replica must be decode-capable (``decode`` or
+    ``mixed``)."""
 
     def __init__(self, replicas, name: str = "fleet",
-                 max_retries: Optional[int] = None):
+                 max_retries: Optional[int] = None,
+                 phases: Optional[dict] = None):
         if not isinstance(replicas, dict):
             replicas = {e.name: e for e in replicas}
         if not replicas:
@@ -151,11 +174,30 @@ class FleetRouter:
             raise ValueError("replica names must be unique")
         self.name = name
         self._engines: dict[str, ServingEngine] = dict(replicas)
+        self._phases: dict[str, str] = {nm: "mixed" for nm in replicas}
+        if phases:
+            for nm, ph in phases.items():
+                if nm not in self._engines:
+                    raise ValueError(
+                        f"phases names unknown replica {nm!r}")
+                if ph not in _PHASES:
+                    raise ValueError(
+                        f"phase must be one of {_PHASES}, got {ph!r} "
+                        f"for replica {nm!r} (BIGDL_FLEET_PHASE)")
+                self._phases[nm] = ph
+        if not any(ph in ("decode", "mixed")
+                   for ph in self._phases.values()):
+            raise ValueError(
+                "a fleet needs at least one decode-capable replica "
+                "(phase 'decode' or 'mixed'); all-prefill fleets can "
+                "never finish a request")
         self._lock = threading.Lock()
         self._dispatched = 0
         self._retries = 0
         self._replica_downs = 0
         self._rejected = 0
+        self._handoffs = 0
+        self._handoff_failures = 0
         self.max_retries = (max_retries if max_retries is not None
                             else 4 * len(replicas))
         obs_exporter.register_fleet(self)
@@ -163,22 +205,47 @@ class FleetRouter:
     # -------------------------------------------------------- construction
     @classmethod
     def replicate(cls, model, max_len: int, replicas: Optional[int] = None,
-                  name: str = "fleet", **engine_kwargs) -> "FleetRouter":
+                  name: str = "fleet", phases=None,
+                  **engine_kwargs) -> "FleetRouter":
         """Build a fleet of ``replicas`` engines over ONE model instance
         (BIGDL_FLEET_REPLICAS, default 2). Shared instance = shared
         ``_apply_cache``: N replicas, each program still compiled once.
         ``engine_kwargs`` pass through to every :class:`ServingEngine`
-        (slots, buckets, draft_model, prefix_pool, overload, ...)."""
+        (slots, buckets, draft_model, prefix_pool, overload, ...).
+
+        ``phases`` (or BIGDL_FLEET_PHASE, a comma list) assigns replica
+        roles positionally — ``"prefill,decode"`` makes replica 0 the
+        prefill tier and replica 1 the decode tier; a single value
+        broadcasts to every replica. Decode-phase replicas need a prefix
+        pool to absorb handoffs — pass ``prefix_pool=`` (it is harmless on
+        the prefill tier)."""
         if replicas is None:
             replicas = _env_int("BIGDL_FLEET_REPLICAS", 2)
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if phases is None:
+            spec = os.environ.get("BIGDL_FLEET_PHASE", "")
+            phases = [p.strip() for p in spec.split(",") if p.strip()] \
+                if spec else None
+        phase_map = None
+        if phases is not None:
+            if isinstance(phases, str):
+                phases = [p.strip() for p in phases.split(",") if p.strip()]
+            phases = list(phases)
+            if len(phases) == 1:
+                phases = phases * replicas
+            if len(phases) != replicas:
+                raise ValueError(
+                    f"phases lists {len(phases)} roles for {replicas} "
+                    f"replicas (BIGDL_FLEET_PHASE)")
+            phase_map = {f"{name}-r{i}": phases[i]
+                         for i in range(replicas)}
         engines = {
             f"{name}-r{i}": ServingEngine(
                 model, max_len=max_len, name=f"{name}-r{i}",
                 **engine_kwargs)
             for i in range(replicas)}
-        return cls(engines, name=name)
+        return cls(engines, name=name, phases=phase_map)
 
     # ------------------------------------------------------------- registry
     @property
@@ -189,12 +256,21 @@ class FleetRouter:
     def engine(self, name: str) -> ServingEngine:
         return self._engines[name]
 
-    def add_replica(self, name: str, engine: ServingEngine) -> None:
+    def phase(self, name: str) -> str:
+        """The replica's serving role: ``prefill``, ``decode``, or
+        ``mixed``."""
+        return self._phases[name]
+
+    def add_replica(self, name: str, engine: ServingEngine,
+                    phase: str = "mixed") -> None:
         """Grow the fleet mid-flight — the next dispatch round sees it."""
+        if phase not in _PHASES:
+            raise ValueError(f"phase must be one of {_PHASES}, got {phase!r}")
         with self._lock:
             if name in self._engines:
                 raise ValueError(f"replica {name!r} already registered")
             self._engines[name] = engine
+            self._phases[name] = phase
 
     def remove_replica(self, name: str, drain: bool = True) -> None:
         """Take a replica out of rotation; ``drain=True`` lets its
@@ -202,6 +278,7 @@ class FleetRouter:
         with ``EngineShutdown`` and re-route via their FleetHandles)."""
         with self._lock:
             eng = self._engines.pop(name)
+            self._phases.pop(name, None)
         eng.shutdown(wait=False, drain=drain)
 
     # ------------------------------------------------------------- dispatch
@@ -210,20 +287,92 @@ class FleetRouter:
                 if e.stats()["health"] in _DISPATCHABLE]
 
     def _rank(self, exclude: Optional[str] = None) -> list[tuple]:
-        """Dispatch order: healthy replicas by ``(queue_depth +
-        active_slots, est_wait_ms, name)`` — fewest waiting sequences
-        first, EWMA wait estimate as tiebreak, name for determinism."""
-        order = []
+        """Dispatch order: healthy DECODE-CAPABLE replicas (phase
+        ``decode`` or ``mixed``) by ``(memory-starved, queue_depth +
+        active_slots, est_wait_ms, name)`` — a replica whose
+        ``free_page_ratio`` hit 0 (no free page in paged mode, no free
+        slot in legacy) ranks after every replica with headroom no matter
+        how short its queue looks (the queue-depth triple saturates and
+        cannot tell a draining replica from a memory-starved one), then
+        fewest waiting sequences first, EWMA wait estimate as tiebreak,
+        name for determinism. Healthy PREFILL-phase replicas rank strictly
+        after every decode-capable one (a prefill engine serves end to
+        end, slower) — they are the retry-elsewhere tail, so a decode
+        replica dying MID-dispatch still leaves the candidate list a
+        healthy target and phase churn never strands a request a mixed
+        fleet would have served."""
+        order, fallback = [], []
         for nm, eng in list(self._engines.items()):
             if nm == exclude:
                 continue
             st = eng.stats()
             if st["health"] not in _DISPATCHABLE:
                 continue
-            order.append(((st["queue_depth"] + st["active_slots"],
-                           st["est_wait_ms"], nm), nm, eng))
+            starved = st.get("free_page_ratio", 1.0) <= 0.0
+            entry = ((starved, st["queue_depth"] + st["active_slots"],
+                      st["est_wait_ms"], nm), nm, eng)
+            if self._phases.get(nm, "mixed") in ("decode", "mixed"):
+                order.append(entry)
+            else:
+                fallback.append(entry)
+        order.sort(key=lambda t: t[0])
+        fallback.sort(key=lambda t: t[0])
+        return [(nm, eng) for _, nm, eng in order + fallback]
+
+    def _rank_prefill(self) -> list[tuple]:
+        """Healthy prefill-phase replicas by export load ``(prefill
+        in-flight + backlog, name)`` — the handoff's source ranking."""
+        order = []
+        for nm, eng in list(self._engines.items()):
+            if self._phases.get(nm, "mixed") != "prefill":
+                continue
+            st = eng.stats()
+            if st["health"] not in _DISPATCHABLE:
+                continue
+            order.append(((st.get("prefill_inflight", 0)
+                           + st["queue_depth"], nm), nm, eng))
         order.sort(key=lambda t: t[0])
         return [(nm, eng) for _, nm, eng in order]
+
+    def _maybe_handoff(self, fh: FleetHandle) -> Optional[str]:
+        """Disaggregated prefill→decode handoff: run the prompt's prefill
+        on the least-busy prefill replica and seed the result into the
+        best decode target's prefix pool, returning that target's name so
+        dispatch prefers it (admission there is an exact pool hit — no
+        prefill program runs on the decode tier, and the tokens are
+        bitwise the colocated engine's). Returns None (plain dispatch)
+        when the fleet has no prefill tier, no seedable decode target, or
+        ANY handoff step fails — degraded latency, never a lost
+        request."""
+        sources = self._rank_prefill()
+        if not sources:
+            return None
+        targets = [(nm, eng) for nm, eng in self._rank()
+                   if self._phases.get(nm, "mixed") != "prefill"
+                   and eng._prefix is not None]
+        if not targets:
+            return None
+        src_nm, src = sources[0]
+        dst_nm, dst = targets[0]
+        try:
+            tok, states = src.prefill_export(fh._prompt)
+            dst.seed_prefix(fh._prompt, states, tok)
+        except BaseException as e:  # noqa: BLE001 — handoff is best-effort
+            self._handoff_failures += 1
+            registry.counter("fleet/handoff_failures").inc()
+            events.record("fleet_handoff_failed", fleet=self.name,
+                          request_id=fh.request_id, trace_id=fh.trace_id,
+                          prefill=src_nm, decode=dst_nm,
+                          error=f"{type(e).__name__}: {e}")
+            return None
+        self._handoffs += 1
+        registry.counter("fleet/handoffs").inc()
+        events.record("fleet_handoff", fleet=self.name,
+                      request_id=fh.request_id, trace_id=fh.trace_id,
+                      prefill=src_nm, decode=dst_nm,
+                      prompt_len=int(fh._prompt.size)
+                      if hasattr(fh._prompt, "size") else len(fh._prompt))
+        return dst_nm
 
     def _kill_replica(self, name: str, engine: ServingEngine) -> None:
         """The ``replica_down`` fault fired for this pick: crash the
@@ -236,12 +385,13 @@ class FleetRouter:
                       in_flight=engine.stats()["active_slots"])
         engine.shutdown(wait=False)
 
-    def _dispatch(self, fh: FleetHandle,
-                  exclude: Optional[str] = None) -> None:
+    def _dispatch(self, fh: FleetHandle, exclude: Optional[str] = None,
+                  prefer: Optional[str] = None) -> None:
         """Submit ``fh`` to the best healthy replica, walking down the
-        ranking on per-replica rejection. Raises the last per-replica
-        error (or :class:`FleetExhausted`) only when NO candidate took
-        it."""
+        ranking on per-replica rejection. ``prefer`` (the handoff's seeded
+        decode target) is tried first — its prefix pool already holds this
+        prompt. Raises the last per-replica error (or
+        :class:`FleetExhausted`) only when NO candidate took it."""
         deadline_ms = fh.remaining_deadline_ms()
         if deadline_ms is not None and deadline_ms <= 0.0:
             self._rejected += 1
@@ -251,6 +401,8 @@ class FleetRouter:
                 f"[trace {fh.trace_id}]")
         errors: dict[str, BaseException] = {}
         candidates = self._rank(exclude)
+        if prefer is not None:
+            candidates.sort(key=lambda t: t[0] != prefer)   # stable
         for nm, eng in candidates:
             if check_fault(faults.SITE_REPLICA_DOWN) is not None:
                 self._kill_replica(nm, eng)
@@ -335,7 +487,8 @@ class FleetRouter:
         fh = FleetHandle(self, prompt, max_new_tokens, request_id,
                          deadline_ms / 1000.0
                          if deadline_ms and deadline_ms > 0 else None)
-        self._dispatch(fh)
+        prefer = self._maybe_handoff(fh)
+        self._dispatch(fh, prefer=prefer)
         return fh
 
     # ------------------------------------------------------------ lifecycle
@@ -343,7 +496,11 @@ class FleetRouter:
         """Router ledger + every replica's ``stats()`` under its name —
         the ``/metrics`` exporter renders these as ``{replica=...}``
         gauges."""
-        reps = {nm: eng.stats() for nm, eng in self._engines.items()}
+        reps = {}
+        for nm, eng in self._engines.items():
+            st = eng.stats()
+            st["phase"] = self._phases.get(nm, "mixed")
+            reps[nm] = st
         return {
             "name": self.name,
             "replicas": reps,
@@ -353,6 +510,9 @@ class FleetRouter:
             "retries": self._retries,
             "replica_downs": self._replica_downs,
             "rejected": self._rejected,
+            "phases": dict(self._phases),
+            "handoffs": self._handoffs,
+            "handoff_failures": self._handoff_failures,
         }
 
     def shutdown(self, wait: bool = True, drain: bool = False) -> None:

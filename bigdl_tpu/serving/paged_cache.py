@@ -1,0 +1,339 @@
+"""Paged KV cache: a fixed-size page pool per attention layer + page tables.
+
+The slot-grid decode cache reserves ``slots × max_len`` K/V rows up front —
+a short sequence in a long-cache engine wastes almost its whole row, and
+resident concurrency is hard-capped at ``slots`` no matter how short the
+traffic runs. This module decouples the LOGICAL layout (one sequence's KV
+history, contiguous positions ``0..depth``) from the PHYSICAL layout
+(fixed-size pages in a shared pool) — the vLLM idea, and the same lesson as
+GSPMD applied to serving memory: keep the program shape static while
+residency scales with *tokens in flight*, not *slots reserved*.
+
+Layout, per causal ``MultiHeadAttention`` layer::
+
+    page_k, page_v : (pages + 1, kv_heads, page_tokens, head_dim)
+    page_table     : (slots, max_len // page_tokens) int32  — physical ids
+    pos            : (slots,) int32                         — per-slot depth
+
+plus the usual ``pos_idx`` per ``PositionEmbedding``. Physical page **0 is
+the reserved trash page**: never allocated, it backs every unallocated
+page-table entry, so free rows riding the decode batch (static shape!)
+scatter their garbage into a page nobody ever attends, and unallocated
+logical pages gather finite junk that the position mask zeroes out exactly.
+
+Three invariants carry the engine's bitwise contract over:
+
+- **Gather-by-page-index is static-shape**: ``page_k[page_table]`` →
+  ``(slots, W, kv_heads, page_tokens, head_dim)`` reshapes to the SAME
+  ``(slots, kv_heads, max_len, head_dim)`` logical view the slot grid holds
+  — one decode program ever, same shape as the unpaged one (the
+  gather-by-index shape of ``parallel/moe.py`` and the sharded embedding
+  lookups).
+- **Masked garbage cannot leak**: every position ``> pos`` gets
+  ``_NEG_INF`` before the softmax (``parallel/ring_attention.py``), so its
+  weight is exactly ``0.0`` and ``0.0 × finite = 0.0`` — which is why
+  :func:`reset_page_slot` ZEROES freed pages on the poison path (NaN is the
+  one value a zero weight does not kill).
+- **Host owns the table**: page allocation/free is host bookkeeping
+  (:class:`PageAllocator`); the device table is refreshed by
+  :func:`with_page_table` before the next tick. Freed rows point at trash
+  BEFORE their pages are handed to anyone else.
+
+``assign_cache_pages`` / ``reset_page_slot`` are the page-granular
+generalizations of ``nn.incremental.assign_cache_slot`` /
+``reset_decode_slot`` — jit-safe with traced page lists, so ONE compiled
+program serves every (slot, page-set) combination and the engine's
+``compiled_programs`` ledger stays bounded by the bucket grid.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from bigdl_tpu.utils import faults
+from bigdl_tpu.utils.faults import check_fault
+from bigdl_tpu.utils.robustness import events
+
+#: paged-cache leaf names (the page-granular analogue of
+#: ``nn.incremental._CACHE_ROW_KEYS``). CONTRACT: a module carrying other
+#: paged decode state must use these names or extend this set.
+_PAGE_POOL_KEYS = ("page_k", "page_v")
+_PAGE_TABLE_KEY = "page_table"
+
+#: physical id of the reserved trash page (never allocated, never attended)
+TRASH_PAGE = 0
+
+
+def logical_pages(max_len: int, page_tokens: int) -> int:
+    """Pages per sequence window (``W``); ``max_len`` must divide evenly so
+    the gathered logical view is EXACTLY the slot-grid shape — a ragged tail
+    page would change the attention shape and break the bitwise contract."""
+    if page_tokens < 1:
+        raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+    if max_len % page_tokens != 0:
+        raise ValueError(
+            f"max_len {max_len} must be a multiple of page_tokens "
+            f"{page_tokens} (the gathered view must tile exactly)")
+    return max_len // page_tokens
+
+
+class PageAllocator:
+    """Host-side free list over physical pages ``1..pages`` (page 0 is the
+    trash page and is never handed out). Thread-safe out of caution; in
+    practice only the owning engine's decode thread allocates.
+
+    ``alloc`` returns None on exhaustion (or when the scripted
+    ``serve_page_alloc`` fault fires) — exhaustion is BACKPRESSURE, not a crash:
+    the engine blocks admission, sheds, or preempts its youngest sequence.
+    """
+
+    def __init__(self, pages: int):
+        if pages < 1:
+            raise ValueError(f"pages must be >= 1, got {pages}")
+        self.pages = int(pages)
+        self._free = list(range(1, self.pages + 1))
+        self._lock = threading.Lock()
+        self.alloc_failures = 0
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.pages - len(self._free)
+
+    def alloc(self, n: int = 1) -> Optional[list[int]]:
+        """Claim ``n`` pages (lowest ids first — deterministic under test),
+        or None when the pool cannot satisfy the request. All-or-nothing:
+        a partial grant would strand pages on the failure path."""
+        with self._lock:
+            if check_fault(faults.SITE_PAGE_ALLOC) is not None:
+                self.alloc_failures += 1
+                events.record("serving_page_alloc_fault", requested=n,
+                              pages_free=len(self._free))
+                return None
+            if n < 0 or n > len(self._free):
+                self.alloc_failures += 1
+                return None
+            got, self._free = self._free[:n], self._free[n:]
+            return got
+
+    def free(self, pages) -> None:
+        """Return pages to the pool (trash-page padding is skipped). Sorted
+        re-insert keeps allocation order deterministic across recycles."""
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                if p == TRASH_PAGE:
+                    continue
+                if p < 1 or p > self.pages:
+                    raise ValueError(
+                        f"page id {p} outside pool [1, {self.pages}]")
+                if p in self._free:
+                    raise ValueError(f"double free of page {p}")
+                self._free.append(p)
+            self._free.sort()
+
+    def reset(self) -> None:
+        """Every page back to the pool — crash-recovery / weight-swap path,
+        where the engine rebuilds the device state from scratch."""
+        with self._lock:
+            self._free = list(range(1, self.pages + 1))
+
+
+def install_paged_cache(model, slots: int, max_len: int, pages: int,
+                        page_tokens: int, dtype=None, roots=None) -> dict:
+    """Install a paged decode cache into ``model``'s attention/position
+    modules and return the state pytree — the page-pool analogue of
+    ``nn.install_decode_cache(per_slot=True)``. Every attention layer gets
+    its own ``pages + 1``-page pool (page 0 = trash) and a shared-shape
+    ``(slots, W)`` page table; position counters are per-slot, as the
+    continuous-batching engine requires."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.transformerlm.transformerlm import PositionEmbedding
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+    from bigdl_tpu.nn.incremental import iter_modules
+
+    if slots < 1:
+        raise ValueError(f"slots must be >= 1, got {slots}")
+    if pages < 1:
+        raise ValueError(f"pages must be >= 1, got {pages}")
+    w = logical_pages(max_len, page_tokens)
+    dtype = jnp.float32 if dtype is None else dtype
+
+    scope = roots if roots is not None else [model]
+    mods = [m for r in scope for m in iter_modules(r)]
+    attns = [m for m in mods if isinstance(m, MultiHeadAttention)]
+    if not attns:
+        raise ValueError("model has no MultiHeadAttention modules to cache")
+    for mod in attns:
+        if not mod.causal:
+            raise ValueError(
+                "paged decode cache requires causal attention "
+                f"({mod!r} is bidirectional)")
+    pos0 = jnp.zeros((slots,), jnp.int32)
+    table0 = jnp.full((slots, w), TRASH_PAGE, jnp.int32)
+    for mod in attns:
+        kv_h = getattr(mod, "kv_heads", mod.num_heads)
+        mod.set_state({
+            "page_k": jnp.zeros((pages + 1, kv_h, page_tokens,
+                                 mod.head_dim), dtype),
+            "page_v": jnp.zeros((pages + 1, kv_h, page_tokens,
+                                 mod.head_dim), dtype),
+            "page_table": table0,
+            "pos": pos0,
+        })
+    for mod in mods:
+        if isinstance(mod, PositionEmbedding):
+            mod.set_state({"pos_idx": pos0})
+    return model.get_state()
+
+
+def is_paged_state(state) -> bool:
+    """True when ``state`` carries paged-cache leaves anywhere — the guard
+    hook ``reset_decode_slot``/``assign_cache_slot`` use to refuse a paged
+    pytree loudly instead of silently corrupting the pool."""
+    if isinstance(state, dict):
+        if any(k in state for k in _PAGE_POOL_KEYS) \
+                or _PAGE_TABLE_KEY in state:
+            return True
+        return any(is_paged_state(v) for v in state.values())
+    return False
+
+
+def with_page_table(state: dict, table) -> dict:
+    """Return ``state`` with every ``page_table`` leaf replaced by
+    ``table`` — how the host-authoritative table reaches the device before
+    a tick after allocation/free changed it. One shared table: every layer
+    pages identically (same depths, same allocation), so one (slots, W)
+    array serves the whole stack."""
+    import jax
+    import jax.numpy as jnp
+
+    table = jnp.asarray(table, jnp.int32)
+
+    def g(path, leaf):
+        if path and getattr(path[-1], "key", None) == _PAGE_TABLE_KEY:
+            if leaf.shape != table.shape:
+                raise ValueError(
+                    f"page table shape mismatch: state has {leaf.shape}, "
+                    f"injected {table.shape}")
+            return table
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(g, state)
+
+
+def assign_cache_pages(dst_state: dict, src_state: dict, pages, slot,
+                       pos) -> dict:
+    """Scatter a just-prefilled CONTIGUOUS batch-1 cache (``src_state``,
+    the engine's bucket-prefill output) into the page pool: each of the
+    ``W`` logical pages of the source row lands in the physical page
+    ``pages[i]`` names, ``slot``'s table row becomes ``pages``, and its
+    position counters become ``pos`` (the TRUE context length, not the
+    bucket length). Logical pages past the context are backed by the trash
+    page (``pages[i] == 0``): their garbage content is written to a page
+    nobody attends.
+
+    Jit-safe with traced ``pages``/``slot``/``pos`` — one compiled program
+    performs every admission regardless of which pages the allocator chose,
+    the page-granular generalization of ``assign_cache_slot``."""
+    import jax.numpy as jnp
+
+    pages = jnp.asarray(pages, jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
+
+    def assign_attn(d: dict, s: dict) -> dict:
+        ck, cv = s["cache_k"], s["cache_v"]
+        if ck.shape[0] != 1:
+            raise ValueError(
+                f"assign_cache_pages source must be a batch-1 cache, got "
+                f"leading dim {ck.shape[0]}")
+        kv_h, lmax, hd = ck.shape[1:]
+        pk, pv = d["page_k"], d["page_v"]
+        ptok = pk.shape[2]
+        w = d["page_table"].shape[1]
+        if lmax != w * ptok:
+            raise ValueError(
+                f"source cache length {lmax} does not tile the page grid "
+                f"({w} pages × {ptok} tokens) — prefill and paged caches "
+                f"must share max_len")
+        if pages.shape != (w,):
+            raise ValueError(
+                f"pages must be ({w},) physical ids, got {pages.shape}")
+        # (kv_h, W·ptok, hd) → (W, kv_h, ptok, hd): one page per leading row
+        src_k = ck[0].reshape(kv_h, w, ptok, hd).transpose(1, 0, 2, 3)
+        src_v = cv[0].reshape(kv_h, w, ptok, hd).transpose(1, 0, 2, 3)
+        return {
+            "page_k": pk.at[pages].set(src_k.astype(pk.dtype)),
+            "page_v": pv.at[pages].set(src_v.astype(pv.dtype)),
+            "page_table": d["page_table"].at[slot].set(pages),
+            "pos": d["pos"].at[slot].set(pos),
+        }
+
+    def walk(d, s):
+        if isinstance(d, dict):
+            if "page_k" in d:
+                if not (isinstance(s, dict) and "cache_k" in s):
+                    raise ValueError(
+                        "assign_cache_pages source must be a CONTIGUOUS "
+                        "batch-1 cache (install_decode_cache) — got a "
+                        "state without cache_k leaves")
+                return assign_attn(d, s)
+            if "cache_k" in d:
+                raise ValueError(
+                    "assign_cache_pages destination is an UNPAGED slot-grid "
+                    "cache — use assign_cache_slot, or install the paged "
+                    "cache (install_paged_cache)")
+            if "pos_idx" in d:
+                return {**d, "pos_idx": d["pos_idx"].at[slot].set(pos)}
+            return {k: walk(v, s[k] if isinstance(s, dict) else None)
+                    for k, v in d.items()}
+        return d
+
+    return walk(dst_state, src_state)
+
+
+def reset_page_slot(state: dict, pages, slot) -> dict:
+    """Wipe one slot's paged footprint: ZERO the physical pages listed in
+    ``pages`` (finite garbage is masked away, but a poisoned row can hold
+    NaN — and ``0.0 × NaN = NaN`` punches through the mask, so the pages
+    must be scrubbed, exactly like ``reset_decode_slot`` zeroes its row),
+    point the slot's table row at the trash page, and rewind its position
+    counters. Fault-path + recycle hygiene only — never compiled on a
+    clean run.
+
+    Refuses an UNPAGED state loudly: zeroing "pages" of a contiguous cache
+    would silently corrupt other slots' rows (the same loud-refusal
+    contract as ``reset_decode_slot`` on a scalar-pos cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    if not is_paged_state(state):
+        raise ValueError(
+            "reset_page_slot needs a PAGED cache "
+            "(install_paged_cache); this state has no page pool — "
+            "use reset_decode_slot for the slot-grid cache")
+    pages = jnp.asarray(pages, jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+
+    def g(path, leaf):
+        key = path and getattr(path[-1], "key", None)
+        if key in _PAGE_POOL_KEYS:
+            return leaf.at[pages].set(jnp.zeros((), leaf.dtype))
+        if key == _PAGE_TABLE_KEY:
+            return leaf.at[slot].set(
+                jnp.full((leaf.shape[1],), TRASH_PAGE, jnp.int32))
+        if key in ("pos", "pos_idx"):
+            if leaf.ndim != 1:
+                raise ValueError(
+                    "reset_page_slot needs per-slot position counters; "
+                    "this cache has a batch-wide scalar position")
+            return leaf.at[slot].set(0)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(g, state)

@@ -24,11 +24,17 @@ seeds its slot from the pool and prefills only the REMAINDER:
   shape-keyed bucket prefill programs, and an EXACT hit (c == prompt length)
   skips prefill entirely using the entry's stored next-token — the
   ``compiled_programs`` ledger stays at ``len(buckets) + 2``.
-- **LRU over entries, capacity in entries**: each entry holds full cache
-  pytrees (per layer: 2 × max_len × kv_heads × head_dim × dtype, times two
-  models when speculative decoding is on), so the budget knob
-  (``BIGDL_PREFIX_POOL``) counts entries, not bytes — see docs/serving.md
-  for sizing arithmetic.
+- **Page-truncated storage**: an entry stores only the first
+  ``ceil(L / page)`` pages of each cache-row leaf (``page`` defaults to the
+  chunk size; a paged engine passes its ``page_tokens``), not the whole
+  ``max_len`` window — pool memory scales with PREFIX length, not cache
+  length. :meth:`seeded` zero-pads the rows back to full length before
+  use; the restored rows sit at positions ``>= L`` that are never attended
+  (the bucket-padding invariant), so pooled serving stays bitwise.
+- **LRU over entries, capacity in entries**: the budget knob
+  (``BIGDL_PREFIX_POOL``) counts entries, not bytes; ``stats()['bytes']``
+  reports the resident footprint (exported as a tenant gauge by the obs
+  plane) — see docs/serving.md for sizing arithmetic.
 
 Correctness does not rest on the hash: a candidate hit is verified by exact
 token comparison before use, so a collision degrades to a miss, never to
@@ -53,18 +59,54 @@ def _digest(tokens: np.ndarray) -> bytes:
                         .tobytes()).digest()
 
 
+def _trim_states(states: tuple, page: int, n: int) -> tuple[tuple, int]:
+    """Truncate every cache-row leaf to its first ``ceil(n / page)`` pages
+    along the length axis. Returns ``(trimmed_states, full_len)`` where
+    ``full_len`` is the original row count (0 when nothing was trimmed —
+    the leaves were already within the kept window)."""
+    import jax
+
+    from bigdl_tpu.nn.incremental import _CACHE_ROW_KEYS, _leaf_key
+
+    kept = -(-n // page) * page
+    full = [0]
+
+    def g(path, leaf):
+        if _leaf_key(path) in _CACHE_ROW_KEYS \
+                and getattr(leaf, "ndim", 0) >= 3 \
+                and leaf.shape[-2] > kept:
+            full[0] = max(full[0], leaf.shape[-2])
+            return leaf[..., :kept, :]
+        return leaf
+
+    out = tuple(jax.tree_util.tree_map_with_path(g, s) for s in states)
+    return out, full[0]
+
+
+def _states_nbytes(states: tuple) -> int:
+    import jax
+
+    return sum(int(getattr(leaf, "nbytes", 0))
+               for s in states for leaf in jax.tree_util.tree_leaves(s))
+
+
 class PrefixEntry:
     """One pooled prefix: the token content, the filled batch-1 cache
-    state(s) — one pytree per model when the engine runs a draft model too —
-    and the greedy next-token after the full context (the exact-hit
-    fast path)."""
+    state(s) — one pytree per model when the engine runs a draft model too,
+    cache rows page-truncated to the prefix length — and the greedy
+    next-token after the full context (the exact-hit fast path).
+    ``full_len`` remembers the untrimmed row count so :meth:`PrefixPool.
+    seeded` can zero-pad the rows back (0 = stored untrimmed)."""
 
-    __slots__ = ("tokens", "states", "next_token")
+    __slots__ = ("tokens", "states", "next_token", "full_len", "nbytes")
 
-    def __init__(self, tokens: np.ndarray, states: tuple, next_token: int):
+    def __init__(self, tokens: np.ndarray, states: tuple, next_token: int,
+                 full_len: int = 0):
         self.tokens = np.asarray(tokens, np.int32)
         self.states = tuple(states)
         self.next_token = int(next_token)
+        self.full_len = int(full_len)
+        self.nbytes = int(self.tokens.nbytes) + _states_nbytes(self.states)
 
     def __len__(self):
         return int(self.tokens.size)
@@ -75,13 +117,20 @@ class PrefixPool:
     hashes. Thread-safe out of caution; in practice only the owning engine's
     decode thread touches it."""
 
-    def __init__(self, capacity: int, chunk: int = 16):
+    def __init__(self, capacity: int, chunk: int = 16,
+                 page: Optional[int] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if page is not None and page < 1:
+            raise ValueError(f"page must be >= 1, got {page}")
         self.capacity = int(capacity)
         self.chunk = int(chunk)
+        # storage granularity for cache rows: a paged engine passes its
+        # page_tokens so pooled pages mirror allocator pages; otherwise the
+        # chunk size is the natural alignment
+        self.page = int(page) if page is not None else self.chunk
         # full-length digest -> entry, LRU order (oldest first)
         self._entries: "OrderedDict[bytes, PrefixEntry]" = OrderedDict()
         # prefix-boundary digest -> full-length digest of the NEWEST entry
@@ -144,7 +193,10 @@ class PrefixPool:
         if n < self.chunk:
             return
         full = _digest(ctx)
-        entry = PrefixEntry(ctx, states, next_token)
+        # keep only the first ceil(n / page) pages of cache rows: memory
+        # scales with the prefix, not with max_len
+        states, full_len = _trim_states(states, self.page, n)
+        entry = PrefixEntry(ctx, states, next_token, full_len=full_len)
         with self._lock:
             if full in self._entries:
                 self._entries[full] = entry
@@ -165,17 +217,31 @@ class PrefixPool:
     @staticmethod
     def seeded(entry: PrefixEntry, c: int) -> tuple:
         """The entry's cache state(s) with every position leaf rewritten to
-        ``c`` — ready for the remainder prefill to continue from depth
-        ``c``. K/V rows beyond ``c`` stay as-is: never attended, and
-        overwritten by the remainder (the bucket-padding invariant)."""
+        ``c`` and page-truncated cache rows zero-padded back to their full
+        window — ready for the remainder prefill to continue from depth
+        ``c`` (or to scatter straight into a decode row on an exact hit).
+        Rows beyond the kept pages restore as zeros instead of the original
+        prefill junk: both sit at positions ``>= c`` that are never
+        attended and are overwritten as the sequence grows (the
+        bucket-padding invariant), so pooled tokens stay bitwise."""
         import jax
         import jax.numpy as jnp
 
-        from bigdl_tpu.nn.incremental import _CACHE_POS_KEYS, _leaf_key
+        from bigdl_tpu.nn.incremental import (
+            _CACHE_POS_KEYS, _CACHE_ROW_KEYS, _leaf_key)
+
+        full = entry.full_len
 
         def g(path, leaf):
-            if _leaf_key(path) in _CACHE_POS_KEYS:
+            key = _leaf_key(path)
+            if key in _CACHE_POS_KEYS:
                 return jnp.full(leaf.shape, c, leaf.dtype)
+            if full and key in _CACHE_ROW_KEYS \
+                    and getattr(leaf, "ndim", 0) >= 3 \
+                    and leaf.shape[-2] < full:
+                pad = [(0, 0)] * leaf.ndim
+                pad[-2] = (0, full - leaf.shape[-2])
+                return jnp.pad(leaf, pad)
             return leaf
 
         return tuple(jax.tree_util.tree_map_with_path(g, s)
@@ -200,8 +266,12 @@ class PrefixPool:
                 "entries": len(self._entries),
                 "capacity": self.capacity,
                 "chunk": self.chunk,
+                "page": self.page,
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "tokens_saved": self.tokens_saved,
+                # resident footprint of the page-truncated entries (tokens
+                # + cache pytrees) — the obs exporter's prefix_bytes gauge
+                "bytes": sum(e.nbytes for e in self._entries.values()),
             }

@@ -64,14 +64,17 @@ def pick_seed_bucket(n: int, buckets: Sequence[int], base: int,
 
 
 class Slot:
-    """One decode-batch row: which request owns it and the last token fed."""
+    """One decode-batch row: which request owns it, the last token fed, and
+    the sequence depth (context + generated — the device-side ``pos``
+    mirror the paged engine's host allocator sizes pages from)."""
 
-    __slots__ = ("index", "request", "last_token")
+    __slots__ = ("index", "request", "last_token", "depth")
 
     def __init__(self, index: int):
         self.index = index
         self.request: Optional[Request] = None
         self.last_token: int = 0
+        self.depth: int = 0
 
 
 class SlotScheduler:
@@ -117,6 +120,7 @@ class SlotScheduler:
         slot = self._slots[self._free.pop(0)]
         slot.request = request
         slot.last_token = 0
+        slot.depth = 0
         if slot.index in self._ever_used:
             self._recycles += 1     # a finished sequence's row, reassigned
         self._ever_used.add(slot.index)
@@ -132,6 +136,7 @@ class SlotScheduler:
         for s in self._slots:
             s.request = None
             s.last_token = 0
+            s.depth = 0
         self._free = list(range(self.num_slots))
         return evicted
 
@@ -142,4 +147,5 @@ class SlotScheduler:
             raise RuntimeError(f"slot {slot.index} is already free")
         slot.request = None
         slot.last_token = 0
+        slot.depth = 0
         self._free.append(slot.index)

@@ -93,6 +93,12 @@ site                      where it fires
                           before swapping the previous version back —
                           ``error`` fails the attempt (retried within the
                           rollback budget), ``stall`` delays it
+``serve_page_alloc``      the paged KV cache's page allocator
+                          (``serving/paged_cache.py`` ``PageAllocator.alloc``)
+                          — any action makes that allocation report
+                          exhaustion (returns no pages), driving the
+                          backpressure / shed / preemption paths without
+                          actually filling the pool
 ========================  ====================================================
 
 A plan is a ``;``-separated list of entries ``site@N`` or ``site@N=action``.
@@ -148,6 +154,9 @@ SITE_HOST_DOWN = "host_down"
 SITE_PROMOTE_EVAL = "promote_eval"
 SITE_PROMOTE_SWAP = "promote_swap"
 SITE_PROMOTE_ROLLBACK = "promote_rollback"
+#: paged-serving drill: the Nth page allocation reports pool exhaustion —
+#: the backpressure/shed/preemption paths without filling the pool for real
+SITE_PAGE_ALLOC = "serve_page_alloc"
 
 #: sites whose plan entries match the caller-supplied ``index`` (training
 #: iteration) instead of the site's hit counter
@@ -177,6 +186,7 @@ _DEFAULT_ACTION = {
     SITE_PROMOTE_EVAL: "error",
     SITE_PROMOTE_SWAP: "error",
     SITE_PROMOTE_ROLLBACK: "error",
+    SITE_PAGE_ALLOC: "error",
 }
 
 _KNOWN_ACTIONS = frozenset({"error", "death", "nan", "sigterm", "torn",

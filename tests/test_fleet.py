@@ -295,8 +295,9 @@ class TestPrefixPool:
                            buckets=(8, 16, 32), max_len=64)
         assert miss is None
         assert pool.stats() == {
-            "entries": 1, "capacity": 4, "chunk": 8, "hits": 2,
-            "misses": 1, "evictions": 0, "tokens_saved": 40}
+            "entries": 1, "capacity": 4, "chunk": 8, "page": 8, "hits": 2,
+            "misses": 1, "evictions": 0, "tokens_saved": 40,
+            "bytes": ctx.nbytes}   # opaque states carry no nbytes
 
     def test_pool_unit_hit_needs_seedable_bucket(self):
         """A partial hit is only usable when the remainder fits a bucket

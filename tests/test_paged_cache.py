@@ -1,0 +1,394 @@
+"""Paged KV cache + disaggregated serving (serving/paged_cache.py).
+
+The contracts under test, in dependency order:
+
+- **PageAllocator**: deterministic free-list bookkeeping — all-or-nothing
+  allocation, lowest-id-first reuse, double-free/out-of-range rejection,
+  and conservation (used + free == pool) under randomized alloc/free
+  storms. No device involved.
+- **Paged == slot grid, bitwise**: the same scripted mixed-length traffic
+  trace through a paged engine and a slot-grid engine yields identical
+  tokens, with the paged ``compiled_programs`` ledger still at
+  ``len(buckets) + 2`` and the pool fully drained after the trace.
+- **Backpressure, not deadlock**: a pool too small for the offered load
+  preempts the youngest sequence (requeue + re-prefill), and every request
+  still finishes with oracle-identical tokens.
+- **Disaggregated handoff**: ``prefill_export`` on one engine +
+  ``seed_prefix`` on another makes the decode replica resume bitwise from
+  the handed-off pages (an exact prefix-pool hit — no prefill program runs
+  there), and a ``phases="prefill,decode"`` fleet serves bitwise
+  end-to-end.
+- **Speculative decoding over paged state**: the k+1 verify chunk written
+  through the page table emits exactly ``nn.greedy_generate``'s tokens at
+  any acceptance rate.
+- **Rollback knob**: BIGDL_KV_PAGED=0 forces the slot grid even when
+  ``pages`` asks for a pool.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.models.transformerlm import TransformerLM
+from bigdl_tpu.serving import EngineOverloaded, FleetRouter, ServingEngine
+from bigdl_tpu.serving.paged_cache import (
+    TRASH_PAGE, PageAllocator, logical_pages,
+)
+from bigdl_tpu.serving.prefix_cache import PrefixPool
+
+pytestmark = [pytest.mark.serving, pytest.mark.paged]
+
+VOCAB = 50
+BUCKETS = (8, 16, 32)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return TransformerLM(VOCAB, embed_dim=16, num_heads=2, num_layers=2,
+                         max_len=48).evaluate()
+
+
+@pytest.fixture(scope="module")
+def draft():
+    return TransformerLM(VOCAB, embed_dim=16, num_heads=2, num_layers=1,
+                         max_len=48).evaluate()
+
+
+def _prompt(seed, n):
+    return np.random.default_rng(seed).integers(
+        0, VOCAB, (n,)).astype(np.int32)
+
+
+def _oracle(model, prompt, steps):
+    return np.asarray(
+        nn.greedy_generate(model, jnp.asarray(prompt)[None, :], steps))[0]
+
+
+# ---------------------------------------------------- allocator properties
+class TestPageAllocator:
+    def test_alloc_is_deterministic_lowest_first(self):
+        a = PageAllocator(8)
+        assert a.alloc(3) == [1, 2, 3]
+        assert a.alloc(2) == [4, 5]
+        a.free([2, 4])
+        # freed ids come back lowest-first, regardless of free order
+        assert a.alloc(2) == [2, 4]
+
+    def test_alloc_all_or_nothing(self):
+        a = PageAllocator(4)
+        got = a.alloc(3)
+        assert got == [1, 2, 3]
+        assert a.alloc(2) is None          # only 1 free: nothing handed out
+        assert a.free_count == 1           # the failed alloc took none
+        assert a.alloc(1) == [4]
+
+    def test_double_free_and_out_of_range_rejected(self):
+        a = PageAllocator(4)
+        pages = a.alloc(2)
+        a.free(pages)
+        with pytest.raises(ValueError):
+            a.free(pages)                  # double free
+        with pytest.raises(ValueError):
+            a.free([99])                   # never existed
+        with pytest.raises(ValueError):
+            a.free([1])                    # free page freed again
+
+    def test_trash_page_never_allocated(self):
+        a = PageAllocator(3)
+        got = a.alloc(3)
+        assert TRASH_PAGE not in got
+        assert a.alloc(1) is None
+
+    def test_random_storm_conserves_pool(self):
+        """Randomized alloc/free storm: used + free == pool at every step,
+        no page is ever held twice, and after freeing everything the pool
+        is byte-for-byte back at its initial state (no leak, bounded
+        fragmentation: a full free always re-enables a full alloc)."""
+        rng = np.random.default_rng(7)
+        pool = 32
+        a = PageAllocator(pool)
+        held: list[list[int]] = []
+        for _ in range(600):
+            if rng.random() < 0.55:
+                n = int(rng.integers(1, 5))
+                got = a.alloc(n)
+                if got is not None:
+                    assert len(got) == n
+                    held.append(got)
+            elif held:
+                a.free(held.pop(int(rng.integers(len(held)))))
+            flat = [p for grp in held for p in grp]
+            assert len(flat) == len(set(flat))          # no double-hand-out
+            assert a.used_count == len(flat)
+            assert a.used_count + a.free_count == pool  # conservation
+            assert TRASH_PAGE not in flat
+        for grp in held:
+            a.free(grp)
+        assert a.free_count == pool
+        assert a.alloc(pool) == list(range(1, pool + 1))  # defragmented
+
+    def test_logical_pages_validates_divisibility(self):
+        assert logical_pages(48, 16) == 3
+        with pytest.raises(ValueError):
+            logical_pages(50, 16)
+
+
+# ------------------------------------------------ paged vs slot grid (A/B)
+class TestPagedBitwise:
+    def test_scripted_trace_bitwise_vs_slot_grid(self, lm):
+        """The acceptance pin: one scripted mixed-length trace, two
+        engines. Tokens must match bitwise, the paged ledger must stay at
+        len(buckets) + 2, and the pool must drain to zero."""
+        prompts = [_prompt(100 + i, n) for i, n in enumerate(
+            [3, 7, 12, 17, 25, 5, 30, 9, 14, 21, 4, 28])]
+        news = [6, 8, 4, 10, 6, 12, 5, 8, 6, 4, 9, 6]
+
+        def trace(**kw):
+            with ServingEngine(lm, max_len=48, slots=4, buckets=BUCKETS,
+                               **kw) as eng:
+                outs = []
+                for wave in range(0, len(prompts), 4):
+                    hs = [eng.submit(p, n) for p, n in
+                          zip(prompts[wave:wave + 4], news[wave:wave + 4])]
+                    outs.extend(h.result(timeout=120).tokens for h in hs)
+                st = eng.stats()
+            return outs, st
+
+        grid, _ = trace()
+        paged, st = trace(pages=12, page_tokens=16)
+        for g, p in zip(grid, paged):
+            assert np.array_equal(g, p)
+        assert st["paged"] is True
+        assert st["compiled_programs"] <= st["program_grid_bound"]
+        assert st["pages_used"] == 0            # drained: nothing leaked
+        assert st["free_page_ratio"] == 1.0
+
+    def test_pool_exhaustion_preempts_youngest_and_completes(self, lm):
+        """4-page pool, two 17-token sequences: both admit (2 content
+        pages each), and the first row to outgrow its pages forces a
+        youngest-first preemption. The evicted request re-prefills and
+        every token still matches the oracle — backpressure, never a lost
+        or corrupted future."""
+        p1, p2 = _prompt(201, 17), _prompt(202, 17)
+        with ServingEngine(lm, max_len=48, slots=2, buckets=BUCKETS,
+                           pages=4, page_tokens=16) as eng:
+            h1 = eng.submit(p1, 17)    # writes position 32: needs page 3
+            h2 = eng.submit(p2, 17)
+            r1 = h1.result(timeout=120)
+            r2 = h2.result(timeout=120)
+            st = eng.stats()
+        assert st["page_evictions"] >= 1
+        assert np.array_equal(r1.tokens[17:], _oracle(lm, p1, 17)[17:])
+        assert np.array_equal(r2.tokens[17:], _oracle(lm, p2, 17)[17:])
+        assert st["pages_used"] == 0
+
+    def test_oversized_request_rejected_at_submit(self, lm):
+        with ServingEngine(lm, max_len=48, slots=2, buckets=BUCKETS,
+                           pages=2, page_tokens=16) as eng:
+            with pytest.raises(ValueError, match="pages"):
+                eng.submit(_prompt(203, 20), 20)   # needs 3 of 2 pages
+
+    def test_shed_mode_reports_pages_free(self, lm):
+        """Shed overload: a submit the pool cannot back right now raises
+        EngineOverloaded carrying pages_free — the router's signal that
+        this is memory pressure, not queue depth."""
+        with ServingEngine(lm, max_len=48, slots=2, buckets=BUCKETS,
+                           pages=3, page_tokens=16,
+                           overload="shed") as eng:
+            h = eng.submit(_prompt(204, 17), 12)   # holds >= 2 pages
+            deadline = time.perf_counter() + 60
+            while eng.stats()["pages_used"] < 2:
+                assert time.perf_counter() < deadline
+                time.sleep(0.005)
+            with pytest.raises(EngineOverloaded) as ei:
+                for _ in range(50):
+                    eng.submit(_prompt(205, 30), 8)   # needs 2+ free
+                    time.sleep(0.01)
+            assert ei.value.pages_free is not None
+            h.result(timeout=120)
+
+    def test_kv_paged_zero_forces_slot_grid(self, lm, monkeypatch):
+        monkeypatch.setenv("BIGDL_KV_PAGED", "0")
+        with ServingEngine(lm, max_len=48, slots=2, buckets=BUCKETS,
+                           pages=12, page_tokens=16) as eng:
+            st = eng.stats()
+            assert st["paged"] is False
+            assert st["pages_total"] == 0
+            p = _prompt(206, 9)
+            out = eng.submit(p, 6).result(timeout=120).tokens
+        assert np.array_equal(out[9:], _oracle(lm, p, 6)[9:])
+
+    def test_free_page_ratio_in_stats(self, lm):
+        with ServingEngine(lm, max_len=48, slots=4, buckets=BUCKETS) as eng:
+            assert eng.stats()["free_page_ratio"] == 1.0   # legacy: slots
+        with ServingEngine(lm, max_len=48, slots=4, buckets=BUCKETS,
+                           pages=10, page_tokens=16) as eng:
+            p = _prompt(207, 17)
+            h = eng.submit(p, 12)
+            deadline = time.perf_counter() + 60
+            while eng.stats()["pages_used"] < 2:
+                assert time.perf_counter() < deadline
+                time.sleep(0.005)
+            st = eng.stats()
+            assert st["free_page_ratio"] < 1.0
+            assert st["free_page_ratio"] == round(
+                st["pages_free"] / st["pages_total"], 4)
+            h.result(timeout=120)
+
+
+# --------------------------------------------------- disaggregated handoff
+class TestDisaggregatedHandoff:
+    def test_prefill_export_seed_prefix_resumes_bitwise(self, lm):
+        """The handoff primitive pair: prefill on engine A, decode on
+        engine B. B's admission is an exact prefix-pool hit (prefix_hits
+        == 1, no prefill bucket program compiles there), and the tokens
+        are bitwise the oracle's — the pooled-pages resume IS a correct
+        continuation."""
+        p = _prompt(300, 14)
+        with ServingEngine(lm, max_len=48, slots=2, buckets=BUCKETS,
+                           name="pre") as a, \
+                ServingEngine(lm, max_len=48, slots=2, buckets=BUCKETS,
+                              pages=8, page_tokens=16, prefix_pool=4,
+                              prefix_chunk=8, name="dec") as b:
+            tok, states = a.prefill_export(p)
+            b.seed_prefix(p, states, tok)
+            out = b.submit(p, 8).result(timeout=120).tokens
+            st = b.stats()
+        assert np.array_equal(out[14:], _oracle(lm, p, 8)[14:])
+        assert st["prefix_hits"] == 1
+        # the exact hit ran no prefill program: only decode + assign
+        assert not any(k[0].startswith("serve_prefill")
+                       for k in b._programs)
+
+    def test_phase_fleet_serves_bitwise_with_handoffs(self, lm):
+        prompts = [_prompt(310 + i, n) for i, n in
+                   enumerate([5, 11, 17, 23, 8, 14])]
+        fleet = FleetRouter.replicate(
+            lm, max_len=48, replicas=2, slots=2, buckets=BUCKETS,
+            name="pgfleet", phases="prefill,decode", prefix_pool=8,
+            prefix_chunk=8)
+        try:
+            hs = [fleet.submit(p, 6) for p in prompts]
+            outs = [h.result(timeout=120).tokens for h in hs]
+            st = fleet.stats()
+        finally:
+            fleet.shutdown()
+        for p, o in zip(prompts, outs):
+            assert np.array_equal(o[p.size:], _oracle(lm, p, 6)[p.size:])
+        assert st["handoffs"] >= 1
+        assert st["handoff_failures"] == 0
+        assert st["phases"] == {"pgfleet-r0": "prefill",
+                                "pgfleet-r1": "decode"}
+
+    def test_rank_puts_memory_starved_replicas_last(self):
+        """free_page_ratio == 0 outranks a longer queue: the router must
+        stop preferring a replica with no memory headroom even when its
+        queue looks shorter."""
+        class Stub:
+            def __init__(self, st):
+                self._st = st
+
+            def stats(self):
+                return dict(self._st)
+
+        starved = Stub({"health": "ready", "queue_depth": 0,
+                        "active_slots": 0, "est_wait_ms": 0.0,
+                        "free_page_ratio": 0.0})
+        busy = Stub({"health": "ready", "queue_depth": 5,
+                     "active_slots": 2, "est_wait_ms": 9.0,
+                     "free_page_ratio": 0.5})
+        fleet = FleetRouter.__new__(FleetRouter)
+        fleet._engines = {"a": starved, "b": busy}
+        fleet._phases = {"a": "mixed", "b": "mixed"}
+        order = [nm for nm, _ in fleet._rank()]
+        assert order == ["b", "a"]
+
+    def test_all_prefill_fleet_rejected(self, lm):
+        with pytest.raises(ValueError, match="decode-capable"):
+            FleetRouter.replicate(lm, max_len=48, replicas=2, slots=2,
+                                  buckets=BUCKETS, name="allpre",
+                                  phases="prefill")
+
+
+# -------------------------------------------------- speculation over pages
+class TestSpeculativePaged:
+    def test_spec_over_paged_bitwise_full_acceptance(self, lm):
+        """draft == target pins acceptance near 100%: the k+1 verify chunk
+        is written through the page table every round, and the tokens must
+        still be exactly greedy."""
+        prompts = [_prompt(400 + i, n) for i, n in enumerate([4, 9, 15])]
+        with ServingEngine(lm, max_len=48, slots=3, buckets=BUCKETS,
+                           draft_model=lm, spec_tokens=3,
+                           pages=10, page_tokens=16) as eng:
+            hs = [eng.submit(p, 8) for p in prompts]
+            outs = [h.result(timeout=120).tokens for h in hs]
+            st = eng.stats()
+        for p, o in zip(prompts, outs):
+            assert np.array_equal(o[p.size:], _oracle(lm, p, 8)[p.size:])
+        assert st["spec_acceptance"] > 0.5
+        assert st["compiled_programs"] <= st["program_grid_bound"]
+        assert st["pages_used"] == 0
+
+    def test_spec_over_paged_bitwise_low_acceptance(self, lm, draft):
+        """An independent draft mostly disagrees — every round rewinds —
+        and the output must STILL be bitwise greedy (the speculative
+        contract at any acceptance rate, now over paged state)."""
+        prompts = [_prompt(410 + i, n) for i, n in enumerate([6, 13])]
+        with ServingEngine(lm, max_len=48, slots=2, buckets=BUCKETS,
+                           draft_model=draft, spec_tokens=3,
+                           pages=8, page_tokens=16) as eng:
+            hs = [eng.submit(p, 8) for p in prompts]
+            outs = [h.result(timeout=120).tokens for h in hs]
+            st = eng.stats()
+        for p, o in zip(prompts, outs):
+            assert np.array_equal(o[p.size:], _oracle(lm, p, 8)[p.size:])
+        assert st["pages_used"] == 0
+
+
+# --------------------------------------------------- prefix pool footprint
+class TestPrefixPoolPaging:
+    def _states(self, rows=48):
+        return ({"attn": {"cache_k": jnp.ones((1, 2, rows, 8)),
+                          "cache_v": jnp.ones((1, 2, rows, 8)),
+                          "pos": jnp.zeros((1,), jnp.int32)}},)
+
+    def test_insert_stores_only_prefix_pages(self):
+        pool = PrefixPool(4, chunk=8, page=16)
+        ctx = _prompt(500, 10)
+        pool.insert(ctx, self._states(), 3)
+        entry = next(iter(pool._entries.values()))
+        # ceil(10 / 16) = 1 page of 16 rows kept, not the 48-row window
+        assert entry.states[0]["attn"]["cache_k"].shape[-2] == 16
+        assert entry.full_len == 48
+        full_bytes = sum(
+            int(x.nbytes) for x in
+            (self._states()[0]["attn"]["cache_k"],
+             self._states()[0]["attn"]["cache_v"]))
+        assert pool.stats()["bytes"] < full_bytes   # scales with prefix
+
+    def test_seeded_rehydrates_full_window(self):
+        pool = PrefixPool(4, chunk=8, page=16)
+        ctx = _prompt(501, 10)
+        pool.insert(ctx, self._states(), 3)
+        entry = next(iter(pool._entries.values()))
+        states = PrefixPool.seeded(entry, 10)
+        ck = states[0]["attn"]["cache_k"]
+        assert ck.shape[-2] == 48                    # restored
+        assert np.all(np.asarray(ck[..., :16, :]) == 1.0)   # kept rows
+        assert np.all(np.asarray(ck[..., 16:, :]) == 0.0)   # zero-padded
+        assert int(states[0]["attn"]["pos"][0]) == 10
+
+    def test_bytes_exported_in_engine_stats(self, lm):
+        with ServingEngine(lm, max_len=48, slots=2, buckets=BUCKETS,
+                           prefix_pool=4, prefix_chunk=8) as eng:
+            p = _prompt(502, 12)
+            a = eng.submit(p, 6).result(timeout=120).tokens
+            b = eng.submit(p, 6).result(timeout=120).tokens  # exact hit
+            st = eng.stats()
+        assert np.array_equal(a, b)                  # hydrated hit bitwise
+        assert st["prefix_hits"] >= 1
+        assert st["prefix_bytes"] > 0

@@ -237,3 +237,50 @@ class TestStallDeadlineWatchdog:
             assert plan.unfired() == []
         assert not any(t.name.startswith("bigdl-serve") and t.is_alive()
                        for t in threading.enumerate())
+
+
+# ------------------------------------------------------ page-pool pressure
+class TestPageAllocFaults:
+    """``serve_page_alloc`` (utils/faults.py): an injected allocator
+    exhaustion must surface as graceful backpressure — the request waits
+    and then completes bitwise — never as a crash or a lost future."""
+
+    def test_alloc_fault_at_admission_backpressures_then_serves(self, lm):
+        """The FIRST page allocation reports exhaustion: admission returns
+        the request to the head of the queue, the next loop pass allocates
+        for real, and the tokens match the oracle exactly."""
+        c0 = events.counts()
+        p = _prompt(470, 9)
+        with inject_faults("serve_page_alloc@1") as plan:
+            with ServingEngine(lm, max_len=48, slots=2, buckets=(16,),
+                               pages=6, page_tokens=16) as eng:
+                r = eng.submit(p, 6).result(timeout=180)
+                st = eng.stats()
+            assert plan.unfired() == []
+        assert np.array_equal(
+            np.asarray(r.tokens[9:]), _oracle(lm, p, 6)[9:])
+        assert st["pages_used"] == 0          # drained clean afterwards
+        d = events.deltas(c0)
+        assert d.get("serving_page_alloc_fault", 0) == 1
+        assert d.get("serving_page_backpressure", 0) >= 1
+
+    def test_alloc_fault_midflight_preempts_not_crashes(self, lm):
+        """Exhaustion during decode-time page growth fires the preemption
+        path (youngest requeued, re-prefilled bitwise) instead of killing
+        the engine thread — respawns stays 0 and both requests finish with
+        oracle tokens."""
+        p1, p2 = _prompt(471, 17), _prompt(472, 17)
+        with inject_faults("serve_page_alloc@3") as plan:
+            with ServingEngine(lm, max_len=48, slots=2, buckets=(8, 32),
+                               pages=8, page_tokens=16) as eng:
+                h1 = eng.submit(p1, 17)
+                h2 = eng.submit(p2, 17)
+                r1, r2 = h1.result(timeout=180), h2.result(timeout=180)
+                st = eng.stats()
+            assert plan.unfired() == []
+        assert st["respawns"] == 0
+        assert np.array_equal(
+            np.asarray(r1.tokens[17:]), _oracle(lm, p1, 17)[17:])
+        assert np.array_equal(
+            np.asarray(r2.tokens[17:]), _oracle(lm, p2, 17)[17:])
+        assert st["pages_used"] == 0

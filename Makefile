@@ -1,6 +1,6 @@
 # Developer entry points (reference build-system analog, SURVEY.md §2.5 L8).
 SHELL := /bin/bash
-.PHONY: test t1 t1-faults t1-obs t1-kernels t1-serving t1-serving-faults t1-streaming t1-fleet t1-recsys t1-elastic t1-promotion t1-paged dist bench bench-smoke bench-pipeline multichip clean
+.PHONY: test t1 t1-faults t1-obs t1-cluster-obs t1-kernels t1-serving t1-serving-faults t1-streaming t1-fleet t1-recsys t1-elastic t1-promotion t1-paged dist bench bench-smoke bench-pipeline multichip clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -29,6 +29,14 @@ t1-faults:
 # runs these too; this is the fast inner loop for obs work.
 t1-obs:
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Cluster-telemetry suite only (docs/observability.md "Cluster aggregation"):
+# spool merge + {host=} round-trip, the 2-process gloo drill with the
+# SIGKILL-one-host stale degrade, device-memory gauges, /profilez routes,
+# access-log → .bdlrec replay. `-m obs` (and make t1) run these too; this
+# target is the focused loop.
+t1-cluster-obs:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_cluster_obs.py -q --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Kernel-equivalence suite only (docs/performance.md "Kernel fusion & memory"):
 # fused conv-bn(-relu) vs unfused fp32 bitwise, flat-param SGD/Adam updates vs

@@ -956,6 +956,60 @@ def _measure_obs(batch: int, iters: int) -> dict:
                           "bigdl_train_model_flops_per_sec")
                     for k in parsed)}
 
+    def cluster_leg() -> dict:
+        """The SAME untraced loop with the whole cluster-obs plane live:
+        DeviceMonitor polling at 0.2 s, the snapshot spool appending at
+        0.2 s, and the access log absorbing ~100 request records/sec (a
+        side thread standing in for a busy serving engine — the trainer
+        itself writes no access records). Everything-on must clear the
+        same <3% gate as the tracer."""
+        import threading
+
+        from bigdl_tpu.obs import access_log as obs_access_log
+        from bigdl_tpu.obs import cluster as obs_cluster
+        from bigdl_tpu.obs import device as obs_device
+
+        spool_dir = os.path.join(tmp, "spool")
+        log_dir = os.path.join(tmp, "alog")
+        saved = os.environ.get("BIGDL_ACCESS_LOG")
+        os.environ["BIGDL_ACCESS_LOG"] = log_dir
+        obs_access_log.reset()
+        mon = obs_device.DeviceMonitor(interval_s=0.2).start()
+        writer = obs_cluster.SpoolWriter(spool_dir, host="bench",
+                                         interval_s=0.2).start()
+        stop_evt = threading.Event()
+
+        def spam_log():
+            while not stop_evt.is_set():
+                obs_access_log.log_request(
+                    trace_id="bench", tenant="bench", phase="decode",
+                    prompt_tokens=128, output_tokens=64, ttft_ms=1.0,
+                    e2e_ms=2.0, flops=1e9, outcome="ok")
+                stop_evt.wait(0.01)
+
+        th = threading.Thread(target=spam_log, daemon=True)
+        th.start()
+        try:
+            ips = leg(False)
+        finally:
+            stop_evt.set()
+            th.join(timeout=5)
+            mon.stop()
+            writer.stop()
+            alog = obs_access_log.from_env()
+            records = alog.records if alog is not None else 0
+            log_ok = alog is not None and not alog.disabled
+            obs_access_log.reset()
+            if saved is None:
+                os.environ.pop("BIGDL_ACCESS_LOG", None)
+            else:
+                os.environ["BIGDL_ACCESS_LOG"] = saved
+        spooled = obs_cluster.read_spools(spool_dir, stale_after_s=3600.0)
+        return {"ips": ips, "records": records, "log_ok": log_ok,
+                "device_polls": mon.polls, "spool_writes": writer.writes,
+                "spool_valid": ("bench" in spooled
+                                and not spooled["bench"]["stale"])}
+
     try:
         off_a = leg(False)
         traced_a = leg(True)
@@ -978,12 +1032,17 @@ def _measure_obs(batch: int, iters: int) -> dict:
         traced_b = leg(True)
         trace.reset()
         exp_b = exporter_leg()
+        cl_a = cluster_leg()
+        cl_b = cluster_leg()
     finally:
         trace.reset()
         shutil.rmtree(tmp, ignore_errors=True)
     off_ips = max(off_a, off_b)
     traced_ips = max(traced_a, traced_b)
     exp_ips = max(exp_a["ips"], exp_b["ips"])
+    cl_ips = max(cl_a["ips"], cl_b["ips"])
+    cl_leg = cl_a if cl_a["log_ok"] and cl_a["spool_valid"] else cl_b
+    cl_overhead = max(0.0, 1.0 - cl_ips / off_ips) if off_ips else 0.0
     exp_leg = exp_a if (exp_a["parse_ok"] and exp_a["error"] is None) \
         else exp_b
     exp_leg["scrapes"] = exp_a["scrapes"] + exp_b["scrapes"]
@@ -1013,6 +1072,16 @@ def _measure_obs(batch: int, iters: int) -> dict:
                                       and exp_leg["has_train_metrics"]
                                       and exp_leg["error"] is None),
         "exporter_has_mfu_gauge": exp_leg["has_mfu_gauge"],
+        # everything-on leg: DeviceMonitor + access log + snapshot spool
+        # together must clear the same <3% gate
+        "access_log_images_per_sec": round(cl_ips, 1),
+        "access_log_records": cl_leg["records"],
+        "access_log_ok": bool(cl_leg["log_ok"]),
+        "access_log_overhead_pct": round(100.0 * cl_overhead, 2),
+        "access_log_overhead_ok": cl_overhead < 0.03,
+        "cluster_device_polls": cl_leg["device_polls"],
+        "cluster_spool_writes": cl_leg["spool_writes"],
+        "cluster_spool_valid": bool(cl_leg["spool_valid"]),
     }
 
 
@@ -2437,6 +2506,28 @@ def _obs_record() -> dict:
     return out
 
 
+def _device_memory_record() -> dict:
+    """Per-device HBM block embedded next to the ``obs`` snapshot in every
+    bench record (degraded path included — memory numbers must never
+    silently vanish; a backend that reports no memory_stats yields
+    ``devices: []``, absent-not-wrong)."""
+    from bigdl_tpu.obs import device as obs_device
+
+    try:
+        devices = obs_device.sample_device_memory(publish=False)
+    except Exception:
+        devices = []
+    return {
+        "devices": [{"id": d["id"],
+                     "hbm_bytes_in_use": d["bytes_in_use"],
+                     "hbm_peak_bytes": d["peak_bytes"],
+                     "hbm_bytes_limit": d["bytes_limit"]}
+                    for d in devices],
+        "hbm_bytes_in_use": sum(d["bytes_in_use"] for d in devices),
+        "hbm_peak_bytes": sum(d["peak_bytes"] or 0 for d in devices),
+    }
+
+
 def run_worker(args) -> None:
     """The measured child process: ONE dtype, one JSON line, exit.
 
@@ -2507,6 +2598,7 @@ def run_worker(args) -> None:
         except Exception as e:
             line["streamed_leg_error"] = f"{type(e).__name__}: {e}"[:300]
     line["obs"] = _obs_record()
+    line["device_memory"] = _device_memory_record()
     print(json.dumps(line))
 
 
@@ -2587,6 +2679,7 @@ def _emit(record: dict, model: str) -> None:
     # built here gets the orchestrator's (usually near-empty — itself a signal
     # that the leg died before measuring anything).
     record.setdefault("obs", _obs_record())
+    record.setdefault("device_memory", _device_memory_record())
     print(json.dumps(record))
 
 
@@ -2978,6 +3071,7 @@ def _run_worker_modes(args) -> int:
         run_worker(args)  # attaches its own end-of-leg obs snapshot
         return 0
     res["obs"] = _obs_record()
+    res["device_memory"] = _device_memory_record()
     print(json.dumps(res))
     return 0
 

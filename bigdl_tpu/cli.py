@@ -203,6 +203,50 @@ def _render_top(metrics: dict, health=None) -> str:
         + "   ttft p99 " + g('bigdl_serving_ttft_ms{quantile="0.99"}', "{:.1f}")
         + "ms   e2e p99 " + g('bigdl_serving_e2e_ms{quantile="0.99"}', "{:.1f}")
         + "ms")
+
+    def gb(name):
+        # bytes gauge → human-readable, "-" when the backend never said
+        v = metrics.get(name)
+        if v is None:
+            return "-"
+        for unit in ("B", "KB", "MB", "GB", "TB"):
+            if abs(v) < 1024.0 or unit == "TB":
+                return f"{v:.1f}{unit}" if unit != "B" else f"{v:.0f}B"
+            v /= 1024.0
+
+    headroom = metrics.get("bigdl_device_hbm_headroom")
+    lines.append(
+        "  device  hbm " + gb("bigdl_device_hbm_bytes_in_use")
+        + "   peak " + gb("bigdl_device_hbm_peak_bytes")
+        + "   headroom " + (f"{100 * headroom:.1f}%"
+                            if headroom is not None else "-")
+        + "   live " + g("bigdl_device_live_buffers", "{:.0f}")
+        + " (" + gb("bigdl_device_live_buffer_bytes") + ")")
+    # cluster view: every {host=}-labelled series from the spool merge
+    hosts: dict = {}
+    hpat = re.compile(r'^(\w+)\{host="([^"]*)"(?:,[^}]*)?\}$')
+    for key, val in metrics.items():
+        m = hpat.match(key)
+        if m:
+            hosts.setdefault(m.group(2), {})[m.group(1)] = val
+    if hosts:
+        lines.append("  hosts")
+        for hid in sorted(hosts):
+            h = hosts[hid]
+
+            def hv(name, fmt="{:.4g}"):
+                v = h.get(name)
+                return fmt.format(v) if v is not None else "-"
+
+            state = ("STALE" if h.get("bigdl_obs_host_up") == 0.0 else "up"
+                     if h.get("bigdl_obs_host_up") is not None else "-")
+            lines.append(
+                f"    {hid:<12} {state:<6}"
+                f" age {hv('bigdl_obs_host_age_seconds', '{:.0f}')}s"
+                f"  thr {hv('bigdl_train_throughput', '{:.1f}')}"
+                f"  mfu {hv('bigdl_train_mfu')}"
+                f"  hbm {hv('bigdl_device_hbm_bytes_in_use', '{:.3g}')}"
+                f"  headroom {hv('bigdl_device_hbm_headroom')}")
     tenants: dict = {}
     pat = re.compile(r'^bigdl_serving_tenant_(\w+)\{tenant="([^"]*)"\}$')
     for key, val in metrics.items():
@@ -274,6 +318,36 @@ def _render_top(metrics: dict, health=None) -> str:
                     f" wait {r.get('est_wait_ms', 0):.0f}ms"
                     f" tps {r.get('decode_rate', 0):.1f}")
     return "\n".join(lines)
+
+
+def _run_prof(args) -> int:
+    """``bigdl-tpu prof``: the CLI form of ``/profilez`` — ask the running
+    process for a ``jax.profiler.trace`` capture of ``--seconds`` and print
+    the artifact path. The request blocks for the capture duration; a 409
+    means another capture is already running."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    url = (f"http://{args.host}:{args.port}/profilez"
+           f"?seconds={args.seconds:g}")
+    try:
+        with urllib.request.urlopen(url,
+                                    timeout=args.seconds + 30.0) as r:
+            payload = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            detail = json.loads(e.read().decode()).get("error", "")
+        except Exception:
+            detail = ""
+        print(f"prof: capture failed (HTTP {e.code}): {detail}",
+              file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001 — connection errors end the run
+        print(f"prof: cannot reach {url}: {e}", file=sys.stderr)
+        return 1
+    print(payload.get("artifact", ""))
+    return 0
 
 
 def _run_top(args) -> int:
@@ -375,6 +449,17 @@ def main(argv=None) -> int:
     top.add_argument("--once", action="store_true",
                      help="render one frame and exit (for scripts)")
 
+    prof = sub.add_parser(
+        "prof", help="trigger an on-demand jax.profiler capture on a "
+                     "running process via its /profilez endpoint and print "
+                     "the artifact path")
+    prof.add_argument("--host", default="127.0.0.1")
+    prof.add_argument("--port", type=int,
+                      default=int(_os.environ.get("BIGDL_METRICS_PORT") or 0),
+                      help="exporter port (default: $BIGDL_METRICS_PORT)")
+    prof.add_argument("--seconds", type=float, default=2.0,
+                      help="capture duration")
+
     launch = sub.add_parser(
         "launch", help="spawn an N-process jax.distributed training run on "
                        "this host (the spark-submit analog; each process = "
@@ -398,6 +483,12 @@ def main(argv=None) -> int:
                   "BIGDL_METRICS_PORT", file=sys.stderr)
             return 2
         return _run_top(args)
+    if args.command == "prof":
+        if not args.port:
+            print("prof: no exporter port — pass --port or set "
+                  "BIGDL_METRICS_PORT", file=sys.stderr)
+            return 2
+        return _run_prof(args)
     if args.command == "train":
         mod, _ = _TRAIN_MAINS[args.model]
         return _run_module(mod, args.rest)

@@ -25,7 +25,15 @@ each with its own accumulator and consumer glue. This package unifies them:
   ``serve/model_flops_per_sec`` gauges against a peak-FLOPs table;
 - :mod:`bigdl_tpu.obs.slo` — SLO monitor over windowed registry percentiles
   (p99 TTFT, feed-stall rate, throughput floor) whose breach events flip
-  serving health to ``degraded``.
+  serving health to ``degraded``;
+- :mod:`bigdl_tpu.obs.device` — device-memory accounting: HBM gauges from
+  ``memory_stats()``, live-buffer census, per-program ``memory_analysis()``
+  attribution, ``hbm_pressure`` events (``BIGDL_HBM_PRESSURE_PCT``);
+- :mod:`bigdl_tpu.obs.cluster` — multi-host aggregation: per-process
+  snapshot spools (``BIGDL_OBS_SPOOL_DIR``) merged into one ``/metrics``
+  scrape with ``{host=}`` labels;
+- :mod:`bigdl_tpu.obs.access_log` — opt-in structured request log
+  (``BIGDL_ACCESS_LOG``) with the ``to_bdlrec`` flywheel converter.
 
 Dependency-free by design: nothing here imports ``optim``/``dataset``/
 ``nn``, so every layer of the framework may publish into it (``mfu``
@@ -36,8 +44,8 @@ from __future__ import annotations
 
 import os
 
-from bigdl_tpu.obs import exporter, mfu, registry, report, slo, trace, \
-    watchdog
+from bigdl_tpu.obs import access_log, cluster, device, exporter, mfu, \
+    registry, report, slo, trace, watchdog
 from bigdl_tpu.obs.registry import registry as metric_registry
 
 
@@ -64,4 +72,5 @@ def describe_config() -> str:
 
 
 __all__ = ["trace", "registry", "watchdog", "report", "exporter", "mfu",
-           "slo", "metric_registry", "describe_config"]
+           "slo", "device", "cluster", "access_log", "metric_registry",
+           "describe_config"]

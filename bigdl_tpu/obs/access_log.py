@@ -1,0 +1,183 @@
+"""Structured serving access log — one JSONL record per finished request.
+
+The serving engine completes thousands of requests and keeps only
+aggregates; nothing records the individual requests, so the roadmap's
+serving-logs→trainer flywheel has no input edge. This module is that edge:
+
+- :class:`AccessLog` — an opt-in, size-rotated JSONL writer. Every
+  completed OR failed request appends one record::
+
+      {trace_id, tenant, phase, prompt_tokens, output_tokens,
+       ttft_ms, e2e_ms, flops, outcome}
+
+  ``outcome`` is ``ok`` / ``timeout`` / ``poisoned`` / ``aborted``;
+  ``phase`` is where the request ended (``queue`` before admission,
+  ``decode`` after). Enabled by pointing ``BIGDL_ACCESS_LOG`` at a
+  directory; files rotate at ``BIGDL_ACCESS_LOG_ROTATE_MB`` megabytes
+  (default 64) to ``access-<pid>-<k>.jsonl`` so a long-lived server never
+  grows one unbounded file. Writes are append+flush under a lock from the
+  engine thread; a write failure disables the log loudly (one event)
+  rather than failing requests — the log observes serving, it must never
+  become serving's failure mode.
+
+- :func:`to_bdlrec` — the flywheel converter: re-shards every record in a
+  log directory into ``.bdlrec`` shards (payload = the JSON line, CRC per
+  record courtesy of the container format) that
+  :class:`~bigdl_tpu.dataset.streaming.StreamingDataSet` replays with
+  :func:`access_record_decoder`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from bigdl_tpu.obs import trace
+
+_LOG: Optional["AccessLog"] = None
+_LOG_LOCK = threading.Lock()
+_ENV_SEEN: Optional[str] = None
+
+#: record fields, in pinned order (the replay test asserts fidelity)
+FIELDS = ("trace_id", "tenant", "phase", "prompt_tokens", "output_tokens",
+          "ttft_ms", "e2e_ms", "flops", "outcome")
+
+
+class AccessLog:
+    """Size-rotated JSONL request log rooted at one directory."""
+
+    def __init__(self, directory: str, rotate_mb: float = 64.0):
+        self.directory = directory
+        self.rotate_bytes = max(int(rotate_mb * 1024 * 1024), 4096)
+        self.path = os.path.join(directory,
+                                 "access-%d.jsonl" % os.getpid())
+        self.records = 0
+        self.rotations = 0
+        self.disabled = False
+        self._lock = threading.Lock()
+        self._f = None
+
+    def log(self, **fields) -> None:
+        """Append one request record (missing FIELDS become None; extra
+        kwargs ride along). Never raises."""
+        if self.disabled:
+            return
+        rec = {k: fields.pop(k, None) for k in FIELDS}
+        rec.update(fields)
+        line = json.dumps(rec, separators=(",", ":"), default=str) + "\n"
+        try:
+            with self._lock:
+                if self._f is None:
+                    os.makedirs(self.directory, exist_ok=True)
+                    self._f = open(self.path, "a")
+                self._f.write(line)
+                self._f.flush()
+                self.records += 1
+                if self._f.tell() >= self.rotate_bytes:
+                    self._rotate_locked()
+        except Exception as exc:
+            self.disabled = True
+            trace.event("access_log_disabled", path=self.path,
+                        error=str(exc))
+            import logging
+            logging.getLogger("bigdl_tpu.obs").error(
+                "access log write to %s failed (%s); request logging "
+                "disabled for this process", self.path, exc)
+
+    def _rotate_locked(self) -> None:
+        self._f.close()
+        self._f = None
+        self.rotations += 1
+        rotated = self.path[:-len(".jsonl")] + "-%d.jsonl" % self.rotations
+        os.replace(self.path, rotated)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def from_env() -> Optional[AccessLog]:
+    """The process-wide log when ``BIGDL_ACCESS_LOG`` names a directory
+    (``BIGDL_ACCESS_LOG_ROTATE_MB`` sizes the rotation); None — allocating
+    nothing — when unset. Re-reads the env when its value changes so tests
+    can re-point the log."""
+    global _LOG, _ENV_SEEN
+    raw = os.environ.get("BIGDL_ACCESS_LOG", "").strip()
+    with _LOG_LOCK:
+        if raw != _ENV_SEEN:
+            if _LOG is not None:
+                _LOG.close()
+            _ENV_SEEN = raw
+            if raw:
+                try:
+                    mb = float(os.environ.get(
+                        "BIGDL_ACCESS_LOG_ROTATE_MB", "64") or "64")
+                except ValueError:
+                    mb = 64.0
+                _LOG = AccessLog(raw, rotate_mb=mb)
+            else:
+                _LOG = None
+        return _LOG
+
+
+def log_request(**fields) -> None:
+    """Engine-side entry point: record one finished request when the log
+    is enabled, free when it is not."""
+    log = from_env()
+    if log is not None:
+        log.log(**fields)
+
+
+def reset() -> None:
+    """Test isolation: close and forget the process-wide log."""
+    global _LOG, _ENV_SEEN
+    with _LOG_LOCK:
+        if _LOG is not None:
+            _LOG.close()
+        _LOG = None
+        _ENV_SEEN = None
+
+
+# ------------------------------------------------------------- the flywheel
+def access_record_decoder(payload: bytes) -> dict:
+    """``.bdlrec`` payload → the original access-log record (dict)."""
+    return json.loads(payload.decode("utf-8"))
+
+
+def to_bdlrec(log_dir: str, out_dir: str, shards: int = 1,
+              prefix: str = "access") -> "tuple[list, int]":
+    """Re-shard every access-log record under ``log_dir`` (all
+    ``*.jsonl`` files, rotated generations included) into ``shards``
+    ``.bdlrec`` files under ``out_dir``. Returns ``(shard_paths, count)``.
+    Blank / torn tail lines are skipped; a record's payload is its exact
+    JSON line, so the round trip is byte-faithful."""
+    from bigdl_tpu.dataset.recordio import RecordWriter
+
+    shards = max(int(shards), 1)
+    names = sorted(n for n in os.listdir(log_dir) if n.endswith(".jsonl"))
+    os.makedirs(out_dir, exist_ok=True)
+    paths = [os.path.join(out_dir, "%s-%05d.bdlrec" % (prefix, s))
+             for s in range(shards)]
+    writers = [RecordWriter(p) for p in paths]
+    n = 0
+    try:
+        for name in names:
+            with open(os.path.join(log_dir, name), "rb") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        json.loads(line.decode("utf-8"))
+                    except (ValueError, UnicodeDecodeError):
+                        continue   # torn tail of a crashed writer
+                    writers[n % shards].write(line)
+                    n += 1
+    finally:
+        for w in writers:
+            w.close()
+    return paths, n

@@ -1,0 +1,303 @@
+"""Multi-host metric aggregation — spool snapshots, merge on the scraper.
+
+PR 11's exporter is strictly per-process: under ``jax.distributed`` each
+host runs its own registry and nothing ever joins them, so a multi-host
+scaling claim needs N scrapes of N processes. This module makes one pane:
+
+- every process with ``BIGDL_OBS_SPOOL_DIR`` set runs a :class:`SpoolWriter`
+  daemon appending periodic registry snapshots to its own
+  ``host-<id>.jsonl`` in that (shared) directory. Each line is
+  ``<json>\\t<crc32 hex>`` — the utils/file.py integrity discipline in
+  newline form — and every append lands via write+flush on an O_APPEND
+  handle, so a torn tail line is detectable and skippable, never fatal.
+  The file is compacted in place (atomic rewrite of the last line) when it
+  outgrows ``_MAX_SPOOL_BYTES``: the merge only ever wants the newest
+  snapshot, the history is a crash-forensics convenience.
+- the exporter (any process, in practice process 0 — the one operators
+  scrape) merges the spools: :func:`read_spools` returns the newest valid
+  snapshot per host, stamped ``stale`` when its age exceeds
+  ``BIGDL_OBS_STALE_S`` (a dead host degrades to a stamped row, the merge
+  and the scrape never fail), and ``render_host_lines`` turns them into
+  Prometheus rows carrying a ``{host="<id>"}`` label.
+
+Spool writes run through the ``obs_spool_write`` fault site: a scripted
+(or real) write failure flips the writer to local-only mode with a loud
+``obs_spool_degraded`` event — metrics keep flowing, only the aggregation
+narrows.
+
+Host identity: ``BIGDL_OBS_HOST_ID`` if set, else ``jax.process_index()``
+when jax.distributed is live, else the OS pid. jax stays a lazy import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Optional
+
+from bigdl_tpu.obs import trace
+from bigdl_tpu.obs.registry import registry
+
+#: compact the per-host spool when it outgrows this (the merge reads only
+#: the newest line; older lines are forensics, not state)
+_MAX_SPOOL_BYTES = 256 * 1024
+
+_WRITER: Optional["SpoolWriter"] = None
+_WRITER_LOCK = threading.Lock()
+
+
+def host_id() -> str:
+    """Stable identity for this process's spool and its ``{host=}`` label."""
+    raw = os.environ.get("BIGDL_OBS_HOST_ID", "").strip()
+    if raw:
+        return raw
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return str(jax.process_index())
+    except Exception:
+        pass
+    return str(os.getpid())
+
+
+def spool_dir() -> Optional[str]:
+    raw = os.environ.get("BIGDL_OBS_SPOOL_DIR", "").strip()
+    return raw or None
+
+
+def stale_s() -> float:
+    try:
+        return float(os.environ.get("BIGDL_OBS_STALE_S", "15") or "15")
+    except ValueError:
+        return 15.0
+
+
+def _encode_line(rec: dict) -> bytes:
+    body = json.dumps(rec, separators=(",", ":"), default=str).encode()
+    return body + b"\t%08x\n" % zlib.crc32(body)
+
+
+def _decode_line(line: bytes) -> Optional[dict]:
+    """One spool line → record, or None for a torn/corrupt line."""
+    line = line.rstrip(b"\n")
+    body, sep, crc = line.rpartition(b"\t")
+    if not sep or len(crc) != 8:
+        return None
+    try:
+        if zlib.crc32(body) != int(crc, 16):
+            return None
+        return json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+class SpoolWriter:
+    """Daemon appending this process's registry snapshots to its spool."""
+
+    def __init__(self, directory: str, host: Optional[str] = None,
+                 interval_s: float = 2.0):
+        self.directory = directory
+        self.host = host if host is not None else host_id()
+        self.path = os.path.join(directory, "host-%s.jsonl" % self.host)
+        self.interval_s = max(float(interval_s), 0.05)
+        self.degraded = False
+        self.writes = 0
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def write_once(self) -> bool:
+        """Append one snapshot line now. Returns False (and degrades to
+        local-only mode, loudly, exactly once) on any write failure —
+        telemetry must never crash the process it observes."""
+        if self.degraded:
+            return False
+        from bigdl_tpu.utils.faults import SITE_OBS_SPOOL_WRITE, fault_point
+        self._seq += 1
+        rec = {"host": self.host, "ts": time.time(), "seq": self._seq,
+               "snapshot": registry.snapshot()}
+        try:
+            fault_point(SITE_OBS_SPOOL_WRITE)
+            os.makedirs(self.directory, exist_ok=True)
+            data = _encode_line(rec)
+            if (os.path.exists(self.path)
+                    and os.path.getsize(self.path) > _MAX_SPOOL_BYTES):
+                # compact: atomically rewrite the spool as just this line
+                tmp = self.path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                os.replace(tmp, self.path)
+            else:
+                with open(self.path, "ab") as f:
+                    f.write(data)
+                    f.flush()
+            self.writes += 1
+            return True
+        except Exception as exc:
+            self.degraded = True
+            registry.counter("obs/spool_write_failures").inc()
+            trace.event("obs_spool_degraded", host=self.host,
+                        path=self.path, error=str(exc))
+            from bigdl_tpu.utils.robustness import events
+            events.record("obs_spool_degraded", host=self.host,
+                          error=str(exc))
+            import logging
+            logging.getLogger("bigdl_tpu.obs").error(
+                "metric spool write to %s failed (%s); this host degrades "
+                "to local-only metrics", self.path, exc)
+            return False
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.write_once()
+            if self.degraded:
+                return
+
+    def start(self) -> "SpoolWriter":
+        if self._thread is None:
+            self.write_once()
+            self._thread = threading.Thread(
+                target=self._run, name="bigdl-obs-spool", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, final_write: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if final_write:
+            self.write_once()
+
+
+def read_spools(directory: Optional[str] = None,
+                stale_after_s: Optional[float] = None) -> dict:
+    """Newest valid snapshot per host:
+    ``{host: {"snapshot", "ts", "seq", "age_s", "stale"}}``.
+
+    A file whose every line is torn is skipped; a host whose newest
+    snapshot is older than ``stale_after_s`` is STAMPED stale but still
+    returned — the merge degrades, it never throws."""
+    directory = directory if directory is not None else spool_dir()
+    if not directory or not os.path.isdir(directory):
+        return {}
+    if stale_after_s is None:
+        stale_after_s = stale_s()
+    out = {}
+    now = time.time()
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return {}
+    for name in names:
+        if not (name.startswith("host-") and name.endswith(".jsonl")):
+            continue
+        path = os.path.join(directory, name)
+        rec = None
+        try:
+            with open(path, "rb") as f:
+                for line in f:
+                    decoded = _decode_line(line)
+                    if decoded is not None and "snapshot" in decoded:
+                        rec = decoded   # last valid line wins
+        except OSError:
+            continue
+        if rec is None:
+            continue
+        host = str(rec.get("host", name[len("host-"):-len(".jsonl")]))
+        age = max(0.0, now - float(rec.get("ts", 0.0)))
+        out[host] = {"snapshot": rec["snapshot"], "ts": rec.get("ts"),
+                     "seq": rec.get("seq"), "age_s": round(age, 3),
+                     "stale": age > stale_after_s}
+    return out
+
+
+def render_host_lines(hosts: Optional[dict] = None) -> list:
+    """Prometheus text rows for every spooled host, each series labelled
+    ``{host="<id>"}``, plus ``bigdl_obs_host_up`` (0 = stale-stamped) and
+    ``bigdl_obs_host_age_seconds`` liveness rows. Returns ``[]`` when no
+    spool dir is configured — the exporter's zero-cost default."""
+    from bigdl_tpu.obs.exporter import _fmt, _san
+    if hosts is None:
+        hosts = read_spools()
+    if not hosts:
+        return []
+    lines = []
+    for host in sorted(hosts):
+        info = hosts[host]
+        up = 0 if info["stale"] else 1
+        lines.append('bigdl_obs_host_up{host="%s"} %d' % (host, up))
+        lines.append('bigdl_obs_host_age_seconds{host="%s"} %s'
+                     % (host, _fmt(info["age_s"])))
+        snap = info["snapshot"] or {}
+        for name, v in sorted((snap.get("counters") or {}).items()):
+            lines.append('%s_total{host="%s"} %s'
+                         % (_san(name), host, _fmt(v)))
+        for name, v in sorted((snap.get("gauges") or {}).items()):
+            if v is None:
+                continue
+            lines.append('%s{host="%s"} %s' % (_san(name), host, _fmt(v)))
+        for name, h in sorted((snap.get("histograms") or {}).items()):
+            m = _san(name)
+            for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                if h.get(key) is not None:
+                    lines.append('%s{host="%s",quantile="%s"} %s'
+                                 % (m, host, q, _fmt(h[key])))
+            lines.append('%s_sum{host="%s"} %s' % (m, host, _fmt(h["total"])))
+            lines.append('%s_count{host="%s"} %s'
+                         % (m, host, _fmt(h["count"])))
+    return lines
+
+
+def host_table(hosts: Optional[dict] = None) -> dict:
+    """Per-host summary for /statusz: liveness + headline gauges."""
+    if hosts is None:
+        hosts = read_spools()
+    table = {}
+    for host, info in sorted(hosts.items()):
+        gauges = (info["snapshot"] or {}).get("gauges") or {}
+        table[host] = {
+            "stale": info["stale"], "age_s": info["age_s"],
+            "seq": info["seq"],
+            "throughput": gauges.get("train/throughput"),
+            "mfu": gauges.get("train/mfu"),
+            "hbm_bytes_in_use": gauges.get("device/hbm_bytes_in_use"),
+            "hbm_headroom": gauges.get("device/hbm_headroom"),
+        }
+    return table
+
+
+def writer() -> Optional[SpoolWriter]:
+    return _WRITER
+
+
+def start_from_env() -> Optional[SpoolWriter]:
+    """Start (once per process) the spool writer when
+    ``BIGDL_OBS_SPOOL_DIR`` is set; None — allocating nothing — when not.
+    Interval from ``BIGDL_OBS_SPOOL_S`` (default 2s)."""
+    d = spool_dir()
+    if not d:
+        return None
+    global _WRITER
+    with _WRITER_LOCK:
+        if _WRITER is not None:
+            return _WRITER
+        try:
+            interval = float(os.environ.get("BIGDL_OBS_SPOOL_S", "2") or "2")
+        except ValueError:
+            interval = 2.0
+        _WRITER = SpoolWriter(d, interval_s=interval).start()
+        return _WRITER
+
+
+def reset() -> None:
+    """Test isolation: stop and forget the active writer."""
+    global _WRITER
+    with _WRITER_LOCK:
+        if _WRITER is not None:
+            _WRITER.stop(final_write=False)
+        _WRITER = None

@@ -19,7 +19,18 @@ in-process telemetry rails into a scrapeable plane:
   peer degrades the fleet instead of 503ing the process: the router is
   routing around it, which is the design working, not an outage.
 - ``/statusz`` — JSON status: the latest run report (published by the
-  trainer at end of run), MFU accounting, full engine ledgers, SLO state.
+  trainer at end of run), MFU accounting, full engine ledgers, SLO state,
+  the device-memory picture, and — under ``BIGDL_OBS_SPOOL_DIR`` — a
+  per-host table merged from the cluster spools (``obs/cluster.py``).
+- ``/profilez?seconds=N`` — on-demand ``jax.profiler.trace`` capture into
+  ``BIGDL_TRACE_DIR``; responds with the artifact path when the capture
+  completes, 409 while another capture runs (``bigdl-tpu prof`` is the
+  CLI form).
+
+Under ``BIGDL_OBS_SPOOL_DIR`` the ``/metrics`` body additionally carries
+every spooled host's snapshot with a ``{host="<id>"}`` label — one scrape
+of process 0 sees the whole job (stale hosts are stamped
+``bigdl_obs_host_up 0``, never dropped).
 
 The exporter is strictly opt-in: :func:`start_from_env` returns ``None``
 without allocating ANYTHING when ``BIGDL_METRICS_PORT`` is unset — the
@@ -37,12 +48,15 @@ from __future__ import annotations
 import json
 import os
 import re
+import tempfile
 import threading
+import time
+import urllib.parse
 import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from bigdl_tpu.obs import mfu
+from bigdl_tpu.obs import cluster, mfu
 from bigdl_tpu.obs import watchdog as obs_watchdog
 from bigdl_tpu.obs.registry import registry
 
@@ -217,7 +231,85 @@ def render_metrics() -> str:
         for fname, rname, code in rep_health:
             lines.append('bigdl_fleet_replica_health{fleet="%s",'
                          'replica="%s"} %d' % (fname, rname, code))
+    # cluster merge: every spooled host's snapshot rides the same scrape
+    # with a {host=} label ([] when BIGDL_OBS_SPOOL_DIR is unset — and a
+    # corrupt/stale spool degrades to a stamped row, never a failed scrape)
+    try:
+        lines.extend(cluster.render_host_lines())
+    except Exception:
+        pass
     return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------- profiler capture
+class ProfilerBusy(RuntimeError):
+    """A /profilez capture is already running (HTTP 409)."""
+
+
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_BUSY = False
+_PROFILE_SEQ = 0
+#: upper bound on one capture (a typo'd ?seconds= must not wedge the server
+#: thread pool for an hour)
+_PROFILE_MAX_S = 120.0
+
+
+def profilez_capture(seconds: float) -> str:
+    """Run one ``jax.profiler.trace`` capture of ``seconds`` and return the
+    artifact directory (under ``BIGDL_TRACE_DIR``, else a tmpdir). Raises
+    :class:`ProfilerBusy` while another capture runs — captures serialize,
+    they never stack."""
+    global _PROFILE_BUSY, _PROFILE_SEQ
+    seconds = min(max(float(seconds), 0.01), _PROFILE_MAX_S)
+    with _PROFILE_LOCK:
+        if _PROFILE_BUSY:
+            raise ProfilerBusy("a profiler capture is already running")
+        _PROFILE_BUSY = True
+        _PROFILE_SEQ += 1
+        seq = _PROFILE_SEQ
+    try:
+        from bigdl_tpu.utils.faults import SITE_PROFILEZ_CAPTURE, fault_point
+        fault_point(SITE_PROFILEZ_CAPTURE)
+        base = os.environ.get("BIGDL_TRACE_DIR", "").strip() or os.path.join(
+            tempfile.gettempdir(), "bigdl-profilez")
+        out = os.path.join(base, "profilez-%d-%d" % (os.getpid(), seq))
+        os.makedirs(out, exist_ok=True)
+        import jax
+        jax.profiler.start_trace(out)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        registry.counter("obs/profilez_captures").inc()
+        return out
+    finally:
+        with _PROFILE_LOCK:
+            _PROFILE_BUSY = False
+
+
+def _render_profilez(path: str) -> "tuple[int, bytes, str]":
+    """(status, body, content-type) for GET /profilez?seconds=N."""
+    query = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+    try:
+        seconds = float(query.get("seconds", ["1"])[0])
+    except ValueError:
+        return (400, b'{"error": "seconds must be a number"}\n',
+                "application/json")
+    try:
+        artifact = profilez_capture(seconds)
+    except ProfilerBusy as exc:
+        return (409, json.dumps({"error": str(exc)}).encode() + b"\n",
+                "application/json")
+    except Exception as exc:
+        # fault-injected or real capture failure: loud, but the endpoint
+        # (and the process it observes) keeps serving
+        registry.counter("obs/profilez_failures").inc()
+        return (503, json.dumps(
+            {"error": "profiler capture failed: %s" % exc}).encode() + b"\n",
+            "application/json")
+    body = json.dumps({"artifact": artifact,
+                       "seconds": min(max(seconds, 0.01), _PROFILE_MAX_S)})
+    return 200, body.encode() + b"\n", "application/json"
 
 
 def parse_metrics(text: str) -> dict:
@@ -303,10 +395,24 @@ def render_statusz() -> dict:
         except Exception:
             continue
         fls[str(st.get("name", "?"))] = st
+    hosts = {}
+    try:
+        hosts = cluster.host_table()
+    except Exception:
+        pass
+    device_block = None
+    try:
+        from bigdl_tpu.obs import device as obs_device
+        if obs_device.monitor() is not None or obs_device.last_sample():
+            device_block = obs_device.stats()
+    except Exception:
+        pass
     return {"run_report": status.get("run_report"),
             "slo": status.get("slo"),
             "status": status,
             "mfu": mfu.stats(),
+            "device_memory": device_block,
+            "hosts": hosts,
             "engines": engs,
             "fleets": fls}
 
@@ -327,6 +433,8 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(render_statusz(),
                                   default=str).encode("utf-8")
                 ctype = "application/json"
+            elif self.path.startswith("/profilez"):
+                code, body, ctype = _render_profilez(self.path)
             else:
                 code, body = 404, b"not found\n"
                 ctype = "text/plain"

@@ -95,6 +95,21 @@ def device_peak() -> Optional[float]:
     return _peak_cache
 
 
+def avals_of(args) -> tuple:
+    """Argument tree → ShapeDtypeStruct avals: the donation-safe lowering
+    inputs shared by :func:`program_flops` and
+    :func:`bigdl_tpu.obs.device.program_memory` (shapes/dtypes only — live
+    or donated buffers are never touched)."""
+    import jax
+
+    def _aval(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(_aval, args)
+
+
 def program_flops(fn, *args) -> Optional[float]:
     """Model FLOPs of one compiled program, from XLA cost analysis.
 
@@ -104,15 +119,7 @@ def program_flops(fn, *args) -> Optional[float]:
     backend provides no cost analysis (callers memoize either way: this
     re-traces, ~ms per program)."""
     try:
-        import jax
-
-        def _aval(x):
-            if hasattr(x, "shape") and hasattr(x, "dtype"):
-                return jax.ShapeDtypeStruct(x.shape, x.dtype)
-            return x
-
-        avals = jax.tree_util.tree_map(_aval, args)
-        ca = fn.lower(*avals).cost_analysis()
+        ca = fn.lower(*avals_of(args)).cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
         f = ca.get("flops") if hasattr(ca, "get") else None

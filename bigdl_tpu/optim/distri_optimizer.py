@@ -175,14 +175,41 @@ class DistriOptimizer(Optimizer):
             donate_argnums=(0, 1, 2),
         )
 
+    @staticmethod
+    def _put_sharded(x, sh):
+        """Place a host batch under ``sh`` without issuing collectives.
+
+        On a multi-process mesh ``jax.device_put(np_array, sharding)`` runs a
+        cross-process ``assert_equal`` — a broadcast of the whole batch — to
+        check every process passed the same value. That collective is issued
+        from the prefetch producer thread and can interleave with the step
+        collective in a different order on each process, which deadlocks the
+        gloo transport (each side services its first-enqueued collective).
+        The SPMD contract already guarantees identical batches per process,
+        so assemble the global array from the locally addressable shards
+        instead: pure h2d, no cross-process traffic, and the per-batch
+        broadcast disappears from the feed path entirely.
+        """
+        if sh.is_fully_addressable:
+            return jax.device_put(x, sh)
+
+        def put_leaf(leaf):
+            leaf = np.asarray(leaf)
+            shards = [jax.device_put(leaf[idx], d) for d, idx in
+                      sh.addressable_devices_indices_map(leaf.shape).items()]
+            return jax.make_array_from_single_device_arrays(
+                leaf.shape, sh, shards)
+
+        return jax.tree_util.tree_map(put_leaf, x)
+
     def _place_batch(self, batch):
         n_dev = int(dict(self._mesh.shape)[Engine.DATA_AXIS])
         bsz = batch.size()
         if bsz % n_dev != 0:
             raise ValueError(
                 f"batch size {bsz} not divisible by data-parallel size {n_dev}")
-        inp = jax.device_put(self._feed_cast(batch.input), self._batch_sh)
-        target = jax.device_put(batch.target, self._batch_sh)
+        inp = self._put_sharded(self._feed_cast(batch.input), self._batch_sh)
+        target = self._put_sharded(batch.target, self._batch_sh)
         return inp, target
 
     def _place_window(self, batches):
@@ -195,8 +222,8 @@ class DistriOptimizer(Optimizer):
         inp = jax.tree_util.tree_map(
             self._feed_cast, self._stack_window([b.input for b in batches]))
         target = self._stack_window([b.target for b in batches])
-        return (jax.device_put(inp, self._window_sh),
-                jax.device_put(target, self._window_sh))
+        return (self._put_sharded(inp, self._window_sh),
+                self._put_sharded(target, self._window_sh))
 
     def _optimize_impl(self):
         # compile path sets mesh/shardings before the first _put_batch

@@ -37,6 +37,7 @@ from bigdl_tpu.dataset.dataset import (
 from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.nn.abstractnn import AbstractModule
 from bigdl_tpu.nn.criterion import AbstractCriterion
+from bigdl_tpu.obs import device as obs_device
 from bigdl_tpu.obs import exporter as obs_exporter
 from bigdl_tpu.obs import mfu as obs_mfu
 from bigdl_tpu.obs import registry as obs_registry
@@ -1476,8 +1477,16 @@ class Optimizer:
         # lifetime)
         obs_exporter.start_from_env()
         obs_slo.start_from_env()
+        # cluster-scope plane: device-memory gauges (HBM polls + pressure
+        # events) and, under jax.distributed with BIGDL_OBS_SPOOL_DIR set,
+        # the per-host snapshot spool process 0's exporter merges
+        obs_device.start_from_env()
+        from bigdl_tpu.obs import cluster as obs_cluster
+        obs_cluster.start_from_env()
         if not hasattr(self, "_flops_memo"):
             self._flops_memo = {}
+        if not hasattr(self, "_mem_memo"):
+            self._mem_memo = {}
         rob_snap0 = getattr(self, "_rob_snap0", None)
         if rob_snap0 is None:  # _optimize_impl called outside optimize()
             rob_snap0 = events.snapshot()
@@ -1712,6 +1721,9 @@ class Optimizer:
                             self._flops_memo[wf_key] = obs_mfu.program_flops(
                                 window_fn, params, mstate, ostate, step_idx0,
                                 inp, target, base_rng)
+                        self._note_program_memory(
+                            wf_key, window_fn, params, mstate, ostate,
+                            step_idx0, inp, target, base_rng)
                         now = time.perf_counter()
                         self._obs_step(now - iter_mark, k, step_hist,
                                        flops=self._flops_memo[wf_key])
@@ -1809,6 +1821,9 @@ class Optimizer:
                             self._flops_memo[sf_key] = obs_mfu.program_flops(
                                 step_fn, params, mstate, ostate, step_idx,
                                 inp, target, base_rng)
+                        self._note_program_memory(
+                            sf_key, step_fn, params, mstate, ostate,
+                            step_idx, inp, target, base_rng)
                         now = time.perf_counter()
                         self._obs_step(now - iter_mark, 1, step_hist,
                                        flops=self._flops_memo[sf_key])
@@ -1898,6 +1913,29 @@ class Optimizer:
         return out
 
     # ------------------------------------------------------- observability
+    def _note_program_memory(self, key, fn, *args) -> None:
+        """Per-program device-memory attribution (the memory twin of the
+        FLOPs memo): one ``memory_analysis()`` per program-cache key,
+        published as ``train/program_*_bytes`` gauges and a /statusz
+        block. Costs one extra AOT compile per program, so it is gated
+        behind an active exporter (a scraped process) or
+        ``BIGDL_PROGRAM_MEMORY=1`` — absent-not-wrong everywhere else."""
+        if key in self._mem_memo:
+            return
+        if not (os.environ.get("BIGDL_PROGRAM_MEMORY", "").strip()
+                or obs_exporter.active() is not None):
+            return
+        mem = obs_device.program_memory(fn, *args)
+        self._mem_memo[key] = mem
+        if mem:
+            reg = obs_registry.registry
+            for field, v in mem.items():
+                reg.gauge("train/program_%s" % field).set(v)
+            obs_exporter.publish_status(
+                "program_memory",
+                {"/".join(str(p) for p in k): v
+                 for k, v in self._mem_memo.items() if v})
+
     def _obs_step(self, wall_s: float, k: int, step_hist,
                   flops: Optional[float] = None) -> None:
         """Per-step observability bookkeeping at a step/window boundary:

@@ -84,6 +84,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from bigdl_tpu.obs import access_log as obs_access_log
 from bigdl_tpu.obs import exporter as obs_exporter
 from bigdl_tpu.obs import mfu as obs_mfu
 from bigdl_tpu.obs import slo as obs_slo
@@ -1558,6 +1559,7 @@ class ServingEngine:
             f"({'mid-decode' if in_slot else 'while queued'}, "
             f"{len(req.generated)} tokens generated) "
             f"[trace {req.trace_id}]"))
+        self._access_log(req, "timeout")
         if not in_slot:
             self._backlog_dec()
 
@@ -1895,6 +1897,7 @@ class ServingEngine:
         req.handle._fail(NonFiniteLogitsError(
             f"non-finite logits decoding request {req.request_id} "
             f"(slot {slot.index}) [trace {req.trace_id}]"))
+        self._access_log(req, "poisoned")
         self._reset_row(slot.index)   # paged: zeroes the pages themselves
         self._free_slot_pages(slot.index)
         self._sched.release(slot)
@@ -1919,8 +1922,33 @@ class ServingEngine:
         self._tok_per_req = (float(n) if self._tok_per_req == 0.0
                              else 0.8 * self._tok_per_req + 0.2 * n)
         self._maybe_persist_trace(req, result)
+        self._access_log(req, "ok", e2e_s=result.latency_s)
         self._free_slot_pages(slot.index)
         self._sched.release(slot)
+
+    def _access_log(self, req: Request, outcome: str,
+                    e2e_s: Optional[float] = None) -> None:
+        """One structured access-log record per finished request
+        (``obs/access_log.py``; free when ``BIGDL_ACCESS_LOG`` is unset).
+        ``flops`` is the per-request estimate from the memoized program
+        FLOPs: one prefill plus one decode step per generated token —
+        None (absent, not wrong) when the backend reported neither."""
+        n_out = len(req.generated)
+        flops = None
+        if self._last_prefill_flops is not None or \
+                self._decode_flops is not None:
+            flops = ((self._last_prefill_flops or 0.0)
+                     + (self._decode_flops or 0.0) * n_out)
+        now = time.perf_counter()
+        obs_access_log.log_request(
+            trace_id=req.trace_id, tenant=self.name,
+            phase="decode" if req.admit_t is not None else "queue",
+            prompt_tokens=req.prompt_len, output_tokens=n_out,
+            ttft_ms=(round((req.first_token_t - req.submit_t) * 1e3, 3)
+                     if req.first_token_t is not None else None),
+            e2e_ms=round((e2e_s if e2e_s is not None
+                          else now - req.submit_t) * 1e3, 3),
+            flops=flops, outcome=outcome)
 
     def _maybe_persist_trace(self, req: Request, result) -> None:
         """Tail sampling: persist the request's span tree to the JSONL log
@@ -1968,10 +1996,12 @@ class ServingEngine:
             f"engine {self.name!r} shut down before the request finished")
         for slot in self._sched.active_slots():
             slot.request.handle._fail(err)
+            self._access_log(slot.request, "aborted")
             self._free_slot_pages(slot.index)
             self._sched.release(slot)
         for req in pending:
             req.handle._fail(err)
+            self._access_log(req, "aborted")
             self._backlog_dec()
         pending.clear()
         # the queue was closed with drain=True: items a racing submit

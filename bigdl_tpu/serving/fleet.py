@@ -54,6 +54,7 @@ import time
 import uuid
 from typing import Optional
 
+from bigdl_tpu.obs import access_log as obs_access_log
 from bigdl_tpu.obs import exporter as obs_exporter
 from bigdl_tpu.obs.registry import registry
 from bigdl_tpu.serving.engine import (
@@ -385,6 +386,16 @@ class FleetRouter:
                       in_flight=engine.stats()["active_slots"])
         engine.shutdown(wait=False)
 
+    def _log_rejection(self, fh: FleetHandle) -> None:
+        """A router-rejected request never reaches an engine, so the access
+        log would otherwise lose it — record it here with the fleet as the
+        tenant (free when ``BIGDL_ACCESS_LOG`` is unset)."""
+        obs_access_log.log_request(
+            trace_id=fh.trace_id, tenant=self.name, phase="route",
+            prompt_tokens=int(fh._prompt.shape[0]),
+            output_tokens=0, ttft_ms=None, e2e_ms=None, flops=None,
+            outcome="rejected")
+
     def _dispatch(self, fh: FleetHandle, exclude: Optional[str] = None,
                   prefer: Optional[str] = None) -> None:
         """Submit ``fh`` to the best healthy replica, walking down the
@@ -395,6 +406,7 @@ class FleetRouter:
         deadline_ms = fh.remaining_deadline_ms()
         if deadline_ms is not None and deadline_ms <= 0.0:
             self._rejected += 1
+            self._log_rejection(fh)
             raise RequestTimeout(
                 f"fleet {self.name!r}: request {fh.request_id} deadline "
                 f"expired before a replica could take it "
@@ -424,6 +436,7 @@ class FleetRouter:
             return
         self._rejected += 1
         registry.counter("fleet/rejected").inc()
+        self._log_rejection(fh)
         events.record("fleet_exhausted", fleet=self.name,
                       request_id=fh.request_id, trace_id=fh.trace_id,
                       tried=[nm for nm, _ in candidates],

@@ -99,6 +99,15 @@ site                      where it fires
                           exhaustion (returns no pages), driving the
                           backpressure / shed / preemption paths without
                           actually filling the pool
+``obs_spool_write``       the cluster-obs spool writer's snapshot append
+                          (``obs/cluster.py`` ``SpoolWriter.write_once``) —
+                          the write fails, the host degrades to local-only
+                          metrics with a loud ``obs_spool_degraded`` event,
+                          the process never crashes
+``profilez_capture``      the exporter's on-demand profiler capture
+                          (``obs/exporter.py`` ``profilez_capture``) — the
+                          capture fails; ``/profilez`` answers 503 and the
+                          server keeps serving
 ========================  ====================================================
 
 A plan is a ``;``-separated list of entries ``site@N`` or ``site@N=action``.
@@ -157,6 +166,11 @@ SITE_PROMOTE_ROLLBACK = "promote_rollback"
 #: paged-serving drill: the Nth page allocation reports pool exhaustion —
 #: the backpressure/shed/preemption paths without filling the pool for real
 SITE_PAGE_ALLOC = "serve_page_alloc"
+#: cluster-obs drills (docs/observability.md): a failed metric-spool write
+#: must degrade that host to local-only metrics, and a failed /profilez
+#: capture must 503 the request — neither may crash the observed process
+SITE_OBS_SPOOL_WRITE = "obs_spool_write"
+SITE_PROFILEZ_CAPTURE = "profilez_capture"
 
 #: sites whose plan entries match the caller-supplied ``index`` (training
 #: iteration) instead of the site's hit counter
@@ -187,6 +201,8 @@ _DEFAULT_ACTION = {
     SITE_PROMOTE_SWAP: "error",
     SITE_PROMOTE_ROLLBACK: "error",
     SITE_PAGE_ALLOC: "error",
+    SITE_OBS_SPOOL_WRITE: "error",
+    SITE_PROFILEZ_CAPTURE: "error",
 }
 
 _KNOWN_ACTIONS = frozenset({"error", "death", "nan", "sigterm", "torn",

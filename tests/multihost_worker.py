@@ -21,6 +21,14 @@ Modes (``BIGDL_MH_MODE``):
   coordinator, verify the restored leaves are bitwise what the 2-process fleet
   saved, then ``optimize(resume="auto")`` to the end. The out-file records the
   resume point, the bitwise verdict, and the elastic robustness events.
+- ``obs`` — the cluster-telemetry drill: both processes train with metric
+  spooling to a shared ``BIGDL_OBS_SPOOL_DIR``; process 0 then starts the
+  exporter, scrapes ITSELF, and verifies the merged ``/metrics`` carries both
+  hosts' ``train/throughput`` under distinct ``{host=}`` labels. It then
+  SIGKILLs process 1 (``BIGDL_MH_PEER_PID``) and re-scrapes until the dead
+  host is stale-stamped (``bigdl_obs_host_up 0``) — the scrape itself must
+  never fail. Verdicts go to process 0's out-file; process 1 writes its
+  out-file BEFORE idling into the kill.
 """
 
 import json
@@ -74,6 +82,120 @@ def _watch_peer(peer_pid: int, argv: list) -> None:
             os.execve(sys.executable,
                       [sys.executable, os.path.abspath(__file__)] + argv, env)
         time.sleep(0.1)
+
+
+def _obs_mode(pid, out_file, nn, DataSet, SampleToMiniBatch, Sample, SGD,
+              Trigger, DistriOptimizer) -> None:
+    """Cluster-telemetry drill body (both processes already Engine.init'd)."""
+    import signal
+    import urllib.request
+
+    import jax
+
+    from bigdl_tpu.obs import cluster as obs_cluster
+    from bigdl_tpu.obs import exporter as obs_exporter
+    from bigdl_tpu.obs.exporter import parse_metrics
+
+    iters = int(os.environ.get("BIGDL_MH_ITERS", "6"))
+    opt = _build_optimizer(nn, DataSet, SampleToMiniBatch, Sample, SGD,
+                           Trigger, DistriOptimizer)
+    opt.set_end_when(Trigger.max_iteration(iters))
+    opt.optimize()   # BIGDL_OBS_SPOOL_DIR is set → this starts the spool
+
+    w = obs_cluster.writer()
+    assert w is not None, "BIGDL_OBS_SPOOL_DIR set but no spool writer ran"
+    assert not w.degraded, "spool writer degraded during the drill"
+    w.write_once()   # final snapshot carries the end-of-run throughput gauge
+
+    # detach BOTH processes from jax.distributed before the kill: the spool
+    # plane is plain files + threads, so the telemetry drill needs no
+    # collectives from here on — and SIGKILLing a still-connected peer makes
+    # the survivor's coordination client abort the whole process, which is
+    # the elastic drill's problem (tests/test_multihost.py), not this one's
+    jax.distributed.shutdown()
+
+    if pid == 1:
+        # report now — then idle with the spool daemon refreshing until the
+        # peer SIGKILLs this process (the "host dies" event under test)
+        with open(out_file, "w") as f:
+            json.dump({"mode": "obs", "process_id": pid,
+                       "host": w.host,
+                       "loss": float(opt.state["loss"]),
+                       "spool_writes": w.writes,
+                       "process_count": 2}, f)
+        sys.stdout.flush()
+        while True:
+            time.sleep(0.2)
+
+    # ---------------- process 0: merge + scrape + degrade-on-host-loss
+    deadline = time.time() + 60
+    while time.time() < deadline:   # wait for host 1's final spool line
+        hosts = obs_cluster.read_spools(stale_after_s=1e9)
+        g = (hosts.get("1", {}).get("snapshot") or {}).get("gauges") or {}
+        if g.get("train/throughput") is not None:
+            break
+        time.sleep(0.2)
+
+    srv = obs_exporter.MetricsExporter(0).start()
+
+    def scrape(path="/metrics"):
+        with urllib.request.urlopen(srv.url + path, timeout=10) as r:
+            return r.status, r.read().decode()
+
+    st1, body1 = scrape()
+    parsed1 = parse_metrics(body1)
+    thr_key = 'bigdl_train_throughput{host="%s"}'
+    thr_hosts = sorted(h for h in ("0", "1") if thr_key % h in parsed1)
+    hbm_hosts = sorted(h for h in ("0", "1") if any(
+        k.startswith("bigdl_device_hbm_") and k.endswith('{host="%s"}' % h)
+        for k in parsed1))
+    # fidelity: the parsed scrape value equals the spooled gauge, per host
+    hosts_now = obs_cluster.read_spools(stale_after_s=1e9)
+    rt_ok = all(
+        abs(parsed1[thr_key % h]
+            - float(hosts_now[h]["snapshot"]["gauges"]["train/throughput"]))
+        <= 1e-6 * abs(parsed1[thr_key % h])
+        for h in thr_hosts) if thr_hosts else False
+
+    peer = int(os.environ["BIGDL_MH_PEER_PID"])
+    time.sleep(0.5)   # worker 1's out-file write is strictly faster than the
+    os.kill(peer, signal.SIGKILL)  # scrape above, but don't even race it
+
+    up_key = 'bigdl_obs_host_up{host="%s"}'
+    stale_seen, st2, parsed2 = False, None, {}
+    deadline = time.time() + 60
+    while time.time() < deadline:   # host 1 must age into a stamped row
+        st2, body2 = scrape()
+        parsed2 = parse_metrics(body2)
+        if st2 == 200 and parsed2.get(up_key % "1") == 0:
+            stale_seen = True
+            break
+        time.sleep(0.3)
+
+    sst, sbody = scrape("/statusz")
+    statusz_hosts = (json.loads(sbody).get("hosts") or {}) if sst == 200 else {}
+
+    with open(out_file, "w") as f:
+        json.dump({"mode": "obs", "process_id": pid,
+                   "host": w.host,
+                   "loss": float(opt.state["loss"]),
+                   "scrape_status": st1,
+                   "throughput_hosts": thr_hosts,
+                   "hbm_hosts": hbm_hosts,
+                   "host_up_initial": {h: parsed1.get(up_key % h)
+                                       for h in ("0", "1")},
+                   "round_trip_ok": bool(rt_ok),
+                   "stale_stamped": stale_seen,
+                   "scrape_status_after_kill": st2,
+                   "host0_up_after_kill": parsed2.get(up_key % "0"),
+                   "statusz_hosts": sorted(statusz_hosts),
+                   "statusz_host1_stale": bool(
+                       (statusz_hosts.get("1") or {}).get("stale")),
+                   "process_count": 2}, f)
+    print("obs worker 0: hosts=%s stale_stamped=%s" % (thr_hosts, stale_seen))
+    sys.stdout.flush()
+    # the SIGKILLed peer leaves jax.distributed unrecoverable — skip teardown
+    os._exit(0)
 
 
 def main() -> None:
@@ -177,6 +299,11 @@ def main() -> None:
                        "process_count": jax.process_count()}, f)
         print(f"drill worker {pid}: completed without dying "
               f"(unfired={plan.unfired() if plan else []})")
+        return
+
+    if mode == "obs":
+        _obs_mode(pid, out_file, nn, DataSet, SampleToMiniBatch, Sample, SGD,
+                  Trigger, DistriOptimizer)
         return
 
     opt = _build_optimizer(nn, DataSet, SampleToMiniBatch, Sample, SGD,
